@@ -1,0 +1,89 @@
+"""Cycle configuration: plugin args + weights, mirroring the reference's
+component-config (reference ``pkg/scheduler/apis/config/types.go:30-205``,
+defaults ``v1beta2/defaults.go:33-48``)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Tuple, Union
+
+import jax.numpy as jnp
+
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.model.snapshot import (
+    DEFAULT_ESTIMATED_SCALING_FACTORS,
+    DEFAULT_RESOURCE_WEIGHTS,
+    DEFAULT_USAGE_THRESHOLDS,
+)
+
+LEAST_ALLOCATED = "LeastAllocated"
+MOST_ALLOCATED = "MostAllocated"
+
+# Configs are passed to jax.jit as static arguments, so every field must be
+# hashable: mappings are stored as sorted (name, value) tuples.
+ResMap = Union[Mapping[str, int], Tuple[Tuple[str, int], ...]]
+
+
+def _freeze(m: ResMap) -> Tuple[Tuple[str, int], ...]:
+    if isinstance(m, tuple):
+        return m
+    return tuple(sorted((k, int(v)) for k, v in m.items()))
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadAwareArgs:
+    """reference config.LoadAwareSchedulingArgs (types.go:30)."""
+
+    resource_weights: ResMap = _freeze(DEFAULT_RESOURCE_WEIGHTS)
+    usage_thresholds: ResMap = _freeze(DEFAULT_USAGE_THRESHOLDS)
+    estimated_scaling_factors: ResMap = _freeze(DEFAULT_ESTIMATED_SCALING_FACTORS)
+    filter_expired_node_metrics: bool = True
+    node_metric_expiration_seconds: int = 180
+
+    def __post_init__(self):
+        object.__setattr__(self, "resource_weights", _freeze(self.resource_weights))
+        object.__setattr__(self, "usage_thresholds", _freeze(self.usage_thresholds))
+        object.__setattr__(
+            self, "estimated_scaling_factors", _freeze(self.estimated_scaling_factors)
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class CycleConfig:
+    """One scheduling cycle's plugin set and weights.
+
+    Plugin score weights mirror the k8s framework's per-plugin weight
+    multiplier applied when summing plugin scores.
+    """
+
+    loadaware: LoadAwareArgs = LoadAwareArgs()
+    fit_scoring_strategy: str = LEAST_ALLOCATED
+    fit_resource_weights: ResMap = _freeze({res.CPU: 1, res.MEMORY: 1})
+    fit_plugin_weight: int = 1
+    loadaware_plugin_weight: int = 1
+    enable_loadaware: bool = True
+    enable_fit_score: bool = True
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "fit_resource_weights", _freeze(self.fit_resource_weights)
+        )
+
+    # Dense device-side encodings (constant-folded under jit)
+    def loadaware_weights_arr(self) -> jnp.ndarray:
+        return jnp.asarray(
+            res.weights_vector(dict(self.loadaware.resource_weights)), jnp.int64
+        )
+
+    def loadaware_thresholds_arr(self) -> jnp.ndarray:
+        return jnp.asarray(
+            res.weights_vector(dict(self.loadaware.usage_thresholds)), jnp.int64
+        )
+
+    def fit_weights_arr(self) -> jnp.ndarray:
+        return jnp.asarray(
+            res.weights_vector(dict(self.fit_resource_weights)), jnp.int64
+        )
+
+
+DEFAULT_CYCLE_CONFIG = CycleConfig()

@@ -1,0 +1,6 @@
+from koordinator_tpu.constraints.quota import (  # noqa: F401
+    QuotaGroup,
+    refresh_runtime,
+    build_quota_table_inputs,
+)
+from koordinator_tpu.constraints.gang import gang_satisfaction  # noqa: F401

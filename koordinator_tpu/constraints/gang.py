@@ -1,0 +1,33 @@
+"""Coscheduling gang (PodGroup) helpers.
+
+The reference gates gangs at PreFilter (member count below minMember never
+enters the cycle — ``coscheduling/core/core.go:241-246``) and at Permit
+(assumed members counted against minMember; short gangs Wait —
+``core.go:308-338``).  In the batched cycle the PreFilter gate is a
+host-side check at encode time; the Permit gate is the post-scan
+all-or-nothing reduction below (also used standalone for tests).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def gang_satisfaction(
+    assignment: jnp.ndarray,  # i32[P] node or -1
+    pod_valid: jnp.ndarray,  # bool[P]
+    gang_id: jnp.ndarray,  # i32[P], -1 = no gang
+    min_member: jnp.ndarray,  # i32[G]
+):
+    """Returns (gang_satisfied bool[G], pod_gang_ok bool[P]).
+
+    A pod with no gang is always ok; a gang is satisfied when its number of
+    assigned members reaches minMember (Permit-stage check, core.go:308).
+    """
+    G = min_member.shape[0]
+    assigned = (assignment >= 0) & pod_valid
+    slot = jnp.where(gang_id >= 0, gang_id, G)
+    counts = jnp.zeros((G + 1,), jnp.int32).at[slot].add(assigned.astype(jnp.int32))
+    satisfied = counts[:G] >= min_member
+    pod_ok = jnp.where(gang_id >= 0, satisfied[jnp.maximum(gang_id, 0)], True)
+    return satisfied, pod_ok

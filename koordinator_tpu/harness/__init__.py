@@ -1,0 +1,1 @@
+from koordinator_tpu.harness import generators, reference  # noqa: F401

@@ -1,0 +1,167 @@
+"""Synthetic snapshot generators for the five BASELINE.json configs.
+
+Each generator returns ``(nodes, pods, gangs, quotas)`` plain-dict lists
+accepted by ``model.snapshot.encode_snapshot``.  Values are deterministic
+per seed.  Shapes follow /root/repo/BASELINE.json:
+
+1. ``spark_colocation``   — 3 nodes, spark-driver/executor + nginx pods
+   (reference ``examples/spark-jobs``).
+2. ``loadaware_joint``    — 1k pods x 200 nodes, LoadAware + Fit.
+3. ``gang_batch``         — 5k pods x 500 nodes, PodGroups minMember=8.
+4. ``quota_colocation``   — 10k pods x 2k nodes, LS/BE mix + quota tree.
+5. rebalance reuses config 4's snapshot.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+Mi = 1024 * 1024
+Gi = 1024 * Mi
+
+
+def _node(name: str, cpu_milli: int, mem: int, used_cpu: int, used_mem: int, pods_cap: int = 110) -> Dict:
+    return {
+        "name": name,
+        "allocatable": {"cpu": f"{cpu_milli}m", "memory": mem, "pods": pods_cap},
+        "requested": {},
+        "usage": {"cpu": f"{used_cpu}m", "memory": used_mem},
+        "metric_fresh": True,
+    }
+
+
+def spark_colocation(seed: int = 0) -> Tuple[List, List, List, List]:
+    rng = np.random.RandomState(seed)
+    nodes = [
+        _node(f"kind-worker-{i}", 8000, 16 * Gi, int(rng.randint(500, 2000)), int(rng.randint(1, 4) * Gi))
+        for i in range(3)
+    ]
+    pods: List[Dict] = []
+    # one nginx LS deployment + spark driver/executors as koord-batch
+    for i in range(6):
+        pods.append(
+            {
+                "name": f"nginx-{i}",
+                "requests": {"cpu": "500m", "memory": 512 * Mi, "pods": 1},
+                "limits": {"cpu": "1", "memory": Gi},
+                "qos": "LS",
+                "priority_class": "koord-prod",
+                "priority": 9500,
+            }
+        )
+    pods.append(
+        {
+            "name": "spark-driver",
+            "requests": {"cpu": "1", "memory": Gi, "pods": 1},
+            "limits": {"cpu": "1", "memory": Gi},
+            "qos": "BE",
+            "priority_class": "koord-batch",
+            "priority": 5500,
+        }
+    )
+    for i in range(8):
+        pods.append(
+            {
+                "name": f"spark-exec-{i}",
+                "requests": {"cpu": "1", "memory": 2 * Gi, "pods": 1},
+                "limits": {"cpu": "2", "memory": 2 * Gi},
+                "qos": "BE",
+                "priority_class": "koord-batch",
+                "priority": 5400,
+            }
+        )
+    return nodes, pods, [], []
+
+
+def _random_nodes(rng, count: int, cpu_choices=(16000, 32000, 64000), mem_per_core=4 * Gi) -> List[Dict]:
+    nodes = []
+    for i in range(count):
+        cpu = int(rng.choice(cpu_choices))
+        mem = (cpu // 1000) * mem_per_core
+        used_frac = rng.uniform(0.05, 0.55)
+        nodes.append(
+            _node(
+                f"node-{i}",
+                cpu,
+                mem,
+                int(cpu * used_frac),
+                int(mem * rng.uniform(0.05, 0.6)),
+                pods_cap=256,
+            )
+        )
+    return nodes
+
+
+def _random_pods(rng, count: int, name_prefix: str = "pod") -> List[Dict]:
+    pods = []
+    for i in range(count):
+        cpu_m = int(rng.choice([250, 500, 1000, 2000, 4000]))
+        mem = int(rng.choice([256, 512, 1024, 2048, 4096])) * Mi
+        be = rng.uniform() < 0.4
+        pods.append(
+            {
+                "name": f"{name_prefix}-{i}",
+                "requests": {"cpu": f"{cpu_m}m", "memory": mem, "pods": 1},
+                "limits": {"cpu": f"{cpu_m * 2}m", "memory": mem * 2},
+                "qos": "BE" if be else "LS",
+                "priority_class": "koord-batch" if be else "koord-prod",
+                "priority": int(5000 + rng.randint(0, 999)) if be else int(9000 + rng.randint(0, 999)),
+            }
+        )
+    return pods
+
+
+def loadaware_joint(seed: int = 0, pods: int = 1000, nodes: int = 200):
+    rng = np.random.RandomState(seed)
+    return _random_nodes(rng, nodes), _random_pods(rng, pods), [], []
+
+
+def gang_batch(seed: int = 0, pods: int = 5000, nodes: int = 500, min_member: int = 8):
+    rng = np.random.RandomState(seed)
+    node_list = _random_nodes(rng, nodes)
+    pod_list = _random_pods(rng, pods, name_prefix="member")
+    gangs = []
+    n_gangs = pods // min_member
+    for g in range(n_gangs):
+        gangs.append({"name": f"gang-{g}", "min_member": min_member})
+    for i, p in enumerate(pod_list):
+        if i < n_gangs * min_member:
+            p["gang"] = f"gang-{i // min_member}"
+    return node_list, pod_list, gangs, []
+
+
+def quota_colocation(seed: int = 0, pods: int = 10000, nodes: int = 2000, tenants: int = 16):
+    """LS/BE multi-tenant mix with an elastic quota group per tenant.
+
+    Quota ``min``/``max`` are chosen so the tree's fair division matters:
+    total min ~60% of cluster CPU, max twice min.
+    """
+    rng = np.random.RandomState(seed)
+    node_list = _random_nodes(rng, nodes)
+    pod_list = _random_pods(rng, pods, name_prefix="tenant-pod")
+    total_cpu = sum(int(n["allocatable"]["cpu"][:-1]) for n in node_list)
+    total_mem = sum(int(n["allocatable"]["memory"]) for n in node_list)
+    quotas = []
+    for t in range(tenants):
+        quotas.append(
+            {
+                "name": f"tenant-{t}",
+                "min": {"cpu": f"{total_cpu * 6 // 10 // tenants}m", "memory": total_mem * 6 // 10 // tenants},
+                "max": {"cpu": f"{total_cpu * 12 // 10 // tenants}m", "memory": total_mem * 12 // 10 // tenants},
+                "shared_weight": int(rng.randint(1, 4)),
+                "used": {},
+            }
+        )
+    for i, p in enumerate(pod_list):
+        p["quota"] = f"tenant-{i % tenants}"
+    return node_list, pod_list, [], quotas
+
+
+CONFIGS = {
+    "spark_colocation": spark_colocation,
+    "loadaware_joint": loadaware_joint,
+    "gang_batch": gang_batch,
+    "quota_colocation": quota_colocation,
+}

@@ -1,0 +1,428 @@
+"""Fixed-shape cluster snapshot: the contract everything compiles against.
+
+A scheduling cycle's world state — nodes (allocatable / requested / measured
+usage), pending pods (requests / estimated usage / QoS / priority / gang /
+quota membership) — is encoded as dense, padded int64 arrays so that one
+``jax.jit``-compiled program scores and assigns every pending pod against
+every candidate node at once.  This mirrors the semantics of the reference's
+data model (reference ``apis/extension/qos.go:22``, ``priority.go:29``,
+``resource.go:26``) without its per-object Go representation.
+
+Pod/node counts vary per cycle; arrays are padded to shape *buckets*
+(powers of two by default) so XLA compiles one program per bucket instead of
+one per cycle (reference analog: the Go scheduler has no compile step; for
+XLA this padding is what keeps the hot path recompile-free).
+
+Estimator semantics (``estimated`` field) follow the reference's
+defaultEstimator exactly (reference
+``pkg/scheduler/plugins/loadaware/estimator/default_estimator.go:81-127``):
+``max(request, limit)`` scaled by per-resource factors, with 250m CPU /
+200MiB defaults for unset requests, translated to batch-/mid- resources by
+priority class (reference ``apis/extension/resource.go:53``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import math
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.model import resources as res
+
+MAX_NODE_SCORE = 100  # k8s framework.MaxNodeScore
+
+DEFAULT_MILLI_CPU_REQUEST = 250  # default_estimator.go:36
+DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024  # default_estimator.go:38
+
+# v1beta2/defaults.go:35-48
+DEFAULT_RESOURCE_WEIGHTS = {res.CPU: 1, res.MEMORY: 1}
+DEFAULT_USAGE_THRESHOLDS = {res.CPU: 65, res.MEMORY: 95}
+DEFAULT_ESTIMATED_SCALING_FACTORS = {res.CPU: 85, res.MEMORY: 70}
+DEFAULT_NODE_METRIC_EXPIRATION_SECONDS = 180
+
+
+class PriorityClass(enum.IntEnum):
+    """Koordinator priority bands (reference apis/extension/priority.go:29)."""
+
+    PROD = 0
+    MID = 1
+    BATCH = 2
+    FREE = 3
+    NONE = 4
+
+    @classmethod
+    def from_name(cls, name: Optional[str]) -> "PriorityClass":
+        return {
+            "koord-prod": cls.PROD,
+            "koord-mid": cls.MID,
+            "koord-batch": cls.BATCH,
+            "koord-free": cls.FREE,
+        }.get(name or "", cls.NONE)
+
+    @classmethod
+    def from_priority_value(cls, priority: Optional[int]) -> "PriorityClass":
+        # reference apis/extension/priority.go:84-101
+        if priority is None:
+            return cls.NONE
+        if 9000 <= priority <= 9999:
+            return cls.PROD
+        if 7000 <= priority <= 7999:
+            return cls.MID
+        if 5000 <= priority <= 5999:
+            return cls.BATCH
+        if 3000 <= priority <= 3999:
+            return cls.FREE
+        return cls.NONE
+
+
+class QoSClass(enum.IntEnum):
+    """Koordinator QoS classes (reference apis/extension/qos.go:22-28)."""
+
+    LSE = 0
+    LSR = 1
+    LS = 2
+    BE = 3
+    SYSTEM = 4
+    NONE = 5
+
+    @classmethod
+    def from_name(cls, name: Optional[str]) -> "QoSClass":
+        return {
+            "LSE": cls.LSE,
+            "LSR": cls.LSR,
+            "LS": cls.LS,
+            "BE": cls.BE,
+            "SYSTEM": cls.SYSTEM,
+        }.get(name or "", cls.NONE)
+
+
+# PriorityClass -> {native resource index -> translated resource index},
+# reference apis/extension/resource.go:40-49.
+_RESOURCE_TRANSLATION = {
+    PriorityClass.BATCH: {
+        res.RESOURCE_INDEX[res.CPU]: res.RESOURCE_INDEX[res.BATCH_CPU],
+        res.RESOURCE_INDEX[res.MEMORY]: res.RESOURCE_INDEX[res.BATCH_MEMORY],
+    },
+    PriorityClass.MID: {
+        res.RESOURCE_INDEX[res.CPU]: res.RESOURCE_INDEX[res.MID_CPU],
+        res.RESOURCE_INDEX[res.MEMORY]: res.RESOURCE_INDEX[res.MID_MEMORY],
+    },
+}
+
+
+def translate_resource_index(priority_class: PriorityClass, idx: int) -> int:
+    """reference apis/extension/resource.go:53 TranslateResourceNameByPriorityClass."""
+    if priority_class in (PriorityClass.PROD, PriorityClass.NONE):
+        return idx
+    return _RESOURCE_TRANSLATION.get(priority_class, {}).get(idx, idx)
+
+
+def pad_bucket(n: int, minimum: int = 8) -> int:
+    """Smallest power-of-two bucket >= n (>= minimum)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass
+class NodeBatch:
+    """Dense node-side state, shapes [N] / [N, R]."""
+
+    allocatable: jnp.ndarray  # i64[N, R] node allocatable (estimator-adjusted)
+    requested: jnp.ndarray  # i64[N, R] sum of scheduled pod requests (Fit accounting)
+    usage: jnp.ndarray  # i64[N, R] measured usage from NodeMetric
+    metric_fresh: jnp.ndarray  # bool[N] NodeMetric exists and is not expired
+    valid: jnp.ndarray  # bool[N] padding mask
+    names: Tuple[str, ...] = ()
+
+    @property
+    def capacity(self) -> int:
+        return self.allocatable.shape[0]
+
+
+@dataclasses.dataclass
+class PodBatch:
+    """Dense pending-pod state, shapes [P] / [P, R]."""
+
+    requests: jnp.ndarray  # i64[P, R] raw requests (Fit accounting)
+    estimated: jnp.ndarray  # i64[P, R] LoadAware estimator output
+    priority_class: jnp.ndarray  # i32[P] PriorityClass enum
+    qos: jnp.ndarray  # i32[P] QoSClass enum
+    priority: jnp.ndarray  # i32[P] raw pod priority value (queue order)
+    gang_id: jnp.ndarray  # i32[P] index into GangTable, -1 = no gang
+    quota_id: jnp.ndarray  # i32[P] index into QuotaTable, -1 = no quota
+    valid: jnp.ndarray  # bool[P] padding mask
+    names: Tuple[str, ...] = ()
+
+    @property
+    def capacity(self) -> int:
+        return self.requests.shape[0]
+
+
+@dataclasses.dataclass
+class GangTable:
+    """Coscheduling PodGroups (reference plugins/coscheduling/core/core.go:220).
+
+    ``min_member`` is the gang's minMember; a gang admits only if at least
+    that many members can be placed in the same cycle (all-or-nothing mask).
+    """
+
+    min_member: jnp.ndarray  # i32[G]
+    valid: jnp.ndarray  # bool[G]
+    names: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass
+class QuotaTable:
+    """Flattened ElasticQuota groups after host-side runtime fair division.
+
+    ``runtime`` is each group's runtimeQuota per resource, computed by
+    ``koordinator_tpu.constraints.quota`` with the same redistribution rule
+    as the reference (``elasticquota/core/runtime_quota_calculator.go:126``);
+    ``used`` is current usage.  The device-side mask admits a pod onto any
+    node only while its quota group stays within runtime.
+    """
+
+    runtime: jnp.ndarray  # i64[Q, R]
+    used: jnp.ndarray  # i64[Q, R]
+    limited: jnp.ndarray  # bool[Q, R] quota declares this dimension
+    valid: jnp.ndarray  # bool[Q]
+    names: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass
+class ClusterSnapshot:
+    nodes: NodeBatch
+    pods: PodBatch
+    gangs: GangTable
+    quotas: QuotaTable
+
+    @property
+    def num_nodes(self) -> int:
+        return int(np.asarray(self.nodes.valid).sum())
+
+    @property
+    def num_pods(self) -> int:
+        return int(np.asarray(self.pods.valid).sum())
+
+
+# Snapshot containers cross the jit boundary: register as pytrees with the
+# host-side name tuples as static aux data.
+for _cls, _data in (
+    (NodeBatch, ["allocatable", "requested", "usage", "metric_fresh", "valid"]),
+    (
+        PodBatch,
+        [
+            "requests",
+            "estimated",
+            "priority_class",
+            "qos",
+            "priority",
+            "gang_id",
+            "quota_id",
+            "valid",
+        ],
+    ),
+    (GangTable, ["min_member", "valid"]),
+    (QuotaTable, ["runtime", "used", "limited", "valid"]),
+):
+    jax.tree_util.register_dataclass(_cls, data_fields=_data, meta_fields=["names"])
+jax.tree_util.register_dataclass(
+    ClusterSnapshot, data_fields=["nodes", "pods", "gangs", "quotas"], meta_fields=[]
+)
+
+
+# ---------------------------------------------------------------------------
+# Host-side estimator (exact integer parity with default_estimator.go)
+# ---------------------------------------------------------------------------
+
+
+def _estimated_used_by_resource(
+    request: int, limit: int, default_value: int, scaling_factor: int
+) -> int:
+    """default_estimator.go:81-127 estimatedUsedByResource, one resource."""
+    if limit > request:
+        scaling_factor = 100
+        quantity = limit
+    else:
+        quantity = request
+    if quantity == 0:
+        return default_value
+    # Go: int64(math.Round(float64(q) * float64(factor) / 100)); math.Round
+    # rounds half away from zero (quantities are non-negative here), unlike
+    # Python's banker's round().
+    estimated = int(math.floor(quantity * scaling_factor / 100 + 0.5))
+    if limit > 0 and estimated > limit:
+        estimated = limit
+    return estimated
+
+
+def estimate_pod(
+    requests_vec: Sequence[int],
+    limits_vec: Sequence[int],
+    priority_class: PriorityClass,
+    resource_weights: Mapping[str, int] = DEFAULT_RESOURCE_WEIGHTS,
+    scaling_factors: Mapping[str, int] = DEFAULT_ESTIMATED_SCALING_FACTORS,
+) -> List[int]:
+    """defaultEstimator.EstimatePod (default_estimator.go:58-73), dense form.
+
+    Returns estimated used in the *weighted* (native) resource slots; the
+    lookup reads the priority-translated slot of requests/limits.
+    """
+    out = [0] * res.NUM_RESOURCES
+    for name, _w in resource_weights.items():
+        idx = res.RESOURCE_INDEX[name]
+        real_idx = translate_resource_index(priority_class, idx)
+        if res.RESOURCE_AXIS[real_idx] in (res.CPU, res.BATCH_CPU):
+            default_value = DEFAULT_MILLI_CPU_REQUEST
+        elif res.RESOURCE_AXIS[real_idx] in (res.MEMORY, res.BATCH_MEMORY):
+            default_value = DEFAULT_MEMORY_REQUEST
+        else:
+            default_value = 0
+        out[idx] = _estimated_used_by_resource(
+            requests_vec[real_idx],
+            limits_vec[real_idx],
+            default_value,
+            int(scaling_factors.get(name, 100)),
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Encoders
+# ---------------------------------------------------------------------------
+
+
+def encode_snapshot(
+    nodes: Sequence[Mapping],
+    pods: Sequence[Mapping],
+    gangs: Sequence[Mapping] = (),
+    quotas: Sequence[Mapping] = (),
+    *,
+    resource_weights: Mapping[str, int] = DEFAULT_RESOURCE_WEIGHTS,
+    scaling_factors: Mapping[str, int] = DEFAULT_ESTIMATED_SCALING_FACTORS,
+    node_bucket: Optional[int] = None,
+    pod_bucket: Optional[int] = None,
+) -> ClusterSnapshot:
+    """Encode plain-dict cluster state into a padded ClusterSnapshot.
+
+    Node dict: ``{"name", "allocatable": {res: qty}, "requested": {...},
+    "usage": {...}, "metric_fresh": bool}``.
+    Pod dict: ``{"name", "requests": {...}, "limits": {...},
+    "priority_class": "koord-prod"|..., "priority": int, "qos": "LS"|...,
+    "gang": gang-name|None, "quota": quota-name|None}``.
+    Gang dict: ``{"name", "min_member": int}``.
+    Quota dict: ``{"name", "runtime": {...}, "used": {...}}`` (runtime from
+    ``constraints.quota.refresh_runtime``).
+    """
+    n_bucket = node_bucket or pad_bucket(len(nodes))
+    p_bucket = pod_bucket or pad_bucket(len(pods))
+    g_bucket = pad_bucket(max(len(gangs), 1))
+    q_bucket = pad_bucket(max(len(quotas), 1))
+    R = res.NUM_RESOURCES
+
+    gang_index = {g["name"]: i for i, g in enumerate(gangs)}
+    quota_index = {q["name"]: i for i, q in enumerate(quotas)}
+
+    node_alloc = np.zeros((n_bucket, R), np.int64)
+    node_req = np.zeros((n_bucket, R), np.int64)
+    node_usage = np.zeros((n_bucket, R), np.int64)
+    node_fresh = np.zeros((n_bucket,), bool)
+    node_valid = np.zeros((n_bucket,), bool)
+    for i, nd in enumerate(nodes):
+        node_alloc[i] = res.resource_vector(nd.get("allocatable", {}))
+        node_req[i] = res.resource_vector(nd.get("requested", {}))
+        node_usage[i] = res.resource_vector(nd.get("usage", {}))
+        node_fresh[i] = bool(nd.get("metric_fresh", True))
+        node_valid[i] = True
+
+    pod_req = np.zeros((p_bucket, R), np.int64)
+    pod_est = np.zeros((p_bucket, R), np.int64)
+    pod_prio_class = np.full((p_bucket,), int(PriorityClass.NONE), np.int32)
+    pod_qos = np.full((p_bucket,), int(QoSClass.NONE), np.int32)
+    pod_prio = np.zeros((p_bucket,), np.int32)
+    pod_gang = np.full((p_bucket,), -1, np.int32)
+    pod_quota = np.full((p_bucket,), -1, np.int32)
+    pod_valid = np.zeros((p_bucket,), bool)
+    for i, pd in enumerate(pods):
+        req_vec = res.resource_vector(pd.get("requests", {}))
+        lim_vec = res.resource_vector(pd.get("limits", {}))
+        pc = pd.get("priority_class")
+        if pc is not None:
+            prio_class = PriorityClass.from_name(pc)
+        else:
+            prio_class = PriorityClass.from_priority_value(pd.get("priority"))
+        pod_req[i] = req_vec
+        pod_est[i] = estimate_pod(
+            req_vec, lim_vec, prio_class, resource_weights, scaling_factors
+        )
+        pod_prio_class[i] = int(prio_class)
+        pod_qos[i] = int(QoSClass.from_name(pd.get("qos")))
+        pod_prio[i] = int(pd.get("priority") or 0)
+        if pd.get("gang") is not None:
+            pod_gang[i] = gang_index[pd["gang"]]
+        if pd.get("quota") is not None:
+            pod_quota[i] = quota_index[pd["quota"]]
+        pod_valid[i] = True
+
+    gang_min = np.zeros((g_bucket,), np.int32)
+    gang_valid = np.zeros((g_bucket,), bool)
+    for i, g in enumerate(gangs):
+        gang_min[i] = int(g.get("min_member", 0))
+        gang_valid[i] = True
+
+    quota_runtime = np.zeros((q_bucket, R), np.int64)
+    quota_used = np.zeros((q_bucket, R), np.int64)
+    quota_limited = np.zeros((q_bucket, R), bool)
+    quota_valid = np.zeros((q_bucket,), bool)
+    for i, q in enumerate(quotas):
+        quota_runtime[i] = res.resource_vector(q.get("runtime", {}))
+        quota_used[i] = res.resource_vector(q.get("used", {}))
+        # A quota constrains only the dimensions it declares (the reference
+        # checks used+request against runtime only for the quota's declared
+        # resource dimensions, elasticquota plugin PreFilter).
+        for name in q.get("runtime", {}):
+            idx = res.RESOURCE_INDEX.get(name)
+            if idx is not None:
+                quota_limited[i, idx] = True
+        quota_valid[i] = True
+
+    return ClusterSnapshot(
+        nodes=NodeBatch(
+            allocatable=jnp.asarray(node_alloc),
+            requested=jnp.asarray(node_req),
+            usage=jnp.asarray(node_usage),
+            metric_fresh=jnp.asarray(node_fresh),
+            valid=jnp.asarray(node_valid),
+            names=tuple(nd.get("name", f"node-{i}") for i, nd in enumerate(nodes)),
+        ),
+        pods=PodBatch(
+            requests=jnp.asarray(pod_req),
+            estimated=jnp.asarray(pod_est),
+            priority_class=jnp.asarray(pod_prio_class),
+            qos=jnp.asarray(pod_qos),
+            priority=jnp.asarray(pod_prio),
+            gang_id=jnp.asarray(pod_gang),
+            quota_id=jnp.asarray(pod_quota),
+            valid=jnp.asarray(pod_valid),
+            names=tuple(pd.get("name", f"pod-{i}") for i, pd in enumerate(pods)),
+        ),
+        gangs=GangTable(
+            min_member=jnp.asarray(gang_min),
+            valid=jnp.asarray(gang_valid),
+            names=tuple(g["name"] for g in gangs),
+        ),
+        quotas=QuotaTable(
+            runtime=jnp.asarray(quota_runtime),
+            used=jnp.asarray(quota_used),
+            limited=jnp.asarray(quota_limited),
+            valid=jnp.asarray(quota_valid),
+            names=tuple(q["name"] for q in quotas),
+        ),
+    )

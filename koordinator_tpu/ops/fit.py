@@ -1,0 +1,47 @@
+"""Filter-phase feasibility masks (NodeResourcesFit semantics).
+
+The reference runs Filter per (pod, node) in parallel goroutines
+(``frameworkext/framework_extender.go:192``); here feasibility is one
+boolean ``pods x nodes`` tensor produced by a broadcast compare.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from koordinator_tpu.model import resources as res
+
+# Upstream kube-scheduler non-zero request defaults
+# (k8s.io/kubernetes/pkg/scheduler/util: DefaultMilliCPURequest=100,
+# DefaultMemoryRequest=200MB), applied by NodeResourcesFit scoring.
+NONZERO_MILLI_CPU = 100
+NONZERO_MEMORY = 200 * 1024 * 1024
+
+_CPU_IDX = res.RESOURCE_INDEX[res.CPU]
+_MEM_IDX = res.RESOURCE_INDEX[res.MEMORY]
+
+
+def nonzero_requests(pod_requests: jnp.ndarray) -> jnp.ndarray:
+    """Apply upstream GetNonzeroRequests defaults to cpu/memory slots."""
+    defaults = jnp.zeros((res.NUM_RESOURCES,), jnp.int64)
+    defaults = defaults.at[_CPU_IDX].set(NONZERO_MILLI_CPU)
+    defaults = defaults.at[_MEM_IDX].set(NONZERO_MEMORY)
+    return jnp.where(pod_requests == 0, defaults[None, :], pod_requests)
+
+
+def fit_mask(
+    pod_requests: jnp.ndarray,  # i64[P, R]
+    node_requested: jnp.ndarray,  # i64[N, R]
+    node_allocatable: jnp.ndarray,  # i64[N, R]
+    node_valid: jnp.ndarray,  # bool[N]
+    pod_valid: jnp.ndarray,  # bool[P]
+) -> jnp.ndarray:
+    """Feasibility mask bool[P, N]: pod fits node's remaining allocatable.
+
+    A resource constrains only when the pod requests it (upstream Fit checks
+    only the pod's requested resources; zero-request resources never fail).
+    """
+    need = pod_requests[:, None, :] > 0
+    fits_r = node_requested[None, :, :] + pod_requests[:, None, :] <= node_allocatable[None, :, :]
+    ok = jnp.all(jnp.where(need, fits_r, True), axis=-1)
+    return ok & node_valid[None, :] & pod_valid[:, None]

@@ -1,0 +1,69 @@
+"""LoadAwareScheduling scorer and filter as batched tensors.
+
+Reference: ``pkg/scheduler/plugins/loadaware/load_aware.go``:
+
+* Score (:269-335): estimatedUsed = estimator(pod) + estimated(assigned pods
+  not yet in metrics) + measured node usage, then
+  ``loadAwareSchedulingScorer`` (:378) = weighted leastRequestedScore.
+  Nodes without a fresh NodeMetric score 0 (:282-289).
+* Filter (:173-224): usage percentage >= threshold -> unschedulable;
+  ``usage = round(used/total*100)`` in float64, reproduced here with exact
+  integer arithmetic.
+
+The assign-cache term (:298) is carried as ``node_estimated`` state by the
+solver; in one-shot scoring it is an input tensor.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from koordinator_tpu.ops.scoring import least_requested_score, weighted_resource_score
+
+
+def loadaware_scores(
+    pod_estimated: jnp.ndarray,  # i64[P, R] estimator output per pod
+    node_usage: jnp.ndarray,  # i64[N, R] measured usage (NodeMetric)
+    node_estimated: jnp.ndarray,  # i64[N, R] assign-cache estimated usage
+    node_allocatable: jnp.ndarray,  # i64[N, R]
+    weights: jnp.ndarray,  # i64[R]
+    metric_fresh: jnp.ndarray,  # bool[N]
+) -> jnp.ndarray:
+    """LoadAware Score for all (pod, node) pairs -> i64[P, N]."""
+    estimated_used = (
+        node_usage[None, :, :] + node_estimated[None, :, :] + pod_estimated[:, None, :]
+    )
+    scores = least_requested_score(estimated_used, node_allocatable[None, :, :])
+    score = weighted_resource_score(scores, weights)
+    return jnp.where(metric_fresh[None, :], score, 0)
+
+
+def usage_percent(used: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
+    """round(used/total*100) half-away-from-zero, exact integers.
+
+    Go (:214): int64(math.Round(float64(used)/float64(total)*100)).
+    For non-negative ints floor((200*used + total) / (2*total)) is identical.
+    """
+    used = used.astype(jnp.int64)
+    total = total.astype(jnp.int64)
+    safe_total = jnp.where(total == 0, 1, total)
+    pct = (200 * used + safe_total) // (2 * safe_total)
+    return jnp.where(total == 0, 0, pct)
+
+
+def loadaware_filter_mask(
+    node_usage: jnp.ndarray,  # i64[N, R]
+    node_allocatable: jnp.ndarray,  # i64[N, R]
+    thresholds: jnp.ndarray,  # i64[R] usage thresholds percent (0 = unchecked)
+    metric_fresh: jnp.ndarray,  # bool[N]
+) -> jnp.ndarray:
+    """Filter mask bool[N]; True = node passes the utilization thresholds.
+
+    Per reference :185-222: a resource with threshold 0 or zero allocatable
+    is skipped; usage% >= threshold rejects the node.  Nodes without a fresh
+    metric pass (Filter skips them, :138-147).
+    """
+    pct = usage_percent(node_usage, node_allocatable)
+    checked = (thresholds[None, :] > 0) & (node_allocatable > 0)
+    exceeded = jnp.any(checked & (pct >= thresholds[None, :]), axis=-1)
+    return ~exceeded | ~metric_fresh

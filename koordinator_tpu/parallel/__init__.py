@@ -1,0 +1,5 @@
+from koordinator_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    shard_snapshot_for_scoring,
+    shard_snapshot_for_assign,
+)

@@ -1,0 +1,120 @@
+"""Multi-chip scale-out of the scoring/assignment tensors.
+
+The reference scales the Score phase by fanning goroutines over nodes on one
+machine (``frameworkext/framework_extender.go:216``).  The TPU-native scale
+axis is a ``jax.sharding.Mesh``:
+
+* ``pods`` mesh axis — data-parallel analog: each chip scores a slice of the
+  pending-pod batch.
+* ``nodes`` mesh axis — model-parallel analog: node state (allocatable /
+  requested / usage) is sharded so clusters larger than one chip's HBM
+  spread across ICI neighbors; argmax-over-nodes becomes an XLA collective.
+
+One ``pods x nodes`` score tensor sharded over a 2-D mesh keeps all
+collectives on ICI (scaling-book recipe: annotate shardings, let XLA insert
+the collectives).  The sequential greedy scan shards node state over the
+whole mesh and keeps per-pod rows replicated — each scan step's
+argmax(masked score) then runs as a sharded reduce.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from koordinator_tpu.model.snapshot import ClusterSnapshot
+
+
+def _factor2(n: int):
+    """Split n into (a, b) with a*b = n, as square as possible."""
+    a = int(np.floor(np.sqrt(n)))
+    while n % a:
+        a -= 1
+    return a, n // a
+
+
+def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    dp, tp = _factor2(len(devices))
+    return Mesh(np.asarray(devices).reshape(dp, tp), ("pods", "nodes"))
+
+
+def _put(tree, shardings):
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), tree, shardings
+    )
+
+
+def shard_snapshot_for_scoring(snap: ClusterSnapshot, mesh: Mesh) -> ClusterSnapshot:
+    """Shard pods over the 'pods' axis and nodes over the 'nodes' axis.
+
+    The resulting ``score_cycle`` output [P, N] is sharded over both mesh
+    axes with zero communication (pure SPMD map).
+    """
+    pod2 = NamedSharding(mesh, P("pods", None))
+    pod1 = NamedSharding(mesh, P("pods"))
+    node2 = NamedSharding(mesh, P("nodes", None))
+    node1 = NamedSharding(mesh, P("nodes"))
+    rep = NamedSharding(mesh, P())
+
+    pods = snap.pods
+    nodes = snap.nodes
+    return ClusterSnapshot(
+        nodes=dataclass_replace(
+            nodes,
+            allocatable=jax.device_put(nodes.allocatable, node2),
+            requested=jax.device_put(nodes.requested, node2),
+            usage=jax.device_put(nodes.usage, node2),
+            metric_fresh=jax.device_put(nodes.metric_fresh, node1),
+            valid=jax.device_put(nodes.valid, node1),
+        ),
+        pods=dataclass_replace(
+            pods,
+            requests=jax.device_put(pods.requests, pod2),
+            estimated=jax.device_put(pods.estimated, pod2),
+            priority_class=jax.device_put(pods.priority_class, pod1),
+            qos=jax.device_put(pods.qos, pod1),
+            priority=jax.device_put(pods.priority, pod1),
+            gang_id=jax.device_put(pods.gang_id, pod1),
+            quota_id=jax.device_put(pods.quota_id, pod1),
+            valid=jax.device_put(pods.valid, pod1),
+        ),
+        gangs=jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), snap.gangs),
+        quotas=jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), snap.quotas),
+    )
+
+
+def shard_snapshot_for_assign(snap: ClusterSnapshot, mesh: Mesh) -> ClusterSnapshot:
+    """Shard node state across ALL mesh devices; replicate pod rows.
+
+    The greedy scan's carried node state lives sharded; each step's
+    argmax-over-nodes is a sharded reduce over ICI.
+    """
+    all_axes = ("pods", "nodes")
+    node2 = NamedSharding(mesh, P(all_axes, None))
+    node1 = NamedSharding(mesh, P(all_axes))
+    rep = NamedSharding(mesh, P())
+
+    nodes = snap.nodes
+    return ClusterSnapshot(
+        nodes=dataclass_replace(
+            nodes,
+            allocatable=jax.device_put(nodes.allocatable, node2),
+            requested=jax.device_put(nodes.requested, node2),
+            usage=jax.device_put(nodes.usage, node2),
+            metric_fresh=jax.device_put(nodes.metric_fresh, node1),
+            valid=jax.device_put(nodes.valid, node1),
+        ),
+        pods=jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), snap.pods),
+        gangs=jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), snap.gangs),
+        quotas=jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), snap.quotas),
+    )
+
+
+def dataclass_replace(obj, **changes):
+    import dataclasses
+
+    return dataclasses.replace(obj, **changes)

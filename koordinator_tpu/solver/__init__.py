@@ -1,0 +1,8 @@
+from koordinator_tpu.solver.greedy import (  # noqa: F401
+    CycleResult,
+    STATUS_ASSIGNED,
+    STATUS_UNSCHEDULABLE,
+    STATUS_WAIT_GANG,
+    score_cycle,
+    greedy_assign,
+)

@@ -1,0 +1,248 @@
+"""Batched assignment solver: one device program replaces the per-pod cycle.
+
+The reference schedules one pod at a time: PreFilter -> parallel Filter ->
+parallel Score -> Reserve mutates plugin caches (assign-cache
+``plugins/loadaware/pod_assign_cache.go``; NodeInfo requested) so the next
+pod sees the updated world.  ``greedy_assign`` reproduces those sequential
+semantics exactly with a ``lax.scan`` over pods in queue order, carrying
+(node_requested, node_estimated, quota_used) as scan state — so its
+placements match the reference pod-for-pod — while ``score_cycle`` is the
+stateless "score every pending pod at once" tensor program for score-only
+parity and for the descheduler's candidate ranking.
+
+Queue order follows the Coscheduling QueueSort (``coscheduling.go:118``):
+higher priority first, then stable by submission index.
+
+Gang all-or-nothing (Permit, ``coscheduling/core/core.go:308``): after the
+scan, gangs whose assigned-member count is below minMember have their pods
+marked WAIT_GANG — resources stay reserved within the cycle, exactly like
+waiting pods hold their reservations in the reference's Permit stage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG, MOST_ALLOCATED
+from koordinator_tpu.model.snapshot import ClusterSnapshot
+from koordinator_tpu.ops.fit import fit_mask, nonzero_requests
+from koordinator_tpu.ops.loadaware import loadaware_filter_mask, loadaware_scores
+from koordinator_tpu.ops.scoring import (
+    least_requested_score,
+    most_requested_score,
+    weighted_resource_score,
+)
+
+STATUS_ASSIGNED = 0
+STATUS_UNSCHEDULABLE = 1
+STATUS_WAIT_GANG = 2
+
+
+@dataclasses.dataclass
+class CycleResult:
+    assignment: jnp.ndarray  # i32[P] node index, -1 = none
+    status: jnp.ndarray  # i32[P]
+    scores: Optional[jnp.ndarray] = None  # i64[P, N] (score_cycle only)
+    node_requested: Optional[jnp.ndarray] = None  # i64[N, R] post-cycle
+    node_estimated: Optional[jnp.ndarray] = None  # i64[N, R] post-cycle
+    quota_used: Optional[jnp.ndarray] = None  # i64[Q, R] post-cycle
+
+
+jax.tree_util.register_dataclass(
+    CycleResult,
+    data_fields=[
+        "assignment",
+        "status",
+        "scores",
+        "node_requested",
+        "node_estimated",
+        "quota_used",
+    ],
+    meta_fields=[],
+)
+
+
+def queue_order(priority: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Pod visit order: priority desc, stable by index; padding last."""
+    key = jnp.where(valid, -priority.astype(jnp.int64), jnp.iinfo(jnp.int64).max)
+    return jnp.argsort(key, stable=True)
+
+
+def _fit_score_requests(requests: jnp.ndarray) -> jnp.ndarray:
+    return nonzero_requests(requests)
+
+
+def _combined_scores(
+    snapshot: ClusterSnapshot,
+    node_requested: jnp.ndarray,
+    node_estimated: jnp.ndarray,
+    cfg: CycleConfig,
+    pod_requests: jnp.ndarray,
+    pod_score_requests: jnp.ndarray,
+    pod_estimated: jnp.ndarray,
+):
+    """Weighted sum of enabled plugin scores; broadcasting over [P?, N]."""
+    nodes = snapshot.nodes
+    total = jnp.zeros(
+        pod_requests.shape[:-1] + (nodes.allocatable.shape[0],), jnp.int64
+    )
+    if cfg.enable_fit_score:
+        t = node_requested + pod_score_requests[..., None, :]
+        if cfg.fit_scoring_strategy == MOST_ALLOCATED:
+            per_res = most_requested_score(t, nodes.allocatable)
+        else:
+            per_res = least_requested_score(t, nodes.allocatable)
+        total = total + cfg.fit_plugin_weight * weighted_resource_score(
+            per_res, cfg.fit_weights_arr()
+        )
+    if cfg.enable_loadaware:
+        est_used = nodes.usage + node_estimated + pod_estimated[..., None, :]
+        per_res = least_requested_score(est_used, nodes.allocatable)
+        la = weighted_resource_score(per_res, cfg.loadaware_weights_arr())
+        la = jnp.where(nodes.metric_fresh, la, 0)
+        total = total + cfg.loadaware_plugin_weight * la
+    return total
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def score_cycle(snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG):
+    """Stateless batch scoring: scores + feasibility for every (pod, node).
+
+    Equivalent to running the reference's Filter+Score for each pending pod
+    against the *initial* snapshot (no intra-batch Reserve effects).
+    Returns (scores i64[P, N], feasible bool[P, N]).
+    """
+    pods, nodes = snapshot.pods, snapshot.nodes
+    feasible = fit_mask(
+        pods.requests, nodes.requested, nodes.allocatable, nodes.valid, pods.valid
+    )
+    if cfg.enable_loadaware:
+        la_mask = loadaware_filter_mask(
+            nodes.usage,
+            nodes.allocatable,
+            cfg.loadaware_thresholds_arr(),
+            nodes.metric_fresh,
+        )
+        feasible = feasible & la_mask[None, :]
+    zero_nr = jnp.zeros_like(nodes.requested)
+    scores = _combined_scores(
+        snapshot,
+        nodes.requested,
+        zero_nr,
+        cfg,
+        pods.requests,
+        _fit_score_requests(pods.requests),
+        pods.estimated,
+    )
+    return scores, feasible
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def greedy_assign(
+    snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG
+) -> CycleResult:
+    """Sequential-parity greedy assignment of the whole pending batch."""
+    pods, nodes, gangs, quotas = (
+        snapshot.pods,
+        snapshot.nodes,
+        snapshot.gangs,
+        snapshot.quotas,
+    )
+    P = pods.capacity
+    N = nodes.allocatable.shape[0]
+
+    order = queue_order(pods.priority, pods.valid)
+    score_requests = _fit_score_requests(pods.requests)
+
+    la_mask = loadaware_filter_mask(
+        nodes.usage,
+        nodes.allocatable,
+        cfg.loadaware_thresholds_arr(),
+        nodes.metric_fresh,
+    )
+    if not cfg.enable_loadaware:
+        la_mask = jnp.ones_like(la_mask)
+
+    def step(state, p):
+        node_requested, node_estimated, quota_used = state
+        req = pods.requests[p]
+        sreq = score_requests[p]
+        est = pods.estimated[p]
+        qid = pods.quota_id[p]
+        is_valid = pods.valid[p]
+
+        need = req > 0
+        fits = jnp.all(
+            jnp.where(need[None, :], node_requested + req[None, :] <= nodes.allocatable, True),
+            axis=-1,
+        )
+        q = jnp.maximum(qid, 0)
+        quota_ok = jnp.where(
+            qid >= 0,
+            jnp.all(
+                jnp.where(
+                    quotas.limited[q],
+                    quota_used[q] + req <= quotas.runtime[q],
+                    True,
+                )
+            ),
+            True,
+        )
+        feasible = fits & nodes.valid & la_mask & quota_ok & is_valid
+
+        scores = _combined_scores(
+            snapshot, node_requested, node_estimated, cfg, req, sreq, est
+        )
+        masked = jnp.where(feasible, scores, jnp.iinfo(jnp.int64).min)
+        best = jnp.argmax(masked).astype(jnp.int32)
+        any_feasible = jnp.any(feasible)
+        chosen = jnp.where(any_feasible, best, -1)
+
+        assign_onehot = (jnp.arange(N) == chosen) & any_feasible
+        node_requested = node_requested + jnp.where(
+            assign_onehot[:, None], req[None, :], 0
+        )
+        node_estimated = node_estimated + jnp.where(
+            assign_onehot[:, None], est[None, :], 0
+        )
+        quota_used = jnp.where(
+            any_feasible & (qid >= 0),
+            quota_used.at[q].add(req),
+            quota_used,
+        )
+        return (node_requested, node_estimated, quota_used), chosen
+
+    init = (nodes.requested, jnp.zeros_like(nodes.requested), quotas.used)
+    (node_requested, node_estimated, quota_used), chosen_in_order = lax.scan(
+        step, init, order
+    )
+
+    assignment = jnp.full((P,), -1, jnp.int32).at[order].set(chosen_in_order)
+    status = jnp.where(assignment >= 0, STATUS_ASSIGNED, STATUS_UNSCHEDULABLE)
+
+    # Gang all-or-nothing: a gang below minMember keeps its pods WAITing.
+    G = gangs.min_member.shape[0]
+    assigned = (assignment >= 0) & pods.valid
+    gid = jnp.where(pods.gang_id >= 0, pods.gang_id, G)  # overflow slot
+    member_count = jnp.zeros((G + 1,), jnp.int32).at[gid].add(
+        assigned.astype(jnp.int32)
+    )
+    gang_satisfied = member_count[:G] >= gangs.min_member
+    pod_gang_ok = jnp.where(
+        pods.gang_id >= 0, gang_satisfied[jnp.maximum(pods.gang_id, 0)], True
+    )
+    status = jnp.where(assigned & ~pod_gang_ok, STATUS_WAIT_GANG, status)
+
+    return CycleResult(
+        assignment=assignment,
+        status=status.astype(jnp.int32),
+        node_requested=node_requested,
+        node_estimated=node_estimated,
+        quota_used=quota_used,
+    )

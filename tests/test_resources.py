@@ -1,0 +1,32 @@
+from koordinator_tpu.model import resources as res
+
+
+def test_cpu_milli_parsing():
+    assert res.parse_quantity("500m", res.CPU) == 500
+    assert res.parse_quantity("1", res.CPU) == 1000
+    assert res.parse_quantity("1.5", res.CPU) == 1500
+    assert res.parse_quantity(2, res.CPU) == 2000
+
+
+def test_memory_parsing():
+    assert res.parse_quantity("1Gi", res.MEMORY) == 1024**3
+    assert res.parse_quantity("512Mi", res.MEMORY) == 512 * 1024**2
+    assert res.parse_quantity("1G", res.MEMORY) == 10**9
+    assert res.parse_quantity(12345, res.MEMORY) == 12345
+
+
+def test_vectors():
+    vec = res.resource_vector({"cpu": "2", "memory": "4Gi", "pods": 10})
+    assert vec[res.RESOURCE_INDEX[res.CPU]] == 2000
+    assert vec[res.RESOURCE_INDEX[res.MEMORY]] == 4 * 1024**3
+    assert vec[res.RESOURCE_INDEX[res.PODS]] == 10
+    w = res.weights_vector({"cpu": 1, "memory": 2})
+    assert w[res.RESOURCE_INDEX[res.CPU]] == 1
+    assert w[res.RESOURCE_INDEX[res.MEMORY]] == 2
+    assert sum(w) == 3
+
+
+def test_unknown_resources_ignored():
+    vec = res.resource_vector({"cpu": "1", "example.com/foo": 5})
+    assert vec[res.RESOURCE_INDEX[res.CPU]] == 1000
+    assert sum(vec) == 1000

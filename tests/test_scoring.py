@@ -1,0 +1,136 @@
+"""Kernel parity vs the pure-Python sequential reference (fuzzed)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from koordinator_tpu.harness import reference as ref
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.ops import (
+    fit_mask,
+    least_requested_score,
+    loadaware_filter_mask,
+    loadaware_scores,
+    most_requested_score,
+    usage_percent,
+    weighted_resource_score,
+)
+
+R = res.NUM_RESOURCES
+
+
+def _rand_i64(rng, shape, hi):
+    return rng.randint(0, hi, size=shape).astype(np.int64)
+
+
+def test_least_requested_score_parity():
+    rng = np.random.RandomState(0)
+    req = _rand_i64(rng, (1000,), 10**12)
+    cap = _rand_i64(rng, (1000,), 10**12)
+    cap[::7] = 0  # exercise zero-capacity branch
+    got = np.asarray(least_requested_score(jnp.asarray(req), jnp.asarray(cap)))
+    want = [ref.least_requested_score(int(r), int(c)) for r, c in zip(req, cap)]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_most_requested_score_parity():
+    rng = np.random.RandomState(1)
+    req = _rand_i64(rng, (1000,), 10**12)
+    cap = _rand_i64(rng, (1000,), 10**12)
+    cap[::5] = 0
+    got = np.asarray(most_requested_score(jnp.asarray(req), jnp.asarray(cap)))
+    want = [ref.most_requested_score(int(r), int(c)) for r, c in zip(req, cap)]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_weighted_score_parity():
+    rng = np.random.RandomState(2)
+    scores = _rand_i64(rng, (500, R), 101)
+    weights = _rand_i64(rng, (R,), 5)
+    got = np.asarray(weighted_resource_score(jnp.asarray(scores), jnp.asarray(weights)))
+    want = [ref.weighted_score([int(x) for x in row], [int(w) for w in weights]) for row in scores]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_usage_percent_parity():
+    rng = np.random.RandomState(3)
+    used = _rand_i64(rng, (5000,), 10**9)
+    total = _rand_i64(rng, (5000,), 10**9)
+    total[::9] = 0
+    got = np.asarray(usage_percent(jnp.asarray(used), jnp.asarray(total)))
+    want = [ref.usage_percent(int(u), int(t)) for u, t in zip(used, total)]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_usage_percent_half_rounding():
+    # 65.5% must round to 66 (math.Round half away from zero)
+    assert int(usage_percent(jnp.asarray([655]), jnp.asarray([1000]))[0]) == 66
+    assert int(usage_percent(jnp.asarray([654]), jnp.asarray([1000]))[0]) == 65
+
+
+def test_fit_mask_parity():
+    rng = np.random.RandomState(4)
+    P, N = 40, 30
+    pod_req = _rand_i64(rng, (P, R), 4000)
+    pod_req[:, ::3] = 0
+    node_req = _rand_i64(rng, (N, R), 50000)
+    node_alloc = _rand_i64(rng, (N, R), 64000)
+    got = np.asarray(
+        fit_mask(
+            jnp.asarray(pod_req),
+            jnp.asarray(node_req),
+            jnp.asarray(node_alloc),
+            jnp.ones((N,), bool),
+            jnp.ones((P,), bool),
+        )
+    )
+    cyc = ref.ReferenceCycle(node_alloc, node_req, np.zeros((N, R)), [True] * N)
+    for p in range(P):
+        for n in range(N):
+            assert got[p, n] == cyc.fit_ok(n, [int(x) for x in pod_req[p]]), (p, n)
+
+
+def test_loadaware_parity():
+    rng = np.random.RandomState(5)
+    P, N = 30, 25
+    pod_est = _rand_i64(rng, (P, R), 4000)
+    usage = _rand_i64(rng, (N, R), 30000)
+    node_est = _rand_i64(rng, (N, R), 10000)
+    alloc = _rand_i64(rng, (N, R), 64000)
+    fresh = rng.rand(N) > 0.2
+    weights = np.asarray(res.weights_vector({res.CPU: 1, res.MEMORY: 1}), np.int64)
+    got = np.asarray(
+        loadaware_scores(
+            jnp.asarray(pod_est),
+            jnp.asarray(usage),
+            jnp.asarray(node_est),
+            jnp.asarray(alloc),
+            jnp.asarray(weights),
+            jnp.asarray(fresh),
+        )
+    )
+    cyc = ref.ReferenceCycle(alloc, np.zeros((N, R)), usage, list(fresh))
+    cyc.estimated = [[int(x) for x in row] for row in node_est]
+    for p in range(P):
+        for n in range(N):
+            want = cyc.loadaware_score(n, [int(x) for x in pod_est[p]])
+            assert got[p, n] == want, (p, n)
+
+
+def test_loadaware_filter_parity():
+    rng = np.random.RandomState(6)
+    N = 200
+    usage = _rand_i64(rng, (N, R), 1000)
+    alloc = _rand_i64(rng, (N, R), 1200)
+    alloc[::4] = 0
+    fresh = rng.rand(N) > 0.3
+    thresholds = np.asarray(
+        res.weights_vector({res.CPU: 65, res.MEMORY: 95}), np.int64
+    )
+    got = np.asarray(
+        loadaware_filter_mask(
+            jnp.asarray(usage), jnp.asarray(alloc), jnp.asarray(thresholds), jnp.asarray(fresh)
+        )
+    )
+    cyc = ref.ReferenceCycle(alloc, np.zeros((N, R)), usage, list(fresh))
+    for n in range(N):
+        assert got[n] == cyc.loadaware_filter_ok(n), n

@@ -1,0 +1,89 @@
+import numpy as np
+
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.model.snapshot import (
+    DEFAULT_MEMORY_REQUEST,
+    DEFAULT_MILLI_CPU_REQUEST,
+    PriorityClass,
+    QoSClass,
+    encode_snapshot,
+    estimate_pod,
+)
+
+Mi = 1024 * 1024
+_CPU = res.RESOURCE_INDEX[res.CPU]
+_MEM = res.RESOURCE_INDEX[res.MEMORY]
+_BCPU = res.RESOURCE_INDEX[res.BATCH_CPU]
+_BMEM = res.RESOURCE_INDEX[res.BATCH_MEMORY]
+
+
+def _vec(**kw):
+    v = [0] * res.NUM_RESOURCES
+    for name, val in kw.items():
+        v[res.RESOURCE_INDEX[{"cpu": res.CPU, "mem": res.MEMORY, "bcpu": res.BATCH_CPU, "bmem": res.BATCH_MEMORY}[name]]] = val
+    return v
+
+
+class TestEstimator:
+    """Parity with default_estimator.go estimatedUsedByResource."""
+
+    def test_request_scaled(self):
+        # 1000m * 85% = 850, 1000Mi * 70% = 700Mi
+        est = estimate_pod(_vec(cpu=1000, mem=1000 * Mi), _vec(), PriorityClass.PROD)
+        assert est[_CPU] == 850
+        assert est[_MEM] == 700 * Mi
+
+    def test_limit_greater_uses_100pct(self):
+        est = estimate_pod(_vec(cpu=1000), _vec(cpu=2000), PriorityClass.PROD)
+        assert est[_CPU] == 2000
+
+    def test_zero_defaults(self):
+        est = estimate_pod(_vec(), _vec(), PriorityClass.PROD)
+        assert est[_CPU] == DEFAULT_MILLI_CPU_REQUEST
+        assert est[_MEM] == DEFAULT_MEMORY_REQUEST
+
+    def test_batch_translation(self):
+        # batch pod: estimator reads batch-cpu/batch-memory slots
+        est = estimate_pod(_vec(bcpu=4000, bmem=2048 * Mi), _vec(), PriorityClass.BATCH)
+        assert est[_CPU] == round(4000 * 0.85)
+        assert est[_MEM] == round(2048 * Mi * 0.70)
+
+    def test_rounding_half_away(self):
+        # 3m * 85% = 2.55 -> 3 (Go math.Round)
+        est = estimate_pod(_vec(cpu=3), _vec(), PriorityClass.PROD)
+        assert est[_CPU] == 3
+
+
+class TestEncode:
+    def test_padding_and_masks(self):
+        nodes = [{"name": "a", "allocatable": {"cpu": "4", "memory": "8Gi"}}]
+        pods = [
+            {"name": "p1", "requests": {"cpu": "1"}, "priority": 9100, "qos": "LS"},
+            {"name": "p2", "requests": {"cpu": "2"}, "priority_class": "koord-batch", "qos": "BE"},
+        ]
+        snap = encode_snapshot(nodes, pods)
+        assert snap.nodes.valid.shape[0] == 8  # min bucket
+        assert snap.num_nodes == 1
+        assert snap.num_pods == 2
+        assert int(snap.pods.priority_class[0]) == PriorityClass.PROD
+        assert int(snap.pods.priority_class[1]) == PriorityClass.BATCH
+        assert int(snap.pods.qos[1]) == QoSClass.BE
+        assert not bool(snap.pods.valid[2])
+        np.testing.assert_array_equal(
+            np.asarray(snap.pods.requests[0])[_CPU], 1000
+        )
+
+    def test_gang_quota_wiring(self):
+        nodes = [{"name": "a", "allocatable": {"cpu": "4"}}]
+        gangs = [{"name": "g0", "min_member": 3}]
+        quotas = [{"name": "q0", "runtime": {"cpu": "10"}, "used": {"cpu": "1"}}]
+        pods = [
+            {"name": "p", "requests": {"cpu": "1"}, "gang": "g0", "quota": "q0"},
+            {"name": "p2", "requests": {"cpu": "1"}},
+        ]
+        snap = encode_snapshot(nodes, pods, gangs, quotas)
+        assert int(snap.pods.gang_id[0]) == 0
+        assert int(snap.pods.quota_id[0]) == 0
+        assert int(snap.pods.gang_id[1]) == -1
+        assert int(snap.gangs.min_member[0]) == 3
+        assert int(snap.quotas.runtime[0][_CPU]) == 10000

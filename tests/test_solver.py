@@ -1,0 +1,171 @@
+"""Solver parity: batched greedy vs the sequential CPU reference cycle."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from koordinator_tpu.config import CycleConfig
+from koordinator_tpu.harness import generators
+from koordinator_tpu.harness.reference import ReferenceCycle
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.model.snapshot import encode_snapshot
+from koordinator_tpu.solver import (
+    STATUS_ASSIGNED,
+    STATUS_UNSCHEDULABLE,
+    STATUS_WAIT_GANG,
+    greedy_assign,
+    score_cycle,
+)
+
+R = res.NUM_RESOURCES
+
+
+def _reference_from_snapshot(snap, cfg=CycleConfig(), quotas=False):
+    n = int(np.asarray(snap.nodes.valid).sum())
+    quota_runtime = {}
+    quota_used = {}
+    quota_limited = {}
+    if quotas:
+        qvalid = np.asarray(snap.quotas.valid)
+        for q in range(int(qvalid.sum())):
+            quota_runtime[q] = [int(x) for x in np.asarray(snap.quotas.runtime[q])]
+            quota_used[q] = [int(x) for x in np.asarray(snap.quotas.used[q])]
+            quota_limited[q] = [bool(x) for x in np.asarray(snap.quotas.limited[q])]
+    return ReferenceCycle(
+        np.asarray(snap.nodes.allocatable[:n]),
+        np.asarray(snap.nodes.requested[:n]),
+        np.asarray(snap.nodes.usage[:n]),
+        [bool(b) for b in np.asarray(snap.nodes.metric_fresh[:n])],
+        cfg=cfg,
+        quota_runtime=quota_runtime,
+        quota_used=quota_used,
+        quota_limited=quota_limited,
+    )
+
+
+def _assert_parity(snap, cfg=CycleConfig(), quotas=False):
+    n_pods = int(np.asarray(snap.pods.valid).sum())
+    n_nodes = int(np.asarray(snap.nodes.valid).sum())
+    result = greedy_assign(snap, cfg)
+    got = np.asarray(result.assignment)[:n_pods]
+
+    cyc = _reference_from_snapshot(snap, cfg, quotas)
+    want = cyc.schedule_batch(
+        [[int(x) for x in row] for row in np.asarray(snap.pods.requests[:n_pods])],
+        [[int(x) for x in row] for row in np.asarray(snap.pods.estimated[:n_pods])],
+        priorities=[int(x) for x in np.asarray(snap.pods.priority[:n_pods])],
+        quota_ids=[int(x) for x in np.asarray(snap.pods.quota_id[:n_pods])],
+    )
+    np.testing.assert_array_equal(got, want)
+    # device post-cycle accounting matches the reference's
+    np.testing.assert_array_equal(
+        np.asarray(result.node_requested)[:n_nodes], np.asarray(cyc.requested)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(result.node_estimated)[:n_nodes], np.asarray(cyc.estimated)
+    )
+    return result
+
+
+def test_spark_colocation_parity():
+    nodes, pods, gangs, quotas = generators.spark_colocation()
+    snap = encode_snapshot(nodes, pods, gangs, quotas)
+    result = _assert_parity(snap)
+    # all spark+nginx pods fit on a 3-node cluster
+    n_pods = int(np.asarray(snap.pods.valid).sum())
+    assert (np.asarray(result.status)[:n_pods] == STATUS_ASSIGNED).all()
+
+
+def test_loadaware_joint_parity_small():
+    nodes, pods, gangs, quotas = generators.loadaware_joint(seed=7, pods=120, nodes=24)
+    snap = encode_snapshot(nodes, pods, gangs, quotas)
+    _assert_parity(snap)
+
+
+def test_score_cycle_matches_per_pod_score():
+    nodes, pods, gangs, quotas = generators.loadaware_joint(seed=8, pods=40, nodes=16)
+    snap = encode_snapshot(nodes, pods, gangs, quotas)
+    cfg = CycleConfig()
+    scores, feasible = score_cycle(snap, cfg)
+    n_pods = int(np.asarray(snap.pods.valid).sum())
+    n_nodes = int(np.asarray(snap.nodes.valid).sum())
+    cyc = _reference_from_snapshot(snap, cfg)
+    reqs = np.asarray(snap.pods.requests[:n_pods])
+    ests = np.asarray(snap.pods.estimated[:n_pods])
+    for p in range(n_pods):
+        for n in range(n_nodes):
+            want = cyc.combined_score(n, [int(x) for x in reqs[p]], [int(x) for x in ests[p]])
+            assert int(scores[p, n]) == want, (p, n)
+
+
+def test_unschedulable_when_no_capacity():
+    nodes = [{"name": "tiny", "allocatable": {"cpu": "1", "memory": "1Gi"}, "usage": {}}]
+    pods = [{"name": "big", "requests": {"cpu": "8", "memory": "8Gi"}}]
+    snap = encode_snapshot(nodes, pods)
+    result = greedy_assign(snap)
+    assert int(result.assignment[0]) == -1
+    assert int(result.status[0]) == STATUS_UNSCHEDULABLE
+
+
+def test_priority_order_wins_contention():
+    # One node with room for exactly one pod; higher priority pod gets it.
+    nodes = [{"name": "n", "allocatable": {"cpu": "2", "memory": "4Gi"}, "usage": {}}]
+    pods = [
+        {"name": "low", "requests": {"cpu": "2"}, "priority": 5000},
+        {"name": "high", "requests": {"cpu": "2"}, "priority": 9500},
+    ]
+    snap = encode_snapshot(nodes, pods)
+    result = greedy_assign(snap)
+    assert int(result.assignment[1]) == 0
+    assert int(result.assignment[0]) == -1
+
+
+def test_gang_wait_status():
+    # gang of 3 but only capacity for 2 -> assigned members flip to WAIT_GANG
+    nodes = [{"name": "n", "allocatable": {"cpu": "2", "memory": "16Gi"}, "usage": {}}]
+    gangs = [{"name": "g", "min_member": 3}]
+    pods = [
+        {"name": f"m{i}", "requests": {"cpu": "1"}, "gang": "g", "priority": 5000}
+        for i in range(3)
+    ]
+    snap = encode_snapshot(nodes, pods, gangs)
+    result = greedy_assign(snap)
+    status = np.asarray(result.status)[:3]
+    assert (np.asarray(result.assignment)[:3] >= 0).sum() == 2
+    assert (status == STATUS_WAIT_GANG).sum() == 2
+    assert (status == STATUS_UNSCHEDULABLE).sum() == 1
+
+
+def test_gang_satisfied_all_assigned():
+    nodes = [{"name": "n", "allocatable": {"cpu": "8", "memory": "16Gi"}, "usage": {}}]
+    gangs = [{"name": "g", "min_member": 3}]
+    pods = [
+        {"name": f"m{i}", "requests": {"cpu": "1"}, "gang": "g", "priority": 5000}
+        for i in range(3)
+    ]
+    snap = encode_snapshot(nodes, pods, gangs)
+    result = greedy_assign(snap)
+    assert (np.asarray(result.status)[:3] == STATUS_ASSIGNED).all()
+
+
+def test_quota_cap_blocks_overuse():
+    nodes = [{"name": "n", "allocatable": {"cpu": "16", "memory": "64Gi"}, "usage": {}}]
+    quotas = [{"name": "q", "runtime": {"cpu": "2"}, "used": {}}]
+    pods = [
+        {"name": f"p{i}", "requests": {"cpu": "1"}, "quota": "q", "priority": 5000}
+        for i in range(4)
+    ]
+    snap = encode_snapshot(nodes, pods, quotas=quotas)
+    result = _assert_parity(snap, quotas=True)
+    assert (np.asarray(result.assignment)[:4] >= 0).sum() == 2
+
+
+def test_quota_parity_randomized():
+    nodes, pods, gangs, _ = generators.loadaware_joint(seed=9, pods=60, nodes=12)
+    quotas = [
+        {"name": "qa", "runtime": {"cpu": "40", "memory": "100Gi"}, "used": {}},
+        {"name": "qb", "runtime": {"cpu": "2", "memory": "4Gi"}, "used": {}},
+    ]
+    for i, p in enumerate(pods):
+        p["quota"] = "qa" if i % 2 else "qb"
+    snap = encode_snapshot(nodes, pods, quotas=quotas)
+    _assert_parity(snap, quotas=True)
