@@ -42,12 +42,26 @@ class QuotaGroup:
         default_factory=lambda: [0] * res.NUM_RESOURCES
     )
     allow_lent_resource: bool = True
+    # resource dims the quota spec declares (indices into RESOURCE_AXIS);
+    # admission applies only to these.  A declared dim with runtime 0
+    # admits nothing: the reference's RefreshRuntime emits declared dims
+    # with an explicit 0 that quotav1.LessThanOrEqual then compares
+    # against (undeclared dims are simply absent and fall open).
+    declared: List[int] = dataclasses.field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "QuotaGroup":
         def vec(key):
             return res.resource_vector(d.get(key, {}) or {})
 
+        declared = sorted(
+            {
+                res.RESOURCE_INDEX[name]
+                for key in ("min", "max")
+                for name in (d.get(key, {}) or {})
+                if name in res.RESOURCE_INDEX
+            }
+        )
         return cls(
             name=d["name"],
             min=vec("min"),
@@ -57,6 +71,7 @@ class QuotaGroup:
             shared_weight=int(d.get("shared_weight", 1)),
             guarantee=vec("guarantee"),
             allow_lent_resource=bool(d.get("allow_lent_resource", True)),
+            declared=declared,
         )
 
 
@@ -142,10 +157,16 @@ def build_quota_table_inputs(
     runtimes = refresh_runtime(groups, total_resource)
     out = []
     for g, rt in zip(groups, runtimes):
+        # Emit every *declared* dimension, including runtime 0: the
+        # reference's RefreshRuntime keeps declared dims with explicit
+        # zeros, so admission rejects on them; only undeclared dims are
+        # absent from the runtime list and fall open.
+        limited = set(g.declared) | {r for r in range(res.NUM_RESOURCES) if rt[r]}
         out.append(
             {
                 "name": g.name,
-                "runtime": {res.RESOURCE_AXIS[r]: rt[r] for r in range(res.NUM_RESOURCES) if rt[r]},
+                "runtime": {res.RESOURCE_AXIS[r]: rt[r] for r in sorted(limited)},
+                "limited": [res.RESOURCE_AXIS[r] for r in sorted(limited)],
                 "used": {res.RESOURCE_AXIS[r]: g.used[r] for r in range(res.NUM_RESOURCES) if g.used[r]},
             }
         )

@@ -1,0 +1,22 @@
+"""Descheduler analog of koord-descheduler (reference ``pkg/descheduler``).
+
+Modules
+-------
+- ``anomaly``     — node anomaly circuit breaker
+                    (reference ``utils/anomaly/basic_detector.go``).
+- ``sorter``      — multi-key pod/node ranking (reference ``utils/sorter``).
+- ``evictions``   — eviction rate limiting + the evictor seam
+                    (reference ``evictions/evictions.go``, ``eviction_limiter.go``).
+- ``lownodeload`` — the LowNodeLoad Balance plugin: utilization
+                    classification + eviction planning (reference
+                    ``framework/plugins/loadaware/low_node_load.go``).
+- ``migration``   — PodMigrationJob controller state machine + arbitration
+                    (reference ``controllers/migration``).
+"""
+
+from koordinator_tpu.descheduler.anomaly import BasicDetector, State  # noqa: F401
+from koordinator_tpu.descheduler.lownodeload import (  # noqa: F401
+    LowNodeLoadArgs,
+    NodePool,
+    balance,
+)

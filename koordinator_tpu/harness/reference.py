@@ -120,11 +120,16 @@ class ReferenceCycle:
             return True
         used = self.quota_used.setdefault(qid, [0] * res.NUM_RESOURCES)
         rt = self.quota_runtime[qid]
+        # Declared-but-zero runtime dims must reject (the reference keeps
+        # declared dims in the runtime list with explicit zeros; undeclared
+        # dims fall open); callers pass quota_limited for that.
         limited = self.quota_limited.get(qid)
+        if limited is None:
+            limited = [v > 0 for v in rt]
         return all(
             used[r] + pod_req[r] <= rt[r]
             for r in range(res.NUM_RESOURCES)
-            if (limited[r] if limited is not None else rt[r] > 0)
+            if limited[r]
         )
 
     # --- Score ------------------------------------------------------------
@@ -175,7 +180,9 @@ class ReferenceCycle:
         quota_fits = self.quota_ok(quota_id, pod_req)
         for n in range(n_nodes):
             feasible = (
-                quota_fits and self.fit_ok(n, pod_req) and self.loadaware_filter_ok(n)
+                quota_fits
+                and self.fit_ok(n, pod_req)
+                and (not self.cfg.enable_loadaware or self.loadaware_filter_ok(n))
             )
             s = self.combined_score(n, pod_req, pod_est)
             scores[n] = s

@@ -289,7 +289,9 @@ def estimate_pod(
             requests_vec[real_idx],
             limits_vec[real_idx],
             default_value,
-            int(scaling_factors.get(name, 100)),
+            # A weighted resource with no scaling-factor entry estimates 0
+            # (Go map zero-value in estimatedPodUsed, default_estimator.go:67).
+            int(scaling_factors.get(name, 0)),
         )
     return out
 
@@ -365,10 +367,12 @@ def encode_snapshot(
         pod_prio_class[i] = int(prio_class)
         pod_qos[i] = int(QoSClass.from_name(pd.get("qos")))
         pod_prio[i] = int(pd.get("priority") or 0)
+        # Unknown gang/quota names (object not yet synced into the snapshot)
+        # degrade to "no gang"/"no quota" rather than crashing the encode.
         if pd.get("gang") is not None:
-            pod_gang[i] = gang_index[pd["gang"]]
+            pod_gang[i] = gang_index.get(pd["gang"], -1)
         if pd.get("quota") is not None:
-            pod_quota[i] = quota_index[pd["quota"]]
+            pod_quota[i] = quota_index.get(pd["quota"], -1)
         pod_valid[i] = True
 
     gang_min = np.zeros((g_bucket,), np.int32)
@@ -386,8 +390,11 @@ def encode_snapshot(
         quota_used[i] = res.resource_vector(q.get("used", {}))
         # A quota constrains only the dimensions it declares (the reference
         # checks used+request against runtime only for the quota's declared
-        # resource dimensions, elasticquota plugin PreFilter).
-        for name in q.get("runtime", {}):
+        # resource dimensions, elasticquota plugin PreFilter).  "limited"
+        # lists the declared dims explicitly so a zero-runtime dimension
+        # still rejects (the reference keeps declared dims in the runtime
+        # list with explicit zeros; only undeclared dims fall open).
+        for name in q.get("limited", q.get("runtime", {})):
             idx = res.RESOURCE_INDEX.get(name)
             if idx is not None:
                 quota_limited[i, idx] = True

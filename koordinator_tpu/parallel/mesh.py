@@ -19,6 +19,7 @@ argmax(masked score) then runs as a sharded reduce.
 
 from __future__ import annotations
 
+from dataclasses import replace as dataclass_replace
 from typing import Optional, Sequence
 
 import jax
@@ -40,12 +41,6 @@ def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     dp, tp = _factor2(len(devices))
     return Mesh(np.asarray(devices).reshape(dp, tp), ("pods", "nodes"))
-
-
-def _put(tree, shardings):
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, s), tree, shardings
-    )
 
 
 def shard_snapshot_for_scoring(snap: ClusterSnapshot, mesh: Mesh) -> ClusterSnapshot:
@@ -114,7 +109,3 @@ def shard_snapshot_for_assign(snap: ClusterSnapshot, mesh: Mesh) -> ClusterSnaps
     )
 
 
-def dataclass_replace(obj, **changes):
-    import dataclasses
-
-    return dataclasses.replace(obj, **changes)

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG, MOST_ALLOCATED
+from koordinator_tpu.constraints.gang import gang_satisfaction
 from koordinator_tpu.model.snapshot import ClusterSnapshot
 from koordinator_tpu.ops.fit import fit_mask, nonzero_requests
 from koordinator_tpu.ops.loadaware import loadaware_filter_mask, loadaware_scores
@@ -227,15 +228,9 @@ def greedy_assign(
     status = jnp.where(assignment >= 0, STATUS_ASSIGNED, STATUS_UNSCHEDULABLE)
 
     # Gang all-or-nothing: a gang below minMember keeps its pods WAITing.
-    G = gangs.min_member.shape[0]
     assigned = (assignment >= 0) & pods.valid
-    gid = jnp.where(pods.gang_id >= 0, pods.gang_id, G)  # overflow slot
-    member_count = jnp.zeros((G + 1,), jnp.int32).at[gid].add(
-        assigned.astype(jnp.int32)
-    )
-    gang_satisfied = member_count[:G] >= gangs.min_member
-    pod_gang_ok = jnp.where(
-        pods.gang_id >= 0, gang_satisfied[jnp.maximum(pods.gang_id, 0)], True
+    _, pod_gang_ok = gang_satisfaction(
+        assignment, pods.valid, pods.gang_id, gangs.min_member
     )
     status = jnp.where(assigned & ~pod_gang_ok, STATUS_WAIT_GANG, status)
 

@@ -1,11 +1,19 @@
 """Test environment: force an 8-device virtual CPU platform before JAX
-initializes, so multi-chip sharding tests run without TPU hardware."""
+initializes, so multi-chip sharding tests run without TPU hardware.
+
+Note: the env-var route (``JAX_PLATFORMS=cpu``) is not enough on machines
+where a platform plugin site-hook pins ``jax_platforms`` itself (e.g. the
+axon TPU tunnel); ``jax.config.update`` after import wins either way.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,323 @@
+"""Tests for the descheduler analog: anomaly circuit breaker, sorter
+ordering, eviction limiter, LowNodeLoad classification + balance planning,
+migration arbitration + state machine."""
+
+import numpy as np
+
+from koordinator_tpu.descheduler import anomaly, evictions, lownodeload, migration, sorter
+from koordinator_tpu.descheduler.anomaly import BasicDetector, State
+from koordinator_tpu.descheduler.evictions import PodEvictor
+from koordinator_tpu.descheduler.lownodeload import LowNodeLoadArgs, NodePool, balance, classify
+from koordinator_tpu.model import resources as res
+
+Gi = 1024**3
+
+
+class TestAnomalyDetector:
+    def test_trips_after_consecutive_abnormalities(self):
+        t = [0.0]
+        d = BasicDetector("n1", clock=lambda: t[0])
+        for _ in range(5):
+            assert d.mark(False) is State.OK
+        assert d.mark(False) is State.ANOMALY  # 6th: > 5
+
+    def test_recovers_after_consecutive_normalities(self):
+        t = [0.0]
+        d = BasicDetector("n1", clock=lambda: t[0])
+        for _ in range(6):
+            d.mark(False)
+        assert d.state() is State.ANOMALY
+        for _ in range(3):
+            assert d.mark(True) is State.ANOMALY
+        assert d.mark(True) is State.OK  # 4th: > 3
+
+    def test_generation_timeout_clears_counts(self):
+        t = [0.0]
+        d = BasicDetector("n1", timeout_seconds=60, clock=lambda: t[0])
+        for _ in range(5):
+            d.mark(False)
+        t[0] = 61.0  # counters roll
+        for _ in range(5):
+            assert d.mark(False) is State.OK
+        assert d.mark(False) is State.ANOMALY
+
+    def test_reset(self):
+        d = BasicDetector("n1")
+        for _ in range(6):
+            d.mark(False)
+        d.reset()
+        assert d.state() is State.OK
+        assert d.counter.total == 0
+
+
+class TestSorter:
+    def test_eviction_order_priority_first(self):
+        pods = [
+            {"name": "prod", "priority_class": "koord-prod", "priority": 9500},
+            {"name": "batch-low-use", "priority_class": "koord-batch", "priority": 5500},
+            {"name": "free", "priority_class": "koord-free", "priority": 3500},
+        ]
+        out = sorter.sort_pods_for_eviction(pods, {}, {"cpu": "10"}, {res.CPU: 1})
+        assert [p["name"] for p in out] == ["free", "batch-low-use", "prod"]
+
+    def test_usage_breaks_ties_high_first(self):
+        pods = [
+            {"name": "a", "priority": 5500},
+            {"name": "b", "priority": 5500},
+        ]
+        metrics = {"a": {"cpu": "1"}, "b": {"cpu": "4"}}
+        out = sorter.sort_pods_for_eviction(pods, metrics, {"cpu": "10"}, {res.CPU: 1})
+        assert [p["name"] for p in out] == ["b", "a"]
+
+    def test_qos_rank(self):
+        pods = [
+            {"name": "ls", "priority": 5500, "qos": "LS"},
+            {"name": "be", "priority": 5500, "qos": "BE"},
+        ]
+        out = sorter.sort_pods_for_eviction(pods, {}, {"cpu": "10"}, {res.CPU: 1})
+        assert out[0]["name"] == "be"
+
+
+class TestPodEvictor:
+    def test_per_node_cap(self):
+        ev = PodEvictor(max_pods_per_node=1)
+        assert ev.evict({"name": "a"}, "n1")
+        assert not ev.evict({"name": "b"}, "n1")
+        assert ev.evict({"name": "c"}, "n2")
+
+    def test_per_namespace_cap(self):
+        ev = PodEvictor(max_pods_per_namespace=1)
+        assert ev.evict({"name": "a", "namespace": "x"}, "n1")
+        assert not ev.evict({"name": "b", "namespace": "x"}, "n2")
+
+    def test_rate_limiter(self):
+        t = [0.0]
+        ev = PodEvictor(qps=1.0, burst=2, clock=lambda: t[0])
+        assert ev.evict({"name": "a"}, "n1")
+        assert ev.evict({"name": "b"}, "n1")
+        assert not ev.evict({"name": "c"}, "n1")
+        t[0] = 1.1  # one token refilled
+        assert ev.evict({"name": "c"}, "n1")
+
+    def test_evict_fn_failure_not_counted(self):
+        ev = PodEvictor(evict_fn=lambda pod, reason: False)
+        assert not ev.evict({"name": "a"}, "n1")
+        assert ev.total_evicted() == 0
+
+
+class TestClassify:
+    def test_under_over(self):
+        usage = np.array([[10, 10], [50, 50], [95, 40]], dtype=np.int64)
+        alloc = np.full((3, 2), 100, dtype=np.int64)
+        cls = classify(
+            ["a", "b", "c"], usage, alloc,
+            low_pct=np.array([30.0, 30.0]), high_pct=np.array([80.0, 80.0]),
+            use_deviation=False,
+        )
+        assert cls.underutilized.tolist() == [True, False, False]
+        assert cls.overutilized.tolist() == [False, False, True]  # any-resource
+
+    def test_unschedulable_never_underutilized(self):
+        usage = np.array([[10, 10]], dtype=np.int64)
+        alloc = np.full((1, 2), 100, dtype=np.int64)
+        cls = classify(
+            ["a"], usage, alloc,
+            np.array([30.0, 30.0]), np.array([80.0, 80.0]),
+            False, unschedulable=np.array([True]),
+        )
+        assert not cls.underutilized[0]
+
+    def test_deviation_mode(self):
+        # avg usage 50%; low=avg-10=40, high=avg+10=60
+        usage = np.array([[30], [50], [70]], dtype=np.int64)
+        alloc = np.full((3, 1), 100, dtype=np.int64)
+        cls = classify(
+            ["a", "b", "c"], usage, alloc,
+            np.array([10.0]), np.array([10.0]), use_deviation=True,
+        )
+        assert cls.underutilized.tolist() == [True, False, False]
+        assert cls.overutilized.tolist() == [False, False, True]
+
+
+def _mk_node(name, cpu_used, pods=(), cpu_cap=100):
+    return {
+        "name": name,
+        "allocatable": {"cpu": str(cpu_cap), "memory": 100 * Gi},
+        "usage": {"cpu": str(cpu_used), "memory": 10 * Gi},
+        "pods": list(pods),
+    }
+
+
+class TestBalance:
+    def pool(self, **kw):
+        kw.setdefault("low_thresholds", {res.CPU: 30, res.MEMORY: 30})
+        kw.setdefault("high_thresholds", {res.CPU: 70, res.MEMORY: 70})
+        return NodePool(**kw)
+
+    def test_evicts_from_overutilized_until_under_threshold(self):
+        hot_pods = [
+            {"name": f"be-{i}", "priority": 5500, "qos": "BE", "usage": {"cpu": "10"}}
+            for i in range(5)
+        ]
+        nodes = [
+            _mk_node("cold", 10),
+            _mk_node("hot", 90, pods=hot_pods),
+            _mk_node("mid", 50),
+        ]
+        ev = PodEvictor()
+        planned = balance(LowNodeLoadArgs(node_pools=[self.pool()]), nodes, ev)
+        # 90 -> need to drop under 70: evict 2 pods of 10 cpu each (90->80->70)
+        assert [p["pod"] for p in planned] == ["be-0", "be-1"]
+        assert all(p["node"] == "hot" for p in planned)
+
+    def test_no_low_nodes_no_evictions(self):
+        nodes = [_mk_node("hot", 90, pods=[{"name": "p", "usage": {"cpu": "10"}}]), _mk_node("hot2", 85)]
+        planned = balance(LowNodeLoadArgs(node_pools=[self.pool()]), nodes, PodEvictor())
+        assert planned == []
+
+    def test_all_low_nodes_no_evictions(self):
+        nodes = [_mk_node("a", 5), _mk_node("b", 5)]
+        planned = balance(LowNodeLoadArgs(node_pools=[self.pool()]), nodes, PodEvictor())
+        assert planned == []
+
+    def test_anomaly_debounce(self):
+        hot = _mk_node("hot", 90, pods=[{"name": "p", "priority": 5500, "usage": {"cpu": "30"}}])
+        nodes = [_mk_node("cold", 10), hot, _mk_node("mid", 50)]
+        pool = self.pool(consecutive_abnormalities=3)
+        detectors = {}
+        args = LowNodeLoadArgs(node_pools=[pool])
+        # ticks 1-3: counter accumulating (needs > 3)
+        for _ in range(3):
+            assert balance(args, nodes, PodEvictor(), detectors) == []
+        planned = balance(args, nodes, PodEvictor(), detectors)
+        assert len(planned) == 1
+
+    def test_headroom_limits_evictions(self):
+        # low=60/high=70: cold node (59) headroom = 70 - 59 = 11 cpu; two
+        # 10-cpu evictions exhaust it while "hot" (95 -> 75) is still over.
+        hot_pods = [
+            {"name": f"be-{i}", "priority": 5500, "usage": {"cpu": "10"}} for i in range(5)
+        ]
+        pool = self.pool(
+            low_thresholds={res.CPU: 60, res.MEMORY: 60},
+            high_thresholds={res.CPU: 70, res.MEMORY: 70},
+        )
+        nodes = [_mk_node("cold", 59), _mk_node("hot", 95, pods=hot_pods), _mk_node("mid", 65)]
+        planned = balance(LowNodeLoadArgs(node_pools=[pool]), nodes, PodEvictor())
+        assert len(planned) == 2
+
+    def test_pool_selector(self):
+        hot = _mk_node("hot", 90, pods=[{"name": "p", "usage": {"cpu": "30"}}])
+        hot["labels"] = {"pool": "other"}
+        cold = _mk_node("cold", 10)
+        cold["labels"] = {"pool": "other"}
+        pool = self.pool(node_selector={"pool": "mine"})
+        planned = balance(LowNodeLoadArgs(node_pools=[pool]), [hot, cold], PodEvictor())
+        assert planned == []
+
+
+class TestMigration:
+    def test_arbitration_per_node_cap(self):
+        args = migration.MigrationControllerArgs(max_concurrent_reclaims_per_node=1)
+        ctrl = migration.MigrationController(args=args, evict=lambda pod: True)
+        ctrl.create_reservation = lambda job: "r-" + job.name
+        ctrl.reservation_bound = lambda name: True
+        ctrl.submit(migration.MigrationJob("j1", {"name": "a", "node": "n1"}, creation_time=0))
+        ctrl.submit(migration.MigrationJob("j2", {"name": "b", "node": "n1"}, creation_time=1))
+        ctrl.reconcile(now=1.0)
+        j1, j2 = ctrl.jobs["j1"], ctrl.jobs["j2"]
+        assert j1.phase == migration.SUCCEEDED
+        # j2 blocked this round by the per-node cap while j1 was active
+        assert j2.phase == migration.PENDING
+        ctrl.reconcile(now=2.0)
+        assert ctrl.jobs["j2"].phase == migration.SUCCEEDED
+
+    def test_reservation_first_waits_for_bound(self):
+        bound = {"r-j1": False}
+        ctrl = migration.MigrationController(
+            create_reservation=lambda job: "r-" + job.name,
+            reservation_bound=lambda name: bound[name],
+            evict=lambda pod: True,
+        )
+        ctrl.submit(migration.MigrationJob("j1", {"name": "a", "node": "n1"}))
+        ctrl.reconcile(now=0.0)
+        assert ctrl.jobs["j1"].phase == migration.RUNNING
+        assert ctrl.jobs["j1"].reason == migration.REASON_WAIT_RESERVATION
+        bound["r-j1"] = True
+        ctrl.reconcile(now=1.0)
+        assert ctrl.jobs["j1"].phase == migration.SUCCEEDED
+
+    def test_ttl_timeout(self):
+        ctrl = migration.MigrationController(
+            args=migration.MigrationControllerArgs(default_job_ttl_seconds=10),
+            create_reservation=lambda job: None,
+        )
+        ctrl.submit(migration.MigrationJob("j1", {"name": "a"}, creation_time=0.0))
+        ctrl.jobs["j1"].phase = migration.PENDING
+        ctrl.jobs["j1"].passed_arbitration = True  # stuck in queue
+        ctrl.reconcile(now=100.0)
+        assert ctrl.jobs["j1"].phase == migration.FAILED
+        assert ctrl.jobs["j1"].reason == migration.REASON_TIMEOUT
+
+    def test_evict_directly_mode(self):
+        ctrl = migration.MigrationController(
+            args=migration.MigrationControllerArgs(default_job_mode="EvictDirectly"),
+            evict=lambda pod: True,
+        )
+        ctrl.submit(migration.MigrationJob("j1", {"name": "a", "node": "n1"}))
+        ctrl.reconcile(now=0.0)
+        assert ctrl.jobs["j1"].phase == migration.SUCCEEDED
+
+    def test_scavenge(self):
+        ctrl = migration.MigrationController(evict=lambda pod: True)
+        ctrl.submit(migration.MigrationJob("j1", {"name": "a"}, creation_time=0.0, mode="EvictDirectly"))
+        ctrl.reconcile(now=0.0)
+        assert ctrl.scavenge(now=1000.0) == 1
+        assert not ctrl.jobs
+
+
+class TestReviewRegressions:
+    def _pool(self, **kw):
+        kw.setdefault("low_thresholds", {res.CPU: 30, res.MEMORY: 30})
+        kw.setdefault("high_thresholds", {res.CPU: 70, res.MEMORY: 70})
+        return NodePool(**kw)
+
+    def test_dry_run_skips_evictor(self):
+        calls = []
+        hot_pods = [{"name": "p", "priority": 5500, "usage": {"cpu": "30"}}]
+        nodes = [_mk_node("cold", 10), _mk_node("hot", 90, pods=hot_pods), _mk_node("mid", 50)]
+        ev = PodEvictor(evict_fn=lambda pod, reason: calls.append(pod) or True)
+        planned = balance(LowNodeLoadArgs(node_pools=[self._pool()], dry_run=True), nodes, ev)
+        assert len(planned) == 1
+        assert calls == [] and ev.total_evicted() == 0
+
+    def test_node_fit_blocks_oversized_pods(self):
+        # pod requests 90 cpu: no destination headroom fits it
+        hot_pods = [{"name": "big", "priority": 5500, "usage": {"cpu": "30"},
+                     "requests": {"cpu": "90"}}]
+        nodes = [_mk_node("cold", 10), _mk_node("hot", 90, pods=hot_pods), _mk_node("mid", 50)]
+        planned = balance(LowNodeLoadArgs(node_pools=[self._pool()], node_fit=True), nodes, PodEvictor())
+        assert planned == []
+        planned = balance(LowNodeLoadArgs(node_pools=[self._pool()], node_fit=False), nodes, PodEvictor())
+        assert len(planned) == 1
+
+    def test_guard_exit_leaves_nodes_for_next_pool(self):
+        # pool A (all nodes cold for its thresholds) trips a guard; pool B
+        # must still process the same nodes and evict.
+        hot_pods = [{"name": "p", "priority": 5500, "usage": {"cpu": "30"}}]
+        nodes = [_mk_node("cold", 10), _mk_node("hot", 90, pods=hot_pods), _mk_node("mid", 50)]
+        pool_a = self._pool(
+            name="a",
+            low_thresholds={res.CPU: 99, res.MEMORY: 99},
+            high_thresholds={res.CPU: 99, res.MEMORY: 99},
+        )  # all nodes underutilized -> guard exit
+        pool_b = self._pool(name="b")
+        planned = balance(LowNodeLoadArgs(node_pools=[pool_a, pool_b]), nodes, PodEvictor())
+        assert [p["pool"] for p in planned] == ["b"]
+
+    def test_simulated_time_rolls_detector_generation(self):
+        from koordinator_tpu.descheduler.anomaly import BasicDetector, State
+        d = BasicDetector("n", timeout_seconds=60, clock=lambda: 0.0)
+        for _ in range(5):
+            d.mark(False, now=0.0)
+        assert d.mark(False, now=100.0) is State.OK  # generation rolled

@@ -1,0 +1,389 @@
+"""Tests for the koord-manager analog: sloconfig merge, batch/mid
+overcommit math (reference ``batchresource/util.go:38-49`` semantics),
+degrade behavior, NodeSLO rendering, profile mutation, quota profiles."""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.manager import (
+    calculate_batch_resource,
+    calculate_mid_resource,
+    default_colocation_strategy,
+    is_strategy_valid,
+    merge_node_strategy,
+    need_sync,
+)
+from koordinator_tpu.manager import nodemetric, nodeslo, profile, quota_profile
+from koordinator_tpu.manager.noderesource import (
+    batch_allocatable_batch,
+    priority_class_of,
+)
+from koordinator_tpu.manager.sloconfig import parse_strategy
+from koordinator_tpu.model import resources as res
+
+Gi = 1024**3
+
+
+class TestSloConfig:
+    def test_defaults_valid(self):
+        s = default_colocation_strategy()
+        assert is_strategy_valid(s)
+        assert s.cpu_reclaim_threshold_percent == 60
+        assert s.memory_reclaim_threshold_percent == 65
+        assert s.degrade_time_minutes == 15
+
+    def test_parse_overrides(self):
+        s = parse_strategy({"enable": True, "cpuReclaimThresholdPercent": 70})
+        assert s.enable and s.cpu_reclaim_threshold_percent == 70
+        # untouched fields keep defaults
+        assert s.memory_reclaim_threshold_percent == 65
+
+    def test_invalid_on_nonpositive(self):
+        s = default_colocation_strategy().replace(degrade_time_minutes=0)
+        assert not is_strategy_valid(s)
+
+    def test_node_merge_selector(self):
+        cluster = default_colocation_strategy()
+        cfgs = [
+            {
+                "nodeSelector": {"matchLabels": {"pool": "batch"}},
+                "strategy": {"cpuReclaimThresholdPercent": 80},
+            }
+        ]
+        merged = merge_node_strategy(cluster, {"pool": "batch"}, cfgs)
+        assert merged.cpu_reclaim_threshold_percent == 80
+        unmatched = merge_node_strategy(cluster, {"pool": "prod"}, cfgs)
+        assert unmatched.cpu_reclaim_threshold_percent == 60
+
+
+class TestBatchResource:
+    """Hand-computed fixtures for
+    batchAllocatable = capacity - nodeReservation - systemUsedOrReserved - podHPUsed."""
+
+    def strategy(self):
+        return default_colocation_strategy()
+
+    def test_basic_formula(self):
+        # 100-core node (100000m), 100Gi. reclaim 60% cpu / 65% mem
+        # => nodeReservation = 40000m cpu, 35Gi mem.
+        out = calculate_batch_resource(
+            self.strategy(),
+            node_capacity={"cpu": "100", "memory": 100 * Gi},
+            node_annotation_reserved=None,
+            kubelet_reserved=None,
+            system_usage={"cpu": "7", "memory": 12 * Gi},
+            pods=[
+                {
+                    "name": "prod-a",
+                    "requests": {"cpu": "10", "memory": 20 * Gi},
+                    "priority_class": "koord-prod",
+                },
+                {
+                    "name": "batch-b",
+                    "requests": {"cpu": "30", "memory": 30 * Gi},
+                    "priority_class": "koord-batch",
+                },
+            ],
+            pod_metrics={
+                "prod-a": {"cpu": "11", "memory": 18 * Gi},
+                "batch-b": {"cpu": "5", "memory": 5 * Gi},
+            },
+            metric_update_time=1000.0,
+            now=1000.0,
+        )
+        assert not out.degraded
+        # HP used = prod-a metric (11000m, 18Gi); batch-b ignored.
+        assert out.batch_cpu_milli == 100000 - 40000 - 7000 - 11000
+        assert out.batch_memory_bytes == (100 - 35 - 12 - 18) * Gi
+
+    def test_memory_by_request_policy(self):
+        s = self.strategy().replace(memory_calculate_policy="request")
+        out = calculate_batch_resource(
+            s,
+            node_capacity={"cpu": "100", "memory": 100 * Gi},
+            node_annotation_reserved={"memory": 2 * Gi},
+            kubelet_reserved=None,
+            system_usage={"cpu": "7", "memory": 12 * Gi},
+            pods=[
+                {
+                    "name": "prod-a",
+                    "requests": {"cpu": "10", "memory": 20 * Gi},
+                    "priority_class": "koord-prod",
+                }
+            ],
+            pod_metrics={"prod-a": {"cpu": "11", "memory": 18 * Gi}},
+            metric_update_time=0.0,
+            now=0.0,
+        )
+        # memory: capacity - reservation(35Gi) - systemReserved(2Gi) - HPrequest(20Gi)
+        assert out.batch_memory_bytes == (100 - 35 - 2 - 20) * Gi
+        # cpu still byUsage
+        assert out.batch_cpu_milli == 100000 - 40000 - 7000 - 11000
+
+    def test_lse_pod_cpu_by_request(self):
+        out = calculate_batch_resource(
+            self.strategy(),
+            node_capacity={"cpu": "100", "memory": 100 * Gi},
+            node_annotation_reserved=None,
+            kubelet_reserved=None,
+            system_usage={},
+            pods=[
+                {
+                    "name": "lse-a",
+                    "requests": {"cpu": "10", "memory": 10 * Gi},
+                    "priority_class": "koord-prod",
+                    "qos": "LSE",
+                }
+            ],
+            pod_metrics={"lse-a": {"cpu": "2", "memory": 4 * Gi}},
+            metric_update_time=0.0,
+            now=0.0,
+        )
+        # LSE: cpu by request (10), memory by usage (4Gi)
+        assert out.batch_cpu_milli == 100000 - 40000 - 0 - 10000
+        assert out.batch_memory_bytes == (100 - 35 - 4) * Gi
+
+    def test_unknown_metric_pods_count_hp(self):
+        out = calculate_batch_resource(
+            self.strategy(),
+            node_capacity={"cpu": "100", "memory": 100 * Gi},
+            node_annotation_reserved=None,
+            kubelet_reserved=None,
+            system_usage={},
+            pods=[],
+            pod_metrics={"ghost": {"cpu": "3", "memory": 3 * Gi}},
+            metric_update_time=0.0,
+            now=0.0,
+        )
+        assert out.batch_cpu_milli == 100000 - 40000 - 3000
+
+    def test_degrade_on_stale_metric(self):
+        out = calculate_batch_resource(
+            self.strategy(),
+            node_capacity={"cpu": "100", "memory": 100 * Gi},
+            node_annotation_reserved=None,
+            kubelet_reserved=None,
+            system_usage={},
+            pods=[],
+            pod_metrics={},
+            metric_update_time=0.0,
+            now=16 * 60.0,  # > DegradeTimeMinutes(15)
+        )
+        assert out.degraded
+        assert out.as_extended_resources() == {}
+
+    def test_cpu_normalization_amplification(self):
+        out = calculate_batch_resource(
+            self.strategy(),
+            node_capacity={"cpu": "100", "memory": 100 * Gi},
+            node_annotation_reserved=None,
+            kubelet_reserved=None,
+            system_usage={},
+            pods=[],
+            pod_metrics={},
+            metric_update_time=0.0,
+            now=0.0,
+            cpu_normalization_ratio=1.2,
+        )
+        assert out.batch_cpu_milli == int(60000 * 1.2)
+
+    def test_vectorized_matches_scalar(self):
+        s = self.strategy()
+        rng = np.random.RandomState(0)
+        n = 64
+        cap = np.stack(
+            [rng.randint(8000, 128000, n), rng.randint(16, 256, n) * Gi], axis=1
+        ).astype(np.int64)
+        sysres = np.stack([rng.randint(0, 2000, n), rng.randint(0, 4, n) * Gi], axis=1).astype(np.int64)
+        sysuse = np.stack([rng.randint(0, 4000, n), rng.randint(0, 8, n) * Gi], axis=1).astype(np.int64)
+        hpreq = np.stack([rng.randint(0, 64000, n), rng.randint(0, 128, n) * Gi], axis=1).astype(np.int64)
+        hpuse = np.stack([rng.randint(0, 64000, n), rng.randint(0, 128, n) * Gi], axis=1).astype(np.int64)
+        batch = batch_allocatable_batch(s, cap, sysres, sysuse, hpreq, hpuse)
+        for i in range(n):
+            out = calculate_batch_resource(
+                s,
+                node_capacity={"cpu": f"{cap[i,0]}m", "memory": int(cap[i, 1])},
+                node_annotation_reserved={"cpu": f"{sysres[i,0]}m", "memory": int(sysres[i, 1])},
+                kubelet_reserved=None,
+                system_usage={"cpu": f"{sysuse[i,0]}m", "memory": int(sysuse[i, 1])},
+                pods=[
+                    {
+                        "name": "hp",
+                        "requests": {"cpu": f"{hpreq[i,0]}m", "memory": int(hpreq[i, 1])},
+                        "priority_class": "koord-prod",
+                    }
+                ],
+                pod_metrics={"hp": {"cpu": f"{hpuse[i,0]}m", "memory": int(hpuse[i, 1])}},
+                metric_update_time=0.0,
+                now=0.0,
+            )
+            assert out.batch_cpu_milli == batch[i, 0]
+            assert out.batch_memory_bytes == batch[i, 1]
+
+
+class TestMidResource:
+    def test_min_of_reclaimable_and_ratio(self):
+        s = default_colocation_strategy().replace(mid_cpu_threshold_percent=10)
+        out = calculate_mid_resource(
+            s,
+            node_allocatable={"cpu": "100", "memory": 100 * Gi},
+            prod_reclaimable={"cpu": "20", "memory": 5 * Gi},
+            metric_update_time=0.0,
+            now=0.0,
+        )
+        # cpu capped by 10% of allocatable = 10000m < reclaimable 20000m
+        assert out.batch_cpu_milli == 10000
+        assert out.batch_memory_bytes == 5 * Gi
+
+    def test_degrade_without_reclaimable(self):
+        out = calculate_mid_resource(
+            default_colocation_strategy(),
+            node_allocatable={"cpu": "100", "memory": 100 * Gi},
+            prod_reclaimable=None,
+        )
+        assert out.degraded
+
+
+class TestNeedSync:
+    def test_diff_threshold(self):
+        s = default_colocation_strategy()  # threshold 0.1
+        old = {res.BATCH_CPU: 10000, res.BATCH_MEMORY: 100}
+        assert not need_sync(s, old, {res.BATCH_CPU: 10500, res.BATCH_MEMORY: 100})
+        assert need_sync(s, old, {res.BATCH_CPU: 12000, res.BATCH_MEMORY: 100})
+        assert need_sync(s, old, {res.BATCH_CPU: 10000})  # resource removed
+
+
+class TestPriorityClass:
+    def test_band_derivation(self):
+        assert priority_class_of({"priority": 9500}) == "koord-prod"
+        assert priority_class_of({"priority": 5500}) == "koord-batch"
+        assert priority_class_of({"priority_class": "koord-free"}) == "koord-free"
+        assert priority_class_of({}) == "koord-prod"  # HP by default
+
+
+class TestNodeSLO:
+    def test_defaults(self):
+        spec = nodeslo.default_nodeslo_spec()
+        assert spec["resourceUsedThresholdWithBE"]["cpuSuppressThresholdPercent"] == 65
+        assert spec["resourceUsedThresholdWithBE"]["memoryEvictThresholdPercent"] == 70
+        assert spec["cpuBurstStrategy"]["cfsQuotaBurstPercent"] == 300
+        assert spec["resourceQOSStrategy"]["beClass"]["cpuQOS"]["groupIdentity"] == -1
+        assert spec["resourceQOSStrategy"]["beClass"]["resctrlQOS"]["catRangeEndPercent"] == 30
+
+    def test_render_merge(self):
+        spec = nodeslo.render_nodeslo(
+            node_labels={"pool": "batch"},
+            cluster_strategies={
+                "resourceUsedThresholdWithBE": {"enable": True, "cpuSuppressThresholdPercent": 55}
+            },
+            node_strategies=[
+                {
+                    "nodeSelector": {"matchLabels": {"pool": "batch"}},
+                    "strategies": {"resourceUsedThresholdWithBE": {"cpuSuppressThresholdPercent": 45}},
+                }
+            ],
+        )
+        be = spec["resourceUsedThresholdWithBE"]
+        assert be["enable"] is True
+        assert be["cpuSuppressThresholdPercent"] == 45  # node override wins
+        assert be["memoryEvictThresholdPercent"] == 70  # default retained
+
+
+class TestProfileMutation:
+    def test_apply_labels_qos_priority(self):
+        pod = {"name": "p", "labels": {"app": "spark"}, "requests": {"cpu": "2", "memory": Gi}}
+        prof = {
+            "name": "batch-profile",
+            "spec": {
+                "selector": {"matchLabels": {"app": "spark"}},
+                "qosClass": "BE",
+                "priorityClassName": "koord-batch",
+                "priorityClassValue": 5500,
+                "koordinatorPriority": 1111,
+                "labels": {"team": "data"},
+                "schedulerName": "koord-scheduler",
+            },
+        }
+        out = profile.mutate_by_profiles(pod, [prof])
+        assert out["qos"] == "BE"
+        assert out["labels"]["team"] == "data"
+        assert out["labels"][profile.LABEL_POD_QOS] == "BE"
+        assert out["scheduler_name"] == "koord-scheduler"
+        # batch pod: native resources translated to batch-* (cpu in milli)
+        assert res.BATCH_CPU in out["requests"] and "cpu" not in out["requests"]
+        assert out["requests"][res.BATCH_CPU] == 2000
+        assert out["requests"][res.BATCH_MEMORY] == Gi
+
+    def test_prod_pod_not_translated(self):
+        pod = {"name": "p", "labels": {}, "requests": {"cpu": "2"}, "priority_class": "koord-prod"}
+        out = profile.mutate_by_profiles(pod, [])
+        assert "cpu" in out["requests"]
+
+    def test_selector_mismatch_skips(self):
+        pod = {"name": "p", "labels": {"app": "web"}}
+        prof = {"spec": {"selector": {"matchLabels": {"app": "spark"}}, "qosClass": "BE"}}
+        out = profile.mutate_by_profiles(pod, [prof])
+        assert "qos" not in out
+
+
+class TestQuotaProfile:
+    def test_ratio_scaled_root(self):
+        nodes = [
+            {"name": "n1", "labels": {"pool": "a"}, "allocatable": {"cpu": "10", "memory": 10 * Gi}},
+            {"name": "n2", "labels": {"pool": "a"}, "allocatable": {"cpu": "6", "memory": 6 * Gi}},
+            {"name": "n3", "labels": {"pool": "b"}, "allocatable": {"cpu": "99", "memory": 99 * Gi}},
+        ]
+        prof = {
+            "name": "pool-a",
+            "spec": {
+                "quotaName": "pool-a-root",
+                "treeID": "tree-a",
+                "nodeSelector": {"matchLabels": {"pool": "a"}},
+                "resourceRatio": "0.5",
+            },
+        }
+        out = quota_profile.reconcile_profile(prof, nodes)
+        assert out["name"] == "pool-a-root"
+        assert out["min"]["cpu"] == 8000  # (10+6 cores = 16000m) * 0.5
+        assert out["min"]["memory"] == 8 * Gi
+        assert out["labels"][quota_profile.LABEL_QUOTA_TREE_ID] == "tree-a"
+
+
+class TestNodeMetricController:
+    def test_reconcile_specs_and_gc(self):
+        nodes = [{"name": "n1", "labels": {}}]
+        existing = {"n1": {}, "gone": {}}
+        s = default_colocation_strategy()
+        desired = nodemetric.reconcile_nodemetrics(nodes, existing, s)
+        assert desired["gone"] is None
+        policy = desired["n1"]["metricCollectPolicy"]
+        assert policy["aggregateDurationSeconds"] == 300
+        assert policy["reportIntervalSeconds"] == 60
+
+
+class TestReviewRegressions:
+    def test_snake_case_node_override_applies(self):
+        cluster = default_colocation_strategy().replace(cpu_reclaim_threshold_percent=50)
+        merged = merge_node_strategy(
+            cluster,
+            {"pool": "batch"},
+            [{"nodeSelector": {"matchLabels": {"pool": "batch"}},
+              "strategy": {"cpu_reclaim_threshold_percent": 80}}],
+        )
+        assert merged.cpu_reclaim_threshold_percent == 80
+
+    def test_unknown_override_key_ignored_not_reset(self):
+        cluster = default_colocation_strategy().replace(cpu_reclaim_threshold_percent=50)
+        merged = merge_node_strategy(
+            cluster,
+            {"pool": "batch"},
+            [{"nodeSelector": {"matchLabels": {"pool": "batch"}},
+              "strategy": {"someUnknownKnob": 1}}],
+        )
+        assert merged.cpu_reclaim_threshold_percent == 50  # untouched
+
+    def test_free_pod_resources_not_translated(self):
+        # reference ResourceNameMap has no koord-free entry
+        pod = {"name": "p", "requests": {"cpu": "2", "memory": Gi}, "priority_class": "koord-free"}
+        out = profile.mutate_by_profiles(pod, [])
+        assert "cpu" in out["requests"] and res.BATCH_CPU not in out["requests"]

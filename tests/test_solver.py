@@ -169,3 +169,44 @@ def test_quota_parity_randomized():
         p["quota"] = "qa" if i % 2 else "qb"
     snap = encode_snapshot(nodes, pods, quotas=quotas)
     _assert_parity(snap, quotas=True)
+
+
+class TestQuotaZeroRuntime:
+    """A declared dimension whose fair-division runtime is 0 must reject,
+    not fall open (quotav1.LessThanOrEqual missing-key=0 semantics)."""
+
+    def test_zero_runtime_dimension_rejects(self):
+        from koordinator_tpu.constraints import build_quota_table_inputs
+
+        nodes = [
+            {"name": "n0", "allocatable": {"cpu": "10", "memory": 8 * 1024**3}}
+        ]
+        pods = [
+            {"name": "p0", "requests": {"cpu": "1"}, "quota": "starved", "priority": 5000}
+        ]
+        quotas = [{"name": "starved", "min": {"cpu": 0}, "max": {"cpu": 0}}]
+        pod_reqs = [res.resource_vector(p["requests"]) for p in pods]
+        total = res.resource_vector({"cpu": "10", "memory": 8 * 1024**3})
+        qdicts = build_quota_table_inputs(quotas, pod_reqs, [0], total)
+        # the declared cpu dim survives with runtime 0
+        assert "cpu" in qdicts[0]["limited"]
+        snap = encode_snapshot(nodes, pods, [], qdicts)
+        result = greedy_assign(snap)
+        assert int(np.asarray(result.assignment)[0]) == -1
+        _assert_parity(snap, quotas=True)
+
+    def test_encode_unknown_gang_and_quota_degrade(self):
+        nodes = [{"name": "n0", "allocatable": {"cpu": "10", "memory": 8 * 1024**3}}]
+        pods = [
+            {
+                "name": "p0",
+                "requests": {"cpu": "1"},
+                "gang": "not-synced",
+                "quota": "not-synced",
+            }
+        ]
+        snap = encode_snapshot(nodes, pods, [], [])
+        assert int(np.asarray(snap.pods.gang_id)[0]) == -1
+        assert int(np.asarray(snap.pods.quota_id)[0]) == -1
+        result = greedy_assign(snap)
+        assert int(np.asarray(result.assignment)[0]) == 0
