@@ -112,7 +112,9 @@ def classify(
         low_eff, high_eff = low_pct, high_pct
     low_q = (low_eff[None, :] * 0.01 * allocatable).astype(np.int64)
     high_q = (high_eff[None, :] * 0.01 * allocatable).astype(np.int64)
-    under = (usage < low_q).all(axis=1)
+    # usage equal to the threshold is still underutilized (isNodeUnderutilized
+    # rejects only used.Cmp(threshold) > 0, utilization_util.go:406)
+    under = (usage <= low_q).all(axis=1)
     if unschedulable is not None:
         under &= ~np.asarray(unschedulable, dtype=bool)
     over = (usage > high_q).any(axis=1)
@@ -187,10 +189,10 @@ def balance(
         if not len(abnormal):
             continue
 
-        # total headroom on destination nodes: sum(highThreshold - usage)
-        total_available = (
-            cls.high_threshold[low_idx] - cls.usage[low_idx]
-        ).sum(axis=0)
+        # destination headroom per low node (node-fit check) and its total:
+        # sum(highThreshold - usage) over underutilized nodes
+        dest_headroom = cls.high_threshold[low_idx] - cls.usage[low_idx]
+        total_available = dest_headroom.sum(axis=0)
 
         # most-loaded first (weighted usage fraction)
         weights = np.array(
@@ -199,9 +201,6 @@ def balance(
         frac = (cls.usage / np.maximum(cls.allocatable, 1)).astype(float)
         load = (frac * weights).sum(axis=1) / max(weights.sum(), 1e-9)
         abnormal = sorted(abnormal, key=lambda i: -load[i])
-
-        # destination headroom per low node, for the node-fit check
-        dest_headroom = cls.high_threshold[low_idx] - cls.usage[low_idx]
 
         name_to_node = {nd["name"]: nd for nd in pool_nodes}
         for i in abnormal:
@@ -239,8 +238,14 @@ def balance(
                 node_usage -= pod_vec
                 total_available -= pod_vec
                 planned.append({"pod": pod["name"], "node": cls.names[i], "pool": pool.name})
-        # only the processed source nodes are excluded from later pools
-        for i in abnormal:
+        # after the round every overutilized source node is marked normal
+        # once (tryMarkNodesAsNormal, low_node_load.go:234: Mark(true) on
+        # existing detectors only) and excluded from later pools
+        # (low_node_load.go:235-237 inserts all sourceNodes)
+        for i in high_idx:
+            d = detectors.get(cls.names[i])
+            if d:
+                d.mark(True, now)
             processed.add(cls.names[i])
     return planned
 

@@ -15,7 +15,6 @@ plugin and the evictor plug in.
 from __future__ import annotations
 
 import dataclasses
-import itertools
 from typing import Callable, Dict, List, Mapping, Optional, Sequence
 
 PENDING = "Pending"

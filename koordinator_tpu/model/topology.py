@@ -1,0 +1,192 @@
+"""CPU / NUMA topology model.
+
+Host side: ``CPUTopology`` describes one node's logical-CPU layout
+(cpu -> core -> NUMA node -> socket), the contract for the cpuset
+accumulator (reference ``pkg/scheduler/plugins/nodenumaresource/cpu_topology.go``,
+populated from the NodeResourceTopology CR by ``topology_options.go``).
+
+Device side: ``ZoneBatch`` encodes every node's NUMA-zone resources as one
+dense ``[N, Z, R]`` tensor so zone-level fit and scoring run batched on TPU
+(reference keeps per-node ``NUMANodeResource`` lists,
+``topology_options.go TopologyOptions.NUMANodeResources``; here the zone
+axis is padded like every other snapshot axis).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.model import resources as res
+
+DEFAULT_AMPLIFICATION_DENOMINATOR = 10_000
+
+
+def amplify(value: int, ratio_x10000: int) -> int:
+    """reference apis/extension/node.go Amplify: ceil(value * ratio).
+
+    Ratios are carried as fixed-point x10000 ints (the reference uses a
+    float64 Ratio; fixed point keeps the tensor math integral).
+    """
+    if ratio_x10000 <= DEFAULT_AMPLIFICATION_DENOMINATOR:
+        return value
+    num = value * ratio_x10000
+    return -(-num // DEFAULT_AMPLIFICATION_DENOMINATOR)  # ceil div
+
+
+@dataclasses.dataclass(frozen=True)
+class CPUInfo:
+    """One logical CPU (reference cpu_topology.go CPUInfo)."""
+
+    cpu: int
+    core: int
+    node: int  # NUMA node id
+    socket: int
+
+
+@dataclasses.dataclass
+class CPUTopology:
+    """Logical-CPU layout of one node (reference cpu_topology.go CPUTopology).
+
+    ``details`` maps cpu id -> CPUInfo.  Derived counts mirror
+    ``CPUsPerCore/CPUsPerNode/CPUsPerSocket`` (cpu_topology.go:51-73).
+    """
+
+    details: Dict[int, CPUInfo]
+
+    @classmethod
+    def build(
+        cls,
+        sockets: int,
+        nodes_per_socket: int,
+        cores_per_node: int,
+        threads_per_core: int = 2,
+    ) -> "CPUTopology":
+        """Synthesize a regular topology (test/e2e helper; the production
+        path decodes the NodeResourceTopology CR annotation).
+
+        CPU ids are contiguous per core (siblings adjacent), the layout the
+        reference synthesizes in its tests
+        (cpu_accumulator_test.go:30 buildCPUTopologyForTest).
+        """
+        details: Dict[int, CPUInfo] = {}
+        cpu = 0
+        core = 0
+        node = 0
+        for s in range(sockets):
+            for _n in range(nodes_per_socket):
+                for _c in range(cores_per_node):
+                    for _t in range(threads_per_core):
+                        details[cpu] = CPUInfo(cpu=cpu, core=core, node=node, socket=s)
+                        cpu += 1
+                    core += 1
+                node += 1
+        return cls(details=details)
+
+    @property
+    def num_cpus(self) -> int:
+        return len(self.details)
+
+    @property
+    def num_cores(self) -> int:
+        return len({i.core for i in self.details.values()})
+
+    @property
+    def num_nodes(self) -> int:
+        return len({i.node for i in self.details.values()})
+
+    @property
+    def num_sockets(self) -> int:
+        return len({i.socket for i in self.details.values()})
+
+    def is_valid(self) -> bool:
+        return self.num_cpus > 0 and self.num_cores > 0
+
+    def cpus_per_core(self) -> int:
+        return self.num_cpus // max(self.num_cores, 1)
+
+    def cpus_per_node(self) -> int:
+        return self.num_cpus // max(self.num_nodes, 1)
+
+    def cpus_per_socket(self) -> int:
+        return self.num_cpus // max(self.num_sockets, 1)
+
+    def cpus_in_node(self, node: int) -> List[int]:
+        return sorted(i.cpu for i in self.details.values() if i.node == node)
+
+    def cpus_in_socket(self, socket: int) -> List[int]:
+        return sorted(i.cpu for i in self.details.values() if i.socket == socket)
+
+    def cpus_in_core(self, core: int) -> List[int]:
+        return sorted(i.cpu for i in self.details.values() if i.core == core)
+
+
+@dataclasses.dataclass
+class ZoneBatch:
+    """Dense per-node NUMA-zone resources, shapes [N, Z, R] / [N, Z].
+
+    ``allocatable``/``requested`` follow the same resource axis as the
+    snapshot; ``valid`` masks real zones (nodes report differing zone
+    counts; Z is the padded max).  ``cpu_amplification`` is the node-level
+    CPU amplification ratio x10000 (reference
+    ``apis/extension/node.go NodeResourceAmplificationRatio``).
+    """
+
+    allocatable: jnp.ndarray  # i64[N, Z, R]
+    requested: jnp.ndarray  # i64[N, Z, R]
+    valid: jnp.ndarray  # bool[N, Z]
+    cpu_amplification: jnp.ndarray  # i32[N] ratio x10000 (10000 = 1.0)
+
+    @property
+    def num_zones(self) -> int:
+        return self.allocatable.shape[1]
+
+
+jax.tree_util.register_dataclass(
+    ZoneBatch,
+    data_fields=["allocatable", "requested", "valid", "cpu_amplification"],
+    meta_fields=[],
+)
+
+
+def encode_zones(
+    nodes: Sequence[Mapping],
+    *,
+    node_bucket: Optional[int] = None,
+    zone_bucket: Optional[int] = None,
+) -> ZoneBatch:
+    """Encode per-node zone dicts into a ZoneBatch.
+
+    Node dict: ``{"zones": [{"allocatable": {res: qty}, "requested": {...}},
+    ...], "cpu_amplification": float}`` — nodes without zones get zero
+    zones (they fall back to node-level accounting in the kernels).
+    """
+    from koordinator_tpu.model.snapshot import pad_bucket
+
+    n_bucket = node_bucket or pad_bucket(len(nodes))
+    max_zones = max((len(nd.get("zones", ())) for nd in nodes), default=0)
+    z_bucket = zone_bucket or max(1, max_zones)
+    R = res.NUM_RESOURCES
+
+    alloc = np.zeros((n_bucket, z_bucket, R), np.int64)
+    reqd = np.zeros((n_bucket, z_bucket, R), np.int64)
+    valid = np.zeros((n_bucket, z_bucket), bool)
+    ampl = np.full((n_bucket,), DEFAULT_AMPLIFICATION_DENOMINATOR, np.int32)
+    for i, nd in enumerate(nodes):
+        for z, zone in enumerate(nd.get("zones", ())):
+            alloc[i, z] = res.resource_vector(zone.get("allocatable", {}))
+            reqd[i, z] = res.resource_vector(zone.get("requested", {}))
+            valid[i, z] = True
+        ratio = nd.get("cpu_amplification")
+        if ratio:
+            ampl[i] = int(round(float(ratio) * DEFAULT_AMPLIFICATION_DENOMINATOR))
+    return ZoneBatch(
+        allocatable=jnp.asarray(alloc),
+        requested=jnp.asarray(reqd),
+        valid=jnp.asarray(valid),
+        cpu_amplification=jnp.asarray(ampl),
+    )

@@ -1,0 +1,23 @@
+"""Host-side scheduler layer.
+
+The batched TPU kernels (``koordinator_tpu.ops``) replace the reference's
+per-(pod, node) Filter/Score loops; everything that is inherently
+sequential, stateful control flow — cpuset accumulation at Reserve,
+topology-hint merging, the plugin pipeline itself — stays on the host in
+this package (reference ``pkg/scheduler/plugins/*`` and
+``pkg/scheduler/frameworkext``).
+"""
+
+from koordinator_tpu.scheduler.cpu_accumulator import (  # noqa: F401
+    CPUAllocation,
+    CPUBindPolicy,
+    CPUExclusivePolicy,
+    NUMAAllocateStrategy,
+    take_cpus,
+    take_preferred_cpus,
+)
+from koordinator_tpu.scheduler.topologymanager import (  # noqa: F401
+    NUMATopologyHint,
+    NUMATopologyPolicy,
+    merge_hints,
+)

@@ -1,0 +1,319 @@
+"""NodeNUMAResource: cpuset accumulator, topology hints, zone kernels.
+
+The accumulator cases replicate the reference's table tests
+(reference pkg/scheduler/plugins/nodenumaresource/cpu_accumulator_test.go:59
+TestTakeFullPCPUs and the NUMALeastAllocated variant at :180) input-for-input
+so placement parity is checked against the exact expected cpusets.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.model.topology import CPUTopology, amplify, encode_zones
+from koordinator_tpu.ops.numa import (
+    POLICY_BEST_EFFORT,
+    POLICY_RESTRICTED,
+    POLICY_SINGLE_NUMA_NODE,
+    amplified_cpu_scores,
+    numa_admit_mask,
+    numa_zone_scores,
+    zone_fit_mask,
+)
+from koordinator_tpu.scheduler import (
+    CPUBindPolicy,
+    NUMAAllocateStrategy,
+    NUMATopologyHint,
+    NUMATopologyPolicy,
+    merge_hints,
+    take_cpus,
+    take_preferred_cpus,
+)
+from koordinator_tpu.scheduler.cpu_accumulator import (
+    CPUAllocation,
+    CPUAllocationError,
+)
+from koordinator_tpu.scheduler.topologymanager import generate_cpu_hints
+
+
+def parse_set(s):
+    """cpuset.MustParse-style '0-5,16-23'."""
+    out = set()
+    for part in s.split(","):
+        if "-" in part:
+            a, b = part.split("-")
+            out |= set(range(int(a), int(b) + 1))
+        elif part:
+            out.add(int(part))
+    return out
+
+
+def _take(topo_args, allocated, needed, strategy, policy=CPUBindPolicy.FULL_PCPUS):
+    topo = CPUTopology.build(*topo_args)
+    available = set(topo.details) - allocated
+    got = take_cpus(
+        topo, available, needed, bind_policy=policy, strategy=strategy
+    )
+    assert len(got) == needed
+    return set(got)
+
+
+# (topology args, allocated, needed, expected) —
+# cpu_accumulator_test.go TestTakeFullPCPUs (NUMAMostAllocated)
+MOST_ALLOCATED_CASES = [
+    ((1, 1, 4, 2), set(), 2, {0, 1}),
+    ((1, 1, 4, 2), {0, 1}, 2, {2, 3}),
+    ((2, 1, 4, 2), set(), 8, parse_set("0-7")),
+    ((2, 1, 4, 2), set(), 12, parse_set("0-11")),
+    ((2, 1, 4, 2), {0, 1}, 8, parse_set("8-15")),
+    ((2, 2, 4, 2), parse_set("0-5,16-23"), 6, parse_set("24-29")),
+    ((2, 2, 4, 2), parse_set("0-5,16-23"), 12, parse_set("6-15,24-25")),
+    ((2, 2, 4, 2), parse_set("0-3,8-11"), 4, parse_set("4-7")),
+    ((2, 2, 2, 2), {0, 2, 4, 8, 12}, 4, {10, 11, 14, 15}),
+    ((2, 2, 2, 2), {0, 2, 4, 8, 10, 12}, 6, {5, 6, 7, 13, 14, 15}),
+    ((2, 2, 2, 2), {0, 2, 4, 8, 9, 10, 12}, 6, {6, 7, 11, 13, 14, 15}),
+]
+
+# cpu_accumulator_test.go:180 variant (NUMALeastAllocated)
+LEAST_ALLOCATED_CASES = [
+    ((1, 1, 4, 2), set(), 2, {0, 1}),
+    ((1, 1, 4, 2), {0, 1}, 2, {2, 3}),
+    ((2, 1, 4, 2), set(), 8, parse_set("0-7")),
+    ((2, 1, 4, 2), set(), 12, parse_set("0-11")),
+    ((2, 1, 4, 2), {0, 1}, 8, parse_set("8-15")),
+    ((2, 2, 4, 2), parse_set("0-5,16-23"), 6, parse_set("8-13")),
+    ((2, 2, 4, 2), parse_set("0-5,16-23"), 12, parse_set("6-15,24-25")),
+    ((2, 2, 4, 2), parse_set("0-3,8-11"), 4, parse_set("16-19")),
+    ((2, 2, 2, 2), {0, 2, 4, 8, 12}, 4, {10, 11, 14, 15}),
+    ((2, 2, 2, 2), {0, 2, 4, 8, 10, 12}, 6, {6, 7, 14, 15, 1, 3}),
+    ((2, 2, 4, 2), {0, 2, 4, 8, 9, 10, 12}, 6, parse_set("16-21")),
+]
+
+
+class TestCPUAccumulator:
+    @pytest.mark.parametrize("topo_args,allocated,needed,want", MOST_ALLOCATED_CASES)
+    def test_full_pcpus_most_allocated(self, topo_args, allocated, needed, want):
+        got = _take(topo_args, allocated, needed, NUMAAllocateStrategy.MOST_ALLOCATED)
+        assert got == want
+
+    @pytest.mark.parametrize("topo_args,allocated,needed,want", LEAST_ALLOCATED_CASES)
+    def test_full_pcpus_least_allocated(self, topo_args, allocated, needed, want):
+        got = _take(topo_args, allocated, needed, NUMAAllocateStrategy.LEAST_ALLOCATED)
+        assert got == want
+
+    def test_spread_by_pcpus_one_per_core(self):
+        topo = CPUTopology.build(1, 1, 4, 2)
+        got = take_cpus(
+            topo,
+            set(topo.details),
+            4,
+            bind_policy=CPUBindPolicy.SPREAD_BY_PCPUS,
+            strategy=NUMAAllocateStrategy.MOST_ALLOCATED,
+        )
+        # one cpu from each of the 4 cores
+        assert {topo.details[c].core for c in got} == {0, 1, 2, 3}
+
+    def test_not_enough_cpus(self):
+        topo = CPUTopology.build(1, 1, 2, 2)
+        with pytest.raises(CPUAllocationError):
+            take_cpus(topo, {0, 1}, 3)
+
+    def test_preferred_cpus_taken_first(self):
+        topo = CPUTopology.build(2, 1, 4, 2)
+        got = take_preferred_cpus(
+            topo, set(topo.details), preferred={8, 9}, num_needed=4
+        )
+        assert {8, 9} <= set(got)
+        assert len(got) == 4
+
+    def test_exclusive_pcpu_level_avoids_marked_cores(self):
+        # cpu_accumulator_test.go:457 "allocate overlapped cpus with PCPULevel":
+        # with core 0 marked exclusive, a new PCPULevel pod lands elsewhere.
+        topo = CPUTopology.build(2, 1, 4, 2)
+        allocated = CPUAllocation(
+            ref_count={0: 1, 1: 1},
+            exclusive_policy={0: "PCPULevel", 1: "PCPULevel"},
+        )
+        from koordinator_tpu.scheduler import CPUExclusivePolicy
+
+        got = take_cpus(
+            topo,
+            set(topo.details) - {0, 1},
+            2,
+            allocated=allocated,
+            exclusive_policy=CPUExclusivePolicy.PCPU_LEVEL,
+        )
+        assert {topo.details[c].core for c in got} & {0} == set()
+
+
+class TestTopologyManager:
+    def test_policy_none_always_admits(self):
+        hint, admit = merge_hints(NUMATopologyPolicy.NONE, [0, 1], [])
+        assert admit and hint.affinity is None
+
+    def test_single_numa_node_prefers_one_node(self):
+        hints = [{"cpu": [NUMATopologyHint(0b01, True), NUMATopologyHint(0b11, False)]}]
+        hint, admit = merge_hints(NUMATopologyPolicy.SINGLE_NUMA_NODE, [0, 1], hints)
+        assert admit and hint.affinity == 0b01 and hint.preferred
+
+    def test_single_numa_node_rejects_cross_node_only(self):
+        hints = [{"cpu": [NUMATopologyHint(0b11, False)]}]
+        hint, admit = merge_hints(NUMATopologyPolicy.SINGLE_NUMA_NODE, [0, 1], hints)
+        assert not admit
+
+    def test_restricted_rejects_unpreferred(self):
+        hints = [{"cpu": [NUMATopologyHint(0b11, False)]}]
+        _, admit = merge_hints(NUMATopologyPolicy.RESTRICTED, [0, 1], hints)
+        assert not admit
+
+    def test_best_effort_admits_unpreferred(self):
+        hints = [{"cpu": [NUMATopologyHint(0b11, False)]}]
+        hint, admit = merge_hints(NUMATopologyPolicy.BEST_EFFORT, [0, 1], hints)
+        assert admit and hint.affinity == 0b11
+
+    def test_cross_provider_intersection(self):
+        # cpu prefers node0, device prefers node0|node1 -> merged node0
+        hints = [
+            {"cpu": [NUMATopologyHint(0b01, True)]},
+            {"device": [NUMATopologyHint(0b01, True), NUMATopologyHint(0b10, True)]},
+        ]
+        hint, admit = merge_hints(NUMATopologyPolicy.BEST_EFFORT, [0, 1], hints)
+        assert admit and hint.affinity == 0b01 and hint.preferred
+
+    def test_generate_cpu_hints_minimal_width_preferred(self):
+        hints = generate_cpu_hints({0: 4, 1: 8}, 6)["cpu"]
+        by_mask = {h.affinity: h for h in hints}
+        assert by_mask[0b10].preferred  # node1 alone fits
+        assert not by_mask[0b11].preferred  # pair is wider
+        assert 0b01 not in by_mask  # node0 alone can't fit
+
+
+def _zones(node_specs):
+    return encode_zones(node_specs, node_bucket=len(node_specs))
+
+
+class TestZoneKernels:
+    def setup_method(self):
+        self.zb = _zones(
+            [
+                {
+                    "zones": [
+                        {"allocatable": {"cpu": "8", "memory": "16Gi"}},
+                        {
+                            "allocatable": {"cpu": "8", "memory": "16Gi"},
+                            "requested": {"cpu": "6", "memory": "12Gi"},
+                        },
+                    ]
+                },
+                {"zones": []},  # node without NRT
+            ]
+        )
+        self.pods = jnp.asarray(
+            np.array(
+                [
+                    res.resource_vector({"cpu": "4", "memory": "8Gi"}),
+                    res.resource_vector({"cpu": "12", "memory": "1Gi"}),
+                ],
+                dtype=np.int64,
+            )
+        )
+
+    def test_zone_fit(self):
+        fits = np.asarray(
+            zone_fit_mask(
+                self.pods, self.zb.allocatable, self.zb.requested, self.zb.valid
+            )
+        )
+        # pod0 (4c) fits zone0 (free 8c) but not zone1 (free 2c)
+        assert fits[0, 0, 0] and not fits[0, 0, 1]
+        # pod1 (12c) fits no single zone
+        assert not fits[1, 0].any()
+
+    def test_admit_by_policy(self):
+        for policy, want_pod1 in [
+            (POLICY_SINGLE_NUMA_NODE, False),
+            (POLICY_RESTRICTED, False),  # union free cpu = 10 < 12
+            (POLICY_BEST_EFFORT, True),
+        ]:
+            admit = np.asarray(
+                numa_admit_mask(
+                    self.pods,
+                    self.zb.allocatable,
+                    self.zb.requested,
+                    self.zb.valid,
+                    jnp.full((2,), policy, jnp.int32),
+                )
+            )
+            assert admit[0, 0], policy
+            assert admit[1, 0] == want_pod1, policy
+            # node without zones always admits
+            assert admit[:, 1].all(), policy
+
+    def test_zone_scores_pick_allocator_zone(self):
+        weights = jnp.asarray(
+            np.array([1 if r in ("cpu", "memory") else 0 for r in res.RESOURCE_AXIS]),
+            dtype=jnp.int64,
+        )
+        scores = np.asarray(
+            numa_zone_scores(
+                self.pods,
+                self.zb.allocatable,
+                self.zb.requested,
+                self.zb.valid,
+                weights,
+                most_allocated=False,
+            )
+        )
+        # pod0 on node0: only zone0 fits -> least-allocated score of zone0
+        # after placement: cpu (8-4)/8*100=50, mem (16-8)/16*100=50 -> 50
+        assert scores[0, 0] == 50
+        # pod1 fits nowhere on node0 -> 0
+        assert scores[1, 0] == 0
+
+    def test_amplify_fixed_point(self):
+        assert amplify(1000, 10_000) == 1000  # ratio 1.0
+        assert amplify(1000, 15_000) == 1500
+        assert amplify(1, 15_000) == 2  # ceil
+        assert amplify(1000, 5_000) == 1000  # ratios < 1 don't shrink
+
+    def test_amplified_cpu_scores_parity(self):
+        # one node: allocatable 32c (amplified), 8000m held by cpuset pods,
+        # ratio 2.0 -> requested' = req - 8000 + 16000
+        R = res.NUM_RESOURCES
+        cpu = res.RESOURCE_INDEX[res.CPU]
+        node_alloc = np.zeros((1, R), np.int64)
+        node_alloc[0, cpu] = 32_000
+        node_req = np.zeros((1, R), np.int64)
+        node_req[0, cpu] = 10_000
+        pod = np.zeros((1, R), np.int64)
+        pod[0, cpu] = 2_000
+        weights = np.zeros((R,), np.int64)
+        weights[cpu] = 1
+        scores = np.asarray(
+            amplified_cpu_scores(
+                jnp.asarray(pod),
+                jnp.asarray(node_req),
+                jnp.asarray(node_alloc),
+                jnp.asarray(np.array([8_000], np.int64)),
+                jnp.asarray(np.array([20_000], np.int32)),
+                jnp.asarray(weights),
+            )
+        )
+        # requested' = 10000-8000+16000 = 18000; +pod 2000 = 20000
+        # least: (32000-20000)*100/32000 = 37 (int div)
+        assert scores[0, 0] == 37
+
+
+class TestTopologyModel:
+    def test_build_counts(self):
+        topo = CPUTopology.build(2, 2, 4, 2)
+        assert topo.num_cpus == 32
+        assert topo.num_cores == 16
+        assert topo.num_nodes == 4
+        assert topo.num_sockets == 2
+        assert topo.cpus_per_core() == 2
+        assert topo.cpus_per_node() == 8
+        assert topo.cpus_per_socket() == 16
+        assert topo.cpus_in_node(0) == list(range(8))
+        assert topo.cpus_in_core(0) == [0, 1]
