@@ -1,0 +1,113 @@
+"""Device (GPU / RDMA / FPGA / TPU) data model for DeviceShare.
+
+The reference keeps a per-node device cache keyed by device type and minor
+(reference ``pkg/scheduler/plugins/deviceshare/device_cache.go:44
+nodeDevice``: ``deviceTotal/deviceFree/deviceUsed[type][minor]``).  Here
+every node's device minors are one dense ``[N, D, C]`` tensor (D = padded
+minors per node across all types, C = device resource dims), typed by a
+``[N, D]`` device-type code, so device fit counting runs batched on TPU.
+
+On a TPU cluster the GPU type code doubles for TPU chips — device
+enumeration comes from the platform (koordlet's device collector) but the
+allocation math is identical shares-of-100 accounting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.model import resources as res
+
+# Device type codes ([N, D] tensor values; reference
+# apis/scheduling/v1alpha1/device_types.go DeviceType)
+DEVICE_GPU = 0
+DEVICE_RDMA = 1
+DEVICE_FPGA = 2
+DEVICE_TYPE_NAMES = {"gpu": DEVICE_GPU, "rdma": DEVICE_RDMA, "fpga": DEVICE_FPGA}
+
+# Device resource dims (the C axis).  Order is part of the device ABI.
+DEVICE_RESOURCE_AXIS = (
+    res.GPU_CORE,
+    res.GPU_MEMORY,
+    res.GPU_MEMORY_RATIO,
+    res.RDMA,
+    res.FPGA,
+)
+NUM_DEVICE_RESOURCES = len(DEVICE_RESOURCE_AXIS)
+DEVICE_RESOURCE_INDEX = {n: i for i, n in enumerate(DEVICE_RESOURCE_AXIS)}
+
+# Which device resources each type supports (reference
+# pkg/scheduler/plugins/deviceshare/utils.go DeviceResourceNames)
+DEVICE_TYPE_RESOURCES = {
+    DEVICE_GPU: (res.GPU_CORE, res.GPU_MEMORY, res.GPU_MEMORY_RATIO),
+    DEVICE_RDMA: (res.RDMA,),
+    DEVICE_FPGA: (res.FPGA,),
+}
+
+
+def device_resource_vector(rl: Mapping[str, object]) -> np.ndarray:
+    full = res.resource_vector(rl or {})
+    return np.array(
+        [full[res.RESOURCE_INDEX[n]] for n in DEVICE_RESOURCE_AXIS], dtype=np.int64
+    )
+
+
+@dataclasses.dataclass
+class DeviceBatch:
+    """Dense per-node device minors, shapes [N, D, C] / [N, D]."""
+
+    total: jnp.ndarray  # i64[N, D, C]
+    free: jnp.ndarray  # i64[N, D, C]
+    dev_type: jnp.ndarray  # i32[N, D] DEVICE_* code
+    valid: jnp.ndarray  # bool[N, D] healthy minor exists
+
+    @property
+    def minors(self) -> int:
+        return self.total.shape[1]
+
+
+jax.tree_util.register_dataclass(
+    DeviceBatch, data_fields=["total", "free", "dev_type", "valid"], meta_fields=[]
+)
+
+
+def encode_devices(
+    nodes: Sequence[Mapping],
+    *,
+    node_bucket: Optional[int] = None,
+    minor_bucket: Optional[int] = None,
+) -> DeviceBatch:
+    """Encode per-node device dicts into a DeviceBatch.
+
+    Node dict: ``{"devices": [{"type": "gpu", "minor": 0,
+    "total": {res: qty}, "free": {...}}, ...]}``.  ``free`` defaults to
+    ``total`` (an unallocated healthy device).
+    """
+    from koordinator_tpu.model.snapshot import pad_bucket
+
+    n_bucket = node_bucket or pad_bucket(len(nodes))
+    max_minors = max((len(nd.get("devices", ())) for nd in nodes), default=0)
+    d_bucket = minor_bucket or max(1, max_minors)
+    C = NUM_DEVICE_RESOURCES
+
+    total = np.zeros((n_bucket, d_bucket, C), np.int64)
+    free = np.zeros((n_bucket, d_bucket, C), np.int64)
+    dtype = np.zeros((n_bucket, d_bucket), np.int32)
+    valid = np.zeros((n_bucket, d_bucket), bool)
+    for i, nd in enumerate(nodes):
+        for j, dev in enumerate(nd.get("devices", ())):
+            total[i, j] = device_resource_vector(dev.get("total", {}))
+            free[i, j] = device_resource_vector(dev.get("free", dev.get("total", {})))
+            dtype[i, j] = DEVICE_TYPE_NAMES.get(str(dev.get("type", "gpu")).lower(), 0)
+            valid[i, j] = True
+    return DeviceBatch(
+        total=jnp.asarray(total),
+        free=jnp.asarray(free),
+        dev_type=jnp.asarray(dtype),
+        valid=jnp.asarray(valid),
+    )

@@ -1,0 +1,177 @@
+"""Reservation data model: reservations as dense tables + owner matching.
+
+The reference models a Reservation as a pseudo-pod occupying its reserved
+resources on a node, restored into NodeInfo per scheduling pod by the
+transformer (reference ``pkg/scheduler/plugins/reservation/transformer.go:39
+BeforePreFilter``).  Here a cycle carries one ``ReservationTable`` with a
+host-precomputed ``matched[P, V]`` owner matrix, and the restore becomes a
+segment-sum over the node axis inside the jitted cycle
+(``koordinator_tpu.ops.reservation``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.model import resources as res
+
+# Allocate policies (reference apis/scheduling/v1alpha1/reservation_types.go
+# ReservationAllocatePolicy)
+ALLOCATE_POLICY_DEFAULT = 0
+ALLOCATE_POLICY_ALIGNED = 1
+ALLOCATE_POLICY_RESTRICTED = 2
+
+
+@dataclasses.dataclass
+class ReservationTable:
+    """Dense reservation state, shapes [V] / [V, R] (+ matched [P, V]).
+
+    ``remaining = allocatable - allocated`` is what a matching pod may take;
+    ``declared`` marks the nonzero allocatable dims (the reference scores
+    and restricts only over ``quotav1.RemoveZeros(allocatable)``,
+    scoring.go:186).
+    """
+
+    node_index: jnp.ndarray  # i32[V] node the reservation is bound to, -1 unbound
+    allocatable: jnp.ndarray  # i64[V, R]
+    allocated: jnp.ndarray  # i64[V, R] already taken by owner pods
+    declared: jnp.ndarray  # bool[V, R]
+    allocate_policy: jnp.ndarray  # i32[V] ALLOCATE_POLICY_*
+    order: jnp.ndarray  # i64[V] LabelReservationOrder, 0 = unset
+    unschedulable: jnp.ndarray  # bool[V]
+    valid: jnp.ndarray  # bool[V]
+    matched: jnp.ndarray  # bool[P, V] owner match per pending pod
+    names: Tuple[str, ...] = ()
+
+    @property
+    def capacity(self) -> int:
+        return self.allocatable.shape[0]
+
+    @property
+    def remaining(self) -> jnp.ndarray:
+        return self.allocatable - self.allocated
+
+
+jax.tree_util.register_dataclass(
+    ReservationTable,
+    data_fields=[
+        "node_index",
+        "allocatable",
+        "allocated",
+        "declared",
+        "allocate_policy",
+        "order",
+        "unschedulable",
+        "valid",
+        "matched",
+    ],
+    meta_fields=["names"],
+)
+
+
+def match_owners(pod: Mapping, owners: Sequence[Mapping]) -> bool:
+    """reference ``pkg/util/reservation`` MatchReservationOwners: a pod may
+    allocate a reservation if any owner entry matches — by exact object
+    reference (namespace/name), controller reference, or label selector.
+    """
+    for owner in owners or ():
+        obj = owner.get("object")
+        if obj is not None:
+            if obj.get("name") == pod.get("name") and obj.get(
+                "namespace", "default"
+            ) == pod.get("namespace", "default"):
+                return True
+            continue
+        controller = owner.get("controller")
+        if controller is not None:
+            ref = pod.get("owner_ref") or {}
+            if controller.get("name") == ref.get("name") and controller.get(
+                "namespace", pod.get("namespace", "default")
+            ) == pod.get("namespace", "default"):
+                return True
+            continue
+        selector = owner.get("label_selector")
+        if selector is not None:
+            labels = pod.get("labels", {})
+            if all(labels.get(k) == v for k, v in selector.items()):
+                return True
+    return False
+
+
+_POLICY_NAMES = {
+    "Default": ALLOCATE_POLICY_DEFAULT,
+    "Aligned": ALLOCATE_POLICY_ALIGNED,
+    "Restricted": ALLOCATE_POLICY_RESTRICTED,
+}
+
+
+def encode_reservations(
+    reservations: Sequence[Mapping],
+    pods: Sequence[Mapping],
+    node_names: Sequence[str],
+    *,
+    pod_bucket: Optional[int] = None,
+    reservation_bucket: Optional[int] = None,
+) -> ReservationTable:
+    """Encode reservation dicts + pending pods into a ReservationTable.
+
+    Reservation dict: ``{"name", "node": node-name, "allocatable": {...},
+    "allocated": {...}, "owners": [...], "allocate_policy":
+    "Default"|"Aligned"|"Restricted", "order": int, "allocate_once": bool,
+    "assigned_pods": int, "unschedulable": bool}``.
+
+    AllocateOnce reservations that already have assigned pods are dropped
+    from the table entirely (the reference skips them during restore,
+    transformer.go:95).
+    """
+    from koordinator_tpu.model.snapshot import pad_bucket
+
+    active = [
+        r
+        for r in reservations
+        if not (r.get("allocate_once") and r.get("assigned_pods", 0) > 0)
+    ]
+    v_bucket = reservation_bucket or pad_bucket(max(len(active), 1))
+    p_bucket = pod_bucket or pad_bucket(max(len(pods), 1))
+    R = res.NUM_RESOURCES
+    node_idx = {n: i for i, n in enumerate(node_names)}
+
+    node_index = np.full((v_bucket,), -1, np.int32)
+    alloc = np.zeros((v_bucket, R), np.int64)
+    allocated = np.zeros((v_bucket, R), np.int64)
+    declared = np.zeros((v_bucket, R), bool)
+    policy = np.zeros((v_bucket,), np.int32)
+    order = np.zeros((v_bucket,), np.int64)
+    unsched = np.zeros((v_bucket,), bool)
+    valid = np.zeros((v_bucket,), bool)
+    matched = np.zeros((p_bucket, v_bucket), bool)
+
+    for i, r in enumerate(active):
+        node_index[i] = node_idx.get(r.get("node"), -1)
+        alloc[i] = res.resource_vector(r.get("allocatable", {}))
+        allocated[i] = res.resource_vector(r.get("allocated", {}))
+        declared[i] = alloc[i] != 0
+        policy[i] = _POLICY_NAMES.get(r.get("allocate_policy", "Default"), 0)
+        order[i] = int(r.get("order", 0))
+        unsched[i] = bool(r.get("unschedulable"))
+        valid[i] = node_index[i] >= 0
+        for p, pod in enumerate(pods):
+            matched[p, i] = valid[i] and match_owners(pod, r.get("owners", ()))
+
+    return ReservationTable(
+        node_index=jnp.asarray(node_index),
+        allocatable=jnp.asarray(alloc),
+        allocated=jnp.asarray(allocated),
+        declared=jnp.asarray(declared),
+        allocate_policy=jnp.asarray(policy),
+        order=jnp.asarray(order),
+        unschedulable=jnp.asarray(unsched),
+        valid=jnp.asarray(valid),
+        matched=jnp.asarray(matched),
+        names=tuple(r.get("name", f"rsv-{i}") for i, r in enumerate(active)),
+    )

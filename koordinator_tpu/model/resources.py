@@ -27,6 +27,9 @@ MID_CPU = "kubernetes.io/mid-cpu"
 MID_MEMORY = "kubernetes.io/mid-memory"
 GPU_CORE = "koordinator.sh/gpu-core"
 GPU_MEMORY_RATIO = "koordinator.sh/gpu-memory-ratio"
+GPU_MEMORY = "koordinator.sh/gpu-memory"
+RDMA = "koordinator.sh/rdma"
+FPGA = "koordinator.sh/fpga"
 
 RESOURCE_AXIS = (
     CPU,
@@ -39,6 +42,9 @@ RESOURCE_AXIS = (
     MID_MEMORY,
     GPU_CORE,
     GPU_MEMORY_RATIO,
+    GPU_MEMORY,
+    RDMA,
+    FPGA,
 )
 NUM_RESOURCES = len(RESOURCE_AXIS)
 RESOURCE_INDEX: Dict[str, int] = {name: i for i, name in enumerate(RESOURCE_AXIS)}

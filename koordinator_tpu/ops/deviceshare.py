@@ -1,0 +1,216 @@
+"""DeviceShare fit / scoring as batched tensors + exact minor allocation.
+
+Reference: ``pkg/scheduler/plugins/deviceshare``:
+
+* Requests are shares-of-100 per card; a request whose gpu-memory-ratio is a
+  multiple of 100 spans ``ratio/100`` whole cards at ``request/wanted`` per
+  card (``device_cache.go:367 calcDeviceWanted``).
+* gpu-memory and gpu-memory-ratio fill each other from the card's total
+  memory (``utils.go:211 fillGPUTotalMem``) — node-dependent, so the
+  normalized request is a ``[P, N, C]`` tensor here.
+* Filter: a node fits if, per requested device type, at least ``wanted``
+  minors have ``free >= perCard`` (``device_cache.go:329-352``).
+* Score: least/most-allocated over summed minor resources
+  (``scoring.go:179 scoreNode``).
+* The per-minor choice on the selected node replays the reference's exact
+  ordering host-side (``allocate_minors``; ``device_resources.go:161,177``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.model.device import (
+    DEVICE_GPU,
+    DEVICE_RESOURCE_INDEX,
+    DEVICE_TYPE_RESOURCES,
+    DeviceBatch,
+    NUM_DEVICE_RESOURCES,
+)
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.model.snapshot import MAX_NODE_SCORE
+from koordinator_tpu.ops.scoring import (
+    least_requested_score,
+    most_requested_score,
+    weighted_resource_score,
+)
+
+_CORE = DEVICE_RESOURCE_INDEX[res.GPU_CORE]
+_MEM = DEVICE_RESOURCE_INDEX[res.GPU_MEMORY]
+_RATIO = DEVICE_RESOURCE_INDEX[res.GPU_MEMORY_RATIO]
+
+
+def pod_device_requests(pod_requests: jnp.ndarray) -> jnp.ndarray:
+    """i64[P, C]: project snapshot resource rows onto the device axis."""
+    idx = jnp.asarray(
+        [res.RESOURCE_INDEX[n] for n in
+         (res.GPU_CORE, res.GPU_MEMORY, res.GPU_MEMORY_RATIO, res.RDMA, res.FPGA)],
+        dtype=jnp.int32,
+    )
+    return pod_requests[:, idx]
+
+
+def gpu_card_total_memory(devices: DeviceBatch) -> jnp.ndarray:
+    """i64[N]: per-node GPU card memory (all cards on a node are the same
+    model — utils.go:225)."""
+    is_gpu = (devices.dev_type == DEVICE_GPU) & devices.valid
+    mem = jnp.where(is_gpu, devices.total[:, :, _MEM], 0)
+    return mem.max(axis=1)
+
+
+def normalize_gpu_requests(
+    dev_requests: jnp.ndarray,  # i64[P, C]
+    card_mem: jnp.ndarray,  # i64[N]
+) -> jnp.ndarray:
+    """i64[P, N, C]: fill gpu-memory <-> gpu-memory-ratio per node
+    (fillGPUTotalMem): a memory-only request derives its ratio from the
+    node's card memory and vice versa."""
+    P = dev_requests.shape[0]
+    N = card_mem.shape[0]
+    out = jnp.broadcast_to(dev_requests[:, None, :], (P, N, dev_requests.shape[1]))
+    mem_req = dev_requests[:, _MEM][:, None]  # [P, 1]
+    ratio_req = dev_requests[:, _RATIO][:, None]
+    safe_card = jnp.maximum(card_mem, 1)[None, :]  # [1, N]
+    derived_ratio = mem_req * 100 // safe_card
+    derived_mem = ratio_req * card_mem[None, :] // 100
+    new_ratio = jnp.where(mem_req > 0, derived_ratio, ratio_req)
+    new_mem = jnp.where(mem_req > 0, mem_req, derived_mem)
+    out = out.at[:, :, _RATIO].set(new_ratio)
+    out = out.at[:, :, _MEM].set(new_mem)
+    return out
+
+
+def split_per_card(norm_requests: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(perCard i64[P, N, C], wanted i64[P, N]) — calcDeviceWanted: a ratio
+    that is a positive multiple of 100 spans ratio/100 cards."""
+    ratio = norm_requests[..., _RATIO]
+    multi = (ratio >= 100) & (ratio % 100 == 0)
+    wanted = jnp.where(multi, ratio // 100, 1)
+    per_card = norm_requests // jnp.maximum(wanted, 1)[..., None]
+    return per_card, wanted
+
+
+def device_fit_mask(
+    pod_requests: jnp.ndarray,  # i64[P, R] (snapshot axis)
+    devices: DeviceBatch,
+) -> jnp.ndarray:
+    """bool[P, N]: every requested device type has >= wanted satisfying minors."""
+    dev_req = pod_device_requests(pod_requests)  # [P, C]
+    card_mem = gpu_card_total_memory(devices)  # [N]
+    norm = normalize_gpu_requests(dev_req, card_mem)  # [P, N, C]
+    per_card, wanted = split_per_card(norm)
+
+    ok = jnp.ones((dev_req.shape[0], devices.total.shape[0]), bool)
+    for type_code, type_resources in DEVICE_TYPE_RESOURCES.items():
+        dims = jnp.asarray(
+            [DEVICE_RESOURCE_INDEX[n] for n in type_resources], dtype=jnp.int32
+        )
+        req_t = norm[:, :, dims]  # [P, N, Ct]
+        requested_type = jnp.any(dev_req[:, dims] > 0, axis=-1)  # [P]
+        minors_of_type = (devices.dev_type == type_code) & devices.valid  # [N, D]
+        free_t = devices.free[:, :, dims]  # [N, D, Ct]
+        per_card_t = per_card[:, :, dims]  # [P, N, Ct]
+        satisfied = jnp.all(
+            per_card_t[:, :, None, :] <= free_t[None, :, :, :], axis=-1
+        )  # [P, N, D]
+        satisfied &= minors_of_type[None, :, :]
+        count = satisfied.sum(axis=-1)  # [P, N]
+        type_ok = count >= wanted
+        ok &= jnp.where(requested_type[:, None], type_ok, True)
+        # requesting a type the node doesn't have at all fails
+        has_type = jnp.any(minors_of_type, axis=-1)  # [N]
+        ok &= jnp.where(
+            requested_type[:, None], has_type[None, :] | type_ok, True
+        )
+    return ok
+
+
+def deviceshare_scores(
+    pod_requests: jnp.ndarray,  # i64[P, R]
+    devices: DeviceBatch,
+    weights: Optional[jnp.ndarray] = None,  # i64[C]
+    *,
+    most_allocated: bool = False,
+) -> jnp.ndarray:
+    """i64[P, N]: scoreNode (scoring.go:179) — least/most allocated over
+    per-type summed minor resources; types the pod doesn't request
+    contribute weight 0 (the reference masks podRequest per type)."""
+    dev_req = pod_device_requests(pod_requests)  # [P, C]
+    card_mem = gpu_card_total_memory(devices)
+    norm = normalize_gpu_requests(dev_req, card_mem)  # [P, N, C]
+
+    total = jnp.where(devices.valid[:, :, None], devices.total, 0).sum(axis=1)
+    free = jnp.where(devices.valid[:, :, None], devices.free, 0).sum(axis=1)
+    used = total - free  # [N, C]
+    requested = used[None, :, :] + norm  # [P, N, C]
+    if most_allocated:
+        per_res = most_requested_score(requested, total[None, :, :])
+    else:
+        per_res = least_requested_score(requested, total[None, :, :])
+    if weights is None:
+        weights = jnp.ones((NUM_DEVICE_RESOURCES,), jnp.int64)
+    # weight only the dims the pod requests (scoreNode skips total==0 dims;
+    # requested-dim masking keeps non-requested types out of the mean)
+    w = weights[None, None, :] * (norm > 0)
+    wsum = jnp.maximum(w.sum(axis=-1), 1)
+    return (per_res * w).sum(axis=-1) // wsum
+
+
+def allocate_minors(
+    minors: Sequence[Mapping],
+    per_card: Mapping[str, int],
+    wanted: int,
+    *,
+    preferred: Optional[Set[int]] = None,
+    required: Optional[Set[int]] = None,
+    most_allocated: bool = False,
+) -> List[int]:
+    """Host-side exact minor selection on the chosen node.
+
+    ``minors``: ``[{"minor": int, "total": {dim: qty}, "free": {dim: qty}}]``.
+    Ordering parity with scoreDevices + sortDeviceResourcesByMinor
+    (device_resources.go:161,177): preferred minors first, then score
+    descending (scoreDevice), then minor ascending; the first ``wanted``
+    satisfying minors win.  Raises ValueError when the node can't satisfy.
+    """
+    preferred = preferred or set()
+    required = required or set()
+
+    def score(m) -> int:
+        s = 0
+        n = 0
+        for dim, total in (m.get("total") or {}).items():
+            total = int(total)
+            if total == 0:
+                continue
+            free = int((m.get("free") or {}).get(dim, 0))
+            req = total - free + int(per_card.get(dim, 0)) if total >= free else total
+            if most_allocated:
+                val = max(0, MAX_NODE_SCORE * req // total) if req <= total else 0
+            else:
+                val = (total - req) * MAX_NODE_SCORE // total if req <= total else 0
+            s += val
+            n += 1
+        return s // n if n else 0
+
+    ranked = sorted(
+        minors,
+        key=lambda m: (
+            m["minor"] not in preferred,
+            -score(m),
+            m["minor"],
+        ),
+    )
+    out: List[int] = []
+    for m in ranked:
+        if required and m["minor"] not in required:
+            continue
+        free = m.get("free") or {}
+        if all(int(free.get(d, 0)) >= q for d, q in per_card.items()):
+            out.append(m["minor"])
+            if len(out) == wanted:
+                return out
+    raise ValueError(f"node cannot satisfy {wanted} device minors")

@@ -1,0 +1,154 @@
+"""Reservation restore / fit / scoring as batched tensors.
+
+Reference: ``pkg/scheduler/plugins/reservation``:
+
+* BeforePreFilter (``transformer.go:39``): for each node, matched
+  reservations' unallocated remainder is returned to the node's free space
+  for the scheduling pod — here a per-pod segment-sum over the node axis.
+* Filter: Aligned/Restricted policies constrain the pod to the matched
+  reservation's remaining resources (``plugin.go filterWithReservations``).
+* PreScore/Score (``scoring.go:42,105,177``): nodes with a matching
+  reservation score by MostAllocated over the reservation's declared
+  resources; the node carrying the smallest nonzero reservation-order
+  label is the preferred node and scores max.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from koordinator_tpu.model.reservation import (
+    ALLOCATE_POLICY_ALIGNED,
+    ALLOCATE_POLICY_RESTRICTED,
+    ReservationTable,
+)
+from koordinator_tpu.model.snapshot import MAX_NODE_SCORE
+
+_LONG_MAX = jnp.int64(2**62)
+
+
+def _remaining_by_node(rsv: ReservationTable, num_nodes: int) -> jnp.ndarray:
+    """i64[V, N, R] -> segment view helper is avoided; scatter-add each
+    reservation's remainder onto its node row: i64[N, R]."""
+    safe_idx = jnp.where(rsv.valid, rsv.node_index, 0)
+    contrib = jnp.where(rsv.valid[:, None], rsv.remaining, 0)
+    return (
+        jnp.zeros((num_nodes, contrib.shape[-1]), contrib.dtype)
+        .at[safe_idx]
+        .add(jnp.where(rsv.valid[:, None], contrib, 0))
+    )
+
+
+def restored_node_free(
+    node_allocatable: jnp.ndarray,  # i64[N, R]
+    node_requested: jnp.ndarray,  # i64[N, R]
+    rsv: ReservationTable,
+) -> jnp.ndarray:
+    """i64[P, N, R]: per-pod free space after restoring matched reservations.
+
+    The reserve pseudo-pod holds the reservation's full allocatable in
+    ``node_requested``; a matching pod sees the unallocated remainder of its
+    matched reservations returned (transformer.go restore semantics).
+    """
+    base_free = (node_allocatable - node_requested)[None, :, :]  # [1, N, R]
+    num_nodes = node_allocatable.shape[0]
+    # per-pod restore: sum of remaining over matched reservations per node
+    safe_idx = jnp.where(rsv.valid, rsv.node_index, 0)
+    onehot = (
+        (safe_idx[:, None] == jnp.arange(num_nodes)[None, :]) & rsv.valid[:, None]
+    )  # [V, N]
+    m = rsv.matched.astype(jnp.int64)  # [P, V]
+    # [P, V] @ ([V, N] * [V, R] -> via einsum): restore[p, n, r]
+    restore = jnp.einsum("pv,vn,vr->pnr", m, onehot.astype(jnp.int64), rsv.remaining)
+    return base_free + restore
+
+
+def reservation_fit_mask(
+    pod_requests: jnp.ndarray,  # i64[P, R]
+    rsv: ReservationTable,
+) -> jnp.ndarray:
+    """bool[P, V]: pod can allocate from the reservation under its policy.
+
+    Restricted/Aligned: for every declared dim, request fits inside the
+    reservation's remainder (plugin.go filterWithReservations).  Default:
+    always true (the pod may spill to node free space).
+    """
+    fits_declared = jnp.all(
+        ~rsv.declared[None, :, :]
+        | (pod_requests[:, None, :] <= rsv.remaining[None, :, :]),
+        axis=-1,
+    )  # [P, V]
+    constrained = (rsv.allocate_policy == ALLOCATE_POLICY_ALIGNED) | (
+        rsv.allocate_policy == ALLOCATE_POLICY_RESTRICTED
+    )
+    ok = jnp.where(constrained[None, :], fits_declared, True)
+    return ok & rsv.matched & rsv.valid[None, :] & ~rsv.unschedulable[None, :]
+
+
+def reservation_scores(
+    pod_requests: jnp.ndarray,  # i64[P, R]
+    rsv: ReservationTable,
+) -> jnp.ndarray:
+    """i64[P, V]: scoreReservation (scoring.go:177) — MostAllocated over the
+    reservation's declared dims with all weights 1:
+    ``sum over declared r of MaxNodeScore * min-guarded (request+allocated)
+    / allocatable`` divided by the number of declared dims.
+    """
+    requested = pod_requests[:, None, :] + rsv.allocated[None, :, :]
+    cap = rsv.allocatable[None, :, :]
+    safe_cap = jnp.where(cap == 0, 1, cap)
+    per_res = jnp.where(
+        rsv.declared[None, :, :] & (requested <= cap),
+        MAX_NODE_SCORE * requested // safe_cap,
+        0,
+    )
+    w = jnp.maximum(rsv.declared.sum(axis=-1), 1)[None, :]  # [1, V]
+    scores = per_res.sum(axis=-1) // w
+    return jnp.where(rsv.valid[None, :], scores, 0)
+
+
+def nominate_reservations(
+    pod_requests: jnp.ndarray,  # i64[P, R]
+    rsv: ReservationTable,
+    num_nodes: int,
+):
+    """Per (pod, node) nomination + node score, one device program.
+
+    Returns ``(node_scores i64[P, N], nominated i32[P, N])`` where
+    ``nominated`` is the reservation index the pod would allocate on that
+    node (-1 = none).  Mirrors PreScore+Score (scoring.go:42,105): among
+    fitting matched reservations on a node the highest scoreReservation
+    wins; the node holding the globally smallest nonzero order label
+    scores ``mostPreferredScore`` (max score here, the reference uses a
+    large constant then normalizes).
+    """
+    fit = reservation_fit_mask(pod_requests, rsv)  # [P, V]
+    scores = reservation_scores(pod_requests, rsv)  # [P, V]
+    num_v = rsv.capacity
+
+    safe_idx = jnp.where(rsv.valid, rsv.node_index, 0)
+    onehot = (
+        (safe_idx[None, :] == jnp.arange(num_nodes)[:, None]) & rsv.valid[None, :]
+    )  # [N, V]
+
+    masked = jnp.where(fit[:, None, :] & onehot[None, :, :], scores[:, None, :], -1)
+    node_scores = masked.max(axis=-1)  # [P, N]
+    nominated = jnp.where(
+        node_scores >= 0, masked.argmax(axis=-1).astype(jnp.int32), -1
+    )
+    node_scores = jnp.maximum(node_scores, 0)
+
+    # preferred node: reservation with the smallest nonzero order among the
+    # pod's fitting matches (scoring.go:92-101)
+    order = jnp.where(
+        (rsv.order != 0) & fit, rsv.order[None, :], _LONG_MAX
+    )  # [P, V]
+    best_order = order.min(axis=-1)  # [P]
+    best_v = order.argmin(axis=-1)  # [P]
+    has_order = best_order < _LONG_MAX
+    preferred_node = jnp.where(has_order, rsv.node_index[best_v], -1)  # [P]
+    node_ids = jnp.arange(num_nodes)[None, :]
+    node_scores = jnp.where(
+        node_ids == preferred_node[:, None], MAX_NODE_SCORE, node_scores
+    )
+    return node_scores, nominated

@@ -1,0 +1,185 @@
+"""DeviceShare: request normalization, multi-card split, fit, score, minors.
+
+Reference semantics: pkg/scheduler/plugins/deviceshare
+(device_cache.go calcDeviceWanted/tryAllocateByDeviceType,
+utils.go fillGPUTotalMem, scoring.go scoreNode, device_resources.go sort).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.model.device import encode_devices
+from koordinator_tpu.ops.deviceshare import (
+    allocate_minors,
+    device_fit_mask,
+    deviceshare_scores,
+    gpu_card_total_memory,
+    normalize_gpu_requests,
+    pod_device_requests,
+    split_per_card,
+)
+
+GI = 1024**3
+
+
+def pods(*dicts):
+    return jnp.asarray(
+        np.stack([res.resource_vector(d) for d in dicts]).astype(np.int64)
+    )
+
+
+def gpu_node(n_gpus=4, mem_gi=16, free=None):
+    devs = []
+    for m in range(n_gpus):
+        d = {
+            "type": "gpu",
+            "minor": m,
+            "total": {
+                res.GPU_CORE: 100,
+                res.GPU_MEMORY: f"{mem_gi}Gi",
+                res.GPU_MEMORY_RATIO: 100,
+            },
+        }
+        if free and m in free:
+            d["free"] = free[m]
+        devs.append(d)
+    return {"devices": devs}
+
+
+class TestNormalization:
+    def test_ratio_fills_memory(self):
+        batch = encode_devices([gpu_node(mem_gi=16)], node_bucket=1)
+        dev_req = pod_device_requests(pods({res.GPU_MEMORY_RATIO: 50}))
+        norm = np.asarray(
+            normalize_gpu_requests(dev_req, gpu_card_total_memory(batch))
+        )
+        mem = norm[0, 0, 1]  # GPU_MEMORY dim
+        assert mem == 8 * GI
+
+    def test_memory_fills_ratio(self):
+        batch = encode_devices([gpu_node(mem_gi=16)], node_bucket=1)
+        dev_req = pod_device_requests(pods({res.GPU_MEMORY: "4Gi"}))
+        norm = np.asarray(
+            normalize_gpu_requests(dev_req, gpu_card_total_memory(batch))
+        )
+        assert norm[0, 0, 2] == 25  # ratio dim
+
+    def test_multi_card_split(self):
+        dev_req = pod_device_requests(
+            pods({res.GPU_CORE: 200, res.GPU_MEMORY_RATIO: 200})
+        )
+        batch = encode_devices([gpu_node(mem_gi=16)], node_bucket=1)
+        norm = normalize_gpu_requests(dev_req, gpu_card_total_memory(batch))
+        per_card, wanted = split_per_card(norm)
+        assert int(np.asarray(wanted)[0, 0]) == 2
+        assert int(np.asarray(per_card)[0, 0, 0]) == 100  # core per card
+
+
+class TestFit:
+    def test_full_cards(self):
+        batch = encode_devices(
+            [gpu_node(4), gpu_node(1)], node_bucket=2, minor_bucket=4
+        )
+        p = pods({res.GPU_CORE: 200, res.GPU_MEMORY_RATIO: 200})
+        fit = np.asarray(device_fit_mask(p, batch))
+        assert fit[0, 0]  # 4 cards satisfy 2 wanted
+        assert not fit[0, 1]  # 1 card can't
+
+    def test_partial_share(self):
+        # node with one GPU half-used
+        half = {
+            res.GPU_CORE: 50,
+            res.GPU_MEMORY: "8Gi",
+            res.GPU_MEMORY_RATIO: 50,
+        }
+        batch = encode_devices(
+            [gpu_node(1, free={0: half})], node_bucket=1, minor_bucket=1
+        )
+        fits = np.asarray(
+            device_fit_mask(pods({res.GPU_MEMORY_RATIO: 50}), batch)
+        )
+        nofit = np.asarray(
+            device_fit_mask(pods({res.GPU_MEMORY_RATIO: 60}), batch)
+        )
+        assert fits[0, 0]
+        assert not nofit[0, 0]
+
+    def test_no_device_request_always_fits(self):
+        batch = encode_devices([{"devices": []}], node_bucket=1)
+        fit = np.asarray(device_fit_mask(pods({res.CPU: "1"}), batch))
+        assert fit[0, 0]
+
+    def test_rdma(self):
+        batch = encode_devices(
+            [{"devices": [{"type": "rdma", "minor": 0, "total": {res.RDMA: 100}}]},
+             {"devices": []}],
+            node_bucket=2,
+            minor_bucket=1,
+        )
+        fit = np.asarray(device_fit_mask(pods({res.RDMA: 100}), batch))
+        assert fit[0, 0]
+        assert not fit[0, 1]  # no rdma device on node-1
+
+
+class TestScore:
+    def test_least_allocated_prefers_empty_node(self):
+        half = {
+            res.GPU_CORE: 50,
+            res.GPU_MEMORY: "8Gi",
+            res.GPU_MEMORY_RATIO: 50,
+        }
+        batch = encode_devices(
+            [gpu_node(1), gpu_node(1, free={0: half})],
+            node_bucket=2,
+            minor_bucket=1,
+        )
+        p = pods({res.GPU_MEMORY_RATIO: 25})
+        scores = np.asarray(deviceshare_scores(p, batch))
+        assert scores[0, 0] > scores[0, 1]
+
+    def test_most_allocated_prefers_packed_node(self):
+        half = {
+            res.GPU_CORE: 50,
+            res.GPU_MEMORY: "8Gi",
+            res.GPU_MEMORY_RATIO: 50,
+        }
+        batch = encode_devices(
+            [gpu_node(1), gpu_node(1, free={0: half})],
+            node_bucket=2,
+            minor_bucket=1,
+        )
+        p = pods({res.GPU_MEMORY_RATIO: 25})
+        scores = np.asarray(deviceshare_scores(p, batch, most_allocated=True))
+        assert scores[0, 1] > scores[0, 0]
+
+
+class TestAllocateMinors:
+    def _minors(self):
+        return [
+            {"minor": 0, "total": {"core": 100}, "free": {"core": 100}},
+            {"minor": 1, "total": {"core": 100}, "free": {"core": 40}},
+            {"minor": 2, "total": {"core": 100}, "free": {"core": 100}},
+        ]
+
+    def test_least_allocated_picks_freest_lowest_minor(self):
+        got = allocate_minors(self._minors(), {"core": 50}, 1)
+        assert got == [0]
+
+    def test_most_allocated_packs(self):
+        got = allocate_minors(self._minors(), {"core": 30}, 1, most_allocated=True)
+        assert got == [1]
+
+    def test_preferred_first(self):
+        got = allocate_minors(self._minors(), {"core": 50}, 1, preferred={2})
+        assert got == [2]
+
+    def test_multi_card(self):
+        got = allocate_minors(self._minors(), {"core": 100}, 2)
+        assert got == [0, 2]
+
+    def test_unsatisfiable_raises(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            allocate_minors(self._minors(), {"core": 100}, 3)

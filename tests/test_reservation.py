@@ -1,0 +1,182 @@
+"""Reservation: owner matching, restore, policy fit, scoring, nomination.
+
+Reference semantics under test:
+pkg/scheduler/plugins/reservation/{transformer.go,scoring.go,plugin.go}.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.model.reservation import (
+    encode_reservations,
+    match_owners,
+)
+from koordinator_tpu.model.snapshot import MAX_NODE_SCORE
+from koordinator_tpu.ops.reservation import (
+    nominate_reservations,
+    reservation_fit_mask,
+    reservation_scores,
+    restored_node_free,
+)
+
+
+def vec(d):
+    return res.resource_vector(d)
+
+
+class TestMatchOwners:
+    def test_label_selector(self):
+        pod = {"name": "p", "labels": {"app": "web", "tier": "fe"}}
+        assert match_owners(pod, [{"label_selector": {"app": "web"}}])
+        assert not match_owners(pod, [{"label_selector": {"app": "db"}}])
+
+    def test_object_ref(self):
+        pod = {"name": "p", "namespace": "ns1"}
+        assert match_owners(pod, [{"object": {"name": "p", "namespace": "ns1"}}])
+        assert not match_owners(pod, [{"object": {"name": "p", "namespace": "ns2"}}])
+
+    def test_controller_ref(self):
+        pod = {"name": "p-x1", "namespace": "default", "owner_ref": {"name": "rs-1"}}
+        assert match_owners(pod, [{"controller": {"name": "rs-1"}}])
+        assert not match_owners(pod, [{"controller": {"name": "rs-2"}}])
+
+    def test_any_owner_matches(self):
+        pod = {"name": "p", "labels": {"a": "1"}}
+        owners = [{"label_selector": {"b": "2"}}, {"label_selector": {"a": "1"}}]
+        assert match_owners(pod, owners)
+
+
+def _table(extra_rsv=None, pods=None):
+    reservations = [
+        {
+            "name": "rsv-a",
+            "node": "node-0",
+            "allocatable": {"cpu": "4", "memory": "8Gi"},
+            "allocated": {"cpu": "1", "memory": "2Gi"},
+            "owners": [{"label_selector": {"app": "web"}}],
+        }
+    ] + (extra_rsv or [])
+    pods = pods or [
+        {"name": "match", "labels": {"app": "web"}},
+        {"name": "nomatch", "labels": {"app": "db"}},
+    ]
+    return (
+        encode_reservations(
+            reservations, pods, ["node-0", "node-1"], pod_bucket=len(pods)
+        ),
+        pods,
+    )
+
+
+class TestEncode:
+    def test_allocate_once_with_assigned_dropped(self):
+        rsv, _ = _table(
+            extra_rsv=[
+                {
+                    "name": "used-once",
+                    "node": "node-1",
+                    "allocatable": {"cpu": "2"},
+                    "allocate_once": True,
+                    "assigned_pods": 1,
+                    "owners": [{"label_selector": {"app": "web"}}],
+                }
+            ]
+        )
+        assert "used-once" not in rsv.names
+        assert int(np.asarray(rsv.valid).sum()) == 1
+
+    def test_matched_matrix(self):
+        rsv, _ = _table()
+        matched = np.asarray(rsv.matched)
+        assert matched[0, 0] and not matched[1, 0]
+
+
+class TestRestore:
+    def test_matched_pod_sees_remainder(self):
+        rsv, _ = _table()
+        R = res.NUM_RESOURCES
+        node_alloc = np.zeros((2, R), np.int64)
+        node_alloc[:, res.RESOURCE_INDEX[res.CPU]] = 16_000
+        # node-0's requested includes the reserve pod's full 4c
+        node_req = np.zeros((2, R), np.int64)
+        node_req[0, res.RESOURCE_INDEX[res.CPU]] = 10_000
+        free = np.asarray(
+            restored_node_free(jnp.asarray(node_alloc), jnp.asarray(node_req), rsv)
+        )
+        cpu = res.RESOURCE_INDEX[res.CPU]
+        # matched pod: base free 6000 + remainder (4000-1000)=3000 -> 9000
+        assert free[0, 0, cpu] == 9_000
+        # unmatched pod: base free only
+        assert free[1, 0, cpu] == 6_000
+        # other node unaffected
+        assert free[0, 1, cpu] == 16_000
+
+
+class TestFitAndScore:
+    def test_restricted_policy_limits_to_remainder(self):
+        rsv, pods = _table(
+            extra_rsv=[
+                {
+                    "name": "rsv-r",
+                    "node": "node-1",
+                    "allocatable": {"cpu": "2"},
+                    "allocate_policy": "Restricted",
+                    "owners": [{"label_selector": {"app": "web"}}],
+                }
+            ]
+        )
+        small = jnp.asarray(np.array([vec({"cpu": "1"}), vec({"cpu": "1"})], np.int64))
+        big = jnp.asarray(np.array([vec({"cpu": "3"}), vec({"cpu": "3"})], np.int64))
+        fit_small = np.asarray(reservation_fit_mask(small, rsv))
+        fit_big = np.asarray(reservation_fit_mask(big, rsv))
+        # restricted rsv-r (index 1): 1c fits within 2c remainder, 3c does not
+        assert fit_small[0, 1]
+        assert not fit_big[0, 1]
+        # default-policy rsv-a always "fits" (spills to node free space)
+        assert fit_big[0, 0]
+        # non-owner pod never fits
+        assert not fit_small[1, 1]
+
+    def test_score_most_allocated_parity(self):
+        rsv, _ = _table()
+        # declared dims: cpu 4000m, memory 8Gi; allocated 1000m / 2Gi
+        pod = jnp.asarray(np.array([vec({"cpu": "1", "memory": "2Gi"})], np.int64))
+        scores = np.asarray(reservation_scores(pod, rsv))
+        # cpu: 100*(1000+1000)/4000 = 50; mem: 100*(2+2)Gi/8Gi = 50 -> 50
+        assert scores[0, 0] == 50
+
+    def test_score_overflowing_dim_counts_zero(self):
+        rsv, _ = _table()
+        pod = jnp.asarray(np.array([vec({"cpu": "4", "memory": "1Gi"})], np.int64))
+        scores = np.asarray(reservation_scores(pod, rsv))
+        # cpu 5000 > 4000 -> 0; mem 100*3/8 = 37; (0+37)/2 = 18
+        assert scores[0, 0] == 18
+
+
+class TestNominate:
+    def test_node_scores_and_preferred(self):
+        rsv, pods = _table(
+            extra_rsv=[
+                {
+                    "name": "rsv-ordered",
+                    "node": "node-1",
+                    "allocatable": {"cpu": "4"},
+                    "order": 7,
+                    "owners": [{"label_selector": {"app": "web"}}],
+                }
+            ]
+        )
+        pod = jnp.asarray(
+            np.array([vec({"cpu": "1"}), vec({"cpu": "1"})], np.int64)
+        )
+        node_scores, nominated = nominate_reservations(pod, rsv, 2)
+        node_scores = np.asarray(node_scores)
+        nominated = np.asarray(nominated)
+        # matched pod nominates rsv-a on node-0
+        assert nominated[0, 0] == 0
+        # ordered reservation's node is preferred -> max score
+        assert node_scores[0, 1] == MAX_NODE_SCORE
+        # unmatched pod: no nominations, zero scores
+        assert (nominated[1] == -1).all()
+        assert (node_scores[1] == 0).all()
