@@ -179,15 +179,26 @@ def allocate_minors(
     preferred = preferred or set()
     required = required or set()
 
+    def q(dim, value) -> int:
+        return res.parse_quantity(value, dim)
+
+    def free_of(m) -> Dict[str, int]:
+        # an unallocated healthy device is fully free (deviceFree == total)
+        src = m.get("free")
+        if src is None:
+            src = m.get("total") or {}
+        return {dim: q(dim, v) for dim, v in src.items()}
+
     def score(m) -> int:
         s = 0
         n = 0
+        free = free_of(m)
         for dim, total in (m.get("total") or {}).items():
-            total = int(total)
+            total = q(dim, total)
             if total == 0:
                 continue
-            free = int((m.get("free") or {}).get(dim, 0))
-            req = total - free + int(per_card.get(dim, 0)) if total >= free else total
+            f = free.get(dim, 0)
+            req = total - f + int(per_card.get(dim, 0)) if total >= f else total
             if most_allocated:
                 val = max(0, MAX_NODE_SCORE * req // total) if req <= total else 0
             else:
@@ -208,8 +219,8 @@ def allocate_minors(
     for m in ranked:
         if required and m["minor"] not in required:
             continue
-        free = m.get("free") or {}
-        if all(int(free.get(d, 0)) >= q for d, q in per_card.items()):
+        free = free_of(m)
+        if all(free.get(d, 0) >= q_ for d, q_ in per_card.items()):
             out.append(m["minor"])
             if len(out) == wanted:
                 return out

@@ -1,0 +1,229 @@
+"""FrameworkExtender: the plugin pipeline around the batched TPU cycle.
+
+Reference: ``pkg/scheduler/frameworkext`` — the extender wraps the upstream
+framework and interposes Before/After transformers around PreFilter /
+Filter / Score (``framework_extender.go:155,192,216``), adds reservation
+extension points (``interface.go:110-226``), debug score tables
+(``debug.go:37``, ``framework_extender.go:236``) and an error-handler
+dispatcher (``errorhandler_dispatcher.go``).
+
+TPU-first shape: every plugin contributes *tensors* — a bool ``[P, N]``
+filter mask and an i64 ``[P, N]`` score — composed once per cycle into a
+single jitted program (masks AND, weighted scores SUM), instead of the
+reference's per-(plugin, pod, node) goroutine fan-out.  Host-side extension
+points (Reserve / Permit / PreBind) run only for the solver's chosen
+placements.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG
+from koordinator_tpu.model.snapshot import ClusterSnapshot
+from koordinator_tpu.solver.greedy import CycleResult, greedy_assign, score_cycle
+
+
+@dataclasses.dataclass
+class CycleContext:
+    """One scheduling cycle's world state handed to every plugin.
+
+    ``extras`` carries optional subsystem tables (ZoneBatch, ReservationTable,
+    DeviceBatch, policy vectors…) keyed by name; ``state`` is the host-side
+    CycleState analog (reference framework.CycleState) for cross-extension
+    communication within the cycle.
+    """
+
+    snapshot: ClusterSnapshot
+    cfg: CycleConfig = DEFAULT_CYCLE_CONFIG
+    extras: Dict[str, object] = dataclasses.field(default_factory=dict)
+    state: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+
+class TensorPlugin:
+    """Base extended plugin (reference framework.Plugin + frameworkext
+    extension interfaces).  Override any subset."""
+
+    name = "plugin"
+    weight = 1
+
+    def filter_mask(self, ctx: CycleContext) -> Optional[jnp.ndarray]:
+        """bool[P, N] admission mask, or None when not filtering."""
+        return None
+
+    def score(self, ctx: CycleContext) -> Optional[jnp.ndarray]:
+        """i64[P, N] scores in [0, MAX_NODE_SCORE], or None."""
+        return None
+
+    # Host-side extension points, invoked for chosen placements only.
+    def reserve(self, ctx: CycleContext, pod_idx: int, node_idx: int) -> None:
+        pass
+
+    def unreserve(self, ctx: CycleContext, pod_idx: int, node_idx: int) -> None:
+        pass
+
+    def pre_bind(
+        self, ctx: CycleContext, pod_idx: int, node_idx: int
+    ) -> Optional[Mapping]:
+        """Return a patch fragment; DefaultPreBind merges all fragments
+        into one apiserver patch (reference plugins/defaultprebind)."""
+        return None
+
+
+Transformer = Callable[[CycleContext], CycleContext]
+ErrorHandler = Callable[[CycleContext, int, Exception], bool]
+
+
+@dataclasses.dataclass
+class DebugScoresTable:
+    """Top-N per-plugin score table (reference frameworkext/debug.go:37)."""
+
+    top_n: int
+    rows: List[Tuple[str, List[Tuple[str, int]]]]
+
+    def __str__(self) -> str:
+        lines = []
+        for plugin, pairs in self.rows:
+            cells = " | ".join(f"{n}:{s}" for n, s in pairs)
+            lines.append(f"{plugin:>24} | {cells}")
+        return "\n".join(lines)
+
+
+class FrameworkExtender:
+    """Composes transformers + tensor plugins into one cycle program."""
+
+    def __init__(
+        self,
+        plugins: Sequence[TensorPlugin] = (),
+        *,
+        before_pre_filter: Sequence[Transformer] = (),
+        before_score: Sequence[Transformer] = (),
+        debug_top_n: int = 0,
+    ):
+        self.plugins = list(plugins)
+        self.before_pre_filter = list(before_pre_filter)
+        self.before_score = list(before_score)
+        self.debug_top_n = debug_top_n
+        self.error_handlers: List[ErrorHandler] = []
+        self.last_debug: Optional[DebugScoresTable] = None
+
+    def register(self, plugin: TensorPlugin) -> None:
+        self.plugins.append(plugin)
+
+    def register_error_handler(self, handler: ErrorHandler) -> None:
+        """reference errorhandler_dispatcher.go: handlers run in order until
+        one claims the failure."""
+        self.error_handlers.append(handler)
+
+    # -- phases -----------------------------------------------------------
+
+    def run_transformers(self, ctx: CycleContext) -> CycleContext:
+        """BeforePreFilter transformer chain (framework_extender.go:155)."""
+        for t in self.before_pre_filter:
+            ctx = t(ctx)
+        return ctx
+
+    def extended_tensors(
+        self, ctx: CycleContext
+    ) -> Tuple[Optional[jnp.ndarray], Optional[jnp.ndarray], Dict[str, jnp.ndarray]]:
+        """Collect every plugin's mask and weighted score."""
+        mask = None
+        total = None
+        per_plugin: Dict[str, jnp.ndarray] = {}
+        for t in self.before_score:
+            ctx = t(ctx)
+        for pl in self.plugins:
+            m = pl.filter_mask(ctx)
+            if m is not None:
+                mask = m if mask is None else (mask & m)
+            s = pl.score(ctx)
+            if s is not None:
+                per_plugin[pl.name] = s
+                ws = pl.weight * s
+                total = ws if total is None else (total + ws)
+        return mask, total, per_plugin
+
+    def run_cycle(self, ctx: CycleContext) -> CycleResult:
+        """transformers -> masks+scores -> sequential greedy assignment ->
+        Reserve/Permit host hooks (the reference's full cycle, §3.1)."""
+        ctx = self.run_transformers(ctx)
+        mask, scores, per_plugin = self.extended_tensors(ctx)
+        result = greedy_assign(
+            ctx.snapshot, ctx.cfg, extra_mask=mask, extra_scores=scores
+        )
+        if self.debug_top_n:
+            self.last_debug = self._debug_table(ctx, per_plugin, result)
+        assignment = np.asarray(result.assignment)
+        for p in np.flatnonzero(assignment >= 0):
+            node = int(assignment[p])
+            try:
+                for pl in self.plugins:
+                    pl.reserve(ctx, int(p), node)
+            except Exception as exc:  # Reserve failure unwinds (Unreserve)
+                for pl in self.plugins:
+                    pl.unreserve(ctx, int(p), node)
+                handled = any(h(ctx, int(p), exc) for h in self.error_handlers)
+                if not handled:
+                    raise
+        return result
+
+    def run_score_only(self, ctx: CycleContext):
+        """Score-only mode for strict plugin parity checks (the reference
+        seam at framework_extender.go:216)."""
+        ctx = self.run_transformers(ctx)
+        mask, extra, per_plugin = self.extended_tensors(ctx)
+        scores, feasible = score_cycle(ctx.snapshot, ctx.cfg)
+        if extra is not None:
+            scores = scores + extra
+        if mask is not None:
+            feasible = feasible & mask
+        return scores, feasible, per_plugin
+
+    def pre_bind_patches(
+        self, ctx: CycleContext, result: CycleResult
+    ) -> Dict[int, Dict]:
+        """DefaultPreBind: merge every plugin's patch fragments into one
+        combined patch per assigned pod (reference
+        plugins/defaultprebind/plugin.go)."""
+        patches: Dict[int, Dict] = {}
+        assignment = np.asarray(result.assignment)
+        status = np.asarray(result.status)
+        for p in np.flatnonzero((assignment >= 0) & (status == 0)):
+            merged: Dict = {}
+            for pl in self.plugins:
+                frag = pl.pre_bind(ctx, int(p), int(assignment[p]))
+                if frag:
+                    _deep_merge(merged, frag)
+            if merged:
+                patches[int(p)] = merged
+        return patches
+
+    def _debug_table(
+        self,
+        ctx: CycleContext,
+        per_plugin: Mapping[str, jnp.ndarray],
+        result: CycleResult,
+    ) -> DebugScoresTable:
+        node_names = ctx.snapshot.nodes.names or tuple(
+            f"node-{i}" for i in range(ctx.snapshot.nodes.capacity)
+        )
+        rows = []
+        for name, scores in per_plugin.items():
+            s0 = np.asarray(scores[0] if scores.ndim == 2 else scores)
+            top = np.argsort(-s0)[: self.debug_top_n]
+            rows.append(
+                (name, [(node_names[i] if i < len(node_names) else str(i), int(s0[i])) for i in top])
+            )
+        return DebugScoresTable(self.debug_top_n, rows)
+
+
+def _deep_merge(dst: Dict, src: Mapping) -> None:
+    for k, v in src.items():
+        if isinstance(v, Mapping) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = v

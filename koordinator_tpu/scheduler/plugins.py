@@ -1,0 +1,238 @@
+"""Built-in extended plugins: NUMA, Reservation, DeviceShare adapters.
+
+Each adapter exposes a subsystem's batched kernels through the
+``TensorPlugin`` boundary (reference plugin registrations at
+``cmd/koord-scheduler/main.go:45-53``) and settles exact per-pod allocation
+host-side at Reserve, mirroring the reference's Reserve-phase caches
+(``nodenumaresource/plugin.go Reserve``, ``deviceshare/plugin.go Reserve``).
+
+Context extras consumed:
+* ``zones``: ZoneBatch, ``numa_policy``: i32[N] — NodeNUMAResourcePlugin
+* ``reservations``: ReservationTable — ReservationPlugin
+* ``devices``: DeviceBatch — DeviceSharePlugin
+* ``cpu_topologies``: {node_idx: CPUTopology}, ``available_cpus``:
+  {node_idx: set[int]} — cpuset accumulation at Reserve
+* ``device_minors``: {node_idx: [minor dicts]} — minor selection at Reserve
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.ops.deviceshare import (
+    allocate_minors,
+    device_fit_mask,
+    deviceshare_scores,
+    gpu_card_total_memory,
+    normalize_gpu_requests,
+    pod_device_requests,
+    split_per_card,
+)
+from koordinator_tpu.ops.numa import numa_admit_mask, numa_zone_scores
+from koordinator_tpu.ops.reservation import nominate_reservations
+from koordinator_tpu.scheduler.cpu_accumulator import (
+    CPUBindPolicy,
+    NUMAAllocateStrategy,
+    take_cpus,
+)
+from koordinator_tpu.scheduler.framework import CycleContext, TensorPlugin
+
+_CPU_IDX = res.RESOURCE_INDEX[res.CPU]
+
+
+class NodeNUMAResourcePlugin(TensorPlugin):
+    """Zone admission + zone scoring; cpuset accumulation at Reserve.
+
+    reference pkg/scheduler/plugins/nodenumaresource (PreFilter/Filter/
+    Score plugin.go:210,266, scoring.go:55; Reserve allocates the cpuset).
+    """
+
+    name = "NodeNUMAResource"
+
+    def __init__(
+        self,
+        *,
+        most_allocated: bool = False,
+        bind_policy: CPUBindPolicy = CPUBindPolicy.FULL_PCPUS,
+        strategy: NUMAAllocateStrategy = NUMAAllocateStrategy.LEAST_ALLOCATED,
+    ):
+        self.most_allocated = most_allocated
+        self.bind_policy = bind_policy
+        self.strategy = strategy
+
+    def filter_mask(self, ctx: CycleContext) -> Optional[jnp.ndarray]:
+        zones = ctx.extras.get("zones")
+        policy = ctx.extras.get("numa_policy")
+        if zones is None or policy is None:
+            return None
+        pods = ctx.snapshot.pods
+        return numa_admit_mask(
+            pods.requests, zones.allocatable, zones.requested, zones.valid, policy
+        )
+
+    def score(self, ctx: CycleContext) -> Optional[jnp.ndarray]:
+        zones = ctx.extras.get("zones")
+        if zones is None:
+            return None
+        pods = ctx.snapshot.pods
+        weights = ctx.cfg.fit_weights_arr()
+        return numa_zone_scores(
+            pods.requests,
+            zones.allocatable,
+            zones.requested,
+            zones.valid,
+            weights,
+            most_allocated=self.most_allocated,
+        )
+
+    def reserve(self, ctx: CycleContext, pod_idx: int, node_idx: int) -> None:
+        """LSE/LSR pods get an exact cpuset on the chosen node (the
+        reference runs this same accumulator; plugin.go Reserve)."""
+        topo = (ctx.extras.get("cpu_topologies") or {}).get(node_idx)
+        if topo is None:
+            return
+        qos = int(np.asarray(ctx.snapshot.pods.qos[pod_idx]))
+        if qos > 1:  # only LSE(0)/LSR(1) bind cpus
+            return
+        milli = int(np.asarray(ctx.snapshot.pods.requests[pod_idx, _CPU_IDX]))
+        num_cpus = milli // 1000
+        if num_cpus <= 0:
+            return
+        available = ctx.extras.setdefault("available_cpus", {}).setdefault(
+            node_idx, set(topo.details)
+        )
+        cpus = take_cpus(
+            topo,
+            available,
+            num_cpus,
+            bind_policy=self.bind_policy,
+            strategy=self.strategy,
+        )
+        available -= set(cpus)
+        ctx.state.setdefault("cpuset_allocations", {})[pod_idx] = sorted(cpus)
+
+    def unreserve(self, ctx: CycleContext, pod_idx: int, node_idx: int) -> None:
+        cpus = ctx.state.get("cpuset_allocations", {}).pop(pod_idx, None)
+        if cpus:
+            avail = ctx.extras.get("available_cpus", {}).get(node_idx)
+            if avail is not None:
+                avail |= set(cpus)
+
+    def pre_bind(self, ctx, pod_idx, node_idx) -> Optional[Mapping]:
+        cpus = ctx.state.get("cpuset_allocations", {}).get(pod_idx)
+        if not cpus:
+            return None
+        # reference apis/extension ResourceStatus annotation
+        return {
+            "annotations": {
+                "scheduling.koordinator.sh/resource-status": {
+                    "cpuset": ",".join(map(str, cpus))
+                }
+            }
+        }
+
+
+class ReservationPlugin(TensorPlugin):
+    """Reservation nomination + scoring (reference
+    pkg/scheduler/plugins/reservation scoring.go; restore runs as a
+    BeforePreFilter transformer upstream of this plugin)."""
+
+    name = "Reservation"
+
+    def score(self, ctx: CycleContext) -> Optional[jnp.ndarray]:
+        rsv = ctx.extras.get("reservations")
+        if rsv is None:
+            return None
+        pods = ctx.snapshot.pods
+        num_nodes = ctx.snapshot.nodes.capacity
+        node_scores, nominated = nominate_reservations(pods.requests, rsv, num_nodes)
+        ctx.state["nominated_reservations"] = nominated
+        return node_scores
+
+    def pre_bind(self, ctx, pod_idx, node_idx) -> Optional[Mapping]:
+        nominated = ctx.state.get("nominated_reservations")
+        if nominated is None:
+            return None
+        v = int(np.asarray(nominated[pod_idx, node_idx]))
+        if v < 0:
+            return None
+        rsv = ctx.extras["reservations"]
+        name = rsv.names[v] if v < len(rsv.names) else str(v)
+        return {
+            "annotations": {
+                "scheduling.koordinator.sh/reservation-allocated": {
+                    "name": name
+                }
+            }
+        }
+
+
+class DeviceSharePlugin(TensorPlugin):
+    """Device fit + scoring; minor selection at Reserve (reference
+    pkg/scheduler/plugins/deviceshare plugin.go:146,284,450)."""
+
+    name = "DeviceShare"
+
+    def __init__(self, *, most_allocated: bool = False):
+        self.most_allocated = most_allocated
+
+    def filter_mask(self, ctx: CycleContext) -> Optional[jnp.ndarray]:
+        devices = ctx.extras.get("devices")
+        if devices is None:
+            return None
+        return device_fit_mask(ctx.snapshot.pods.requests, devices)
+
+    def score(self, ctx: CycleContext) -> Optional[jnp.ndarray]:
+        devices = ctx.extras.get("devices")
+        if devices is None:
+            return None
+        return deviceshare_scores(
+            ctx.snapshot.pods.requests, devices, most_allocated=self.most_allocated
+        )
+
+    def reserve(self, ctx: CycleContext, pod_idx: int, node_idx: int) -> None:
+        devices = ctx.extras.get("devices")
+        minors = (ctx.extras.get("device_minors") or {}).get(node_idx)
+        if devices is None or minors is None:
+            return
+        dev_req = pod_device_requests(ctx.snapshot.pods.requests[pod_idx : pod_idx + 1])
+        if not bool(np.asarray(dev_req).any()):
+            return
+        card_mem = gpu_card_total_memory(devices)
+        norm = normalize_gpu_requests(dev_req, card_mem)
+        per_card_t, wanted_t = split_per_card(norm)
+        per_card_vec = np.asarray(per_card_t)[0, node_idx]
+        wanted = int(np.asarray(wanted_t)[0, node_idx])
+        from koordinator_tpu.model.device import DEVICE_RESOURCE_AXIS
+
+        per_card = {
+            name: int(per_card_vec[i])
+            for i, name in enumerate(DEVICE_RESOURCE_AXIS)
+            if per_card_vec[i] > 0
+        }
+        chosen = allocate_minors(
+            minors, per_card, wanted, most_allocated=self.most_allocated
+        )
+        for m in minors:
+            if m["minor"] in chosen:
+                free = m.setdefault("free", dict(m.get("total", {})))
+                for dim, q in per_card.items():
+                    free[dim] = int(res.parse_quantity(free.get(dim, 0), dim)) - q
+        ctx.state.setdefault("device_allocations", {})[pod_idx] = {
+            "minors": chosen,
+            "per_card": per_card,
+        }
+
+    def pre_bind(self, ctx, pod_idx, node_idx) -> Optional[Mapping]:
+        alloc = ctx.state.get("device_allocations", {}).get(pod_idx)
+        if not alloc:
+            return None
+        return {
+            "annotations": {
+                "scheduling.koordinator.sh/device-allocated": alloc
+            }
+        }

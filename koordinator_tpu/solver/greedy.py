@@ -146,9 +146,19 @@ def score_cycle(snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONF
 
 @partial(jax.jit, static_argnames=("cfg",))
 def greedy_assign(
-    snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG
+    snapshot: ClusterSnapshot,
+    cfg: CycleConfig = DEFAULT_CYCLE_CONFIG,
+    extra_mask: Optional[jnp.ndarray] = None,  # bool[P, N] extended-plugin Filter
+    extra_scores: Optional[jnp.ndarray] = None,  # i64[P, N] extended-plugin Score
 ) -> CycleResult:
-    """Sequential-parity greedy assignment of the whole pending batch."""
+    """Sequential-parity greedy assignment of the whole pending batch.
+
+    ``extra_mask``/``extra_scores`` carry the extended plugins' (NUMA,
+    reservation, device-share) stateless Filter/Score tensors into the
+    sequential scan; their intra-batch allocation state is settled exactly
+    at Reserve on the host (scheduler.framework), like the reference's
+    Reserve phase caches.
+    """
     pods, nodes, gangs, quotas = (
         snapshot.pods,
         snapshot.nodes,
@@ -196,10 +206,14 @@ def greedy_assign(
             True,
         )
         feasible = fits & nodes.valid & la_mask & quota_ok & is_valid
+        if extra_mask is not None:
+            feasible = feasible & extra_mask[p]
 
         scores = _combined_scores(
             snapshot, node_requested, node_estimated, cfg, req, sreq, est
         )
+        if extra_scores is not None:
+            scores = scores + extra_scores[p]
         masked = jnp.where(feasible, scores, jnp.iinfo(jnp.int64).min)
         best = jnp.argmax(masked).astype(jnp.int32)
         any_feasible = jnp.any(feasible)

@@ -1,0 +1,286 @@
+"""FrameworkExtender pipeline: transformers, plugin composition, Reserve
+hooks, debug tables, PreBind patch merging.
+
+Reference seams under test: pkg/scheduler/frameworkext
+(framework_extender.go transformer interposition + debugScores,
+errorhandler_dispatcher.go, plugins/defaultprebind).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from koordinator_tpu.config import CycleConfig
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.model.device import encode_devices
+from koordinator_tpu.model.snapshot import encode_snapshot
+from koordinator_tpu.model.topology import CPUTopology, encode_zones
+from koordinator_tpu.ops.numa import POLICY_SINGLE_NUMA_NODE
+from koordinator_tpu.scheduler.framework import (
+    CycleContext,
+    FrameworkExtender,
+    TensorPlugin,
+)
+from koordinator_tpu.scheduler.plugins import (
+    DeviceSharePlugin,
+    NodeNUMAResourcePlugin,
+    ReservationPlugin,
+)
+from koordinator_tpu.solver.greedy import STATUS_ASSIGNED
+
+
+def _snapshot(n_nodes=2, n_pods=3, cpu="2", node_cpu="16"):
+    nodes = [
+        {
+            "name": f"node-{i}",
+            "allocatable": {"cpu": node_cpu, "memory": "32Gi", "pods": "110"},
+            "usage": {"cpu": "1", "memory": "2Gi"},
+        }
+        for i in range(n_nodes)
+    ]
+    pods = [
+        {
+            "name": f"pod-{i}",
+            "requests": {"cpu": cpu, "memory": "4Gi"},
+            "qos": "LSR",
+            "priority": 9000 + i,
+        }
+        for i in range(n_pods)
+    ]
+    return encode_snapshot(nodes, pods)
+
+
+class TestPipeline:
+    def test_plain_cycle_assigns(self):
+        fx = FrameworkExtender()
+        ctx = CycleContext(snapshot=_snapshot())
+        result = fx.run_cycle(ctx)
+        a = np.asarray(result.assignment)
+        assert (a[:3] >= 0).all()
+
+    def test_transformer_interposition(self):
+        calls = []
+
+        def t(ctx):
+            calls.append("before_pre_filter")
+            return ctx
+
+        fx = FrameworkExtender(before_pre_filter=[t])
+        fx.run_cycle(CycleContext(snapshot=_snapshot()))
+        assert calls == ["before_pre_filter"]
+
+    def test_plugin_mask_excludes_node(self):
+        class VetoNode0(TensorPlugin):
+            name = "veto"
+
+            def filter_mask(self, ctx):
+                P = ctx.snapshot.pods.capacity
+                N = ctx.snapshot.nodes.capacity
+                m = jnp.ones((P, N), bool)
+                return m.at[:, 0].set(False)
+
+        fx = FrameworkExtender([VetoNode0()])
+        result = fx.run_cycle(CycleContext(snapshot=_snapshot()))
+        a = np.asarray(result.assignment)
+        assert (a[:3] != 0).all() and (a[:3] >= 0).all()
+
+    def test_plugin_score_steers_choice(self):
+        class PreferNode1(TensorPlugin):
+            name = "prefer1"
+            weight = 100
+
+            def score(self, ctx):
+                P = ctx.snapshot.pods.capacity
+                N = ctx.snapshot.nodes.capacity
+                s = jnp.zeros((P, N), jnp.int64)
+                return s.at[:, 1].set(100)
+
+        fx = FrameworkExtender([PreferNode1()])
+        result = fx.run_cycle(CycleContext(snapshot=_snapshot()))
+        a = np.asarray(result.assignment)
+        assert (a[:3] == 1).all()
+
+    def test_debug_scores_table(self):
+        class Scorer(TensorPlugin):
+            name = "scorer"
+
+            def score(self, ctx):
+                P = ctx.snapshot.pods.capacity
+                N = ctx.snapshot.nodes.capacity
+                return jnp.ones((P, N), jnp.int64) * 7
+
+        fx = FrameworkExtender([Scorer()], debug_top_n=2)
+        fx.run_cycle(CycleContext(snapshot=_snapshot()))
+        assert fx.last_debug is not None
+        assert "scorer" in str(fx.last_debug)
+
+    def test_error_handler_dispatch(self):
+        class FailingReserve(TensorPlugin):
+            name = "fails"
+
+            def reserve(self, ctx, pod_idx, node_idx):
+                raise RuntimeError("boom")
+
+        handled = []
+        fx = FrameworkExtender([FailingReserve()])
+        fx.register_error_handler(lambda ctx, p, exc: handled.append(p) or True)
+        fx.run_cycle(CycleContext(snapshot=_snapshot()))
+        assert handled  # dispatcher claimed the failure, no raise
+
+    def test_error_unhandled_raises(self):
+        class FailingReserve(TensorPlugin):
+            name = "fails"
+
+            def reserve(self, ctx, pod_idx, node_idx):
+                raise RuntimeError("boom")
+
+        fx = FrameworkExtender([FailingReserve()])
+        with pytest.raises(RuntimeError):
+            fx.run_cycle(CycleContext(snapshot=_snapshot()))
+
+
+class TestNUMAPluginIntegration:
+    def test_single_numa_policy_filters_and_cpuset_reserved(self):
+        snap = _snapshot(n_nodes=2, n_pods=1, cpu="4")
+        zones = encode_zones(
+            [
+                # node-0: two 8c zones -> 4c pod fits one zone
+                {"zones": [{"allocatable": {"cpu": "8", "memory": "16Gi"}}] * 2},
+                # node-1: two zones with tiny free cpu -> single-numa fails
+                {
+                    "zones": [
+                        {
+                            "allocatable": {"cpu": "8", "memory": "16Gi"},
+                            "requested": {"cpu": "6"},
+                        }
+                    ]
+                    * 2
+                },
+            ],
+            node_bucket=snap.nodes.capacity,
+        )
+        policy = jnp.full((snap.nodes.capacity,), POLICY_SINGLE_NUMA_NODE, jnp.int32)
+        topo = CPUTopology.build(1, 2, 4, 2)
+        fx = FrameworkExtender([NodeNUMAResourcePlugin()])
+        ctx = CycleContext(
+            snapshot=snap,
+            extras={
+                "zones": zones,
+                "numa_policy": policy,
+                "cpu_topologies": {0: topo},
+            },
+        )
+        result = fx.run_cycle(ctx)
+        a = np.asarray(result.assignment)
+        assert a[0] == 0  # node-1 rejected by single-numa admission
+        cpus = ctx.state["cpuset_allocations"][0]
+        assert len(cpus) == 4
+        # FullPCPUs on one NUMA node
+        assert {topo.details[c].node for c in cpus} == {0} or {
+            topo.details[c].node for c in cpus
+        } == {1}
+        patches = fx.pre_bind_patches(ctx, result)
+        assert "resource-status" in str(patches[0])
+
+
+class TestDevicePluginIntegration:
+    def test_device_fit_and_reserve(self):
+        # device totals also land in node allocatable (koord-manager's
+        # device resource calculator writes gpu-core etc. onto the Node)
+        snap = encode_snapshot(
+            [
+                {
+                    "name": "node-0",
+                    "allocatable": {
+                        "cpu": "16",
+                        "memory": "32Gi",
+                        res.GPU_CORE: 100,
+                        res.GPU_MEMORY: "16Gi",
+                        res.GPU_MEMORY_RATIO: 100,
+                    },
+                },
+                {"name": "node-1", "allocatable": {"cpu": "16", "memory": "32Gi"}},
+            ],
+            [
+                {
+                    "name": "gpu-pod",
+                    "requests": {
+                        "cpu": "2",
+                        "memory": "4Gi",
+                        res.GPU_CORE: 100,
+                        res.GPU_MEMORY_RATIO: 100,
+                    },
+                }
+            ],
+        )
+        devices = encode_devices(
+            [
+                {
+                    "devices": [
+                        {
+                            "type": "gpu",
+                            "minor": 0,
+                            "total": {
+                                res.GPU_CORE: 100,
+                                res.GPU_MEMORY: "16Gi",
+                                res.GPU_MEMORY_RATIO: 100,
+                            },
+                        }
+                    ]
+                },
+                {"devices": []},
+            ],
+            node_bucket=snap.nodes.capacity,
+        )
+        minors = {
+            0: [
+                {
+                    "minor": 0,
+                    "total": {
+                        res.GPU_CORE: 100,
+                        res.GPU_MEMORY: 16 * 1024**3,
+                        res.GPU_MEMORY_RATIO: 100,
+                    },
+                }
+            ]
+        }
+        fx = FrameworkExtender([DeviceSharePlugin()])
+        ctx = CycleContext(
+            snapshot=snap, extras={"devices": devices, "device_minors": minors}
+        )
+        result = fx.run_cycle(ctx)
+        a = np.asarray(result.assignment)
+        assert a[0] == 0  # only node-0 has the GPU
+        alloc = ctx.state["device_allocations"][0]
+        assert alloc["minors"] == [0]
+        # free deducted on the minor
+        assert minors[0][0]["free"][res.GPU_CORE] == 0
+
+
+class TestReservationPluginIntegration:
+    def test_reservation_steers_to_reserved_node(self):
+        from koordinator_tpu.model.reservation import encode_reservations
+
+        snap = _snapshot(n_nodes=2, n_pods=1)
+        pods = [{"name": "pod-0", "labels": {"app": "web"}}]
+        rsv = encode_reservations(
+            [
+                {
+                    "name": "rsv",
+                    "node": "node-1",
+                    "allocatable": {"cpu": "4", "memory": "8Gi"},
+                    "owners": [{"label_selector": {"app": "web"}}],
+                    "order": 1,
+                }
+            ],
+            pods,
+            ["node-0", "node-1"],
+            pod_bucket=snap.pods.capacity,
+        )
+        fx = FrameworkExtender([ReservationPlugin()])
+        ctx = CycleContext(snapshot=snap, extras={"reservations": rsv})
+        result = fx.run_cycle(ctx)
+        a = np.asarray(result.assignment)
+        assert a[0] == 1
+        patches = fx.pre_bind_patches(ctx, result)
+        assert "reservation-allocated" in str(patches.get(0, {}))
