@@ -1,0 +1,445 @@
+"""koordlet agent: sysfs, metriccache, collectors, qos strategies, hooks,
+prediction, pleg, audit — all against a temp-dir fake cgroup/proc fs (the
+reference fakes the cgroup fs the same way,
+pkg/koordlet/util/system/util_test_tool.go).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.koordlet import Daemon
+from koordinator_tpu.koordlet import metriccache as mc
+from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.koordlet.collectors import (
+    BEResourceCollector,
+    MetricsAdvisor,
+    NodeResourceCollector,
+    PodMeta,
+    PodResourceCollector,
+    PSICollector,
+)
+from koordinator_tpu.koordlet.metriccache import MetricCache
+from koordinator_tpu.koordlet.pleg import (
+    CONTAINER_ADDED,
+    POD_ADDED,
+    POD_DELETED,
+    Pleg,
+)
+from koordinator_tpu.koordlet.prediction import (
+    DecayHistogram,
+    FileCheckpointer,
+    PeakPredictServer,
+)
+from koordinator_tpu.koordlet.qosmanager import (
+    CPUSuppressStrategy,
+    Evictor,
+    MemoryEvictStrategy,
+    QOSManager,
+    calculate_be_suppress_cpu,
+)
+from koordinator_tpu.koordlet.resourceexecutor import (
+    CgroupReader,
+    ResourceUpdate,
+    ResourceUpdateExecutor,
+    format_cpuset,
+)
+from koordinator_tpu.koordlet.runtimehooks import (
+    PRE_CREATE_CONTAINER,
+    ContainerContext,
+    Reconciler,
+    default_registry,
+)
+from koordinator_tpu.koordlet.statesinformer import NodeMetricReporter, StatesInformer
+from koordinator_tpu.koordlet.sysfs import (
+    CgroupVersion,
+    KUBEPODS_BESTEFFORT,
+    SysFS,
+    pod_cgroup_dir,
+)
+
+
+@pytest.fixture
+def fs(tmp_path):
+    root = str(tmp_path)
+    f = SysFS(root=root, cgroup_version=CgroupVersion.V1)
+    os.makedirs(os.path.join(root, "proc"), exist_ok=True)
+    return f
+
+
+def write_proc(fs, name, text):
+    path = fs.proc_path(name)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(text)
+
+
+class TestSysFS:
+    def test_meminfo(self, fs):
+        write_proc(
+            fs, "meminfo", "MemTotal: 16000000 kB\nMemAvailable: 4000000 kB\n"
+        )
+        assert fs.memory_usage_bytes() == 12000000 * 1024
+
+    def test_proc_stat_cpu(self, fs):
+        write_proc(fs, "stat", "cpu  100 0 100 700 50 0 0 0 0 0\n")
+        used, total = fs.proc_stat_cpu()
+        assert total == 950 and used == 200
+
+    def test_psi_parse(self, fs):
+        fs.write(
+            fs.cgroup_path("cpu.pressure"),
+            "some avg10=1.50 avg60=0.80 avg300=0.20 total=12345\n"
+            "full avg10=0.10 avg60=0.05 avg300=0.01 total=42\n",
+        )
+        psi = fs.psi("cpu.pressure")
+        assert psi.some.avg10 == 1.5
+        assert psi.full.total == 42
+
+    def test_cgroup_v1_v2_paths(self, tmp_path):
+        v1 = SysFS(root=str(tmp_path), cgroup_version=CgroupVersion.V1)
+        v2 = SysFS(root=str(tmp_path), cgroup_version=CgroupVersion.V2)
+        assert "cpu/kubepods/cpu.cfs_quota_us" in v1.cgroup_path(
+            "cpu.cfs_quota", "kubepods"
+        )
+        assert v2.cgroup_path("cpu.cfs_quota", "kubepods").endswith(
+            "kubepods/cpu.max"
+        )
+
+
+class TestMetricCache:
+    def test_aggregations(self):
+        cache = MetricCache()
+        for i in range(100):
+            cache.append(mc.NODE_CPU_USAGE, float(i), ts=float(i))
+        assert cache.query(mc.NODE_CPU_USAGE, start=0, end=99) == pytest.approx(49.5)
+        assert cache.query(mc.NODE_CPU_USAGE, start=0, end=99, agg=mc.AGG_P50) == 49
+        assert cache.query(mc.NODE_CPU_USAGE, start=0, end=99, agg=mc.AGG_P90) == 89
+        assert (
+            cache.query(mc.NODE_CPU_USAGE, start=0, end=99, agg=mc.AGG_LATEST) == 99
+        )
+        assert cache.query(mc.NODE_CPU_USAGE, start=200, end=300) is None
+
+    def test_window_and_labels(self):
+        cache = MetricCache()
+        cache.append(mc.POD_CPU_USAGE, 1.0, ts=10, labels={"pod": "a"})
+        cache.append(mc.POD_CPU_USAGE, 3.0, ts=10, labels={"pod": "b"})
+        assert (
+            cache.query(mc.POD_CPU_USAGE, start=0, end=20, labels={"pod": "b"}) == 3.0
+        )
+        assert len(cache.series_labels(mc.POD_CPU_USAGE)) == 2
+
+    def test_ring_overwrite(self):
+        cache = MetricCache(capacity_per_series=4)
+        for i in range(10):
+            cache.append("m", float(i), ts=float(i))
+        assert cache.query("m", start=0, end=100, agg=mc.AGG_COUNT) == 4
+
+    def test_save_load(self, tmp_path):
+        cache = MetricCache()
+        cache.append(mc.NODE_CPU_USAGE, 2.5, ts=1.0)
+        path = str(tmp_path / "tsdb.npz")
+        cache.save(path)
+        fresh = MetricCache()
+        assert fresh.load(path)
+        assert fresh.query(mc.NODE_CPU_USAGE, start=0, end=2) == 2.5
+
+
+class TestCollectors:
+    def test_node_cpu_from_stat_deltas(self, fs):
+        cache = MetricCache()
+        col = NodeResourceCollector(fs, cache)
+        write_proc(fs, "stat", "cpu  100 0 100 700 0 0 0 0\n")
+        write_proc(fs, "meminfo", "MemTotal: 1000 kB\nMemAvailable: 500 kB\n")
+        col.collect(0.0)
+        # +200 used ticks over 1s at 100 ticks/s = 2 cores
+        write_proc(fs, "stat", "cpu  200 0 200 800 0 0 0 0\n")
+        col.collect(1.0)
+        assert cache.query(
+            mc.NODE_CPU_USAGE, start=0, end=2, agg=mc.AGG_LATEST
+        ) == pytest.approx(2.0)
+
+    def test_pod_collector(self, fs):
+        cache = MetricCache()
+        pod = PodMeta(name="p", uid="u1", qos="Burstable")
+        cgdir = pod_cgroup_dir("Burstable", "u1")
+        col = PodResourceCollector(fs, cache, lambda: [pod])
+        fs.write(fs.cgroup_path("cpuacct.usage", cgdir), "0")
+        fs.write(fs.cgroup_path("memory.usage", cgdir), "1000")
+        col.collect(0.0)
+        fs.write(fs.cgroup_path("cpuacct.usage", cgdir), str(int(1.5e9)))
+        col.collect(1.0)
+        assert cache.query(
+            mc.POD_CPU_USAGE, start=0, end=2, agg=mc.AGG_LATEST, labels={"pod": "u1"}
+        ) == pytest.approx(1.5)
+
+    def test_advisor_intervals(self, fs):
+        cache = MetricCache()
+        write_proc(fs, "stat", "cpu  1 0 1 1 0 0 0 0\n")
+        write_proc(fs, "meminfo", "MemTotal: 2 kB\nMemAvailable: 1 kB\n")
+        adv = MetricsAdvisor([NodeResourceCollector(fs, cache)])
+        assert adv.run_once(0.0) == ["noderesource"]
+        assert adv.run_once(1.0) == []  # not due yet (10s interval)
+        assert adv.run_once(11.0) == ["noderesource"]
+
+
+class TestNodeMetricReport:
+    def test_report_shape(self, fs):
+        cache = MetricCache()
+        informer = StatesInformer()
+        informer.set_pods([PodMeta(name="p", uid="u1")])
+        for i in range(10):
+            cache.append(mc.NODE_CPU_USAGE, 1.0 + i * 0.1, ts=float(i))
+            cache.append(mc.NODE_MEMORY_USAGE, 1e9, ts=float(i))
+            cache.append(mc.POD_CPU_USAGE, 0.5, ts=float(i), labels={"pod": "u1"})
+        rep = NodeMetricReporter(cache, informer).collect(10.0)
+        assert rep["nodeMetric"]["nodeUsage"]["cpu"].endswith("m")
+        assert set(rep["nodeMetric"]["aggregatedNodeUsages"]) == {
+            "p50",
+            "p90",
+            "p95",
+            "p99",
+        }
+        assert rep["podsMetric"][0]["usage"]["cpu"] == "500m"
+
+    def test_report_none_without_metrics(self):
+        rep = NodeMetricReporter(MetricCache(), StatesInformer()).collect(10.0)
+        assert rep is None
+
+
+class TestResourceExecutor:
+    def test_cache_diff_skips_same_value(self, fs):
+        ex = ResourceUpdateExecutor(fs)
+        u = ResourceUpdate("cpu.cfs_quota", "kubepods", "10000")
+        assert ex.update(u, now=0)
+        assert not ex.update(u, now=1)  # cached
+        assert ex.update(ResourceUpdate("cpu.cfs_quota", "kubepods", "20000"), now=2)
+
+    def test_cache_expiry_rewrites(self, fs):
+        ex = ResourceUpdateExecutor(fs, cache_expire_seconds=10)
+        u = ResourceUpdate("cpu.cfs_quota", "kubepods", "10000")
+        ex.update(u, now=0)
+        assert ex.update(u, now=11)
+
+    def test_reader_cpuset(self, fs):
+        fs.write(fs.cgroup_path("cpuset.cpus", "kubepods"), "0-3,8,10-11\n")
+        assert CgroupReader(fs).read_cpuset("kubepods") == [0, 1, 2, 3, 8, 10, 11]
+
+    def test_format_cpuset_roundtrip(self):
+        assert format_cpuset([0, 1, 2, 3, 8, 10, 11]) == "0-3,8,10-11"
+        assert format_cpuset([]) == ""
+
+
+class TestCPUSuppress:
+    def test_formula_parity(self):
+        # suppress = 16000 * 65% - 6000(nonBE) - max(2000(sys), 0, 0) = 2400
+        got = calculate_be_suppress_cpu(
+            16000,
+            10.0,  # node usage cores
+            {"ls": 6.0, "be": 2.0},  # pods use 8 cores total
+            {"ls": False, "be": True},
+            65,
+        )
+        assert got == 16000 * 65 // 100 - 6000 - 2000
+
+    def test_reserved_floor(self):
+        got = calculate_be_suppress_cpu(
+            16000, 7.0, {"ls": 6.0}, {"ls": False}, 65,
+            node_anno_reserved_milli=3000,
+        )
+        # system used = 1000m but anno reserve 3000m wins
+        assert got == 16000 * 65 // 100 - 6000 - 3000
+
+    def test_strategy_writes_cfs_quota(self, fs):
+        cache = MetricCache()
+        informer = StatesInformer()
+        informer.set_node({"capacity_milli_cpu": 16000})
+        informer.set_node_slo(
+            {
+                "resourceUsedThresholdWithBE": {
+                    "enable": True,
+                    "cpuSuppressThresholdPercent": 65,
+                }
+            }
+        )
+        informer.set_pods([PodMeta(name="ls", uid="ls", koord_qos="LS")])
+        cache.append(mc.NODE_CPU_USAGE, 10.0, ts=9.0)
+        cache.append(mc.POD_CPU_USAGE, 6.0, ts=9.0, labels={"pod": "ls"})
+        ex = ResourceUpdateExecutor(fs)
+        s = CPUSuppressStrategy(informer, cache, ex)
+        s.tick(10.0)
+        quota = fs.read_cgroup("cpu.cfs_quota", KUBEPODS_BESTEFFORT)
+        # suppress = 10400 - 6000 - 4000(sys) = 400m -> 40000us
+        assert quota == str(400 * 100_000 // 1000)
+
+
+class TestMemoryEvict:
+    def test_evicts_lowest_priority_be_first(self):
+        cache = MetricCache()
+        informer = StatesInformer()
+        informer.set_node({"capacity_memory_bytes": 100})
+        informer.set_node_slo(
+            {
+                "resourceUsedThresholdWithBE": {
+                    "memoryEvictThresholdPercent": 70,
+                    "memoryEvictLowerPercent": 60,
+                }
+            }
+        )
+        informer.set_pods(
+            [
+                PodMeta(name="be1", uid="be1", koord_qos="BE"),
+                PodMeta(name="be2", uid="be2", koord_qos="BE"),
+            ],
+            specs={"be1": {"priority": 100}, "be2": {"priority": 10}},
+        )
+        cache.append(mc.NODE_MEMORY_USAGE, 80.0, ts=9.0)
+        cache.append(mc.POD_MEMORY_USAGE, 30.0, ts=9.0, labels={"pod": "be2"})
+        evictor = Evictor()
+        MemoryEvictStrategy(informer, cache, evictor).tick(10.0)
+        assert [e.pod.name for e in evictor.evicted] == ["be2"]
+
+
+class TestRuntimeHooks:
+    def test_group_identity_and_batch_resource(self):
+        reg = default_registry()
+        ctx = ContainerContext(
+            qos="BE",
+            requests={"kubernetes.io/batch-cpu": 2000},
+            limits={"kubernetes.io/batch-memory": 1 << 30},
+        )
+        ran = reg.run(PRE_CREATE_CONTAINER, ctx)
+        assert "groupidentity" in ran and "batchresource" in ran
+        assert ctx.bvt_warp_ns == -1
+        assert ctx.cfs_quota_us == 2000 * 100_000 // 1000
+        assert ctx.memory_limit_bytes == 1 << 30
+
+    def test_cpuset_and_device_env_from_annotations(self):
+        reg = default_registry()
+        ctx = ContainerContext(
+            qos="LSR",
+            pod_annotations={
+                "scheduling.koordinator.sh/resource-status": {"cpuset": "0-3"},
+                "scheduling.koordinator.sh/device-allocated": {"minors": [0, 1]},
+            },
+        )
+        reg.run(PRE_CREATE_CONTAINER, ctx)
+        assert ctx.cpuset_cpus == "0-3"
+        assert ctx.env["TPU_VISIBLE_CHIPS"] == "0,1"
+
+    def test_cpu_normalization_scales_quota(self):
+        reg = default_registry(cpu_normalization_ratio=lambda: 1.5)
+        ctx = ContainerContext(qos="LS", requests={"kubernetes.io/batch-cpu": 1000})
+        reg.run(PRE_CREATE_CONTAINER, ctx)
+        assert ctx.cfs_quota_us == int(1000 * 100_000 // 1000 * 1.5)
+
+    def test_reconciler_applies_to_cgroup(self, fs):
+        reg = default_registry()
+        ex = ResourceUpdateExecutor(fs)
+        ctx = ContainerContext(
+            qos="BE",
+            cgroup_dir="kubepods/besteffort/podx",
+            requests={"kubernetes.io/batch-cpu": 500},
+        )
+        n = Reconciler(reg, ex).reconcile_container(ctx)
+        assert n >= 2
+        assert fs.read_cgroup("cpu.cfs_quota", "kubepods/besteffort/podx") == str(
+            500 * 100_000 // 1000
+        )
+        assert fs.read_cgroup("cpu.bvt_warp_ns", "kubepods/besteffort/podx") == "-1"
+
+
+class TestPrediction:
+    def test_histogram_percentile(self):
+        h = DecayHistogram()
+        for _ in range(100):
+            h.add(1.0, ts=0.0)
+        h.add(10.0, ts=0.0)
+        assert h.percentile(50) <= 1.2
+        assert h.percentile(100) > 9
+
+    def test_decay_prefers_recent(self):
+        h = DecayHistogram(half_life_seconds=3600)
+        h.add(10.0, ts=0.0)
+        for _ in range(3):
+            h.add(1.0, ts=10 * 3600.0)  # much later, heavily weighted
+        assert h.percentile(70) <= 1.2
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        cp = FileCheckpointer(str(tmp_path / "ckpt"))
+        srv = PeakPredictServer(cp, cold_start_seconds=0)
+        for i in range(50):
+            srv.update("prod", 2.0, ts=float(i))
+        srv.checkpoint_all()
+        srv2 = PeakPredictServer(cp, cold_start_seconds=0)
+        assert srv2.peak("prod", now=100.0) == pytest.approx(
+            srv.peak("prod", now=100.0)
+        )
+
+    def test_cold_start_returns_none(self):
+        srv = PeakPredictServer(cold_start_seconds=1000)
+        srv.update("prod", 1.0, ts=0.0)
+        assert srv.peak("prod", now=10.0) is None
+
+    def test_prod_reclaimable(self):
+        srv = PeakPredictServer(cold_start_seconds=0, safety_margin_percent=0)
+        for i in range(100):
+            srv.update("prod", 4.0, ts=float(i))
+        rec = srv.prod_reclaimable(prod_allocated=10.0, now=200.0)
+        assert 5.0 < rec < 6.1  # 10 - ~4.x
+
+
+class TestPleg:
+    def test_pod_lifecycle_events(self, fs):
+        pleg = Pleg(fs)
+        assert pleg.poll_once() == []
+        poddir = os.path.join(
+            fs.root, fs.cgroup_mount, "kubepods/besteffort/podabc-123"
+        )
+        os.makedirs(os.path.join(poddir, "container1"))
+        events = pleg.poll_once()
+        kinds = [(e.kind, e.pod_uid) for e in events]
+        assert (POD_ADDED, "abc-123") in kinds
+        assert (CONTAINER_ADDED, "abc-123") in [
+            (e.kind, e.pod_uid) for e in events if e.container_id
+        ]
+        import shutil
+
+        shutil.rmtree(poddir)
+        events = pleg.poll_once()
+        assert any(e.kind == POD_DELETED for e in events)
+
+
+class TestAudit:
+    def test_log_and_read(self, tmp_path):
+        a = Auditor(str(tmp_path / "audit"))
+        a.log("cgroup_write", resource="cpu.cfs_quota", value="1000")
+        a.log("evict", pod="be-1")
+        events = a.read_events()
+        assert events[0]["event"] in ("cgroup_write", "evict")
+        assert len(a.read_events(event="evict")) == 1
+
+    def test_rotation(self, tmp_path):
+        a = Auditor(str(tmp_path / "audit"), max_file_bytes=200, max_files=3)
+        for i in range(50):
+            a.log("e", i=i)
+        assert len(a.read_events(limit=1000)) < 50  # oldest dropped
+        assert os.path.exists(os.path.join(str(tmp_path / "audit"), "audit.log.1"))
+
+
+class TestDaemon:
+    def test_wiring_run_once(self, fs, tmp_path):
+        d = Daemon(
+            fs,
+            audit_dir=str(tmp_path / "audit"),
+            checkpoint_dir=str(tmp_path / "ckpt"),
+        )
+        write_proc(fs, "stat", "cpu  100 0 100 700 0 0 0 0\n")
+        write_proc(fs, "meminfo", "MemTotal: 1000 kB\nMemAvailable: 500 kB\n")
+        out = d.run_once(0.0)
+        assert "noderesource" in out["collectors"]
+        # second tick produces a node metric report
+        write_proc(fs, "stat", "cpu  200 0 200 800 0 0 0 0\n")
+        out = d.run_once(30.0)
+        assert out["node_metric"] is not None
