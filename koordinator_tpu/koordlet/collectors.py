@@ -217,6 +217,38 @@ class PerformanceCollector(Collector):
             )
 
 
+def make_native_perf_reader(fs: SysFS):
+    """Perf reader backed by the native CPI shim
+    (``koordinator_tpu.native.PerfCPIGroup``; reference cgo path
+    ``perf_group_linux.go collectContainerCPI``): opens the pod cgroup dir
+    as a perf cgroup target.  Returns None when perf is unavailable so the
+    PerformanceCollector disables itself (feature-gate semantics)."""
+    import os
+
+    from koordinator_tpu import native
+
+    if not native.available() or native.read_self_cpi() is None:
+        return None
+
+    def reader(pod: "PodMeta"):
+        cgdir = os.path.join(
+            fs.root, "sys/fs/cgroup/perf_event", pod_cgroup_dir(pod.qos, pod.uid)
+        )
+        try:
+            fd = os.open(cgdir, os.O_RDONLY)
+        except OSError:
+            return None
+        try:
+            with native.PerfCPIGroup(fd, is_cgroup=True) as g:
+                return g.read()
+        except OSError:
+            return None
+        finally:
+            os.close(fd)
+
+    return reader
+
+
 class ColdMemoryCollector(Collector):
     """kidled cold-page accounting (collectors/coldmemoryresource
     cold_page_kidled.go): reads idle-page stats to size reclaimable

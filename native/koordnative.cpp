@@ -1,0 +1,180 @@
+// koordnative — native runtime shims for the koordinator_tpu node agent.
+//
+// Three surfaces, all extern "C" for ctypes:
+//
+// 1. perf group counters: grouped perf_event_open fds reading
+//    cycles+instructions per cgroup/pid for CPI collection.  The reference
+//    does this through cgo + libpfm4
+//    (reference pkg/koordlet/util/perf_group/perf_group_linux.go:38-45);
+//    here raw perf_event_open(2) with PERF_FORMAT_GROUP covers the same
+//    two-event group without the libpfm dependency.
+// 2. batched small-file reader: one call reads N cgroup/proc files into a
+//    caller buffer — the koordlet collectors' hot path (hundreds of tiny
+//    reads per tick) without Python syscall overhead per file.
+// 3. snapshot delta encoder: XOR-RLE delta between two int64 snapshot
+//    tensors, the host->device transfer trimming for warm cycles
+//    (SURVEY §7 "delta encoding and on-device snapshot residency").
+//
+// Build: make -C native   (produces libkoordnative.so)
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#if defined(__linux__)
+#include <linux/perf_event.h>
+#include <sys/ioctl.h>
+#include <sys/syscall.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// perf group (CPI: cycles + instructions)
+// ---------------------------------------------------------------------------
+
+// Opens a two-event group {cpu-cycles, instructions} for `pid` (or a cgroup
+// fd with PERF_FLAG_PID_CGROUP when `is_cgroup_fd` != 0) on `cpu`
+// (-1 = any).  Returns the group-leader fd, or -errno.
+int koord_perf_open_cpi_group(int pid, int cpu, int is_cgroup_fd) {
+#if defined(__linux__)
+  struct perf_event_attr attr;
+  memset(&attr, 0, sizeof(attr));
+  attr.size = sizeof(attr);
+  attr.type = PERF_TYPE_HARDWARE;
+  attr.config = PERF_COUNT_HW_CPU_CYCLES;
+  attr.disabled = 1;
+  attr.inherit = 1;
+  attr.read_format = PERF_FORMAT_GROUP;
+  unsigned long flags = is_cgroup_fd ? PERF_FLAG_PID_CGROUP : 0;
+
+  int leader =
+      (int)syscall(__NR_perf_event_open, &attr, pid, cpu, -1, flags);
+  if (leader < 0) return -errno;
+
+  memset(&attr, 0, sizeof(attr));
+  attr.size = sizeof(attr);
+  attr.type = PERF_TYPE_HARDWARE;
+  attr.config = PERF_COUNT_HW_INSTRUCTIONS;
+  attr.disabled = 0;
+  attr.inherit = 1;
+  attr.read_format = PERF_FORMAT_GROUP;
+  int second =
+      (int)syscall(__NR_perf_event_open, &attr, pid, cpu, leader, flags);
+  if (second < 0) {
+    int err = errno;
+    close(leader);
+    return -err;
+  }
+  // the group is read through the leader; enable it
+  if (ioctl(leader, PERF_EVENT_IOC_ENABLE, PERF_IOC_FLAG_GROUP) != 0) {
+    int err = errno;
+    close(second);
+    close(leader);
+    return -err;
+  }
+  return leader;
+#else
+  (void)pid;
+  (void)cpu;
+  (void)is_cgroup_fd;
+  return -ENOSYS;
+#endif
+}
+
+// Reads {cycles, instructions} from a group leader fd into out[2].
+// Returns 0 or -errno.
+int koord_perf_read_cpi(int leader_fd, uint64_t *out) {
+#if defined(__linux__)
+  // PERF_FORMAT_GROUP layout: u64 nr; struct { u64 value; } values[nr];
+  uint64_t buf[1 + 2];
+  ssize_t n = read(leader_fd, buf, sizeof(buf));
+  if (n < 0) return -errno;
+  if (buf[0] < 2) return -EINVAL;
+  out[0] = buf[1];
+  out[1] = buf[2];
+  return 0;
+#else
+  (void)leader_fd;
+  (void)out;
+  return -ENOSYS;
+#endif
+}
+
+int koord_perf_close(int leader_fd) {
+  return close(leader_fd) == 0 ? 0 : -errno;
+}
+
+// ---------------------------------------------------------------------------
+// batched small-file reader
+// ---------------------------------------------------------------------------
+
+// Reads `n` files (NUL-separated paths in `paths`, total `paths_len`
+// bytes).  Each file's content (up to max_per_file-1 bytes, NUL
+// terminated) lands at out + i*max_per_file; sizes[i] = bytes read, or -1
+// on open/read failure.  Returns the number of files read successfully.
+int koord_read_files(const char *paths, int paths_len, int n, char *out,
+                     long long *sizes, int max_per_file) {
+  int ok = 0;
+  const char *p = paths;
+  const char *end = paths + paths_len;
+  for (int i = 0; i < n; i++) {
+    if (p >= end) {
+      sizes[i] = -1;
+      continue;
+    }
+    char *dst = out + (long long)i * max_per_file;
+    int fd = open(p, O_RDONLY | O_CLOEXEC);
+    if (fd < 0) {
+      sizes[i] = -1;
+    } else {
+      ssize_t got = read(fd, dst, max_per_file - 1);
+      if (got < 0) {
+        sizes[i] = -1;
+      } else {
+        dst[got] = '\0';
+        sizes[i] = got;
+        ok++;
+      }
+      close(fd);
+    }
+    p += strlen(p) + 1;
+  }
+  return ok;
+}
+
+// ---------------------------------------------------------------------------
+// snapshot delta encoder
+// ---------------------------------------------------------------------------
+
+// Encodes the element indices and values of prev[i] != next[i] into
+// idx/val (capacity cap).  Returns the number of changed elements, or -1
+// when the delta exceeds cap (caller falls back to a full transfer).
+long long koord_delta_encode_i64(const int64_t *prev, const int64_t *next,
+                                 long long n, long long *idx, int64_t *val,
+                                 long long cap) {
+  long long m = 0;
+  for (long long i = 0; i < n; i++) {
+    if (prev[i] != next[i]) {
+      if (m >= cap) return -1;
+      idx[m] = i;
+      val[m] = next[i];
+      m++;
+    }
+  }
+  return m;
+}
+
+// Applies a delta in place: base[idx[j]] = val[j].
+void koord_delta_apply_i64(int64_t *base, const long long *idx,
+                           const int64_t *val, long long m) {
+  for (long long j = 0; j < m; j++) {
+    base[idx[j]] = val[j];
+  }
+}
+
+}  // extern "C"

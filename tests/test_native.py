@@ -1,0 +1,55 @@
+"""Native shim library: build, batched reads, delta codec, perf fallback.
+
+Reference native boundary: cgo libpfm4 perf groups
+(pkg/koordlet/util/perf_group/perf_group_linux.go); the delta codec backs
+SURVEY §7's host->device transfer trimming.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from koordinator_tpu import native
+
+
+class TestLibrary:
+    def test_builds_and_loads(self):
+        # the build toolchain is part of the runtime contract; if this
+        # fails the collectors silently lose their native fast path
+        assert native.available()
+
+    def test_read_files_batch(self, tmp_path):
+        for i in range(5):
+            (tmp_path / f"f{i}").write_text(f"value-{i}\n")
+        paths = [str(tmp_path / f"f{i}") for i in range(5)] + ["/no/such/file"]
+        got = native.read_files(paths)
+        assert got[:5] == [f"value-{i}\n" for i in range(5)]
+        assert got[5] is None
+
+    def test_delta_roundtrip(self):
+        rng = np.random.default_rng(0)
+        prev = rng.integers(0, 1000, size=(64, 13)).astype(np.int64)
+        nxt = prev.copy()
+        nxt[rng.integers(0, 64, 20), rng.integers(0, 13, 20)] += 7
+        idx, val = native.delta_encode(prev, nxt)
+        base = prev.copy()
+        native.delta_apply(base, idx, val)
+        assert (base == nxt).all()
+
+    def test_delta_cap_falls_back(self):
+        prev = np.zeros(100, np.int64)
+        nxt = np.ones(100, np.int64)
+        assert native.delta_encode(prev, nxt, max_changes=10) is None
+
+    def test_delta_empty(self):
+        a = np.arange(10, dtype=np.int64)
+        idx, val = native.delta_encode(a, a)
+        assert len(idx) == 0
+
+    def test_perf_graceful(self):
+        # perf_event_open is usually fenced off in CI containers; the API
+        # must degrade to None, never crash (the reference gates CPI
+        # collection behind a feature gate the same way)
+        got = native.read_self_cpi()
+        assert got is None or (got[0] > 0 and got[1] > 0)
