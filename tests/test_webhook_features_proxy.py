@@ -1,0 +1,193 @@
+"""Validating webhook rules, feature gates, runtime proxy interposition.
+
+Reference: pkg/webhook/pod/validating/cluster_colocation_profile.go,
+pkg/webhook/elasticquota, pkg/features, pkg/runtimeproxy.
+"""
+
+import pytest
+
+from koordinator_tpu.features import (
+    FeatureGate,
+    KOORDLET_FEATURES,
+    default_koordlet_gate,
+    is_feature_disabled,
+)
+from koordinator_tpu.manager.validating import (
+    validate_node_colocation,
+    validate_pod,
+    validate_quota_tree,
+)
+from koordinator_tpu.koordlet.runtimehooks import default_registry
+from koordinator_tpu.runtimeproxy import CRIRequest, FailurePolicy, RuntimeProxy
+
+
+class TestValidatePod:
+    def test_batch_resources_require_be(self):
+        pod = {
+            "requests": {"kubernetes.io/batch-cpu": 1000},
+            "labels": {"koordinator.sh/qosClass": "LS"},
+            "priority_class": "koord-batch",
+        }
+        errs = validate_pod(pod)
+        assert any("QoS BE" in e for e in errs)
+        pod["labels"]["koordinator.sh/qosClass"] = "BE"
+        pod["priority_class"] = "koord-batch"
+        assert validate_pod(pod) == []
+
+    def test_forbidden_combinations(self):
+        assert validate_pod(
+            {"labels": {"koordinator.sh/qosClass": "BE"}, "priority_class": "koord-prod"}
+        )
+        assert validate_pod(
+            {"labels": {"koordinator.sh/qosClass": "LSR"}, "priority_class": "koord-batch",
+             "requests": {"cpu": "2"}}
+        )
+        # LSR + prod + integer cpu is fine
+        assert (
+            validate_pod(
+                {
+                    "labels": {"koordinator.sh/qosClass": "LSR"},
+                    "priority_class": "koord-prod",
+                    "requests": {"cpu": "2"},
+                }
+            )
+            == []
+        )
+
+    def test_lsr_integer_cpu(self):
+        base = {
+            "labels": {"koordinator.sh/qosClass": "LSR"},
+            "priority_class": "koord-prod",
+        }
+        assert any(
+            "must declare" in e for e in validate_pod({**base, "requests": {}})
+        )
+        assert any(
+            "integer" in e
+            for e in validate_pod({**base, "requests": {"cpu": "1500m"}})
+        )
+
+    def test_immutability_on_update(self):
+        old = {"labels": {"koordinator.sh/qosClass": "LS"}, "priority_class": "koord-prod"}
+        new = {"labels": {"koordinator.sh/qosClass": "BE"}, "priority_class": "koord-batch"}
+        errs = validate_pod(new, old_pod=old)
+        assert any("immutable" in e for e in errs)
+
+
+class TestQuotaTree:
+    def test_valid_tree(self):
+        quotas = [
+            {"name": "root", "min": {"cpu": "20"}, "max": {"cpu": "40"}},
+            {"name": "a", "parent": "root", "min": {"cpu": "10"}, "max": {"cpu": "20"}},
+            {"name": "b", "parent": "root", "min": {"cpu": "10"}, "max": {"cpu": "20"}},
+        ]
+        assert validate_quota_tree(quotas) == []
+
+    def test_children_min_exceeds_parent(self):
+        quotas = [
+            {"name": "root", "min": {"cpu": "10"}, "max": {"cpu": "40"}},
+            {"name": "a", "parent": "root", "min": {"cpu": "8"}, "max": {"cpu": "20"}},
+            {"name": "b", "parent": "root", "min": {"cpu": "8"}, "max": {"cpu": "20"}},
+        ]
+        assert any("children min sum" in e for e in validate_quota_tree(quotas))
+
+    def test_missing_parent_and_min_gt_max(self):
+        errs = validate_quota_tree(
+            [{"name": "x", "parent": "ghost", "min": {"cpu": "30"}, "max": {"cpu": "20"}}]
+        )
+        assert any("does not exist" in e for e in errs)
+        assert any("exceeds max" in e for e in errs)
+
+
+class TestNodeValidation:
+    def test_batch_exceeds_capacity(self):
+        node = {
+            "capacity": {"cpu": "16"},
+            "allocatable": {"kubernetes.io/batch-cpu": 20000},
+        }
+        assert validate_node_colocation(node)
+        node["allocatable"]["kubernetes.io/batch-cpu"] = 10000
+        assert validate_node_colocation(node) == []
+
+
+class TestFeatureGates:
+    def test_defaults(self):
+        assert default_koordlet_gate.enabled("BECPUSuppress")
+        assert not default_koordlet_gate.enabled("CPICollector")
+
+    def test_parse_and_override(self):
+        g = FeatureGate(KOORDLET_FEATURES)
+        g.parse("CPICollector=true,BECPUSuppress=false")
+        assert g.enabled("CPICollector")
+        assert not g.enabled("BECPUSuppress")
+        with pytest.raises(KeyError):
+            g.set("NoSuchGate", True)
+
+    def test_nodeslo_disable(self):
+        slo = {"resourceUsedThresholdWithBE": {"enable": True}}
+        assert not is_feature_disabled(slo, "BECPUSuppress")
+        assert is_feature_disabled({}, "BECPUSuppress")
+        assert is_feature_disabled(
+            {"resourceUsedThresholdWithBE": {"enable": False}}, "BECPUEvict"
+        )
+
+
+class TestRuntimeProxy:
+    def _proxy(self, policy=FailurePolicy.IGNORE, registry=None):
+        calls = []
+
+        def backend(req):
+            calls.append(req)
+            return {"ok": True}
+
+        proxy = RuntimeProxy(
+            registry or default_registry(), backend, failure_policy=policy
+        )
+        return proxy, calls
+
+    def test_create_container_mutated_by_hooks(self):
+        proxy, calls = self._proxy()
+        proxy.intercept(
+            CRIRequest(
+                call="RunPodSandbox",
+                pod_uid="u1",
+                labels={"koordinator.sh/qosClass": "BE"},
+                annotations={
+                    "scheduling.koordinator.sh/resource-status": {"cpuset": "4-7"}
+                },
+            )
+        )
+        proxy.intercept(
+            CRIRequest(call="CreateContainer", pod_uid="u1", container_name="c1")
+        )
+        created = calls[-1]
+        # cpuset hook applied from the sandbox's stored annotations
+        assert created.cpuset_cpus == "4-7"
+        assert ("u1", "c1") in proxy.containers
+
+    def test_stop_sandbox_clears_store(self):
+        proxy, _ = self._proxy()
+        proxy.intercept(CRIRequest(call="RunPodSandbox", pod_uid="u1"))
+        proxy.intercept(
+            CRIRequest(call="CreateContainer", pod_uid="u1", container_name="c1")
+        )
+        proxy.intercept(CRIRequest(call="StopPodSandbox", pod_uid="u1"))
+        assert "u1" not in proxy.pods and not proxy.containers
+
+    def test_failure_policy(self):
+        from koordinator_tpu.koordlet.runtimehooks import (
+            HookRegistry,
+            PRE_CREATE_CONTAINER,
+        )
+
+        bad = HookRegistry()
+        bad.register(PRE_CREATE_CONTAINER, "boom", lambda ctx: 1 / 0)
+        proxy, calls = self._proxy(registry=bad)
+        # Ignore: forwarded untouched
+        proxy.intercept(CRIRequest(call="CreateContainer", pod_uid="u", container_name="c"))
+        assert len(calls) == 1
+        proxy_fail, _ = self._proxy(policy=FailurePolicy.FAIL, registry=bad)
+        with pytest.raises(ZeroDivisionError):
+            proxy_fail.intercept(
+                CRIRequest(call="CreateContainer", pod_uid="u", container_name="c")
+            )
