@@ -24,7 +24,7 @@ class FeatureGate:
         # name -> (default_enabled, prerelease)
         self._specs: Dict[str, tuple] = dict(defaults)
         self._overrides: Dict[str, bool] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
 
     def enabled(self, feature: str) -> bool:
         with self._lock:
@@ -44,13 +44,29 @@ class FeatureGate:
             self.set(k, bool(v))
 
     def parse(self, spec: str) -> None:
-        """'A=true,B=false' (the --feature-gates flag format)."""
+        """'A=true,B=false' (the --feature-gates flag format).
+
+        Unparseable values raise, matching component-base's strict boolean
+        parsing — a typo must not silently flip a gate."""
+        parsed = {}
         for part in spec.split(","):
             part = part.strip()
             if not part:
                 continue
-            name, _, val = part.partition("=")
-            self.set(name.strip(), val.strip().lower() in ("true", "1", "yes", ""))
+            name, eq, val = part.partition("=")
+            val = val.strip().lower()
+            if not eq or val in ("true", "1"):
+                # bare "Name" means enable, like upstream's map form
+                parsed[name.strip()] = True
+            elif val in ("false", "0"):
+                parsed[name.strip()] = False
+            else:
+                raise ValueError(
+                    f"invalid feature gate value {part!r}: want Name=true|false"
+                )
+        # apply only after the whole spec parsed: an error must not leave
+        # a half-applied gate set
+        self.set_from_map(parsed)
 
     def known(self) -> Dict[str, bool]:
         with self._lock:

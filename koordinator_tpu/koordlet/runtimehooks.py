@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import dataclasses
 import json
+
+from koordinator_tpu.model import resources as res
 from typing import Callable, Dict, List, Mapping, Optional, Sequence
 
 from koordinator_tpu.koordlet.qosmanager import BVT_BY_QOS, CFS_PERIOD_US
@@ -114,7 +116,10 @@ def batch_resource_hook(ctx: ContainerContext) -> None:
         "kubernetes.io/batch-memory"
     )
     if mem:
-        ctx.memory_limit_bytes = mem
+        # webhook-mutated pods carry "<n>Mi" strings; raw numbers are bytes
+        ctx.memory_limit_bytes = res.parse_quantity_bytes(
+            mem, "kubernetes.io/batch-memory"
+        )
 
 
 DEVICE_ALLOCATED_ANNOTATION = "scheduling.koordinator.sh/device-allocated"

@@ -6,7 +6,7 @@ Reference: ``pkg/slo-controller/noderesource`` — BatchResource plugin
 degrade-on-stale-metric (``batchresource/plugin.go:370-388``), and the
 sync-needed diff check (``util.IsResourceDiff``).
 
-The math runs on dense ``[cpu_milli, memory_bytes]`` numpy vectors —
+The math runs on dense ``[cpu_milli, memory_mib]`` numpy vectors —
 exact integer arithmetic, matching the reference's resource.Quantity
 accounting.  For whole-cluster reconciliation, ``batch_allocatable_batch``
 computes every node at once as one vectorized program (the TPU-friendly
@@ -26,7 +26,7 @@ from koordinator_tpu.manager.sloconfig import (
 )
 from koordinator_tpu.model import resources as res
 
-# dense axis for this module: [cpu (milli), memory (bytes)]
+# dense axis for this module: [cpu (milli), memory (MiB)]
 CPU, MEM = 0, 1
 
 PRIORITY_PROD = "koord-prod"
@@ -59,7 +59,7 @@ def priority_class_of(pod: Mapping) -> str:
 
 
 def _vec(rl: Optional[Mapping[str, object]]) -> np.ndarray:
-    """[cpu_milli, mem_bytes] int64 vector from a resource dict."""
+    """[cpu_milli, mem_mib] int64 vector from a resource dict."""
     out = np.zeros(2, dtype=np.int64)
     if rl:
         v = res.resource_vector(rl)
@@ -71,7 +71,7 @@ def _vec(rl: Optional[Mapping[str, object]]) -> np.ndarray:
 @dataclasses.dataclass
 class BatchResourceResult:
     batch_cpu_milli: int
-    batch_memory_bytes: int
+    batch_memory_mib: int
     degraded: bool
     message: str
 
@@ -80,7 +80,9 @@ class BatchResourceResult:
             return {}
         return {
             res.BATCH_CPU: self.batch_cpu_milli,
-            res.BATCH_MEMORY: self.batch_memory_bytes,
+            res.BATCH_MEMORY: res.format_quantity(
+                self.batch_memory_mib, res.BATCH_MEMORY
+            ),
         }
 
 
@@ -235,6 +237,8 @@ def need_sync(
             return True
         if old is None or new is None:
             continue
+        old = res.parse_quantity(old, name)
+        new = res.parse_quantity(new, name)
         if old == 0:
             if new != 0:
                 return True

@@ -98,7 +98,9 @@ def mutate_pod_resources(pod: Mapping[str, Any]) -> Dict[str, Any]:
         for native, extended in name_map.items():
             if native in rl:
                 qty = res.parse_quantity(rl.pop(native), native)
-                rl[extended] = qty
+                # axis units (milli / MiB) must round-trip through a
+                # second parse when the mutated pod is encoded again
+                rl[extended] = res.format_quantity(qty, extended)
     return out
 
 

@@ -49,11 +49,13 @@ def reconcile_profile(
     ratio = spec.get("resourceRatio")
     scaled = scale_total(total, float(ratio) if ratio is not None else None)
     tree_id = spec.get("treeID") or profile.get("name", "")
+    # axis-unit ints must round-trip through a later parse_quantity
+    quantities = {n: res.format_quantity(v, n) for n, v in scaled.items()}
     return {
         "name": spec.get("quotaName", profile.get("name", "")),
         "labels": {LABEL_QUOTA_TREE_ID: tree_id, LABEL_QUOTA_IS_ROOT: "true"},
-        "min": dict(scaled),
-        "max": dict(scaled),
+        "min": dict(quantities),
+        "max": dict(quantities),
     }
 
 

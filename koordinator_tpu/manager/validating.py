@@ -25,10 +25,15 @@ _FORBIDDEN = {
 }
 
 
-def _pod_requests(pod: Mapping) -> Dict[str, int]:
+def _safe_parse(rl: Mapping, errs: List[str], where: str) -> Dict[str, int]:
+    """Parse a resource list; malformed quantities become admission error
+    strings (the reference denies with a field error, never crashes)."""
     out: Dict[str, int] = {}
-    for name, qty in (pod.get("requests") or {}).items():
-        out[name] = res.parse_quantity(qty, name)
+    for name, qty in (rl or {}).items():
+        try:
+            out[name] = res.parse_quantity(qty, name)
+        except ValueError:
+            errs.append(f"{where}[{name}]: unparseable quantity {qty!r}")
     return out
 
 
@@ -40,7 +45,7 @@ def validate_pod(
     labels = pod.get("labels") or {}
     qos = labels.get(LABEL_POD_QOS, pod.get("qos", ""))
     priority_class = pod.get("priority_class", "") or ""
-    requests = _pod_requests(pod)
+    requests = _safe_parse(pod.get("requests") or {}, errs, "requests")
 
     if old_pod is not None:
         old_labels = old_pod.get("labels") or {}
@@ -84,11 +89,8 @@ def validate_quota_tree(quotas: Sequence[Mapping[str, Any]]) -> List[str]:
     errs: List[str] = []
     by_name = {q["name"]: q for q in quotas}
 
-    def vec(m):
-        out: Dict[str, int] = {}
-        for k, v in (m or {}).items():
-            out[k] = res.parse_quantity(v, k)
-        return out
+    def vec(m, where):
+        return _safe_parse(m or {}, errs, where)
 
     children: Dict[str, List[str]] = {}
     for q in quotas:
@@ -99,16 +101,16 @@ def validate_quota_tree(quotas: Sequence[Mapping[str, Any]]) -> List[str]:
                 errs.append(f"{name}: parent quota {parent} does not exist")
             else:
                 children.setdefault(parent, []).append(name)
-        mn, mx = vec(q.get("min")), vec(q.get("max"))
+        mn, mx = vec(q.get("min"), f"{name}.min"), vec(q.get("max"), f"{name}.max")
         for dim, v in mn.items():
             if dim in mx and v > mx[dim]:
                 errs.append(f"{name}: min[{dim}] {v} exceeds max {mx[dim]}")
 
     for parent, kids in children.items():
-        pmin = vec(by_name[parent].get("min")) if parent in by_name else {}
+        pmin = vec(by_name[parent].get("min"), f"{parent}.min") if parent in by_name else {}
         total: Dict[str, int] = {}
         for kid in kids:
-            for dim, v in vec(by_name[kid].get("min")).items():
+            for dim, v in vec(by_name[kid].get("min"), f"{kid}.min").items():
                 total[dim] = total.get(dim, 0) + v
         for dim, v in total.items():
             if v > pmin.get(dim, 0):
@@ -123,13 +125,8 @@ def validate_node_colocation(node: Mapping[str, Any]) -> List[str]:
     """Node validating webhook (pkg/webhook/node): batch allocatable must
     not exceed node capacity."""
     errs: List[str] = []
-    cap = {
-        k: res.parse_quantity(v, k) for k, v in (node.get("capacity") or {}).items()
-    }
-    alloc = {
-        k: res.parse_quantity(v, k)
-        for k, v in (node.get("allocatable") or {}).items()
-    }
+    cap = _safe_parse(node.get("capacity") or {}, errs, "capacity")
+    alloc = _safe_parse(node.get("allocatable") or {}, errs, "allocatable")
     pairs = [(res.BATCH_CPU, res.CPU), (res.BATCH_MEMORY, res.MEMORY)]
     for batch_name, native_name in pairs:
         b = alloc.get(batch_name, 0)

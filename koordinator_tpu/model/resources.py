@@ -53,6 +53,17 @@ RESOURCE_INDEX: Dict[str, int] = {name: i for i, name in enumerate(RESOURCE_AXIS
 # native cpu; batch-cpu / mid-cpu quantities are already expressed in milli).
 _MILLI_RESOURCES = frozenset({CPU})
 
+# Byte-denominated resources are accounted in MiB on the dense axis (the
+# reference accounts them in bytes via Quantity.Value()).  MiB units keep
+# every scoring intermediate — (capacity - requested) * MaxNodeScore — inside
+# int32 for capacities up to 2^31/100 MiB (~20 TiB per node), which lets the
+# Pallas cycle kernel run exact integer score math on the VPU without int64
+# emulation.  Inputs remain k8s byte quantities; only the axis unit changes.
+MIB_RESOURCES = frozenset(
+    {MEMORY, EPHEMERAL_STORAGE, BATCH_MEMORY, MID_MEMORY, GPU_MEMORY}
+)
+MIB = 1024 * 1024
+
 _BINARY_SUFFIX = {
     "Ki": 1024,
     "Mi": 1024**2,
@@ -77,33 +88,67 @@ _DECIMAL_SUFFIX = {
 _QUANTITY_RE = re.compile(r"^([+-]?[0-9.]+)([A-Za-z]*)$")
 
 
+def _base_units(value, resource: str) -> float:
+    """Quantity -> float base units (bytes for memory, cores for cpu)."""
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        return float(value)
+    text = str(value).strip()
+    m = _QUANTITY_RE.match(text)
+    if m is None:
+        raise ValueError(f"unparseable quantity {value!r} for {resource}")
+    digits, suffix = m.groups()
+    if suffix in _BINARY_SUFFIX:
+        return float(digits) * _BINARY_SUFFIX[suffix]
+    if suffix in _DECIMAL_SUFFIX:
+        return float(digits) * _DECIMAL_SUFFIX[suffix]
+    raise ValueError(f"unknown quantity suffix {suffix!r} in {value!r}")
+
+
+def _ceil(base: float) -> int:
+    # Quantity.Value() rounds up to the nearest integer.
+    iv = int(base)
+    return iv if iv == base or base < 0 else iv + 1
+
+
 def parse_quantity(value, resource: str) -> int:
     """Parse a quantity into the integer unit used on the resource axis.
 
     ``cpu`` is returned in milli-cores (``"1.5" -> 1500``, ``"500m" -> 500``);
+    byte-denominated resources (memory, ephemeral-storage, batch/mid memory,
+    gpu-memory) in MiB rounded up (``"1Gi" -> 1024``, ``"512Mi" -> 512``);
     all other resources in base units rounded up like apimachinery's
-    ``Quantity.Value()`` (``"1Gi" -> 1073741824``, ``"100m" -> 1`` for
-    non-cpu, matching ceil semantics).
+    ``Quantity.Value()`` (``"100m" -> 1`` for non-cpu, matching ceil
+    semantics).
     """
-    if isinstance(value, (int, float)) and not isinstance(value, bool):
-        base = float(value)
-    else:
-        text = str(value).strip()
-        m = _QUANTITY_RE.match(text)
-        if m is None:
-            raise ValueError(f"unparseable quantity {value!r} for {resource}")
-        digits, suffix = m.groups()
-        if suffix in _BINARY_SUFFIX:
-            base = float(digits) * _BINARY_SUFFIX[suffix]
-        elif suffix in _DECIMAL_SUFFIX:
-            base = float(digits) * _DECIMAL_SUFFIX[suffix]
-        else:
-            raise ValueError(f"unknown quantity suffix {suffix!r} in {value!r}")
+    base = _base_units(value, resource)
     if resource in _MILLI_RESOURCES:
         return round(base * 1000)
-    # Quantity.Value() rounds up to the nearest integer.
-    iv = int(base)
-    return iv if iv == base or base < 0 else iv + 1
+    if resource in MIB_RESOURCES:
+        base = base / MIB
+    return _ceil(base)
+
+
+def parse_quantity_bytes(value, resource: str) -> int:
+    """Parse a byte-denominated quantity into BYTES (not axis MiB units).
+
+    For node-local actuation (cgroup memory limits) where the kernel needs
+    bytes.  Accepts the same forms as parse_quantity; raw numbers are bytes.
+    """
+    if resource not in MIB_RESOURCES:
+        raise ValueError(f"{resource} is not byte-denominated")
+    return _ceil(_base_units(value, resource))
+
+
+def format_quantity(axis_value: int, resource: str):
+    """Render an axis-unit integer as a quantity that parse_quantity will
+    round-trip exactly (MiB resources need the "Mi" suffix; cpu axis units
+    are milli, rendered with "m").  Producers that write system-computed
+    resources back into pod/node objects must use this."""
+    if resource in MIB_RESOURCES:
+        return f"{int(axis_value)}Mi"
+    if resource in _MILLI_RESOURCES:
+        return f"{int(axis_value)}m"
+    return int(axis_value)
 
 
 def encode_resource_list(resources: Mapping[str, object]) -> Dict[int, int]:

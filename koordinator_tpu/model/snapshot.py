@@ -37,7 +37,7 @@ from koordinator_tpu.model import resources as res
 MAX_NODE_SCORE = 100  # k8s framework.MaxNodeScore
 
 DEFAULT_MILLI_CPU_REQUEST = 250  # default_estimator.go:36
-DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024  # default_estimator.go:38
+DEFAULT_MEMORY_REQUEST = 200  # default_estimator.go:38: 200Mi, on the MiB axis
 
 # v1beta2/defaults.go:35-48
 DEFAULT_RESOURCE_WEIGHTS = {res.CPU: 1, res.MEMORY: 1}

@@ -13,9 +13,10 @@ from koordinator_tpu.model import resources as res
 
 # Upstream kube-scheduler non-zero request defaults
 # (k8s.io/kubernetes/pkg/scheduler/util: DefaultMilliCPURequest=100,
-# DefaultMemoryRequest=200MB), applied by NodeResourcesFit scoring.
+# DefaultMemoryRequest=200*1024*1024 bytes = 200 on the MiB-unit axis),
+# applied by NodeResourcesFit scoring.
 NONZERO_MILLI_CPU = 100
-NONZERO_MEMORY = 200 * 1024 * 1024
+NONZERO_MEMORY = 200
 
 _CPU_IDX = res.RESOURCE_INDEX[res.CPU]
 _MEM_IDX = res.RESOURCE_INDEX[res.MEMORY]

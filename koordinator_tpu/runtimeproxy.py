@@ -62,6 +62,10 @@ class CRIRequest:
     memory_limit_bytes: Optional[int] = None
     env: Dict[str, str] = dataclasses.field(default_factory=dict)
     cgroup_parent: str = ""
+    # pod-level resource spec (kubelet passes it via the CRI config; the
+    # batchresource hook reads batch-* from it)
+    requests: Dict[str, object] = dataclasses.field(default_factory=dict)
+    limits: Dict[str, object] = dataclasses.field(default_factory=dict)
 
 
 class RuntimeProxy:
@@ -94,6 +98,8 @@ class RuntimeProxy:
             cpu_shares=req.cpu_shares,
             cpuset_cpus=req.cpuset_cpus,
             memory_limit_bytes=req.memory_limit_bytes,
+            requests={**pod.get("requests", {}), **req.requests},
+            limits={**pod.get("limits", {}), **req.limits},
         )
 
     def _merge(self, req: CRIRequest, ctx: ContainerContext) -> CRIRequest:
@@ -111,9 +117,12 @@ class RuntimeProxy:
         return req
 
     def intercept(self, req: CRIRequest) -> Mapping:
-        """One proxied CRI call: hooks -> merge -> backend -> store."""
+        """One proxied CRI call: pre hooks -> merge -> backend -> post
+        hooks -> store (criserver interposition order: Post* stages run
+        only after the runtime call returned)."""
         stage = _STAGE_BY_CALL.get(req.call)
-        if stage is not None:
+        is_post = stage is not None and stage.startswith("Post")
+        if stage is not None and not is_post:
             ctx = self._hook_ctx(req)
             try:
                 self.registry.run(stage, ctx)
@@ -126,11 +135,21 @@ class RuntimeProxy:
 
         resp = self.backend(req)
 
+        if is_post:
+            ctx = self._hook_ctx(req)
+            try:
+                self.registry.run(stage, ctx)
+            except Exception:
+                if self.failure_policy == FailurePolicy.FAIL:
+                    raise
+
         if req.call == "RunPodSandbox":
             self.pods[req.pod_uid] = {
                 "annotations": dict(req.annotations),
                 "labels": dict(req.labels),
                 "qos": req.labels.get("koordinator.sh/qosClass", ""),
+                "requests": dict(req.requests),
+                "limits": dict(req.limits),
             }
         elif req.call == "CreateContainer":
             self.containers[(req.pod_uid, req.container_name)] = {

@@ -221,7 +221,9 @@ class DeviceSharePlugin(TensorPlugin):
             if m["minor"] in chosen:
                 free = m.setdefault("free", dict(m.get("total", {})))
                 for dim, q in per_card.items():
-                    free[dim] = int(res.parse_quantity(free.get(dim, 0), dim)) - q
+                    left = int(res.parse_quantity(free.get(dim, 0), dim)) - q
+                    # write back a form parse_quantity round-trips exactly
+                    free[dim] = res.format_quantity(left, dim)
         ctx.state.setdefault("device_allocations", {})[pod_idx] = {
             "minors": chosen,
             "per_card": per_card,

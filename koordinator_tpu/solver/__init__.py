@@ -6,3 +6,60 @@ from koordinator_tpu.solver.greedy import (  # noqa: F401
     score_cycle,
     greedy_assign,
 )
+
+
+# (backend, node-bucket, pod-bucket) combos where the Pallas cycle kernel
+# failed to lower/run; keyed by shape bucket so an oversized cycle (VMEM
+# overflow) doesn't blacklist normal-sized cycles, while a broken combo
+# pays the failed trace once, not once per scheduling cycle.
+_PALLAS_UNSUPPORTED = set()
+
+
+def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None):
+    """Backend-dispatched scheduling cycle.
+
+    On TPU the single-kernel Pallas cycle (solver/pallas_cycle.py) runs the
+    per-pod loop in VMEM; elsewhere (and when extended-plugin tensors are
+    composed in) the lax.scan path runs.  Both are bit-identical
+    (tests/test_pallas_cycle.py).
+    """
+    import jax
+
+    from koordinator_tpu.config import DEFAULT_CYCLE_CONFIG
+
+    if cfg is None:
+        cfg = DEFAULT_CYCLE_CONFIG
+    backend = jax.default_backend()
+    bucket = (
+        backend,
+        int(snapshot.nodes.allocatable.shape[0]),
+        int(snapshot.pods.capacity),
+    )
+    if (
+        extra_mask is None
+        and extra_scores is None
+        and backend != "cpu"
+        and bucket not in _PALLAS_UNSUPPORTED
+    ):
+        import logging
+
+        from koordinator_tpu.solver.pallas_cycle import greedy_assign_pallas
+
+        try:
+            result = greedy_assign_pallas(snapshot, cfg)
+            # materialize before returning: with async dispatch (and lazy
+            # materialization on tunneled platforms) a runtime fault would
+            # otherwise surface at the caller, outside this fallback
+            jax.block_until_ready(result.assignment)
+            import numpy as _np
+
+            _np.asarray(result.assignment)
+            return result
+        except Exception:
+            _PALLAS_UNSUPPORTED.add(bucket)
+            logging.getLogger(__name__).exception(
+                "pallas cycle kernel failed for %r; "
+                "falling back to the lax.scan path for this shape bucket",
+                bucket,
+            )
+    return greedy_assign(snapshot, cfg, extra_mask=extra_mask, extra_scores=extra_scores)

@@ -1,0 +1,350 @@
+"""The whole scheduling cycle as ONE Pallas TPU kernel.
+
+``greedy_assign`` (solver/greedy.py) is semantically a 10k-step ``lax.scan``
+whose per-step tensors are tiny ([nodes, resources]); on TPU its cost is
+pure sequential dispatch latency (~55us/step), not FLOPs or bandwidth.  The
+fix is TPU-native: the full cycle state — node requested/estimated tensors,
+quota usage — is ~100 KB at 2k nodes, so it lives in VMEM for the whole
+cycle and the per-pod loop runs *inside* a single kernel, eliminating the
+inter-step overhead entirely (~10x on the 10k x 2k benchmark).
+
+Layout: resources ride the 128-lane axis (R=13 used), nodes ride sublanes
+([N, 128] i32 blocks); per-pod vectors stream in as (B, 128) blocks with a
+grid over pod batches, and per-pod scalars (quota id, validity) arrive via
+scalar prefetch in SMEM.  All score math is the same exact integer
+arithmetic as ops/scoring.py — MiB resource units (model/resources.py)
+guarantee every intermediate, including ``free * MaxNodeScore``, fits i32,
+so no i64 emulation on the VPU.
+
+Reference semantics mirrored (all paths under /root/reference): the per-pod
+Filter/Score/Reserve cycle of ``pkg/scheduler/frameworkext`` with
+NodeResourcesFit + LoadAware scoring and ElasticQuota admission; see
+solver/greedy.py for the per-line citations — this kernel is bit-identical
+with that scan (tests/test_pallas_cycle.py asserts it).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG, MOST_ALLOCATED
+from koordinator_tpu.constraints.gang import gang_satisfaction
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.model.snapshot import MAX_NODE_SCORE, ClusterSnapshot
+from koordinator_tpu.ops.fit import nonzero_requests
+from koordinator_tpu.ops.loadaware import loadaware_filter_mask
+from koordinator_tpu.solver.greedy import (
+    STATUS_ASSIGNED,
+    STATUS_UNSCHEDULABLE,
+    STATUS_WAIT_GANG,
+    CycleResult,
+    queue_order,
+)
+
+LANES = 128
+I32_MIN = np.int32(np.iinfo(np.int32).min)
+
+
+def _pad_rows(a: jnp.ndarray, rows: int) -> jnp.ndarray:
+    return jnp.pad(a, ((0, rows - a.shape[0]),) + ((0, 0),) * (a.ndim - 1))
+
+
+def _lanes(a: jnp.ndarray) -> jnp.ndarray:
+    """[M, R] -> [M, 128] i32, resources on the lane axis."""
+    return jnp.pad(a.astype(jnp.int32), ((0, 0), (0, LANES - a.shape[1])))
+
+
+def _least_requested(t, cap):
+    """Exact ops/scoring.py least_requested_score in i32 (free pre-clamped
+    so free * MAX_NODE_SCORE never overflows)."""
+    safe = jnp.maximum(cap, 1)
+    free = jnp.clip(cap - t, 0, None)
+    score = (free * MAX_NODE_SCORE) // safe
+    return jnp.where((cap == 0) | (t > cap), 0, score)
+
+
+def _most_requested(t, cap):
+    safe = jnp.maximum(cap, 1)
+    clamped = jnp.clip(t, None, cap)
+    score = (clamped * MAX_NODE_SCORE) // safe
+    return jnp.where(cap == 0, 0, score)
+
+
+def _weighted(per_res, w_row, w_sum: int):
+    if w_sum == 0:
+        return jnp.zeros(per_res.shape[:-1] + (1,), jnp.int32)
+    return jnp.sum(per_res * w_row, axis=-1, keepdims=True) // w_sum
+
+
+def _cycle_kernel(
+    # scalar prefetch (SMEM)
+    qid_ref,  # i32[P] quota id per sorted pod (-1 = none)
+    pvalid_ref,  # i32[P]
+    # inputs (VMEM)
+    preq_ref,  # i32[B, 128] pod requests (sorted)
+    psreq_ref,  # i32[B, 128] nonzero-default score requests
+    pest_ref,  # i32[B, 128] estimator output
+    alloc_ref,  # i32[N, 128]
+    usage_ref,  # i32[N, 128]
+    req0_ref,  # i32[N, 128] initial node requested
+    flags_ref,  # i32[N, 128] lane0 = valid & la_mask, lane1 = metric_fresh
+    qrt_ref,  # i32[Q, 128] quota runtime
+    qlim_ref,  # i32[Q, 128] quota limited mask
+    quse0_ref,  # i32[Q, 128] initial quota used
+    w_ref,  # i32[8, 128] row0 = fit weights, row1 = loadaware weights
+    # outputs
+    chosen_ref,  # i32[B, 128]
+    nreq_out_ref,  # i32[N, 128]
+    nest_out_ref,  # i32[N, 128]
+    quse_out_ref,  # i32[Q, 128]
+    # scratch
+    nreq_ref,
+    nest_ref,
+    quse_ref,
+    *,
+    block: int,
+    cfg: CycleConfig,
+):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        nreq_ref[:] = req0_ref[:]
+        nest_ref[:] = jnp.zeros_like(nest_ref)
+        quse_ref[:] = quse0_ref[:]
+
+    alloc = alloc_ref[:]
+    n_rows = alloc.shape[0]
+    node_ok = flags_ref[:, 0:1] != 0
+    fresh = flags_ref[:, 1:2] != 0
+    row_iota = lax.broadcasted_iota(jnp.int32, (n_rows, 1), 0)
+
+    fit_w_row = w_ref[0:1, :]
+    fit_w_sum = sum(w for _, w in cfg.fit_resource_weights)
+    la_w_row = w_ref[1:2, :]
+    la_w_sum = sum(w for _, w in cfg.loadaware.resource_weights)
+
+    def step(j, _):
+        p = i * block + j
+        req = preq_ref[pl.ds(j, 1), :]  # [1, 128]
+        sreq = psreq_ref[pl.ds(j, 1), :]
+        est = pest_ref[pl.ds(j, 1), :]
+        qid = qid_ref[p]
+        is_valid = pvalid_ref[p] != 0
+        qidx = jnp.maximum(qid, 0)
+
+        nreq = nreq_ref[:]
+        # Filter: Fit (only requested resources constrain) + node flags
+        need = req > 0
+        fits = jnp.all(
+            jnp.where(need, nreq + req <= alloc, True), axis=-1, keepdims=True
+        )
+        # ElasticQuota admission on limited dimensions
+        quse_row = quse_ref[pl.ds(qidx, 1), :]
+        qok = jnp.all(
+            jnp.where(
+                qlim_ref[pl.ds(qidx, 1), :] != 0,
+                quse_row + req <= qrt_ref[pl.ds(qidx, 1), :],
+                True,
+            )
+        )
+        feasible = fits & node_ok & ((qid < 0) | qok) & is_valid
+
+        # Score: NodeResourcesFit + LoadAware, exact integer math
+        total = jnp.zeros((n_rows, 1), jnp.int32)
+        if cfg.enable_fit_score:
+            t = nreq + sreq
+            if cfg.fit_scoring_strategy == MOST_ALLOCATED:
+                per_res = _most_requested(t, alloc)
+            else:
+                per_res = _least_requested(t, alloc)
+            total = total + cfg.fit_plugin_weight * _weighted(
+                per_res, fit_w_row, fit_w_sum
+            )
+        if cfg.enable_loadaware:
+            est_used = usage_ref[:] + nest_ref[:] + est
+            per_res = _least_requested(est_used, alloc)
+            la = _weighted(per_res, la_w_row, la_w_sum)
+            total = total + cfg.loadaware_plugin_weight * jnp.where(fresh, la, 0)
+
+        masked = jnp.where(feasible, total, I32_MIN)
+        best = jnp.max(masked)
+        any_feasible = best > I32_MIN
+        # first index achieving the max == jnp.argmax tie-break
+        chosen = jnp.min(jnp.where(masked == best, row_iota, n_rows))
+        chosen = jnp.where(any_feasible, chosen, -1)
+
+        # Reserve: commit the pod's resources to the chosen node / quota
+        cidx = jnp.maximum(chosen, 0)
+        take = jnp.where(any_feasible, req, 0)
+        nreq_ref[pl.ds(cidx, 1), :] = nreq_ref[pl.ds(cidx, 1), :] + take
+        nest_ref[pl.ds(cidx, 1), :] = nest_ref[pl.ds(cidx, 1), :] + jnp.where(
+            any_feasible, est, 0
+        )
+        quse_ref[pl.ds(qidx, 1), :] = quse_row + jnp.where(
+            any_feasible & (qid >= 0), req, 0
+        )
+
+        chosen_ref[pl.ds(j, 1), :] = jnp.full((1, LANES), chosen, jnp.int32)
+        return 0
+
+    lax.fori_loop(0, block, step, 0)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _fin():
+        nreq_out_ref[:] = nreq_ref[:]
+        nest_out_ref[:] = nest_ref[:]
+        quse_out_ref[:] = quse_ref[:]
+
+
+@partial(jax.jit, static_argnames=("cfg", "block", "interpret"))
+def _run_cycle(
+    preq, psreq, pest, qid, pvalid, alloc, usage, req0, flags, qrt, qlim, quse0,
+    weights, *, cfg: CycleConfig, block: int, interpret: bool
+):
+    P = preq.shape[0]
+    N = alloc.shape[0]
+    Q = qrt.shape[0]
+    grid = (P // block,)
+    node_spec = pl.BlockSpec((N, LANES), lambda i, *_: (0, 0), memory_space=pltpu.VMEM)
+    quota_spec = pl.BlockSpec((Q, LANES), lambda i, *_: (0, 0), memory_space=pltpu.VMEM)
+    pod_spec = pl.BlockSpec((block, LANES), lambda i, *_: (i, 0), memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[pod_spec, pod_spec, pod_spec]
+        + [node_spec] * 4
+        + [quota_spec] * 3
+        + [pl.BlockSpec((8, LANES), lambda i, *_: (0, 0), memory_space=pltpu.VMEM)],
+        out_specs=[pod_spec, node_spec, node_spec, quota_spec],
+        scratch_shapes=[
+            pltpu.VMEM((N, LANES), jnp.int32),
+            pltpu.VMEM((N, LANES), jnp.int32),
+            pltpu.VMEM((Q, LANES), jnp.int32),
+        ],
+    )
+    kernel = partial(_cycle_kernel, block=block, cfg=cfg)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((P, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((N, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((N, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((Q, LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(qid, pvalid, preq, psreq, pest, alloc, usage, req0, flags, qrt, qlim, quse0, weights)
+
+
+@partial(jax.jit, static_argnames=("cfg", "interpret"))
+def greedy_assign_pallas(
+    snapshot: ClusterSnapshot,
+    cfg: CycleConfig = DEFAULT_CYCLE_CONFIG,
+    interpret: bool = False,
+) -> CycleResult:
+    """Drop-in replacement for solver.greedy.greedy_assign on TPU.
+
+    Bit-identical placements (same queue order, same integer scores, same
+    argmax tie-breaks); i32 internally — sound because MiB/milli units bound
+    every intermediate (documented in model/resources.py).
+    """
+    pods, nodes, gangs, quotas = (
+        snapshot.pods,
+        snapshot.nodes,
+        snapshot.gangs,
+        snapshot.quotas,
+    )
+    P = pods.capacity
+    N = nodes.allocatable.shape[0]
+
+    order = queue_order(pods.priority, pods.valid)
+    P_pad = -(-P // 8) * 8
+    block = 128 if P_pad % 128 == 0 else 8
+    N_pad = -(-N // 8) * 8
+
+    def _pods(a):
+        return _pad_rows(_lanes(a[order]), P_pad)
+
+    preq = _pods(pods.requests)
+    psreq = _pods(nonzero_requests(pods.requests))
+    pest = _pods(pods.estimated)
+    qid = jnp.pad(pods.quota_id[order].astype(jnp.int32), (0, P_pad - P))
+    pvalid = jnp.pad(pods.valid[order].astype(jnp.int32), (0, P_pad - P))
+
+    la_mask = loadaware_filter_mask(
+        nodes.usage,
+        nodes.allocatable,
+        cfg.loadaware_thresholds_arr(),
+        nodes.metric_fresh,
+    )
+    if not cfg.enable_loadaware:
+        la_mask = jnp.ones_like(la_mask)
+    flags = jnp.stack(
+        [
+            (nodes.valid & la_mask).astype(jnp.int32),
+            nodes.metric_fresh.astype(jnp.int32),
+        ],
+        axis=1,
+    )
+    flags = _pad_rows(jnp.pad(flags, ((0, 0), (0, LANES - flags.shape[1]))), N_pad)
+
+    Q = max(8, quotas.runtime.shape[0])
+    Q = -(-Q // 8) * 8
+    qrt = _pad_rows(_lanes(quotas.runtime), Q)
+    qlim = _pad_rows(_lanes(quotas.limited.astype(jnp.int32)), Q)
+    quse0 = _pad_rows(_lanes(quotas.used), Q)
+
+    weights = jnp.zeros((8, LANES), jnp.int32)
+    weights = weights.at[0, : res.NUM_RESOURCES].set(
+        jnp.asarray(res.weights_vector(dict(cfg.fit_resource_weights)), jnp.int32)
+    )
+    weights = weights.at[1, : res.NUM_RESOURCES].set(
+        jnp.asarray(
+            res.weights_vector(dict(cfg.loadaware.resource_weights)), jnp.int32
+        )
+    )
+
+    chosen, nreq, nest, quse = _run_cycle(
+        preq,
+        psreq,
+        pest,
+        qid,
+        pvalid,
+        _pad_rows(_lanes(nodes.allocatable), N_pad),
+        _pad_rows(_lanes(nodes.usage), N_pad),
+        _pad_rows(_lanes(nodes.requested), N_pad),
+        flags,
+        qrt,
+        qlim,
+        quse0,
+        weights,
+        cfg=cfg,
+        block=block,
+        interpret=interpret,
+    )
+
+    assignment = jnp.full((P,), -1, jnp.int32).at[order].set(chosen[:P, 0])
+    status = jnp.where(assignment >= 0, STATUS_ASSIGNED, STATUS_UNSCHEDULABLE)
+    assigned = (assignment >= 0) & pods.valid
+    _, pod_gang_ok = gang_satisfaction(
+        assignment, pods.valid, pods.gang_id, gangs.min_member
+    )
+    status = jnp.where(assigned & ~pod_gang_ok, STATUS_WAIT_GANG, status)
+
+    R = res.NUM_RESOURCES
+    nq = quotas.used.shape[0]
+    return CycleResult(
+        assignment=assignment,
+        status=status.astype(jnp.int32),
+        node_requested=nreq[:N, :R].astype(jnp.int64),
+        node_estimated=nest[:N, :R].astype(jnp.int64),
+        quota_used=quse[:nq, :R].astype(jnp.int64),
+    )

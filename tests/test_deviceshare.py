@@ -21,6 +21,7 @@ from koordinator_tpu.ops.deviceshare import (
 )
 
 GI = 1024**3
+GI_M = 1024  # 1 GiB on the dense MiB-unit axis
 
 
 def pods(*dicts):
@@ -55,7 +56,7 @@ class TestNormalization:
             normalize_gpu_requests(dev_req, gpu_card_total_memory(batch))
         )
         mem = norm[0, 0, 1]  # GPU_MEMORY dim
-        assert mem == 8 * GI
+        assert mem == 8 * GI_M
 
     def test_memory_fills_ratio(self):
         batch = encode_devices([gpu_node(mem_gi=16)], node_bucket=1)

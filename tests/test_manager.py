@@ -22,6 +22,7 @@ from koordinator_tpu.manager.sloconfig import parse_strategy
 from koordinator_tpu.model import resources as res
 
 Gi = 1024**3
+Gi_M = 1024  # 1 GiB on the dense MiB-unit axis
 
 
 class TestSloConfig:
@@ -94,7 +95,7 @@ class TestBatchResource:
         assert not out.degraded
         # HP used = prod-a metric (11000m, 18Gi); batch-b ignored.
         assert out.batch_cpu_milli == 100000 - 40000 - 7000 - 11000
-        assert out.batch_memory_bytes == (100 - 35 - 12 - 18) * Gi
+        assert out.batch_memory_mib == (100 - 35 - 12 - 18) * Gi_M
 
     def test_memory_by_request_policy(self):
         s = self.strategy().replace(memory_calculate_policy="request")
@@ -116,7 +117,7 @@ class TestBatchResource:
             now=0.0,
         )
         # memory: capacity - reservation(35Gi) - systemReserved(2Gi) - HPrequest(20Gi)
-        assert out.batch_memory_bytes == (100 - 35 - 2 - 20) * Gi
+        assert out.batch_memory_mib == (100 - 35 - 2 - 20) * Gi_M
         # cpu still byUsage
         assert out.batch_cpu_milli == 100000 - 40000 - 7000 - 11000
 
@@ -141,7 +142,7 @@ class TestBatchResource:
         )
         # LSE: cpu by request (10), memory by usage (4Gi)
         assert out.batch_cpu_milli == 100000 - 40000 - 0 - 10000
-        assert out.batch_memory_bytes == (100 - 35 - 4) * Gi
+        assert out.batch_memory_mib == (100 - 35 - 4) * Gi_M
 
     def test_unknown_metric_pods_count_hp(self):
         out = calculate_batch_resource(
@@ -192,33 +193,33 @@ class TestBatchResource:
         rng = np.random.RandomState(0)
         n = 64
         cap = np.stack(
-            [rng.randint(8000, 128000, n), rng.randint(16, 256, n) * Gi], axis=1
+            [rng.randint(8000, 128000, n), rng.randint(16, 256, n) * Gi_M], axis=1
         ).astype(np.int64)
-        sysres = np.stack([rng.randint(0, 2000, n), rng.randint(0, 4, n) * Gi], axis=1).astype(np.int64)
-        sysuse = np.stack([rng.randint(0, 4000, n), rng.randint(0, 8, n) * Gi], axis=1).astype(np.int64)
-        hpreq = np.stack([rng.randint(0, 64000, n), rng.randint(0, 128, n) * Gi], axis=1).astype(np.int64)
-        hpuse = np.stack([rng.randint(0, 64000, n), rng.randint(0, 128, n) * Gi], axis=1).astype(np.int64)
+        sysres = np.stack([rng.randint(0, 2000, n), rng.randint(0, 4, n) * Gi_M], axis=1).astype(np.int64)
+        sysuse = np.stack([rng.randint(0, 4000, n), rng.randint(0, 8, n) * Gi_M], axis=1).astype(np.int64)
+        hpreq = np.stack([rng.randint(0, 64000, n), rng.randint(0, 128, n) * Gi_M], axis=1).astype(np.int64)
+        hpuse = np.stack([rng.randint(0, 64000, n), rng.randint(0, 128, n) * Gi_M], axis=1).astype(np.int64)
         batch = batch_allocatable_batch(s, cap, sysres, sysuse, hpreq, hpuse)
         for i in range(n):
             out = calculate_batch_resource(
                 s,
-                node_capacity={"cpu": f"{cap[i,0]}m", "memory": int(cap[i, 1])},
-                node_annotation_reserved={"cpu": f"{sysres[i,0]}m", "memory": int(sysres[i, 1])},
+                node_capacity={"cpu": f"{cap[i,0]}m", "memory": f"{cap[i,1]}Mi"},
+                node_annotation_reserved={"cpu": f"{sysres[i,0]}m", "memory": f"{sysres[i,1]}Mi"},
                 kubelet_reserved=None,
-                system_usage={"cpu": f"{sysuse[i,0]}m", "memory": int(sysuse[i, 1])},
+                system_usage={"cpu": f"{sysuse[i,0]}m", "memory": f"{sysuse[i,1]}Mi"},
                 pods=[
                     {
                         "name": "hp",
-                        "requests": {"cpu": f"{hpreq[i,0]}m", "memory": int(hpreq[i, 1])},
+                        "requests": {"cpu": f"{hpreq[i,0]}m", "memory": f"{hpreq[i,1]}Mi"},
                         "priority_class": "koord-prod",
                     }
                 ],
-                pod_metrics={"hp": {"cpu": f"{hpuse[i,0]}m", "memory": int(hpuse[i, 1])}},
+                pod_metrics={"hp": {"cpu": f"{hpuse[i,0]}m", "memory": f"{hpuse[i,1]}Mi"}},
                 metric_update_time=0.0,
                 now=0.0,
             )
             assert out.batch_cpu_milli == batch[i, 0]
-            assert out.batch_memory_bytes == batch[i, 1]
+            assert out.batch_memory_mib == batch[i, 1]
 
 
 class TestMidResource:
@@ -233,7 +234,7 @@ class TestMidResource:
         )
         # cpu capped by 10% of allocatable = 10000m < reclaimable 20000m
         assert out.batch_cpu_milli == 10000
-        assert out.batch_memory_bytes == 5 * Gi
+        assert out.batch_memory_mib == 5 * Gi_M
 
     def test_degrade_without_reclaimable(self):
         out = calculate_mid_resource(
@@ -312,7 +313,12 @@ class TestProfileMutation:
         # batch pod: native resources translated to batch-* (cpu in milli)
         assert res.BATCH_CPU in out["requests"] and "cpu" not in out["requests"]
         assert out["requests"][res.BATCH_CPU] == 2000
-        assert out["requests"][res.BATCH_MEMORY] == Gi
+        # round-trippable quantity string (re-encoding must not re-scale)
+        assert out["requests"][res.BATCH_MEMORY] == "1024Mi"
+        assert (
+            res.parse_quantity(out["requests"][res.BATCH_MEMORY], res.BATCH_MEMORY)
+            == Gi_M
+        )
 
     def test_prod_pod_not_translated(self):
         pod = {"name": "p", "labels": {}, "requests": {"cpu": "2"}, "priority_class": "koord-prod"}
@@ -344,8 +350,10 @@ class TestQuotaProfile:
         }
         out = quota_profile.reconcile_profile(prof, nodes)
         assert out["name"] == "pool-a-root"
-        assert out["min"]["cpu"] == 8000  # (10+6 cores = 16000m) * 0.5
-        assert out["min"]["memory"] == 8 * Gi
+        # quantities are emitted round-trippable (axis units + suffix)
+        assert out["min"]["cpu"] == "8000m"  # (10+6 cores = 16000m) * 0.5
+        assert out["min"]["memory"] == f"{8 * Gi_M}Mi"
+        assert res.parse_quantity(out["min"]["memory"], "memory") == 8 * Gi_M
         assert out["labels"][quota_profile.LABEL_QUOTA_TREE_ID] == "tree-a"
 
 

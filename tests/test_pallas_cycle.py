@@ -1,0 +1,86 @@
+"""Parity: the single-kernel Pallas cycle must be bit-identical with the
+lax.scan reference path (solver/greedy.py) — same placements, same
+post-cycle state — across strategies, gangs, quotas and padding shapes.
+
+Runs in Pallas interpret mode on the CPU test platform; the compiled TPU
+path is exercised by bench.py and __graft_entry__.py on hardware.
+"""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.config import CycleConfig
+from koordinator_tpu.constraints import build_quota_table_inputs
+from koordinator_tpu.harness import generators
+from koordinator_tpu.model import encode_snapshot, resources as res
+from koordinator_tpu.solver import greedy_assign
+from koordinator_tpu.solver.pallas_cycle import greedy_assign_pallas
+
+
+def _quota_snapshot(pods=48, nodes=16, **buckets):
+    nodes_l, pods_l, gangs, quotas = generators.quota_colocation(
+        pods=pods, nodes=nodes
+    )
+    pod_reqs = [res.resource_vector(p["requests"]) for p in pods_l]
+    qidx = {q["name"]: i for i, q in enumerate(quotas)}
+    qids = [qidx.get(p.get("quota"), -1) for p in pods_l]
+    total = [0] * res.NUM_RESOURCES
+    for n in nodes_l:
+        v = res.resource_vector(n["allocatable"])
+        total = [a + b for a, b in zip(total, v)]
+    qdicts = build_quota_table_inputs(quotas, pod_reqs, qids, total)
+    return encode_snapshot(nodes_l, pods_l, gangs, qdicts, **buckets)
+
+
+def _assert_equal(scan, pallas):
+    np.testing.assert_array_equal(
+        np.asarray(scan.assignment), np.asarray(pallas.assignment)
+    )
+    np.testing.assert_array_equal(np.asarray(scan.status), np.asarray(pallas.status))
+    np.testing.assert_array_equal(
+        np.asarray(scan.node_requested), np.asarray(pallas.node_requested)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(scan.node_estimated), np.asarray(pallas.node_estimated)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(scan.quota_used), np.asarray(pallas.quota_used)
+    )
+
+
+class TestPallasCycleParity:
+    def test_quota_colocation_default_cfg(self):
+        snap = _quota_snapshot()
+        _assert_equal(greedy_assign(snap), greedy_assign_pallas(snap, interpret=True))
+
+    def test_most_allocated_strategy(self):
+        snap = _quota_snapshot(pods=32, nodes=8)
+        cfg = CycleConfig(fit_scoring_strategy="MostAllocated")
+        _assert_equal(
+            greedy_assign(snap, cfg), greedy_assign_pallas(snap, cfg, interpret=True)
+        )
+
+    def test_loadaware_disabled(self):
+        snap = _quota_snapshot(pods=32, nodes=8)
+        cfg = CycleConfig(enable_loadaware=False)
+        _assert_equal(
+            greedy_assign(snap, cfg), greedy_assign_pallas(snap, cfg, interpret=True)
+        )
+
+    def test_gangs_and_overload(self):
+        nodes_l, pods_l, gangs = generators.loadaware_joint(seed=3, pods=40, nodes=6)[:3]
+        snap = encode_snapshot(nodes_l, pods_l, gangs, [])
+        _assert_equal(greedy_assign(snap), greedy_assign_pallas(snap, interpret=True))
+
+    def test_unpadded_bucket_shapes(self):
+        # bucket sizes not multiples of 8/128 must still agree
+        snap = _quota_snapshot(pods=21, nodes=5, node_bucket=5, pod_bucket=21)
+        _assert_equal(greedy_assign(snap), greedy_assign_pallas(snap, interpret=True))
+
+    def test_scarce_capacity_leaves_unscheduled(self):
+        nodes_l, pods_l, gangs = generators.loadaware_joint(seed=7, pods=64, nodes=2)[:3]
+        snap = encode_snapshot(nodes_l, pods_l, gangs, [])
+        scan = greedy_assign(snap)
+        pallas = greedy_assign_pallas(snap, interpret=True)
+        _assert_equal(scan, pallas)
+        assert int((np.asarray(scan.assignment) < 0).sum()) > 0

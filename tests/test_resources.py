@@ -9,16 +9,18 @@ def test_cpu_milli_parsing():
 
 
 def test_memory_parsing():
-    assert res.parse_quantity("1Gi", res.MEMORY) == 1024**3
-    assert res.parse_quantity("512Mi", res.MEMORY) == 512 * 1024**2
-    assert res.parse_quantity("1G", res.MEMORY) == 10**9
-    assert res.parse_quantity(12345, res.MEMORY) == 12345
+    # byte-denominated resources land on the dense axis in MiB (ceil)
+    assert res.parse_quantity("1Gi", res.MEMORY) == 1024
+    assert res.parse_quantity("512Mi", res.MEMORY) == 512
+    assert res.parse_quantity("1G", res.MEMORY) == 954  # ceil(1e9 / 2^20)
+    assert res.parse_quantity(12345, res.MEMORY) == 1  # raw bytes, ceil to MiB
+    assert res.parse_quantity(8 * 1024**3, res.MEMORY) == 8 * 1024
 
 
 def test_vectors():
     vec = res.resource_vector({"cpu": "2", "memory": "4Gi", "pods": 10})
     assert vec[res.RESOURCE_INDEX[res.CPU]] == 2000
-    assert vec[res.RESOURCE_INDEX[res.MEMORY]] == 4 * 1024**3
+    assert vec[res.RESOURCE_INDEX[res.MEMORY]] == 4 * 1024
     assert vec[res.RESOURCE_INDEX[res.PODS]] == 10
     w = res.weights_vector({"cpu": 1, "memory": 2})
     assert w[res.RESOURCE_INDEX[res.CPU]] == 1

@@ -165,6 +165,47 @@ class TestRuntimeProxy:
         assert created.cpuset_cpus == "4-7"
         assert ("u1", "c1") in proxy.containers
 
+    def test_batch_resources_applied_through_proxy(self):
+        # a webhook-mutated BE pod's batch-* resources must reach the
+        # container's cgroup parameters via the proxy hook path
+        proxy, calls = self._proxy()
+        proxy.intercept(
+            CRIRequest(
+                call="RunPodSandbox",
+                pod_uid="u2",
+                labels={"koordinator.sh/qosClass": "BE"},
+                requests={
+                    "kubernetes.io/batch-cpu": 2000,
+                    "kubernetes.io/batch-memory": "1024Mi",
+                },
+            )
+        )
+        proxy.intercept(
+            CRIRequest(call="CreateContainer", pod_uid="u2", container_name="c1")
+        )
+        created = calls[-1]
+        assert created.cpu_quota == 2000 * 100_000 // 1000
+        assert created.cpu_shares == 2000 * 1024 // 1000
+        assert created.memory_limit_bytes == 1024 * 1024 * 1024
+
+    def test_post_stop_hooks_run_after_backend(self):
+        from koordinator_tpu.koordlet.runtimehooks import (
+            HookRegistry,
+            POST_STOP_POD_SANDBOX,
+        )
+
+        order = []
+        reg = HookRegistry()
+        reg.register(POST_STOP_POD_SANDBOX, "trace", lambda ctx: order.append("hook"))
+
+        def backend(req):
+            order.append("backend")
+            return {"ok": True}
+
+        proxy = RuntimeProxy(reg, backend, failure_policy=FailurePolicy.IGNORE)
+        proxy.intercept(CRIRequest(call="StopPodSandbox", pod_uid="u1"))
+        assert order == ["backend", "hook"]
+
     def test_stop_sandbox_clears_store(self):
         proxy, _ = self._proxy()
         proxy.intercept(CRIRequest(call="RunPodSandbox", pod_uid="u1"))
