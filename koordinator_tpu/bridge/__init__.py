@@ -1,0 +1,17 @@
+"""The sidecar boundary: host scheduler plugins <-> the JAX/TPU scorer.
+
+SURVEY §7.5: the reference proves the seam at the scheduler framework's
+Score boundary (reference
+``pkg/scheduler/frameworkext/framework_extender.go:216``); its process
+fabric is gRPC over UDS (reference
+``pkg/runtimeproxy/server/cri/criserver.go:93``, proto
+``apis/runtime/v1alpha1/api.proto:148``).  Here the same shape: a
+``BatchedScorer`` gRPC service (scorer.proto) holding the cluster snapshot
+resident on device, with sparse-delta refresh for warm cycles
+(native/koordnative.cpp codec) so the host->device boundary ships only
+what changed.
+"""
+
+from koordinator_tpu.bridge.codegen import pb2  # noqa: F401
+from koordinator_tpu.bridge.client import ScorerClient  # noqa: F401
+from koordinator_tpu.bridge.server import serve_uds  # noqa: F401

@@ -1,0 +1,116 @@
+"""Client shim for the BatchedScorer sidecar.
+
+Plays the role the reference's in-scheduler plugin boundary plays
+(Score/ScoreExtensions at ``frameworkext/framework_extender.go:216``): a
+host scheduler embeds this client, syncs its cluster view (full once,
+sparse deltas on warm cycles) and gets NodeScoreLists / assignments back.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import grpc
+
+from koordinator_tpu.bridge.codegen import method_path, pb2
+from koordinator_tpu.bridge.state import numpy_to_tensor
+
+
+class ScorerClient:
+    def __init__(self, target: str):
+        """``target``: "unix:///path.sock" or host:port."""
+        self._channel = grpc.insecure_channel(target)
+        self._sync = self._channel.unary_unary(
+            method_path("Sync"),
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb2.SyncReply.FromString,
+        )
+        self._score = self._channel.unary_unary(
+            method_path("Score"),
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb2.ScoreReply.FromString,
+        )
+        self._assign = self._channel.unary_unary(
+            method_path("Assign"),
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb2.AssignReply.FromString,
+        )
+        # previous-sync mirrors for delta encoding
+        self._prev: Dict[str, np.ndarray] = {}
+        self.snapshot_id: Optional[str] = None
+
+    def close(self) -> None:
+        self._channel.close()
+
+    # -- sync --
+    def _tensor(self, key: str, arr: Optional[np.ndarray]) -> "pb2.Tensor":
+        if arr is None:
+            return pb2.Tensor()
+        arr = np.ascontiguousarray(arr, np.int64)
+        t = numpy_to_tensor(arr, self._prev.get(key))
+        self._prev[key] = arr
+        return t
+
+    def sync(
+        self,
+        *,
+        node_allocatable: Optional[np.ndarray] = None,
+        node_requested: Optional[np.ndarray] = None,
+        node_usage: Optional[np.ndarray] = None,
+        node_names: Sequence[str] = (),
+        metric_fresh: Optional[Sequence[bool]] = None,
+        pod_requests: Optional[np.ndarray] = None,
+        pod_estimated: Optional[np.ndarray] = None,
+        pod_names: Sequence[str] = (),
+        priority: Optional[Sequence[int]] = None,
+        gang_id: Optional[Sequence[int]] = None,
+        quota_id: Optional[Sequence[int]] = None,
+        gang_min_member: Sequence[int] = (),
+        quota_runtime: Optional[np.ndarray] = None,
+        quota_used: Optional[np.ndarray] = None,
+        quota_limited: Optional[np.ndarray] = None,
+        node_bucket: int = 0,
+        pod_bucket: int = 0,
+    ) -> "pb2.SyncReply":
+        req = pb2.SyncRequest(node_bucket=node_bucket, pod_bucket=pod_bucket)
+        req.nodes.allocatable.CopyFrom(self._tensor("nalloc", node_allocatable))
+        req.nodes.requested.CopyFrom(self._tensor("nreq", node_requested))
+        req.nodes.usage.CopyFrom(self._tensor("nuse", node_usage))
+        req.nodes.names.extend(node_names)
+        if metric_fresh is not None:
+            req.nodes.metric_fresh.extend(bool(b) for b in metric_fresh)
+        req.pods.requests.CopyFrom(self._tensor("preq", pod_requests))
+        req.pods.estimated.CopyFrom(self._tensor("pest", pod_estimated))
+        req.pods.names.extend(pod_names)
+        if priority is not None:
+            req.pods.priority.extend(int(v) for v in priority)
+        if gang_id is not None:
+            req.pods.gang_id.extend(int(v) for v in gang_id)
+        if quota_id is not None:
+            req.pods.quota_id.extend(int(v) for v in quota_id)
+        req.gangs.min_member.extend(int(v) for v in gang_min_member)
+        req.quotas.runtime.CopyFrom(self._tensor("qrt", quota_runtime))
+        req.quotas.used.CopyFrom(self._tensor("quse", quota_used))
+        req.quotas.limited.CopyFrom(self._tensor("qlim", quota_limited))
+        reply = self._sync(req)
+        self.snapshot_id = reply.snapshot_id
+        return reply
+
+    # -- score / assign --
+    def score(self, top_k: int = 0) -> List[List[Tuple[int, int]]]:
+        reply = self._score(
+            pb2.ScoreRequest(snapshot_id=self.snapshot_id or "", top_k=top_k)
+        )
+        return [
+            list(zip(entry.node_index, entry.score)) for entry in reply.pods
+        ]
+
+    def assign(self) -> Tuple[np.ndarray, np.ndarray, float]:
+        reply = self._assign(pb2.AssignRequest(snapshot_id=self.snapshot_id or ""))
+        return (
+            np.asarray(reply.assignment, np.int32),
+            np.asarray(reply.status, np.int32),
+            reply.cycle_ms,
+        )

@@ -1,0 +1,114 @@
+"""BatchedScorer gRPC service (generic handlers; no grpcio-tools needed).
+
+The service composition mirrors the reference's hook-server dispatch
+(reference ``pkg/koordlet/runtimehooks/proxyserver``): one process owns
+the device, callers talk UDS.  Score/Assign run the same device programs
+as the in-process API (solver.run_cycle / solver.score_cycle), so bridge
+clients get identical placements to embedded users.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent import futures
+from typing import Optional
+
+import numpy as np
+
+import grpc
+import jax.numpy as jnp
+from jax import lax
+
+from koordinator_tpu.bridge.codegen import SERVICE, pb2
+from koordinator_tpu.bridge.state import ResidentState
+from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG
+from koordinator_tpu.solver import run_cycle, score_cycle
+
+
+class ScorerServicer:
+    def __init__(self, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG):
+        self.cfg = cfg
+        self.state = ResidentState()
+        self._generation = 0
+
+    # -- RPC bodies (plain request -> reply functions) --
+    def sync(self, req: "pb2.SyncRequest") -> "pb2.SyncReply":
+        self.state.apply_sync(req)
+        self._generation += 1
+        snap = self.state.snapshot()
+        return pb2.SyncReply(
+            snapshot_id=f"s{self._generation}",
+            nodes=snap.num_nodes,
+            pods=snap.num_pods,
+        )
+
+    def score(self, req: "pb2.ScoreRequest") -> "pb2.ScoreReply":
+        snap = self.state.snapshot()
+        scores, feasible = score_cycle(snap, self.cfg)
+        masked = jnp.where(feasible, scores, jnp.iinfo(jnp.int64).min)
+        P = snap.pods.capacity
+        reply = pb2.ScoreReply()
+        k = int(req.top_k) or snap.nodes.capacity
+        k = min(k, snap.nodes.capacity)
+        top_scores, top_idx = lax.top_k(masked, k)
+        top_scores = np.asarray(top_scores)
+        top_idx = np.asarray(top_idx)
+        feasible_np = np.asarray(feasible)
+        valid = np.asarray(snap.pods.valid)
+        for p in range(P):
+            if not valid[p]:
+                continue
+            entry = reply.pods.add()
+            ok = feasible_np[p, top_idx[p]]
+            entry.node_index.extend(int(i) for i, m in zip(top_idx[p], ok) if m)
+            entry.score.extend(int(s) for s, m in zip(top_scores[p], ok) if m)
+        return reply
+
+    def assign(self, req: "pb2.AssignRequest") -> "pb2.AssignReply":
+        snap = self.state.snapshot()
+        t0 = time.perf_counter()
+        result = run_cycle(snap, self.cfg)
+        assignment = np.asarray(result.assignment)
+        status = np.asarray(result.status)
+        ms = (time.perf_counter() - t0) * 1000.0
+        valid = np.asarray(snap.pods.valid)
+        reply = pb2.AssignReply(cycle_ms=ms)
+        reply.assignment.extend(int(a) for a, v in zip(assignment, valid) if v)
+        reply.status.extend(int(s) for s, v in zip(status, valid) if v)
+        return reply
+
+
+def _handler(fn, req_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        lambda req, ctx: fn(req),
+        request_deserializer=req_cls.FromString,
+        response_serializer=lambda msg: msg.SerializeToString(),
+    )
+
+
+def make_server(
+    servicer: Optional[ScorerServicer] = None,
+    cfg: CycleConfig = DEFAULT_CYCLE_CONFIG,
+    max_workers: int = 4,
+) -> grpc.Server:
+    servicer = servicer or ScorerServicer(cfg)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    handlers = {
+        "Sync": _handler(servicer.sync, pb2.SyncRequest),
+        "Score": _handler(servicer.score, pb2.ScoreRequest),
+        "Assign": _handler(servicer.assign, pb2.AssignRequest),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+    )
+    server._koord_servicer = servicer  # test/introspection seam
+    return server
+
+
+def serve_uds(path: str, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG) -> grpc.Server:
+    """Bind the scorer on a unix-domain socket (the reference's CRI proxy
+    transport, criserver.go:93) and start it."""
+    server = make_server(cfg=cfg)
+    server.add_insecure_port(f"unix://{path}")
+    server.start()
+    return server

@@ -1,0 +1,240 @@
+"""Resident snapshot state for the scorer sidecar.
+
+The host->device transfer is the boundary to engineer (SURVEY §5/§7): the
+server keeps numpy mirrors of every snapshot tensor; a warm Sync ships
+only sparse (index, value) deltas (native/koordnative.cpp codec) against
+them, and only the tensors that changed are re-uploaded to the device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from koordinator_tpu import native
+from koordinator_tpu.bridge.codegen import pb2
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.model.snapshot import (
+    ClusterSnapshot,
+    GangTable,
+    NodeBatch,
+    PodBatch,
+    QuotaTable,
+    pad_bucket,
+)
+
+R = res.NUM_RESOURCES
+
+
+def tensor_to_numpy(
+    t: "pb2.Tensor", base: Optional[np.ndarray]
+) -> Optional[np.ndarray]:
+    """Decode a proto Tensor: full payload, or sparse delta onto ``base``.
+
+    Returns the new mirror array, or None when the message carries nothing
+    (tensor unchanged since the last sync).
+    """
+    if t.data:
+        arr = np.frombuffer(t.data, dtype="<i8").copy()
+        return arr.reshape(tuple(t.shape))
+    if t.delta_idx:
+        if base is None:
+            raise ValueError("delta sync without a resident tensor")
+        idx = np.frombuffer(t.delta_idx, dtype="<i8")
+        val = np.frombuffer(t.delta_val, dtype="<i8")
+        out = base.copy()
+        native.delta_apply(out, idx, val)
+        return out
+    return None
+
+
+def numpy_to_tensor(
+    arr: np.ndarray, prev: Optional[np.ndarray] = None, max_delta_ratio: float = 0.25
+) -> "pb2.Tensor":
+    """Encode full, or as a sparse delta when <= max_delta_ratio changed."""
+    t = pb2.Tensor()
+    arr = np.ascontiguousarray(arr, dtype=np.int64)
+    t.shape.extend(arr.shape)
+    if prev is not None and prev.shape == arr.shape:
+        enc = native.delta_encode(
+            prev, arr, max_changes=max(1, int(arr.size * max_delta_ratio))
+        )
+        if enc is not None:
+            idx, val = enc
+            t.delta_idx = idx.astype("<i8").tobytes()
+            t.delta_val = val.astype("<i8").tobytes()
+            return t
+    t.data = arr.astype("<i8").tobytes()
+    return t
+
+
+class ResidentState:
+    """Numpy mirrors + the device ClusterSnapshot built from them."""
+
+    def __init__(self):
+        self.node_alloc: Optional[np.ndarray] = None
+        self.node_requested: Optional[np.ndarray] = None
+        self.node_usage: Optional[np.ndarray] = None
+        self.node_fresh: Optional[np.ndarray] = None
+        self.node_names: tuple = ()
+        self.pod_requests: Optional[np.ndarray] = None
+        self.pod_estimated: Optional[np.ndarray] = None
+        self.pod_priority: Optional[np.ndarray] = None
+        self.pod_gang: Optional[np.ndarray] = None
+        self.pod_quota: Optional[np.ndarray] = None
+        self.pod_names: tuple = ()
+        self.gang_min: Optional[np.ndarray] = None
+        self.quota_runtime: Optional[np.ndarray] = None
+        self.quota_used: Optional[np.ndarray] = None
+        self.quota_limited: Optional[np.ndarray] = None
+        self.node_bucket = 0
+        self.pod_bucket = 0
+        self._snapshot: Optional[ClusterSnapshot] = None
+
+    def apply_sync(self, reqmsg: "pb2.SyncRequest") -> None:
+        n = reqmsg.nodes
+        p = reqmsg.pods
+
+        def upd(current, tensor):
+            new = tensor_to_numpy(tensor, current)
+            return current if new is None else new
+
+        self.node_alloc = upd(self.node_alloc, n.allocatable)
+        self.node_requested = upd(self.node_requested, n.requested)
+        self.node_usage = upd(self.node_usage, n.usage)
+        if n.metric_fresh:
+            self.node_fresh = np.asarray(list(n.metric_fresh), dtype=bool)
+        if n.names:
+            self.node_names = tuple(n.names)
+        self.pod_requests = upd(self.pod_requests, p.requests)
+        self.pod_estimated = upd(self.pod_estimated, p.estimated)
+        if p.priority:
+            self.pod_priority = np.asarray(list(p.priority), dtype=np.int64)
+        if p.gang_id:
+            self.pod_gang = np.asarray(list(p.gang_id), dtype=np.int32)
+        if p.quota_id:
+            self.pod_quota = np.asarray(list(p.quota_id), dtype=np.int32)
+        if p.names:
+            self.pod_names = tuple(p.names)
+        if reqmsg.gangs.min_member:
+            self.gang_min = np.asarray(list(reqmsg.gangs.min_member), np.int32)
+        self.quota_runtime = upd(self.quota_runtime, reqmsg.quotas.runtime)
+        self.quota_used = upd(self.quota_used, reqmsg.quotas.used)
+        self.quota_limited = upd(self.quota_limited, reqmsg.quotas.limited)
+        if self.node_alloc is None or self.pod_requests is None:
+            raise ValueError("first Sync must carry full node and pod tensors")
+        self.node_bucket = int(reqmsg.node_bucket) or pad_bucket(
+            self.node_alloc.shape[0]
+        )
+        self.pod_bucket = int(reqmsg.pod_bucket) or pad_bucket(
+            self.pod_requests.shape[0]
+        )
+        self._snapshot = None  # rebuilt lazily
+
+    def _pad2(self, a: np.ndarray, rows: int) -> np.ndarray:
+        out = np.zeros((rows, a.shape[1]), np.int64)
+        out[: a.shape[0]] = a
+        return out
+
+    def snapshot(self) -> ClusterSnapshot:
+        if self._snapshot is not None:
+            return self._snapshot
+        N = self.node_alloc.shape[0]
+        P = self.pod_requests.shape[0]
+        nb, pb = self.node_bucket, self.pod_bucket
+        nvalid = np.zeros(nb, bool)
+        nvalid[:N] = True
+        pvalid = np.zeros(pb, bool)
+        pvalid[:P] = True
+        fresh = np.zeros(nb, bool)
+        fresh[:N] = (
+            self.node_fresh if self.node_fresh is not None else np.ones(N, bool)
+        )
+        est = (
+            self.pod_estimated
+            if self.pod_estimated is not None
+            else self.pod_requests
+        )
+        prio = (
+            self.pod_priority
+            if self.pod_priority is not None
+            else np.zeros(P, np.int64)
+        )
+        gang = (
+            self.pod_gang if self.pod_gang is not None else np.full(P, -1, np.int32)
+        )
+        quota = (
+            self.pod_quota if self.pod_quota is not None else np.full(P, -1, np.int32)
+        )
+        gmin = self.gang_min if self.gang_min is not None else np.zeros(0, np.int32)
+        G = max(1, len(gmin))
+        gvalid = np.zeros(G, bool)
+        gvalid[: len(gmin)] = True
+        gm = np.zeros(G, np.int32)
+        gm[: len(gmin)] = gmin
+        if self.quota_runtime is not None and self.quota_runtime.size:
+            Q = self.quota_runtime.shape[0]
+            qrt, quse = self.quota_runtime, self.quota_used
+            qlim = self.quota_limited.astype(bool)
+            qvalid = np.ones(Q, bool)
+        else:
+            Q = 1
+            qrt = np.zeros((1, R), np.int64)
+            quse = np.zeros((1, R), np.int64)
+            qlim = np.zeros((1, R), bool)
+            qvalid = np.zeros(1, bool)
+
+        def padded(a, rows):
+            return jnp.asarray(self._pad2(np.asarray(a, np.int64), rows))
+
+        pprio = np.zeros(pb, np.int64)
+        pprio[:P] = prio
+        pgang = np.full(pb, -1, np.int32)
+        pgang[:P] = gang
+        pquota = np.full(pb, -1, np.int32)
+        pquota[:P] = quota
+        self._snapshot = ClusterSnapshot(
+            nodes=NodeBatch(
+                allocatable=padded(self.node_alloc, nb),
+                requested=padded(
+                    self.node_requested
+                    if self.node_requested is not None
+                    else np.zeros_like(self.node_alloc),
+                    nb,
+                ),
+                usage=padded(
+                    self.node_usage
+                    if self.node_usage is not None
+                    else np.zeros_like(self.node_alloc),
+                    nb,
+                ),
+                metric_fresh=jnp.asarray(fresh),
+                valid=jnp.asarray(nvalid),
+                names=self.node_names,
+            ),
+            pods=PodBatch(
+                requests=padded(self.pod_requests, pb),
+                estimated=padded(est, pb),
+                priority_class=jnp.zeros(pb, jnp.int32),
+                qos=jnp.zeros(pb, jnp.int32),
+                priority=jnp.asarray(pprio),
+                gang_id=jnp.asarray(pgang),
+                quota_id=jnp.asarray(pquota),
+                valid=jnp.asarray(pvalid),
+                names=self.pod_names,
+            ),
+            gangs=GangTable(
+                min_member=jnp.asarray(gm), valid=jnp.asarray(gvalid), names=()
+            ),
+            quotas=QuotaTable(
+                runtime=jnp.asarray(qrt),
+                used=jnp.asarray(quse),
+                limited=jnp.asarray(qlim),
+                valid=jnp.asarray(qvalid),
+                names=(),
+            ),
+        )
+        return self._snapshot

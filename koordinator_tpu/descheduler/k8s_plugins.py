@@ -1,0 +1,187 @@
+"""Upstream-descheduler adaptor plugins.
+
+Reference: ``pkg/descheduler/framework/plugins/kubernetes`` wraps
+sigs.k8s.io/descheduler plugins (DefaultEvictor, RemovePodsViolating*,
+RemoveDuplicates, RemovePodsHavingTooManyRestarts) into the koord
+descheduler framework (``framework/types.go:80 DeschedulePlugin``).
+Here the same plugin set as pure functions over pod/node dicts, composed
+with the evictions/ rate-limited evictor the way the adaptor wires the
+upstream evictor seam.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Mapping, Optional, Sequence
+
+PRIORITY_CRITICAL = 2_000_000_000  # system-cluster-critical
+
+
+@dataclasses.dataclass(frozen=True)
+class DefaultEvictorArgs:
+    """sigs.k8s.io defaultevictor semantics: which pods are evictable."""
+
+    evict_system_critical_pods: bool = False
+    evict_local_storage_pods: bool = False
+    evict_failed_bare_pods: bool = False
+    ignore_pvc_pods: bool = False
+    priority_threshold: Optional[int] = None
+    label_selector: Optional[Mapping[str, str]] = None
+
+
+def _matches(selector: Optional[Mapping[str, str]], labels: Mapping) -> bool:
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def default_evictor_filter(pod: Mapping, args: DefaultEvictorArgs) -> List[str]:
+    """Reasons the pod is NOT evictable; empty list = evictable."""
+    reasons: List[str] = []
+    labels = pod.get("labels") or {}
+    annotations = pod.get("annotations") or {}
+    owner_kinds = {o.get("kind") for o in pod.get("owner_references") or []}
+    if not owner_kinds and pod.get("phase") not in ("Failed",):
+        if not args.evict_failed_bare_pods:
+            reasons.append("pod is a bare pod without owner")
+    if "DaemonSet" in owner_kinds:
+        reasons.append("pod is owned by a DaemonSet")
+    if pod.get("mirror") or "kubernetes.io/config.mirror" in annotations:
+        reasons.append("pod is a static/mirror pod")
+    prio = int(pod.get("priority") or 0)
+    if not args.evict_system_critical_pods:
+        if prio >= PRIORITY_CRITICAL:
+            reasons.append("pod is system-critical")
+        if args.priority_threshold is not None and prio >= args.priority_threshold:
+            reasons.append("pod priority above threshold")
+    if not args.evict_local_storage_pods and pod.get("has_local_storage"):
+        reasons.append("pod uses local storage")
+    if args.ignore_pvc_pods and pod.get("has_pvc"):
+        reasons.append("pod uses a PVC")
+    if annotations.get("descheduler.alpha.kubernetes.io/evict") in ("false", False):
+        reasons.append("pod opted out of eviction")
+    if not _matches(args.label_selector, labels):
+        reasons.append("pod does not match the evictor label selector")
+    return reasons
+
+
+@dataclasses.dataclass(frozen=True)
+class TooManyRestartsArgs:
+    pod_restart_threshold: int = 100
+    include_init_containers: bool = False
+
+
+def remove_pods_having_too_many_restarts(
+    pods: Sequence[Mapping], args: TooManyRestartsArgs
+) -> List[Mapping]:
+    """Upstream RemovePodsHavingTooManyRestarts: total container restarts
+    >= threshold selects the pod for eviction."""
+    out = []
+    for pod in pods:
+        restarts = sum(int(c.get("restart_count", 0)) for c in pod.get("containers", []))
+        if args.include_init_containers:
+            restarts += sum(
+                int(c.get("restart_count", 0))
+                for c in pod.get("init_containers", [])
+            )
+        if restarts >= args.pod_restart_threshold:
+            out.append(pod)
+    return out
+
+
+def remove_duplicates(pods: Sequence[Mapping]) -> List[Mapping]:
+    """Upstream RemoveDuplicates: for each (owner, node) keep one replica,
+    select the rest for eviction so replicas spread across nodes."""
+    seen: Dict[tuple, Mapping] = {}
+    dupes: List[Mapping] = []
+    for pod in pods:
+        owners = tuple(
+            sorted(
+                (o.get("kind", ""), o.get("name", ""))
+                for o in pod.get("owner_references") or []
+            )
+        )
+        if not owners:
+            continue
+        key = (owners, pod.get("node"))
+        if key in seen:
+            dupes.append(pod)
+        else:
+            seen[key] = pod
+    return dupes
+
+
+def remove_pods_violating_node_affinity(
+    pods: Sequence[Mapping], nodes: Sequence[Mapping]
+) -> List[Mapping]:
+    """Upstream RemovePodsViolatingNodeAffinity (requiredDuringScheduling
+    IgnoredDuringExecution re-checked): pod's required node selector no
+    longer matches the labels of the node it runs on."""
+    node_labels = {n["name"]: n.get("labels") or {} for n in nodes}
+    out = []
+    for pod in pods:
+        required = pod.get("node_selector") or {}
+        if not required:
+            continue
+        labels = node_labels.get(pod.get("node"), {})
+        if not _matches(required, labels):
+            out.append(pod)
+    return out
+
+
+def remove_pods_violating_interpod_antiaffinity(
+    pods: Sequence[Mapping],
+) -> List[Mapping]:
+    """Upstream RemovePodsViolatingInterPodAntiAffinity: a pod colocated
+    on the same node with a pod whose required anti-affinity selector
+    matches it is selected for eviction."""
+    by_node: Dict[str, List[Mapping]] = {}
+    for pod in pods:
+        by_node.setdefault(pod.get("node", ""), []).append(pod)
+    out = []
+    for node_pods in by_node.values():
+        for holder in node_pods:
+            selector = holder.get("anti_affinity_selector")
+            if not selector:
+                continue
+            for other in node_pods:
+                if other is holder:
+                    continue
+                if _matches(selector, other.get("labels") or {}):
+                    out.append(other)
+    # stable de-dup
+    seen = set()
+    uniq = []
+    for p in out:
+        key = id(p)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(p)
+    return uniq
+
+
+@dataclasses.dataclass
+class DeschedulePluginResult:
+    selected: List[Mapping]
+    evicted: List[Mapping]
+    skipped: Dict[str, List[str]]
+
+
+def run_deschedule_plugin(
+    selector: Callable[[], List[Mapping]],
+    evictor_args: DefaultEvictorArgs,
+    evict: Callable[[Mapping], bool],
+) -> DeschedulePluginResult:
+    """The adaptor glue (framework/plugins/kubernetes): selection ->
+    DefaultEvictor filter -> rate-limited eviction."""
+    selected = selector()
+    evicted: List[Mapping] = []
+    skipped: Dict[str, List[str]] = {}
+    for pod in selected:
+        reasons = default_evictor_filter(pod, evictor_args)
+        if reasons:
+            skipped[pod.get("name", "?")] = reasons
+            continue
+        if evict(pod):
+            evicted.append(pod)
+    return DeschedulePluginResult(selected, evicted, skipped)

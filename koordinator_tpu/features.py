@@ -64,8 +64,11 @@ class FeatureGate:
                 raise ValueError(
                     f"invalid feature gate value {part!r}: want Name=true|false"
                 )
-        # apply only after the whole spec parsed: an error must not leave
-        # a half-applied gate set
+        # apply only after the whole spec parsed AND validated: an error
+        # must not leave a half-applied gate set
+        unknown = [n for n in parsed if n not in self._specs]
+        if unknown:
+            raise ValueError(f"unknown feature gates: {', '.join(sorted(unknown))}")
         self.set_from_map(parsed)
 
     def known(self) -> Dict[str, bool]:

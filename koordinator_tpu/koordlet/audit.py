@@ -79,3 +79,26 @@ class Auditor:
                 if len(out) >= limit:
                     return out
         return out
+
+
+    # -- HTTP /events handler (cmd/koordlet/main.go:64-67,86) --
+    def wsgi_app(self, environ, start_response):
+        from urllib.parse import parse_qs
+
+        try:
+            query = parse_qs(environ.get("QUERY_STRING", ""))
+            try:
+                limit = int(query.get("limit", ["256"])[0])
+            except ValueError:
+                limit = 256
+            event = query.get("event", [None])[0]
+            events = self.read_events(limit=limit, event=event)
+            status, body = "200 OK", json.dumps(events).encode()
+        except Exception as exc:  # never crash the scrape path
+            status, body = "500 Internal", json.dumps({"error": str(exc)}).encode()
+        start_response(
+            status,
+            [("Content-Type", "application/json"),
+             ("Content-Length", str(len(body)))],
+        )
+        return [body]

@@ -1,0 +1,122 @@
+"""Prometheus-style metrics registry with text exposition.
+
+Reference: ``pkg/koordlet/metrics`` (CPI ``cpi.go``, PSI ``psi.go``,
+cpu_suppress / cpu_burst / prediction gauges, common node labels
+``common.go:26,79``) exposed on ``/metrics``
+(``cmd/koordlet/main.go:82-90``).  No prometheus_client dependency: the
+registry renders the text exposition format directly, which is all the
+scrape path needs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Mapping, Optional, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _key(labels: Optional[Mapping[str, str]]) -> LabelKey:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _render_labels(key: LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class MetricsRegistry:
+    """Counters and gauges with labels; render() emits exposition text."""
+
+    def __init__(self, common_labels: Optional[Mapping[str, str]] = None):
+        # common node labels (common.go:26: node name merged into every
+        # series)
+        self.common = dict(common_labels or {})
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Dict[LabelKey, float]] = {}
+        self._gauges: Dict[str, Dict[LabelKey, float]] = {}
+        self._help: Dict[str, str] = {}
+
+    def describe(self, name: str, help_text: str) -> None:
+        self._help[name] = help_text
+
+    def counter_add(
+        self, name: str, value: float, labels: Optional[Mapping[str, str]] = None
+    ) -> None:
+        with self._lock:
+            series = self._counters.setdefault(name, {})
+            k = _key({**self.common, **(labels or {})})
+            series[k] = series.get(k, 0.0) + value
+
+    def gauge_set(
+        self, name: str, value: float, labels: Optional[Mapping[str, str]] = None
+    ) -> None:
+        with self._lock:
+            self._gauges.setdefault(name, {})[
+                _key({**self.common, **(labels or {})})
+            ] = value
+
+    def get(
+        self, name: str, labels: Optional[Mapping[str, str]] = None
+    ) -> Optional[float]:
+        k = _key({**self.common, **(labels or {})})
+        with self._lock:
+            for table in (self._counters, self._gauges):
+                if name in table and k in table[name]:
+                    return table[name][k]
+        return None
+
+    # -- the koordlet metric families (metrics/*.go) --
+    def record_container_cpi(
+        self, pod: str, container: str, cycles: float, instructions: float
+    ) -> None:
+        labels = {"pod": pod, "container": container}
+        self.gauge_set("koordlet_container_cpi_cycles", cycles, labels)
+        self.gauge_set("koordlet_container_cpi_instructions", instructions, labels)
+
+    def record_psi(
+        self, resource: str, level: str, avg10: float, labels=None
+    ) -> None:
+        self.gauge_set(
+            "koordlet_psi_avg10",
+            avg10,
+            {**(labels or {}), "resource": resource, "level": level},
+        )
+
+    def record_be_suppress(self, cpu_cores_milli: float) -> None:
+        self.gauge_set("koordlet_be_suppress_cpu_cores", cpu_cores_milli / 1000.0)
+
+    def record_cpu_burst(self, pod: str, container: str, burst_us: float) -> None:
+        self.gauge_set(
+            "koordlet_container_cpu_burst_us",
+            burst_us,
+            {"pod": pod, "container": container},
+        )
+
+    def record_prediction(self, key: str, peak: float) -> None:
+        self.gauge_set("koordlet_prediction_peak", peak, {"key": key})
+
+    def render(self) -> str:
+        """Prometheus text exposition (the /metrics body)."""
+        out = []
+        with self._lock:
+            for kind, table in (("counter", self._counters), ("gauge", self._gauges)):
+                for name in sorted(table):
+                    if name in self._help:
+                        out.append(f"# HELP {name} {self._help[name]}")
+                    out.append(f"# TYPE {name} {kind}")
+                    for k in sorted(table[name]):
+                        out.append(f"{name}{_render_labels(k)} {table[name][k]:g}")
+        return "\n".join(out) + "\n"
+
+    # -- WSGI /metrics endpoint (main.go:82-90) --
+    def wsgi_app(self, environ, start_response):
+        body = self.render().encode()
+        start_response(
+            "200 OK",
+            [("Content-Type", "text/plain; version=0.0.4"),
+             ("Content-Length", str(len(body)))],
+        )
+        return [body]

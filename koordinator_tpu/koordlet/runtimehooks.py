@@ -110,6 +110,8 @@ def batch_resource_hook(ctx: ContainerContext) -> None:
     quota = batch-cpu(milli) * period / 1000, shares = milli*1024/1000."""
     milli = ctx.requests.get("kubernetes.io/batch-cpu")
     if milli:
+        # batch-cpu quantities are already milli; accept string quantities
+        milli = res.parse_quantity(milli, "kubernetes.io/batch-cpu")
         ctx.cfs_quota_us = milli * CFS_PERIOD_US // 1000
         ctx.cpu_shares = max(2, milli * 1024 // 1000)
     mem = ctx.limits.get("kubernetes.io/batch-memory") or ctx.requests.get(

@@ -127,9 +127,11 @@ def _cycle_kernel(
     row_iota = lax.broadcasted_iota(jnp.int32, (n_rows, 1), 0)
 
     fit_w_row = w_ref[0:1, :]
-    fit_w_sum = sum(w for _, w in cfg.fit_resource_weights)
     la_w_row = w_ref[1:2, :]
-    la_w_sum = sum(w for _, w in cfg.loadaware.resource_weights)
+    # weight sums over the AXIS-MAPPED weights (names not on RESOURCE_AXIS
+    # are dropped by weights_vector; the divisor must match the scan path)
+    fit_w_sum = sum(res.weights_vector(dict(cfg.fit_resource_weights)))
+    la_w_sum = sum(res.weights_vector(dict(cfg.loadaware.resource_weights)))
 
     def step(j, _):
         p = i * block + j

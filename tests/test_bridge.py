@@ -1,0 +1,129 @@
+"""BatchedScorer sidecar: UDS round trip, delta sync, parity with the
+in-process cycle (the bridge must be a transparent seam)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.bridge import ScorerClient, serve_uds
+from koordinator_tpu.bridge.state import numpy_to_tensor, tensor_to_numpy
+from koordinator_tpu.harness import generators
+from koordinator_tpu.model import encode_snapshot, resources as res
+from koordinator_tpu.model.snapshot import PriorityClass, estimate_pod
+from koordinator_tpu.solver import run_cycle
+
+
+@pytest.fixture()
+def bridge():
+    sock = os.path.join(tempfile.mkdtemp(), "scorer.sock")
+    server = serve_uds(sock)
+    client = ScorerClient(f"unix://{sock}")
+    yield client
+    client.close()
+    server.stop(0)
+
+
+def _tables(pods=24, nodes=6):
+    nodes_l, pods_l, gangs, _ = generators.loadaware_joint(
+        seed=11, pods=pods, nodes=nodes
+    )
+    nalloc = np.asarray([res.resource_vector(n["allocatable"]) for n in nodes_l])
+    nuse = np.asarray([res.resource_vector(n.get("usage", {})) for n in nodes_l])
+    preq = np.asarray([res.resource_vector(p["requests"]) for p in pods_l])
+    # the client owns the estimator (the host scheduler computes estimates;
+    # the sidecar scores whatever it is given) — mirror encode_snapshot
+    pest = np.asarray(
+        [
+            estimate_pod(
+                res.resource_vector(p["requests"]),
+                res.resource_vector(p.get("limits", {})),
+                PriorityClass.from_name(p.get("priority_class"))
+                if p.get("priority_class") is not None
+                else PriorityClass.from_priority_value(p.get("priority")),
+            )
+            for p in pods_l
+        ]
+    )
+    return nodes_l, pods_l, gangs, nalloc, nuse, preq, pest
+
+
+class TestBridge:
+    def test_sync_assign_matches_inprocess(self, bridge):
+        nodes_l, pods_l, gangs, nalloc, nuse, preq, pest = _tables()
+        reply = bridge.sync(
+            node_allocatable=nalloc,
+            node_requested=np.zeros_like(nalloc),
+            node_usage=nuse,
+            node_names=[n["name"] for n in nodes_l],
+            pod_requests=preq,
+            pod_estimated=pest,
+            pod_names=[p["name"] for p in pods_l],
+            priority=[p.get("priority", 0) for p in pods_l],
+        )
+        assert reply.nodes == len(nodes_l) and reply.pods == len(pods_l)
+        assignment, status, ms = bridge.assign()
+        assert len(assignment) == len(pods_l)
+        assert ms > 0
+
+        # parity: the same cluster through the in-process entry point
+        snap = encode_snapshot(
+            [{**n, "requested": {}} for n in nodes_l], list(pods_l), [], []
+        )
+        direct = run_cycle(snap)
+        direct_assign = np.asarray(direct.assignment)[: len(pods_l)]
+        np.testing.assert_array_equal(assignment, direct_assign)
+
+    def test_score_topk_sorted_and_feasible(self, bridge):
+        nodes_l, pods_l, gangs, nalloc, nuse, preq, pest = _tables()
+        bridge.sync(
+            node_allocatable=nalloc,
+            node_requested=np.zeros_like(nalloc),
+            node_usage=nuse,
+            pod_requests=preq,
+            pod_estimated=pest,
+        )
+        lists = bridge.score(top_k=3)
+        assert lists and all(len(entry) <= 3 for entry in lists)
+        for entry in lists:
+            scores = [s for _, s in entry]
+            assert scores == sorted(scores, reverse=True)
+
+    def test_delta_sync_updates_usage(self, bridge):
+        nodes_l, pods_l, gangs, nalloc, nuse, preq, pest = _tables()
+        bridge.sync(
+            node_allocatable=nalloc,
+            node_requested=np.zeros_like(nalloc),
+            node_usage=nuse,
+            pod_requests=preq,
+            pod_estimated=pest,
+        )
+        a1, _, _ = bridge.assign()
+        # warm cycle: bump usage on one node; client auto-encodes a delta
+        nuse2 = nuse.copy()
+        nuse2[0, res.RESOURCE_INDEX[res.CPU]] += 1000
+        reply = bridge.sync(
+            node_usage=nuse2,
+            pod_requests=preq,
+            pod_estimated=pest,
+        )
+        assert reply.nodes == len(nodes_l)
+        a2, _, _ = bridge.assign()
+        assert len(a2) == len(a1)
+
+    def test_tensor_delta_roundtrip(self):
+        prev = np.arange(64, dtype=np.int64).reshape(8, 8)
+        nxt = prev.copy()
+        nxt[3, 4] = 999
+        t = numpy_to_tensor(nxt, prev)
+        assert t.delta_idx and not t.data  # shipped as sparse delta
+        back = tensor_to_numpy(t, prev)
+        np.testing.assert_array_equal(back, nxt)
+
+    def test_tensor_full_when_mostly_changed(self):
+        prev = np.zeros((8, 8), np.int64)
+        nxt = np.arange(64, dtype=np.int64).reshape(8, 8)
+        t = numpy_to_tensor(nxt, prev)
+        assert t.data and not t.delta_idx
+        np.testing.assert_array_equal(tensor_to_numpy(t, None), nxt)

@@ -1,0 +1,278 @@
+"""Scheduler REST services, component config, koordlet daemon wiring,
+metrics registry, audit /events, and descheduler k8s-adaptor plugins."""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.descheduler.k8s_plugins import (
+    DefaultEvictorArgs,
+    TooManyRestartsArgs,
+    default_evictor_filter,
+    remove_duplicates,
+    remove_pods_having_too_many_restarts,
+    remove_pods_violating_interpod_antiaffinity,
+    remove_pods_violating_node_affinity,
+    run_deschedule_plugin,
+)
+from koordinator_tpu.harness import generators
+from koordinator_tpu.koordlet.audit import Auditor
+from koordinator_tpu.koordlet.daemon import Daemon
+from koordinator_tpu.koordlet.metrics import MetricsRegistry
+from koordinator_tpu.model import encode_snapshot
+from koordinator_tpu.scheduler.config_api import (
+    ConfigError,
+    load_config,
+    load_profile,
+)
+from koordinator_tpu.scheduler.services import APIService
+
+
+def _call_wsgi(app, path, query=""):
+    captured = {}
+
+    def start_response(status, headers):
+        captured["status"] = status
+
+    body = b"".join(
+        app({"PATH_INFO": path, "QUERY_STRING": query}, start_response)
+    )
+    return captured["status"], json.loads(body) if body else None
+
+
+class TestAPIService:
+    def _api(self):
+        api = APIService()
+        nodes, pods, gangs, quotas = generators.spark_colocation()
+        api.set_snapshot(encode_snapshot(nodes, pods, gangs, quotas))
+        return api, nodes
+
+    def test_node_endpoint(self):
+        api, nodes = self._api()
+        status, body = _call_wsgi(api.wsgi_app, f"/apis/v1/nodes/{nodes[0]['name']}")
+        assert status.startswith("200")
+        assert body["name"] == nodes[0]["name"]
+        assert body["allocatable"].get("cpu", 0) > 0
+
+    def test_node_not_found_and_no_route(self):
+        api, _ = self._api()
+        status, _ = _call_wsgi(api.wsgi_app, "/apis/v1/nodes/ghost")
+        assert status.startswith("404")
+        status, _ = _call_wsgi(api.wsgi_app, "/apis/v1/plugins/none/x")
+        assert status.startswith("404")
+
+    def test_plugin_route_registration(self):
+        api, _ = self._api()
+        api.register_plugin("loadaware", "state", lambda q: (200, {"ok": True}))
+        status, body = _call_wsgi(api.wsgi_app, "/apis/v1/plugins/loadaware/state")
+        assert status.startswith("200") and body == {"ok": True}
+        status, body = _call_wsgi(api.wsgi_app, "/apis/v1/plugins")
+        assert "/apis/v1/plugins/loadaware/state" in body
+
+    def test_handler_error_is_500(self):
+        api, _ = self._api()
+        api.register_plugin("bad", "x", lambda q: 1 / 0)
+        status, body = _call_wsgi(api.wsgi_app, "/apis/v1/plugins/bad/x")
+        assert status.startswith("500")
+
+
+class TestConfigAPI:
+    def test_defaults(self):
+        profile = load_profile({})
+        assert profile.scheduler_name == "koord-scheduler"
+        assert profile.coscheduling.default_timeout_seconds == 600
+        assert profile.cycle.fit_scoring_strategy == "LeastAllocated"
+
+    def test_yaml_round_trip(self):
+        text = """
+profiles:
+- schedulerName: koord-scheduler
+  pluginConfig:
+  - name: LoadAwareScheduling
+    args:
+      usageThresholds: {cpu: 65, memory: 95}
+      estimatedScalingFactors: {cpu: 85, memory: 70}
+  - name: NodeResourcesFit
+    args:
+      scoringStrategy:
+        type: MostAllocated
+        resources: [{name: cpu, weight: 2}, {name: memory, weight: 1}]
+  - name: Coscheduling
+    args: {defaultTimeoutSeconds: 300}
+"""
+        profiles = load_config(text)
+        assert len(profiles) == 1
+        p = profiles[0]
+        assert p.cycle.fit_scoring_strategy == "MostAllocated"
+        assert dict(p.cycle.fit_resource_weights)["cpu"] == 2
+        assert dict(p.cycle.loadaware.usage_thresholds)["cpu"] == 65
+        assert p.coscheduling.default_timeout_seconds == 300
+
+    def test_strict_validation(self):
+        with pytest.raises(ConfigError, match="unknown plugin"):
+            load_profile({"pluginConfig": [{"name": "Bogus", "args": {}}]})
+        with pytest.raises(ConfigError, match="unknown field"):
+            load_profile(
+                {"pluginConfig": [{"name": "Coscheduling", "args": {"nope": 1}}]}
+            )
+        with pytest.raises(ConfigError, match="percent > 100"):
+            load_profile(
+                {
+                    "pluginConfig": [
+                        {
+                            "name": "LoadAwareScheduling",
+                            "args": {"usageThresholds": {"cpu": 150}},
+                        }
+                    ]
+                }
+            )
+
+
+class TestDaemonWiring:
+    def test_tick_order_and_metrics(self, tmp_path):
+        auditor = Auditor(directory=str(tmp_path))
+        metrics = MetricsRegistry(common_labels={"node": "n0"})
+        d = Daemon(auditor=auditor, metrics=metrics)
+        out = d.run_once(now=10.0)
+        assert set(out) == {"pleg_events", "collectors", "strategies", "node_metric"}
+        assert metrics.get("koordlet_ticks_total") == 1.0
+        d.run_once(now=11.0)
+        assert metrics.get("koordlet_ticks_total") == 2.0
+
+    def test_shutdown_checkpoints(self, tmp_path):
+        from koordinator_tpu.koordlet.prediction import (
+            FileCheckpointer,
+            PeakPredictServer,
+        )
+
+        predict = PeakPredictServer(checkpointer=FileCheckpointer(str(tmp_path)))
+        predict.update("node", 4.2, ts=0.0)
+        d = Daemon(predict=predict)
+        d.start(interval_seconds=0.01)
+        d.shutdown()
+        assert FileCheckpointer(str(tmp_path)).keys() == ["node"]
+
+
+class TestMetricsRegistry:
+    def test_exposition_format(self):
+        m = MetricsRegistry(common_labels={"node": "n0"})
+        m.describe("koordlet_be_suppress_cpu_cores", "suppressed BE cpu")
+        m.record_be_suppress(1500)
+        m.record_container_cpi("p1", "c1", cycles=100, instructions=50)
+        text = m.render()
+        assert "# TYPE koordlet_be_suppress_cpu_cores gauge" in text
+        assert 'koordlet_be_suppress_cpu_cores{node="n0"} 1.5' in text
+        assert 'container="c1"' in text and 'pod="p1"' in text
+
+    def test_wsgi_metrics(self):
+        m = MetricsRegistry()
+        m.gauge_set("g", 2.0)
+        captured = {}
+
+        def sr(status, headers):
+            captured["status"] = status
+
+        body = b"".join(m.wsgi_app({}, sr))
+        assert captured["status"].startswith("200") and b"g 2" in body
+
+
+class TestAuditHTTP:
+    def test_events_endpoint(self, tmp_path):
+        a = Auditor(directory=str(tmp_path))
+        a.log("suppress", pods=3)
+        a.log("evict", pod="p1")
+        captured = {}
+
+        def sr(status, headers):
+            captured["status"] = status
+
+        body = b"".join(
+            a.wsgi_app({"QUERY_STRING": "event=evict"}, sr)
+        )
+        events = json.loads(body)
+        assert captured["status"].startswith("200")
+        assert len(events) == 1 and events[0]["event"] == "evict"
+
+
+class TestK8sAdaptorPlugins:
+    def test_default_evictor_filters(self):
+        args = DefaultEvictorArgs()
+        ds_pod = {
+            "name": "d",
+            "owner_references": [{"kind": "DaemonSet", "name": "ds"}],
+        }
+        assert default_evictor_filter(ds_pod, args)
+        critical = {
+            "name": "c",
+            "priority": 2_000_000_001,
+            "owner_references": [{"kind": "ReplicaSet", "name": "rs"}],
+        }
+        assert default_evictor_filter(critical, args)
+        normal = {
+            "name": "n",
+            "owner_references": [{"kind": "ReplicaSet", "name": "rs"}],
+        }
+        assert default_evictor_filter(normal, args) == []
+
+    def test_too_many_restarts(self):
+        pods = [
+            {"name": "a", "containers": [{"restart_count": 150}]},
+            {"name": "b", "containers": [{"restart_count": 2}]},
+        ]
+        got = remove_pods_having_too_many_restarts(
+            pods, TooManyRestartsArgs(pod_restart_threshold=100)
+        )
+        assert [p["name"] for p in got] == ["a"]
+
+    def test_remove_duplicates(self):
+        owner = [{"kind": "ReplicaSet", "name": "rs1"}]
+        pods = [
+            {"name": "a", "node": "n1", "owner_references": owner},
+            {"name": "b", "node": "n1", "owner_references": owner},
+            {"name": "c", "node": "n2", "owner_references": owner},
+        ]
+        got = remove_duplicates(pods)
+        assert [p["name"] for p in got] == ["b"]
+
+    def test_node_affinity_violation(self):
+        pods = [
+            {"name": "a", "node": "n1", "node_selector": {"zone": "us-1"}},
+            {"name": "b", "node": "n2", "node_selector": {"zone": "us-2"}},
+        ]
+        nodes = [
+            {"name": "n1", "labels": {"zone": "us-1"}},
+            {"name": "n2", "labels": {"zone": "us-1"}},  # drifted
+        ]
+        got = remove_pods_violating_node_affinity(pods, nodes)
+        assert [p["name"] for p in got] == ["b"]
+
+    def test_interpod_antiaffinity(self):
+        pods = [
+            {
+                "name": "holder",
+                "node": "n1",
+                "anti_affinity_selector": {"app": "web"},
+                "labels": {"app": "db"},
+            },
+            {"name": "victim", "node": "n1", "labels": {"app": "web"}},
+            {"name": "other", "node": "n2", "labels": {"app": "web"}},
+        ]
+        got = remove_pods_violating_interpod_antiaffinity(pods)
+        assert [p["name"] for p in got] == ["victim"]
+
+    def test_run_plugin_composes_evictor(self):
+        owner = [{"kind": "ReplicaSet", "name": "rs"}]
+        pods = [
+            {"name": "ok", "owner_references": owner},
+            {"name": "ds", "owner_references": [{"kind": "DaemonSet", "name": "d"}]},
+        ]
+        evicted_names = []
+        result = run_deschedule_plugin(
+            lambda: pods,
+            DefaultEvictorArgs(),
+            lambda p: evicted_names.append(p["name"]) or True,
+        )
+        assert evicted_names == ["ok"]
+        assert "ds" in result.skipped
