@@ -18,6 +18,14 @@ from koordinator_tpu.bridge.codegen import method_path, pb2
 from koordinator_tpu.bridge.state import numpy_to_tensor
 
 
+def _parse_generation(snapshot_id: str) -> int:
+    """Server snapshot ids are "s<generation>" (bridge/server.py)."""
+    try:
+        return int(snapshot_id.lstrip("s"))
+    except ValueError:
+        return -1
+
+
 class ScorerClient:
     def __init__(self, target: str):
         """``target``: "unix:///path.sock" or host:port."""
@@ -37,21 +45,23 @@ class ScorerClient:
             request_serializer=lambda m: m.SerializeToString(),
             response_deserializer=pb2.AssignReply.FromString,
         )
-        # previous-sync mirrors for delta encoding
+        # previous-ACKED-sync mirrors (tensor + scalar columns) for delta
+        # encoding and full re-sync.  New values are staged per request and
+        # promoted only after the server confirms the Sync, so a failed RPC
+        # can never desync the delta baseline.
         self._prev: Dict[str, np.ndarray] = {}
+        self._prev_scalars: Dict[str, tuple] = {}
+        self._generation: Optional[int] = None
         self.snapshot_id: Optional[str] = None
 
     def close(self) -> None:
         self._channel.close()
 
-    # -- sync --
-    def _tensor(self, key: str, arr: Optional[np.ndarray]) -> "pb2.Tensor":
-        if arr is None:
-            return pb2.Tensor()
-        arr = np.ascontiguousarray(arr, np.int64)
-        t = numpy_to_tensor(arr, self._prev.get(key))
-        self._prev[key] = arr
-        return t
+    def _invalidate(self) -> None:
+        self._prev.clear()
+        self._prev_scalars.clear()
+        self._generation = None
+        self.snapshot_id = None
 
     def sync(
         self,
@@ -74,41 +84,130 @@ class ScorerClient:
         node_bucket: int = 0,
         pod_bucket: int = 0,
     ) -> "pb2.SyncReply":
-        req = pb2.SyncRequest(node_bucket=node_bucket, pod_bucket=pod_bucket)
-        req.nodes.allocatable.CopyFrom(self._tensor("nalloc", node_allocatable))
-        req.nodes.requested.CopyFrom(self._tensor("nreq", node_requested))
-        req.nodes.usage.CopyFrom(self._tensor("nuse", node_usage))
-        req.nodes.names.extend(node_names)
-        if metric_fresh is not None:
-            req.nodes.metric_fresh.extend(bool(b) for b in metric_fresh)
-        req.pods.requests.CopyFrom(self._tensor("preq", pod_requests))
-        req.pods.estimated.CopyFrom(self._tensor("pest", pod_estimated))
-        req.pods.names.extend(pod_names)
-        if priority is not None:
-            req.pods.priority.extend(int(v) for v in priority)
-        if gang_id is not None:
-            req.pods.gang_id.extend(int(v) for v in gang_id)
-        if quota_id is not None:
-            req.pods.quota_id.extend(int(v) for v in quota_id)
-        req.gangs.min_member.extend(int(v) for v in gang_min_member)
-        req.quotas.runtime.CopyFrom(self._tensor("qrt", quota_runtime))
-        req.quotas.used.CopyFrom(self._tensor("quse", quota_used))
-        req.quotas.limited.CopyFrom(self._tensor("qlim", quota_limited))
-        reply = self._sync(req)
+        tensors = {
+            "nalloc": node_allocatable,
+            "nreq": node_requested,
+            "nuse": node_usage,
+            "preq": pod_requests,
+            "pest": pod_estimated,
+            "qrt": quota_runtime,
+            "quse": quota_used,
+            "qlim": quota_limited,
+        }
+        scalars = {
+            "node_names": tuple(node_names),
+            "metric_fresh": (
+                tuple(bool(b) for b in metric_fresh)
+                if metric_fresh is not None
+                else None
+            ),
+            "pod_names": tuple(pod_names),
+            "priority": tuple(priority) if priority is not None else None,
+            "gang_id": tuple(gang_id) if gang_id is not None else None,
+            "quota_id": tuple(quota_id) if quota_id is not None else None,
+            "gang_min": tuple(gang_min_member),
+        }
+
+        staged: Dict[str, np.ndarray] = {}
+        staged_scalars: Dict[str, tuple] = {}
+
+        def build(baseline: Dict[str, np.ndarray], full: bool):
+            staged.clear()
+            staged_scalars.clear()
+
+            def tensor(key):
+                arr = tensors[key]
+                if full and arr is None:
+                    arr = baseline.get(key)  # resend last acked state
+                if arr is None:
+                    return pb2.Tensor()
+                a = np.ascontiguousarray(arr, np.int64)
+                t = numpy_to_tensor(a, None if full else baseline.get(key))
+                staged[key] = a
+                return t
+
+            def scalar(key):
+                val = scalars[key]
+                if (val is None or val == ()) and full:
+                    val = self._prev_scalars.get(key)
+                if val is not None:
+                    staged_scalars[key] = val
+                return val
+
+            req = pb2.SyncRequest(node_bucket=node_bucket, pod_bucket=pod_bucket)
+            req.nodes.allocatable.CopyFrom(tensor("nalloc"))
+            req.nodes.requested.CopyFrom(tensor("nreq"))
+            req.nodes.usage.CopyFrom(tensor("nuse"))
+            req.nodes.names.extend(scalar("node_names") or ())
+            fresh = scalar("metric_fresh")
+            if fresh is not None:
+                req.nodes.metric_fresh.extend(fresh)
+            req.pods.requests.CopyFrom(tensor("preq"))
+            req.pods.estimated.CopyFrom(tensor("pest"))
+            req.pods.names.extend(scalar("pod_names") or ())
+            prio = scalar("priority")
+            if prio is not None:
+                req.pods.priority.extend(int(v) for v in prio)
+            gang = scalar("gang_id")
+            if gang is not None:
+                req.pods.gang_id.extend(int(v) for v in gang)
+            quota = scalar("quota_id")
+            if quota is not None:
+                req.pods.quota_id.extend(int(v) for v in quota)
+            req.gangs.min_member.extend(int(v) for v in scalar("gang_min") or ())
+            req.quotas.runtime.CopyFrom(tensor("qrt"))
+            req.quotas.used.CopyFrom(tensor("quse"))
+            req.quotas.limited.CopyFrom(tensor("qlim"))
+            return req
+
+        baseline = self._prev
+        try:
+            reply = self._sync(build(baseline, full=False))
+        except grpc.RpcError:
+            # the server may not have applied the deltas (restart loses its
+            # resident tensors): invalidate the baseline so the next sync
+            # ships full tensors
+            self._invalidate()
+            raise
+        gen = _parse_generation(reply.snapshot_id)
+        if self._generation is not None and gen != self._generation + 1:
+            # another client synced in between (or the server restarted and
+            # rebuilt): our deltas were applied onto a base we never saw.
+            # Re-sync full tensors — from the pre-clear baseline, so fields
+            # omitted this cycle still resend their last acked state.
+            reply = self._sync(build(baseline, full=True))
+            gen = _parse_generation(reply.snapshot_id)
+        self._prev = dict(baseline, **staged)
+        self._prev_scalars.update(staged_scalars)
+        self._generation = gen
         self.snapshot_id = reply.snapshot_id
         return reply
 
     # -- score / assign --
+    def _call(self, stub, request):
+        """Invoke Score/Assign; on FAILED_PRECONDITION (our snapshot was
+        displaced by another client's Sync) invalidate the baseline so the
+        caller's next sync() ships full state, then surface the error."""
+        try:
+            return stub(request)
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.FAILED_PRECONDITION:
+                self._invalidate()
+            raise
+
     def score(self, top_k: int = 0) -> List[List[Tuple[int, int]]]:
-        reply = self._score(
-            pb2.ScoreRequest(snapshot_id=self.snapshot_id or "", top_k=top_k)
+        reply = self._call(
+            self._score,
+            pb2.ScoreRequest(snapshot_id=self.snapshot_id or "", top_k=top_k),
         )
         return [
             list(zip(entry.node_index, entry.score)) for entry in reply.pods
         ]
 
     def assign(self) -> Tuple[np.ndarray, np.ndarray, float]:
-        reply = self._assign(pb2.AssignRequest(snapshot_id=self.snapshot_id or ""))
+        reply = self._call(
+            self._assign, pb2.AssignRequest(snapshot_id=self.snapshot_id or "")
+        )
         return (
             np.asarray(reply.assignment, np.int32),
             np.asarray(reply.status, np.int32),

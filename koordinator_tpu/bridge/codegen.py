@@ -1,7 +1,8 @@
-"""protoc codegen shim: import the checked-in scorer_pb2, regenerating it
-from scorer.proto when protoc is available and the proto is newer (the
-image has protoc but not grpcio-tools; services use grpc generic handlers
-so only message codegen is needed)."""
+"""protoc codegen shim: imports the checked-in scorer_pb2 unconditionally.
+After editing scorer.proto, run ``regen()`` (or protoc by hand) and commit
+the result — regeneration is never an import side effect.  The image has
+protoc but not grpcio-tools; services use grpc generic handlers so only
+message codegen is needed."""
 
 from __future__ import annotations
 
@@ -13,24 +14,17 @@ _PROTO = os.path.join(_DIR, "scorer.proto")
 _PB2 = os.path.join(_DIR, "scorer_pb2.py")
 
 
-def _regen_if_stale() -> None:
-    try:
-        if os.path.exists(_PB2) and os.path.getmtime(_PB2) >= os.path.getmtime(
-            _PROTO
-        ):
-            return
-        subprocess.run(
-            ["protoc", f"--python_out={_DIR}", "scorer.proto"],
-            cwd=_DIR,
-            check=True,
-            capture_output=True,
-        )
-    except (OSError, subprocess.CalledProcessError):
-        # no protoc on this machine: use the checked-in scorer_pb2
-        pass
+def regen() -> None:
+    """Regenerate scorer_pb2.py from scorer.proto.  Explicit dev tool —
+    never run as an import side effect (a protoc skew or read-only
+    install must not silently replace the tested checked-in pb2)."""
+    subprocess.run(
+        ["protoc", f"--python_out={_DIR}", "scorer.proto"],
+        cwd=_DIR,
+        check=True,
+        capture_output=True,
+    )
 
-
-_regen_if_stale()
 
 from koordinator_tpu.bridge import scorer_pb2 as pb2  # noqa: E402,F401
 

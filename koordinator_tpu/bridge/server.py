@@ -9,6 +9,7 @@ clients get identical placements to embedded users.
 
 from __future__ import annotations
 
+import threading
 import time
 from concurrent import futures
 from typing import Optional
@@ -30,57 +31,83 @@ class ScorerServicer:
         self.cfg = cfg
         self.state = ResidentState()
         self._generation = 0
+        # one lock over state-mutating Sync and state-reading Score/Assign:
+        # the server runs on a thread pool, and a Sync racing a Score would
+        # otherwise let one cycle mix tensors from two generations
+        self._lock = threading.Lock()
 
-    # -- RPC bodies (plain request -> reply functions) --
-    def sync(self, req: "pb2.SyncRequest") -> "pb2.SyncReply":
-        self.state.apply_sync(req)
-        self._generation += 1
-        snap = self.state.snapshot()
-        return pb2.SyncReply(
-            snapshot_id=f"s{self._generation}",
-            nodes=snap.num_nodes,
-            pods=snap.num_pods,
-        )
+    def _check_generation(self, req, ctx) -> None:
+        want = getattr(req, "snapshot_id", "")
+        if want and want != f"s{self._generation}":
+            msg = (
+                f"snapshot {want!r} is not resident "
+                f"(current s{self._generation})"
+            )
+            if ctx is not None:
+                ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, msg)
+            raise ValueError(msg)
 
-    def score(self, req: "pb2.ScoreRequest") -> "pb2.ScoreReply":
-        snap = self.state.snapshot()
-        scores, feasible = score_cycle(snap, self.cfg)
-        masked = jnp.where(feasible, scores, jnp.iinfo(jnp.int64).min)
-        P = snap.pods.capacity
-        reply = pb2.ScoreReply()
-        k = int(req.top_k) or snap.nodes.capacity
-        k = min(k, snap.nodes.capacity)
-        top_scores, top_idx = lax.top_k(masked, k)
-        top_scores = np.asarray(top_scores)
-        top_idx = np.asarray(top_idx)
-        feasible_np = np.asarray(feasible)
-        valid = np.asarray(snap.pods.valid)
-        for p in range(P):
-            if not valid[p]:
-                continue
-            entry = reply.pods.add()
-            ok = feasible_np[p, top_idx[p]]
-            entry.node_index.extend(int(i) for i, m in zip(top_idx[p], ok) if m)
-            entry.score.extend(int(s) for s, m in zip(top_scores[p], ok) if m)
-        return reply
+    # -- RPC bodies (request -> reply functions) --
+    def sync(self, req: "pb2.SyncRequest", ctx=None) -> "pb2.SyncReply":
+        with self._lock:
+            self.state.apply_sync(req)
+            self._generation += 1
+            snap = self.state.snapshot()
+            return pb2.SyncReply(
+                snapshot_id=f"s{self._generation}",
+                nodes=snap.num_nodes,
+                pods=snap.num_pods,
+            )
 
-    def assign(self, req: "pb2.AssignRequest") -> "pb2.AssignReply":
-        snap = self.state.snapshot()
-        t0 = time.perf_counter()
-        result = run_cycle(snap, self.cfg)
-        assignment = np.asarray(result.assignment)
-        status = np.asarray(result.status)
-        ms = (time.perf_counter() - t0) * 1000.0
-        valid = np.asarray(snap.pods.valid)
-        reply = pb2.AssignReply(cycle_ms=ms)
-        reply.assignment.extend(int(a) for a, v in zip(assignment, valid) if v)
-        reply.status.extend(int(s) for s, v in zip(status, valid) if v)
-        return reply
+    def score(self, req: "pb2.ScoreRequest", ctx=None) -> "pb2.ScoreReply":
+        with self._lock:
+            self._check_generation(req, ctx)
+            snap = self.state.snapshot()
+            scores, feasible = score_cycle(snap, self.cfg)
+            masked = jnp.where(feasible, scores, jnp.iinfo(jnp.int64).min)
+            P = snap.pods.capacity
+            reply = pb2.ScoreReply()
+            k = int(req.top_k) or snap.nodes.capacity
+            k = min(k, snap.nodes.capacity)
+            top_scores, top_idx = lax.top_k(masked, k)
+            top_scores = np.asarray(top_scores)
+            top_idx = np.asarray(top_idx)
+            feasible_np = np.asarray(feasible)
+            valid = np.asarray(snap.pods.valid)
+            for p in range(P):
+                if not valid[p]:
+                    continue
+                entry = reply.pods.add()
+                ok = feasible_np[p, top_idx[p]]
+                entry.node_index.extend(
+                    int(i) for i, m in zip(top_idx[p], ok) if m
+                )
+                entry.score.extend(
+                    int(s) for s, m in zip(top_scores[p], ok) if m
+                )
+            return reply
+
+    def assign(self, req: "pb2.AssignRequest", ctx=None) -> "pb2.AssignReply":
+        with self._lock:
+            self._check_generation(req, ctx)
+            snap = self.state.snapshot()
+            t0 = time.perf_counter()
+            result = run_cycle(snap, self.cfg)
+            assignment = np.asarray(result.assignment)
+            status = np.asarray(result.status)
+            ms = (time.perf_counter() - t0) * 1000.0
+            valid = np.asarray(snap.pods.valid)
+            reply = pb2.AssignReply(cycle_ms=ms)
+            reply.assignment.extend(
+                int(a) for a, v in zip(assignment, valid) if v
+            )
+            reply.status.extend(int(s) for s, v in zip(status, valid) if v)
+            return reply
 
 
 def _handler(fn, req_cls):
     return grpc.unary_unary_rpc_method_handler(
-        lambda req, ctx: fn(req),
+        lambda req, ctx: fn(req, ctx),
         request_deserializer=req_cls.FromString,
         response_serializer=lambda msg: msg.SerializeToString(),
     )

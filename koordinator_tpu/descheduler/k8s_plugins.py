@@ -41,8 +41,11 @@ def default_evictor_filter(pod: Mapping, args: DefaultEvictorArgs) -> List[str]:
     labels = pod.get("labels") or {}
     annotations = pod.get("annotations") or {}
     owner_kinds = {o.get("kind") for o in pod.get("owner_references") or []}
-    if not owner_kinds and pod.get("phase") not in ("Failed",):
-        if not args.evict_failed_bare_pods:
+    if not owner_kinds:
+        # upstream DefaultEvictor: bare pods (no controller to recreate
+        # them) are never evictable, except Failed ones when
+        # evictFailedBarePods is set
+        if not (args.evict_failed_bare_pods and pod.get("phase") == "Failed"):
             reasons.append("pod is a bare pod without owner")
     if "DaemonSet" in owner_kinds:
         reasons.append("pod is owned by a DaemonSet")

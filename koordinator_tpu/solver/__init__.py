@@ -14,6 +14,41 @@ from koordinator_tpu.solver.greedy import (  # noqa: F401
 # pays the failed trace once, not once per scheduling cycle.
 _PALLAS_UNSUPPORTED = set()
 
+# The kernel's scoring multiplies clamped free capacity by MAX_NODE_SCORE
+# (=100) in i32, so scored tensors need that much headroom below 2^31
+# (model/resources.py documents the same ~20 TiB/node bound); quota rows
+# are only added/compared, so they just need room for one more request.
+_I32_SCORED_LIMIT = 2**31 // 100
+_I32_QUOTA_LIMIT = 2**31 - 2**27
+
+
+def pallas_inputs_fit_i32(snapshot) -> bool:
+    """Node rows are bounded by design (MiB units) but quota rows are
+    cluster-wide aggregates that can exceed i32 on very large clusters
+    (> ~2 PiB memory).  Out-of-range inputs must take the i64 scan path —
+    silent truncation would diverge placement with no error."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    scored = (
+        snapshot.nodes.allocatable,
+        snapshot.nodes.requested,
+        snapshot.nodes.usage,
+        snapshot.pods.requests,
+        snapshot.pods.estimated,
+    )
+    quota = (snapshot.quotas.runtime, snapshot.quotas.used)
+    # one fused device->host transfer for the whole check
+    maxima = np.asarray(
+        jnp.stack(
+            [
+                jnp.max(jnp.stack([jnp.max(jnp.abs(t)) for t in scored])),
+                jnp.max(jnp.stack([jnp.max(jnp.abs(t)) for t in quota])),
+            ]
+        )
+    )
+    return maxima[0] < _I32_SCORED_LIMIT and maxima[1] < _I32_QUOTA_LIMIT
+
 
 def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None):
     """Backend-dispatched scheduling cycle.
@@ -40,6 +75,8 @@ def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None):
         and extra_scores is None
         and backend != "cpu"
         and bucket not in _PALLAS_UNSUPPORTED
+        # data-dependent, not shape-dependent: no blacklisting on failure
+        and pallas_inputs_fit_i32(snapshot)
     ):
         import logging
 
