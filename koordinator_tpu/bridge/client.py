@@ -130,7 +130,9 @@ class ScorerClient:
                 val = scalars[key]
                 if (val is None or val == ()) and full:
                     val = self._prev_scalars.get(key)
-                if val is not None:
+                # the server treats empty repeated fields as "unchanged",
+                # so only non-empty values become the acked baseline
+                if val:
                     staged_scalars[key] = val
                 return val
 
@@ -175,7 +177,13 @@ class ScorerClient:
             # rebuilt): our deltas were applied onto a base we never saw.
             # Re-sync full tensors — from the pre-clear baseline, so fields
             # omitted this cycle still resend their last acked state.
-            reply = self._sync(build(baseline, full=True))
+            try:
+                reply = self._sync(build(baseline, full=True))
+            except grpc.RpcError:
+                # the server may have applied the full sync before failing;
+                # treat the baseline as unknown
+                self._invalidate()
+                raise
             gen = _parse_generation(reply.snapshot_id)
         self._prev = dict(baseline, **staged)
         self._prev_scalars.update(staged_scalars)

@@ -10,8 +10,6 @@ import os
 import subprocess
 
 _DIR = os.path.dirname(__file__)
-_PROTO = os.path.join(_DIR, "scorer.proto")
-_PB2 = os.path.join(_DIR, "scorer_pb2.py")
 
 
 def regen() -> None:

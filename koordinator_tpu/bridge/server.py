@@ -92,7 +92,7 @@ class ScorerServicer:
             self._check_generation(req, ctx)
             snap = self.state.snapshot()
             t0 = time.perf_counter()
-            result = run_cycle(snap, self.cfg)
+            result = run_cycle(snap, self.cfg, i32_ok=self.state.i32_fits())
             assignment = np.asarray(result.assignment)
             status = np.asarray(result.status)
             ms = (time.perf_counter() - t0) * 1000.0

@@ -93,6 +93,7 @@ class ResidentState:
         self.node_bucket = 0
         self.pod_bucket = 0
         self._snapshot: Optional[ClusterSnapshot] = None
+        self._i32_ok: Optional[bool] = None
 
     def apply_sync(self, reqmsg: "pb2.SyncRequest") -> None:
         n = reqmsg.nodes
@@ -133,6 +134,40 @@ class ResidentState:
             self.pod_requests.shape[0]
         )
         self._snapshot = None  # rebuilt lazily
+        self._i32_ok = None
+
+    def i32_fits(self) -> bool:
+        """Whether the resident tensors fit the Pallas kernel's i32
+        arithmetic — computed from the host-side numpy mirrors so the
+        per-cycle device round-trip in solver.pallas_inputs_fit_i32 is
+        skipped on the Assign hot path."""
+        if self._i32_ok is None:
+            from koordinator_tpu.solver import check_i32_bounds
+
+            zeros = np.zeros(1, np.int64)
+
+            def amax(a):
+                return int(np.abs(a).max()) if a is not None and a.size else 0
+
+            est = (
+                self.pod_estimated
+                if self.pod_estimated is not None
+                else self.pod_requests
+            )
+            scored = max(
+                amax(self.node_alloc),
+                amax(self.node_requested),
+                amax(self.node_usage),
+                amax(self.pod_requests),
+                amax(est),
+            )
+            quota = max(amax(self.quota_runtime), amax(self.quota_used))
+            est_sum = int(
+                np.abs(est if est is not None else zeros).sum(axis=0).max()
+            )
+            req_sum = int(np.abs(self.pod_requests).sum(axis=0).max())
+            self._i32_ok = check_i32_bounds((scored, quota, est_sum, req_sum))
+        return self._i32_ok
 
     def _pad2(self, a: np.ndarray, rows: int) -> np.ndarray:
         out = np.zeros((rows, a.shape[1]), np.int64)

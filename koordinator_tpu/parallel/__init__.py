@@ -3,3 +3,6 @@ from koordinator_tpu.parallel.mesh import (  # noqa: F401
     shard_snapshot_for_scoring,
     shard_snapshot_for_assign,
 )
+from koordinator_tpu.parallel.shard_assign import (  # noqa: F401
+    greedy_assign_sharded,
+)

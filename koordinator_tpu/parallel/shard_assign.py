@@ -1,0 +1,308 @@
+"""Explicitly-scheduled multi-chip greedy assignment (shard_map).
+
+``greedy_assign`` (solver/greedy.py) is a sequential scan over pods; under
+plain GSPMD sharding every scan step's argmax-over-nodes and node-state
+update make the compiler infer cross-device communication, which scales
+badly with step count.  This module instead partitions the scan body by
+hand with ``jax.shard_map``:
+
+* node state (allocatable / usage / requested / estimated) is sharded
+  across ALL mesh devices along the node axis — the cluster spreads over
+  the combined HBM;
+* pod rows and the quota table are replicated (quota updates are computed
+  identically on every device);
+* each scan step does local Filter+Score on its node shard, then exactly
+  ONE collective — a ``lax.pmax`` of a packed (score, node-index) key —
+  to agree on the winning node, then a local masked update on the owning
+  shard.
+
+The packed key encodes ``score * N_total + (N_total-1 - node_index)`` so a
+single max picks the highest score with the LOWEST node index — the same
+tie-break as ``jnp.argmax`` in the scan path, giving bit-identical
+placements (tests/test_parallel.py asserts parity vs greedy_assign).
+
+Reference analog: the Score fan-out at
+``pkg/scheduler/frameworkext/framework_extender.go:216`` parallelizes one
+pod's scoring over 16 goroutines; here the whole cycle's node dimension is
+parallelized over the device mesh with one ICI collective per pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses as dc
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG, MOST_ALLOCATED
+from koordinator_tpu.constraints.gang import gang_satisfaction
+from koordinator_tpu.model.snapshot import ClusterSnapshot
+from koordinator_tpu.ops.fit import nonzero_requests
+from koordinator_tpu.ops.loadaware import loadaware_filter_mask
+from koordinator_tpu.ops.scoring import (
+    least_requested_score,
+    most_requested_score,
+    weighted_resource_score,
+)
+from koordinator_tpu.solver.greedy import (
+    STATUS_ASSIGNED,
+    STATUS_UNSCHEDULABLE,
+    STATUS_WAIT_GANG,
+    CycleResult,
+    queue_order,
+)
+
+# scores are bounded by plugin weights * MAX_NODE_SCORE (tiny); this
+# sentinel for infeasible nodes leaves the packed key far from i64 limits
+_NEG = jnp.int64(-(2**40))
+
+
+def _pad_nodes_to(snap: ClusterSnapshot, multiple: int) -> ClusterSnapshot:
+    """Pad the node axis to a multiple of the device count with invalid
+    rows (valid=False keeps them unchoosable)."""
+    nodes = snap.nodes
+    N = nodes.allocatable.shape[0]
+    pad = (-N) % multiple
+    if pad == 0:
+        return snap
+    pad2 = lambda a: jnp.pad(a, ((0, pad), (0, 0)))
+    pad1 = lambda a: jnp.pad(a, (0, pad))
+    return dc.replace(
+        snap,
+        nodes=dc.replace(
+            nodes,
+            allocatable=pad2(nodes.allocatable),
+            requested=pad2(nodes.requested),
+            usage=pad2(nodes.usage),
+            metric_fresh=pad1(nodes.metric_fresh),
+            valid=pad1(nodes.valid),
+        ),
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh", "has_mask", "has_scores"))
+def _assign_sharded(
+    snapshot: ClusterSnapshot,
+    extra_mask,
+    extra_scores,
+    *,
+    cfg: CycleConfig,
+    mesh: Mesh,
+    has_mask: bool,
+    has_scores: bool,
+):
+    pods, nodes, quotas = snapshot.pods, snapshot.nodes, snapshot.quotas
+    N = nodes.allocatable.shape[0]
+    axes = tuple(mesh.axis_names)
+    ax = axes if len(axes) > 1 else axes[0]
+
+    order = queue_order(pods.priority, pods.valid)
+    score_requests = nonzero_requests(pods.requests)
+
+    fit_w = cfg.fit_weights_arr()
+    la_w = cfg.loadaware_weights_arr()
+    la_thresh = cfg.loadaware_thresholds_arr()
+
+    node_spec = P(ax, None)
+    flag_spec = P(ax)
+    rep = P()
+    pn_spec = P(None, ax)  # [P, N] extended-plugin tensors: shard nodes
+
+    operands = [
+        nodes.allocatable,
+        nodes.requested,
+        nodes.usage,
+        nodes.valid,
+        nodes.metric_fresh,
+        order,
+        pods.requests,
+        score_requests,
+        pods.estimated,
+        pods.quota_id,
+        pods.valid,
+        quotas.runtime,
+        quotas.limited,
+        quotas.used,
+    ]
+    in_specs = [
+        node_spec, node_spec, node_spec, flag_spec, flag_spec,
+        rep, rep, rep, rep, rep, rep, rep, rep, rep,
+    ]
+    if has_mask:
+        operands.append(extra_mask)
+        in_specs.append(pn_spec)
+    if has_scores:
+        operands.append(extra_scores)
+        in_specs.append(pn_spec)
+
+    def body(
+        alloc, req0, usage, valid, fresh,
+        order, preq, psreq, pest, pqid, pvalid, qrt, qlim, quse0,
+        *extras,
+    ):
+        xmask = extras[0] if has_mask else None
+        xscores = extras[-1] if has_scores else None
+        n_loc = alloc.shape[0]
+        offset = lax.axis_index(ax).astype(jnp.int64) * n_loc
+        gidx = offset + jnp.arange(n_loc, dtype=jnp.int64)
+
+        la_mask = loadaware_filter_mask(usage, alloc, la_thresh, fresh)
+        if not cfg.enable_loadaware:
+            la_mask = jnp.ones_like(la_mask)
+        node_ok = valid & la_mask
+
+        def step(state, p):
+            node_requested, node_estimated, quota_used = state
+            req = preq[p]
+            sreq = psreq[p]
+            est = pest[p]
+            qid = pqid[p]
+            is_valid = pvalid[p]
+            q = jnp.maximum(qid, 0)
+
+            need = req > 0
+            fits = jnp.all(
+                jnp.where(
+                    need[None, :], node_requested + req[None, :] <= alloc, True
+                ),
+                axis=-1,
+            )
+            quota_ok = jnp.where(
+                qid >= 0,
+                jnp.all(jnp.where(qlim[q], quota_used[q] + req <= qrt[q], True)),
+                True,
+            )
+            feasible = fits & node_ok & quota_ok & is_valid
+            if xmask is not None:
+                feasible = feasible & xmask[p]
+
+            total = jnp.zeros((n_loc,), jnp.int64)
+            if cfg.enable_fit_score:
+                t = node_requested + sreq[None, :]
+                if cfg.fit_scoring_strategy == MOST_ALLOCATED:
+                    per_res = most_requested_score(t, alloc)
+                else:
+                    per_res = least_requested_score(t, alloc)
+                total = total + cfg.fit_plugin_weight * weighted_resource_score(
+                    per_res, fit_w
+                )
+            if cfg.enable_loadaware:
+                est_used = usage + node_estimated + est[None, :]
+                per_res = least_requested_score(est_used, alloc)
+                la = weighted_resource_score(per_res, la_w)
+                la = jnp.where(fresh, la, 0)
+                total = total + cfg.loadaware_plugin_weight * la
+            if xscores is not None:
+                total = total + xscores[p]
+
+            masked = jnp.where(feasible, total, _NEG)
+            # ONE collective per step: packed (score, lowest-index) max
+            key = masked * N + (N - 1 - gidx)
+            gkey = lax.pmax(jnp.max(key), ax)
+            best_score = gkey // N  # floor div decodes negatives too
+            chosen = (N - 1 - (gkey - best_score * N)).astype(jnp.int32)
+            any_feasible = best_score > (_NEG // 2)
+            chosen = jnp.where(any_feasible, chosen, -1)
+
+            local = chosen - offset.astype(jnp.int32)
+            hit = (local >= 0) & (local < n_loc) & any_feasible
+            onehot = (jnp.arange(n_loc) == local) & hit
+            node_requested = node_requested + jnp.where(
+                onehot[:, None], req[None, :], 0
+            )
+            node_estimated = node_estimated + jnp.where(
+                onehot[:, None], est[None, :], 0
+            )
+            quota_used = jnp.where(
+                any_feasible & (qid >= 0), quota_used.at[q].add(req), quota_used
+            )
+            return (node_requested, node_estimated, quota_used), chosen
+
+        init = (req0, jnp.zeros_like(req0), quse0)
+        (nreq, nest, quse), chosen_in_order = lax.scan(step, init, order)
+        return chosen_in_order, nreq, nest, quse
+
+    chosen_in_order, node_requested, node_estimated, quota_used = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(rep, node_spec, node_spec, rep),
+        check_vma=False,
+    )(*operands)
+
+    Pcap = pods.capacity
+    assignment = jnp.full((Pcap,), -1, jnp.int32).at[order].set(chosen_in_order)
+    status = jnp.where(assignment >= 0, STATUS_ASSIGNED, STATUS_UNSCHEDULABLE)
+    assigned = (assignment >= 0) & pods.valid
+    _, pod_gang_ok = gang_satisfaction(
+        assignment, pods.valid, pods.gang_id, snapshot.gangs.min_member
+    )
+    status = jnp.where(assigned & ~pod_gang_ok, STATUS_WAIT_GANG, status)
+    return CycleResult(
+        assignment=assignment,
+        status=status.astype(jnp.int32),
+        node_requested=node_requested,
+        node_estimated=node_estimated,
+        quota_used=quota_used,
+    )
+
+
+def greedy_assign_sharded(
+    snapshot: ClusterSnapshot,
+    mesh: Mesh,
+    cfg: CycleConfig = DEFAULT_CYCLE_CONFIG,
+    extra_mask: Optional[jnp.ndarray] = None,
+    extra_scores: Optional[jnp.ndarray] = None,
+) -> CycleResult:
+    """Sequential-parity greedy assignment with node state sharded over
+    every device of ``mesh`` and one collective per pod step.
+
+    Placements are bit-identical with solver.greedy.greedy_assign;
+    ``node_requested``/``node_estimated`` come back node-sharded over the
+    mesh and trimmed to the snapshot's node bucket.
+    """
+    if extra_scores is not None:
+        # the packed key multiplies scores by N; plugin scores are tiny by
+        # construction, but extra_scores is caller-supplied — values at the
+        # sentinel's magnitude would decode as infeasible (or overflow the
+        # key), silently breaking parity, so reject them loudly instead
+        hi = int(jnp.max(jnp.abs(extra_scores)))
+        if hi >= 2**31:
+            raise ValueError(
+                f"extra_scores magnitude {hi} too large for the packed-key "
+                "collective (must be < 2^31); use solver.greedy_assign"
+            )
+    n_dev = mesh.size
+    orig_n = snapshot.nodes.allocatable.shape[0]
+    snapshot = _pad_nodes_to(snapshot, n_dev)
+    padded_n = snapshot.nodes.allocatable.shape[0]
+    if extra_mask is not None and extra_mask.shape[1] != padded_n:
+        extra_mask = jnp.pad(
+            extra_mask, ((0, 0), (0, padded_n - extra_mask.shape[1]))
+        )
+    if extra_scores is not None and extra_scores.shape[1] != padded_n:
+        extra_scores = jnp.pad(
+            extra_scores, ((0, 0), (0, padded_n - extra_scores.shape[1]))
+        )
+    result = _assign_sharded(
+        snapshot,
+        extra_mask,
+        extra_scores,
+        cfg=cfg,
+        mesh=mesh,
+        has_mask=extra_mask is not None,
+        has_scores=extra_scores is not None,
+    )
+    if result.node_requested.shape[0] != orig_n:
+        result = CycleResult(
+            assignment=result.assignment,
+            status=result.status,
+            node_requested=result.node_requested[:orig_n],
+            node_estimated=result.node_estimated[:orig_n],
+            quota_used=result.quota_used,
+        )
+    return result

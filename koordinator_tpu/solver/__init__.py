@@ -22,6 +22,21 @@ _I32_SCORED_LIMIT = 2**31 // 100
 _I32_QUOTA_LIMIT = 2**31 - 2**27
 
 
+def check_i32_bounds(maxima) -> bool:
+    """``maxima``: (scored_max, quota_max, est_sum_max, req_sum_max).
+
+    Bounds the kernel's in-loop accumulators, not just its inputs: the
+    LoadAware term sums usage + all assigned pods' estimates on one node,
+    and a quota's used row sums every assigned request in the cycle, so
+    the worst-case cycle-end values must themselves fit i32."""
+    scored_max, quota_max, est_sum_max, req_sum_max = (int(v) for v in maxima)
+    return (
+        scored_max < _I32_SCORED_LIMIT
+        and quota_max + req_sum_max < _I32_QUOTA_LIMIT
+        and scored_max + est_sum_max < _I32_SCORED_LIMIT
+    )
+
+
 def pallas_inputs_fit_i32(snapshot) -> bool:
     """Node rows are bounded by design (MiB units) but quota rows are
     cluster-wide aggregates that can exceed i32 on very large clusters
@@ -44,19 +59,25 @@ def pallas_inputs_fit_i32(snapshot) -> bool:
             [
                 jnp.max(jnp.stack([jnp.max(jnp.abs(t)) for t in scored])),
                 jnp.max(jnp.stack([jnp.max(jnp.abs(t)) for t in quota])),
+                jnp.max(jnp.sum(jnp.abs(snapshot.pods.estimated), axis=0)),
+                jnp.max(jnp.sum(jnp.abs(snapshot.pods.requests), axis=0)),
             ]
         )
     )
-    return maxima[0] < _I32_SCORED_LIMIT and maxima[1] < _I32_QUOTA_LIMIT
+    return check_i32_bounds(maxima)
 
 
-def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None):
+def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None, i32_ok=None):
     """Backend-dispatched scheduling cycle.
 
     On TPU the single-kernel Pallas cycle (solver/pallas_cycle.py) runs the
     per-pod loop in VMEM; elsewhere (and when extended-plugin tensors are
     composed in) the lax.scan path runs.  Both are bit-identical
     (tests/test_pallas_cycle.py).
+
+    ``i32_ok``: callers that already know whether the snapshot fits the
+    kernel's i32 arithmetic (e.g. the bridge server, which checks host-side
+    numpy mirrors at Sync time) pass it to skip the per-cycle device check.
     """
     import jax
 
@@ -76,7 +97,7 @@ def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None):
         and backend != "cpu"
         and bucket not in _PALLAS_UNSUPPORTED
         # data-dependent, not shape-dependent: no blacklisting on failure
-        and pallas_inputs_fit_i32(snapshot)
+        and (i32_ok if i32_ok is not None else pallas_inputs_fit_i32(snapshot))
     ):
         import logging
 
