@@ -3,29 +3,74 @@
 config #4: ElasticQuota multi-tenant + LS/BE mix).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N, ...}
 
 ``vs_baseline`` is the north-star target (500 ms on one TPU v5e-1, from
 /root/repo/BASELINE.json — the reference publishes no numbers) divided by
 the measured wall-clock: > 1.0 means the target is beaten.
+
+Robustness (the round-1 artifact was lost to a tunnel hiccup, and
+``import jax``/``jax.devices()`` can HANG outright when the tunneled TPU
+backend is unhealthy):
+
+* the parent process (this script, stdlib only — it never imports jax)
+  runs the measurement in a CHILD process under a hard timeout;
+* TPU attempts are retried with backoff; if the backend never comes up the
+  bench falls back to a single-device virtual-CPU run of the same cycle
+  (scan path) so an artifact always exists (``backend`` records the truth);
+* the child separates compile time from steady-state time and records
+  which code path executed (``path``: "pallas" single-kernel cycle vs
+  "scan" lax.scan) — on TPU the Pallas kernel is asserted, NO silent
+  fallback;
+* any failure prints a JSON error line (never a bare stack trace).
 """
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
-
-import numpy as np
-
-import koordinator_tpu  # noqa: F401  (enables x64)
-from koordinator_tpu.constraints import build_quota_table_inputs
-from koordinator_tpu.harness import generators
-from koordinator_tpu.model import encode_snapshot, resources as res
-from koordinator_tpu.solver import run_cycle
 
 TARGET_MS = 500.0
 PODS, NODES = 10_000, 2_000
+METRIC = "sched_cycle_10kpod_2knode_ms"
+
+# NOTE: env vars alone do NOT select the platform on images where a site
+# hook pins jax_platforms (the tunneled-TPU setup does); the child calls
+# jax.config.update before any backend touch when --platform cpu is passed.
+_CPU_ENV = {"XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+# backend-init probes are cheap and discriminate "tunnel dead" (skip
+# straight to CPU) from "compile slow" (give the TPU run its full budget)
+PROBE_TIMEOUT = int(os.environ.get("KOORD_BENCH_PROBE_TIMEOUT", "120"))
+TPU_TIMEOUT = int(os.environ.get("KOORD_BENCH_TPU_TIMEOUT", "600"))
+CPU_TIMEOUT = int(os.environ.get("KOORD_BENCH_CPU_TIMEOUT", "900"))
 
 
-def build_snapshot():
+def child(platform: str) -> None:
+    """Measurement process: prints phase lines then the final JSON line."""
+
+    def phase(name, **kw):
+        print(json.dumps({"phase": name, **kw}), flush=True)
+
+    t0 = time.perf_counter()
+    import jax  # noqa: E402  (may hang; parent enforces the timeout)
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    backend = jax.default_backend()
+    n_dev = len(jax.devices())
+    phase("init", backend=backend, devices=n_dev, ms=_ms(t0))
+
+    t0 = time.perf_counter()
+    import numpy as np
+
+    import koordinator_tpu  # noqa: F401  (enables x64)
+    from koordinator_tpu.constraints import build_quota_table_inputs
+    from koordinator_tpu.harness import generators
+    from koordinator_tpu.model import encode_snapshot, resources as res
+    from koordinator_tpu.solver import pallas_inputs_fit_i32
+
     nodes, pods, gangs, quotas = generators.quota_colocation(pods=PODS, nodes=NODES)
     pod_reqs = [res.resource_vector(p["requests"]) for p in pods]
     qidx = {q["name"]: i for i, q in enumerate(quotas)}
@@ -35,42 +80,177 @@ def build_snapshot():
         v = res.resource_vector(n["allocatable"])
         total = [a + b for a, b in zip(total, v)]
     qdicts = build_quota_table_inputs(quotas, pod_reqs, qids, total)
-    return encode_snapshot(
+    snap = encode_snapshot(
         nodes, pods, gangs, qdicts, node_bucket=NODES, pod_bucket=PODS
     )
+    phase("snapshot", ms=_ms(t0))
 
+    on_tpu = backend != "cpu"
+    if on_tpu:
+        # the flagship single-kernel cycle — invoked directly, so a compile
+        # or runtime failure is a bench FAILURE, never a silent scan
+        assert pallas_inputs_fit_i32(snap), "bench snapshot out of i32 range"
+        from koordinator_tpu.solver.pallas_cycle import greedy_assign_pallas
 
-def main():
-    snap = build_snapshot()
-    # compile + warmup.  NOTE: timing forces a host transfer of the result:
-    # on the tunneled single-chip platform, execution is materialized
-    # lazily, and block_until_ready() alone was measured returning in ~50us
-    # while the same program takes ~550ms when a transfer forces completion
-    # (standard JAX backends block correctly either way; the transfer is
-    # the portable way to time to completion).  The assignment vector is
-    # 40 KB, so the transfer cost itself is negligible.
-    result = run_cycle(snap)
+        run = lambda: greedy_assign_pallas(snap)
+        path = "pallas"
+    else:
+        from koordinator_tpu.solver import greedy_assign
+
+        run = lambda: greedy_assign(snap)
+        path = "scan"
+
+    # compile + first execution.  NOTE: timing forces a host transfer of
+    # the result: on the tunneled single-chip platform execution is
+    # materialized lazily, and block_until_ready() alone was measured
+    # returning in ~50us while the same program takes ~550ms when a
+    # transfer forces completion.  The assignment vector is 40 KB, so the
+    # transfer cost itself is negligible.
+    t0 = time.perf_counter()
+    result = run()
     np.asarray(result.assignment)
+    compile_ms = _ms(t0)
+    phase("compile", ms=compile_ms, path=path)
+
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        result = run_cycle(snap)
+        result = run()
         np.asarray(result.assignment)
-        times.append((time.perf_counter() - t0) * 1000)
+        times.append(_ms(t0))
     ms = min(times)
     assigned = int((np.asarray(result.assignment)[:PODS] >= 0).sum())
     assert assigned > 0, "benchmark snapshot scheduled nothing"
     print(
         json.dumps(
             {
-                "metric": "sched_cycle_10kpod_2knode_ms",
+                "metric": METRIC,
                 "value": round(ms, 2),
                 "unit": "ms",
                 "vs_baseline": round(TARGET_MS / ms, 3),
+                "backend": backend,
+                "path": path,
+                "compile_ms": round(compile_ms, 1),
+                "assigned": assigned,
             }
-        )
+        ),
+        flush=True,
     )
 
 
+def _ms(t0: float) -> float:
+    return (time.perf_counter() - t0) * 1000.0
+
+
+def _spawn(flag, platform, env_extra, timeout):
+    """Run a child stage; returns (ok, final_json_line, err_string)."""
+    env = dict(os.environ, **env_extra)
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                flag,
+                "--platform",
+                platform,
+            ],
+            env=env,
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or b""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        phases = [l for l in out.splitlines() if l.startswith('{"phase"')]
+        return (
+            False,
+            None,
+            f"{flag} timed out after {timeout}s; last phase: "
+            f"{phases[-1] if phases else 'none (backend init hang)'}",
+        )
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    final = [l for l in lines if '"metric"' in l or '"probe"' in l]
+    if proc.returncode == 0 and final:
+        return True, final[-1], ""
+    tail = proc.stderr.strip().splitlines()
+    return (
+        False,
+        None,
+        f"{flag} rc={proc.returncode}: {tail[-1] if tail else 'no stderr'}",
+    )
+
+
+def probe(platform: str) -> None:
+    """Minimal backend touch: init + one tiny op."""
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    x = jax.numpy.zeros(8).sum()
+    x.block_until_ready()
+    print(
+        json.dumps(
+            {"probe": jax.default_backend(), "devices": len(jax.devices())}
+        ),
+        flush=True,
+    )
+
+
+def parent() -> int:
+    """Probe, then measure with retries + hard timeouts; ONE JSON line."""
+    errors = []
+    ok, out, err = _spawn("--probe", "default", {}, PROBE_TIMEOUT)
+    if not ok:
+        errors.append(err)
+        ok, out, err = _spawn("--probe", "default", {}, PROBE_TIMEOUT // 2 or 60)
+        if not ok:
+            errors.append(err)
+    tpu_alive = ok and '"probe": "cpu"' not in (out or "")
+    if tpu_alive:
+        for timeout in (TPU_TIMEOUT, TPU_TIMEOUT * 3 // 4):
+            ok, final, err = _spawn("--child", "default", {}, timeout)
+            if ok:
+                print(final)
+                return 0
+            errors.append(err)
+    # TPU never came up (or failed twice): virtual-CPU fallback so an
+    # artifact exists either way; "backend" in the line records the truth
+    ok, final, err = _spawn("--child", "cpu", _CPU_ENV, CPU_TIMEOUT)
+    if ok:
+        print(final)
+        return 0
+    errors.append(err)
+    print(
+        json.dumps(
+            {
+                "metric": METRIC,
+                "value": -1,
+                "unit": "ms",
+                "vs_baseline": 0.0,
+                "error": "; ".join(errors),
+            }
+        )
+    )
+    return 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--probe", action="store_true")
+    ap.add_argument("--platform", default="default", choices=["default", "cpu"])
+    args = ap.parse_args()
+    if args.probe:
+        probe(args.platform)
+        return 0
+    if args.child:
+        child(args.platform)
+        return 0
+    return parent()
+
+
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

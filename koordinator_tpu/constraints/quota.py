@@ -165,9 +165,24 @@ def build_quota_table_inputs(
         out.append(
             {
                 "name": g.name,
-                "runtime": {res.RESOURCE_AXIS[r]: rt[r] for r in sorted(limited)},
+                # values are in axis units already; render them as
+                # round-trippable quantities ("...Mi"/"...m") so
+                # encode_snapshot's parse_quantity doesn't re-divide
+                # byte-denominated lanes by MiB
+                "runtime": {
+                    res.RESOURCE_AXIS[r]: res.format_quantity(
+                        rt[r], res.RESOURCE_AXIS[r]
+                    )
+                    for r in sorted(limited)
+                },
                 "limited": [res.RESOURCE_AXIS[r] for r in sorted(limited)],
-                "used": {res.RESOURCE_AXIS[r]: g.used[r] for r in range(res.NUM_RESOURCES) if g.used[r]},
+                "used": {
+                    res.RESOURCE_AXIS[r]: res.format_quantity(
+                        g.used[r], res.RESOURCE_AXIS[r]
+                    )
+                    for r in range(res.NUM_RESOURCES)
+                    if g.used[r]
+                },
             }
         )
     return out

@@ -38,22 +38,18 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG, MOST_ALLOCATED
+from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG
 from koordinator_tpu.constraints.gang import gang_satisfaction
 from koordinator_tpu.model.snapshot import ClusterSnapshot
 from koordinator_tpu.ops.fit import nonzero_requests
 from koordinator_tpu.ops.loadaware import loadaware_filter_mask
-from koordinator_tpu.ops.scoring import (
-    least_requested_score,
-    most_requested_score,
-    weighted_resource_score,
-)
 from koordinator_tpu.solver.greedy import (
     STATUS_ASSIGNED,
     STATUS_UNSCHEDULABLE,
     STATUS_WAIT_GANG,
     CycleResult,
     queue_order,
+    step_feasible_scores,
 )
 
 # scores are bounded by plugin weights * MAX_NODE_SCORE (tiny); this
@@ -103,8 +99,6 @@ def _assign_sharded(
     order = queue_order(pods.priority, pods.valid)
     score_requests = nonzero_requests(pods.requests)
 
-    fit_w = cfg.fit_weights_arr()
-    la_w = cfg.loadaware_weights_arr()
     la_thresh = cfg.loadaware_thresholds_arr()
 
     node_spec = P(ax, None)
@@ -158,44 +152,30 @@ def _assign_sharded(
         def step(state, p):
             node_requested, node_estimated, quota_used = state
             req = preq[p]
-            sreq = psreq[p]
             est = pest[p]
             qid = pqid[p]
-            is_valid = pvalid[p]
             q = jnp.maximum(qid, 0)
 
-            need = req > 0
-            fits = jnp.all(
-                jnp.where(
-                    need[None, :], node_requested + req[None, :] <= alloc, True
-                ),
-                axis=-1,
+            # same step semantics as greedy_assign, on the local node shard
+            feasible, total = step_feasible_scores(
+                node_requested,
+                node_estimated,
+                quota_used,
+                alloc,
+                usage,
+                fresh,
+                node_ok,
+                req,
+                psreq[p],
+                est,
+                qid,
+                pvalid[p],
+                qrt,
+                qlim,
+                cfg,
             )
-            quota_ok = jnp.where(
-                qid >= 0,
-                jnp.all(jnp.where(qlim[q], quota_used[q] + req <= qrt[q], True)),
-                True,
-            )
-            feasible = fits & node_ok & quota_ok & is_valid
             if xmask is not None:
                 feasible = feasible & xmask[p]
-
-            total = jnp.zeros((n_loc,), jnp.int64)
-            if cfg.enable_fit_score:
-                t = node_requested + sreq[None, :]
-                if cfg.fit_scoring_strategy == MOST_ALLOCATED:
-                    per_res = most_requested_score(t, alloc)
-                else:
-                    per_res = least_requested_score(t, alloc)
-                total = total + cfg.fit_plugin_weight * weighted_resource_score(
-                    per_res, fit_w
-                )
-            if cfg.enable_loadaware:
-                est_used = usage + node_estimated + est[None, :]
-                per_res = least_requested_score(est_used, alloc)
-                la = weighted_resource_score(per_res, la_w)
-                la = jnp.where(fresh, la, 0)
-                total = total + cfg.loadaware_plugin_weight * la
             if xscores is not None:
                 total = total + xscores[p]
 

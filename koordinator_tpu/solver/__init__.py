@@ -86,17 +86,24 @@ def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None, i32_ok=Non
     if cfg is None:
         cfg = DEFAULT_CYCLE_CONFIG
     backend = jax.default_backend()
+    has_extras = extra_mask is not None or extra_scores is not None
     bucket = (
         backend,
         int(snapshot.nodes.allocatable.shape[0]),
         int(snapshot.pods.capacity),
+        has_extras,
     )
+    extras_ok = True
+    if extra_scores is not None:
+        import jax.numpy as jnp
+
+        # extended-plugin scores join the kernel's i32 accumulation
+        extras_ok = int(jnp.max(jnp.abs(extra_scores))) < 2**29
     if (
-        extra_mask is None
-        and extra_scores is None
-        and backend != "cpu"
+        backend != "cpu"
         and bucket not in _PALLAS_UNSUPPORTED
         # data-dependent, not shape-dependent: no blacklisting on failure
+        and extras_ok
         and (i32_ok if i32_ok is not None else pallas_inputs_fit_i32(snapshot))
     ):
         import logging
@@ -104,7 +111,9 @@ def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None, i32_ok=Non
         from koordinator_tpu.solver.pallas_cycle import greedy_assign_pallas
 
         try:
-            result = greedy_assign_pallas(snapshot, cfg)
+            result = greedy_assign_pallas(
+                snapshot, cfg, extra_mask=extra_mask, extra_scores=extra_scores
+            )
             # materialize before returning: with async dispatch (and lazy
             # materialization on tunneled platforms) a runtime fault would
             # otherwise surface at the caller, outside this fallback

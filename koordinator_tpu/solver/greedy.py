@@ -111,6 +111,58 @@ def _combined_scores(
     return total
 
 
+def step_feasible_scores(
+    node_requested: jnp.ndarray,  # i64[N, R] carried
+    node_estimated: jnp.ndarray,  # i64[N, R] carried
+    quota_used: jnp.ndarray,  # i64[Q, R] carried
+    alloc: jnp.ndarray,  # i64[N, R]
+    usage: jnp.ndarray,  # i64[N, R]
+    fresh: jnp.ndarray,  # bool[N]
+    node_ok: jnp.ndarray,  # bool[N] valid & loadaware filter
+    req: jnp.ndarray,  # i64[R] one pod
+    sreq: jnp.ndarray,  # i64[R]
+    est: jnp.ndarray,  # i64[R]
+    qid: jnp.ndarray,  # i32 scalar
+    is_valid: jnp.ndarray,  # bool scalar
+    qrt: jnp.ndarray,  # i64[Q, R]
+    qlim: jnp.ndarray,  # bool[Q, R]
+    cfg: CycleConfig,
+):
+    """One pod's Filter+Score against a node-state block -> (feasible[N],
+    scores[N]).  The single source of the sequential-cycle step semantics,
+    shared by ``greedy_assign`` and the shard_map variant
+    (parallel/shard_assign.py); the Pallas kernel mirrors it in i32."""
+    q = jnp.maximum(qid, 0)
+    need = req > 0
+    fits = jnp.all(
+        jnp.where(need[None, :], node_requested + req[None, :] <= alloc, True),
+        axis=-1,
+    )
+    quota_ok = jnp.where(
+        qid >= 0,
+        jnp.all(jnp.where(qlim[q], quota_used[q] + req <= qrt[q], True)),
+        True,
+    )
+    feasible = fits & node_ok & quota_ok & is_valid
+
+    total = jnp.zeros((alloc.shape[0],), jnp.int64)
+    if cfg.enable_fit_score:
+        t = node_requested + sreq[None, :]
+        if cfg.fit_scoring_strategy == MOST_ALLOCATED:
+            per_res = most_requested_score(t, alloc)
+        else:
+            per_res = least_requested_score(t, alloc)
+        total = total + cfg.fit_plugin_weight * weighted_resource_score(
+            per_res, cfg.fit_weights_arr()
+        )
+    if cfg.enable_loadaware:
+        est_used = usage + node_estimated + est[None, :]
+        per_res = least_requested_score(est_used, alloc)
+        la = jnp.where(fresh, weighted_resource_score(per_res, cfg.loadaware_weights_arr()), 0)
+        total = total + cfg.loadaware_plugin_weight * la
+    return feasible, total
+
+
 @partial(jax.jit, static_argnames=("cfg",))
 def score_cycle(snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG):
     """Stateless batch scoring: scores + feasibility for every (pod, node).
@@ -180,38 +232,34 @@ def greedy_assign(
     if not cfg.enable_loadaware:
         la_mask = jnp.ones_like(la_mask)
 
+    node_ok = nodes.valid & la_mask
+
     def step(state, p):
         node_requested, node_estimated, quota_used = state
         req = pods.requests[p]
-        sreq = score_requests[p]
         est = pods.estimated[p]
         qid = pods.quota_id[p]
-        is_valid = pods.valid[p]
-
-        need = req > 0
-        fits = jnp.all(
-            jnp.where(need[None, :], node_requested + req[None, :] <= nodes.allocatable, True),
-            axis=-1,
-        )
         q = jnp.maximum(qid, 0)
-        quota_ok = jnp.where(
-            qid >= 0,
-            jnp.all(
-                jnp.where(
-                    quotas.limited[q],
-                    quota_used[q] + req <= quotas.runtime[q],
-                    True,
-                )
-            ),
-            True,
+
+        feasible, scores = step_feasible_scores(
+            node_requested,
+            node_estimated,
+            quota_used,
+            nodes.allocatable,
+            nodes.usage,
+            nodes.metric_fresh,
+            node_ok,
+            req,
+            score_requests[p],
+            est,
+            qid,
+            pods.valid[p],
+            quotas.runtime,
+            quotas.limited,
+            cfg,
         )
-        feasible = fits & nodes.valid & la_mask & quota_ok & is_valid
         if extra_mask is not None:
             feasible = feasible & extra_mask[p]
-
-        scores = _combined_scores(
-            snapshot, node_requested, node_estimated, cfg, req, sreq, est
-        )
         if extra_scores is not None:
             scores = scores + extra_scores[p]
         masked = jnp.where(feasible, scores, jnp.iinfo(jnp.int64).min)

@@ -99,19 +99,21 @@ def _cycle_kernel(
     qlim_ref,  # i32[Q, 128] quota limited mask
     quse0_ref,  # i32[Q, 128] initial quota used
     w_ref,  # i32[8, 128] row0 = fit weights, row1 = loadaware weights
-    # outputs
-    chosen_ref,  # i32[B, 128]
-    nreq_out_ref,  # i32[N, 128]
-    nest_out_ref,  # i32[N, 128]
-    quse_out_ref,  # i32[Q, 128]
-    # scratch
-    nreq_ref,
-    nest_ref,
-    quse_ref,
-    *,
+    *rest,  # optional: xmask_ref i32[N, B], xscore_ref i32[N, B] — the
+    # extended-plugin (NUMA/reservation/deviceshare) tensors, pods on the
+    # lane axis so each step extracts a [N, 1] column — then outputs/scratch
     block: int,
     cfg: CycleConfig,
+    has_extras: bool,
 ):
+    if has_extras:
+        xmask_ref, xscore_ref = rest[0], rest[1]
+        rest = rest[2:]
+    else:
+        xmask_ref = xscore_ref = None
+    (chosen_ref, nreq_out_ref, nest_out_ref, quse_out_ref,
+     nreq_ref, nest_ref, quse_ref) = rest
+
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -158,6 +160,13 @@ def _cycle_kernel(
             )
         )
         feasible = fits & node_ok & ((qid < 0) | qok) & is_valid
+        if has_extras:
+            # extract this pod's [N, 1] column by one-hot lane reduction
+            # (dynamic lane slicing is costly on the VPU; a masked lane
+            # sum is a single vector op)
+            lane = lax.broadcasted_iota(jnp.int32, (1, block), 1) == j
+            xm = jnp.sum(jnp.where(lane, xmask_ref[:], 0), axis=1, keepdims=True)
+            feasible = feasible & (xm != 0)
 
         # Score: NodeResourcesFit + LoadAware, exact integer math
         total = jnp.zeros((n_rows, 1), jnp.int32)
@@ -175,6 +184,9 @@ def _cycle_kernel(
             per_res = _least_requested(est_used, alloc)
             la = _weighted(per_res, la_w_row, la_w_sum)
             total = total + cfg.loadaware_plugin_weight * jnp.where(fresh, la, 0)
+        if has_extras:
+            xs = jnp.sum(jnp.where(lane, xscore_ref[:], 0), axis=1, keepdims=True)
+            total = total + xs
 
         masked = jnp.where(feasible, total, I32_MIN)
         best = jnp.max(masked)
@@ -209,22 +221,35 @@ def _cycle_kernel(
 @partial(jax.jit, static_argnames=("cfg", "block", "interpret"))
 def _run_cycle(
     preq, psreq, pest, qid, pvalid, alloc, usage, req0, flags, qrt, qlim, quse0,
-    weights, *, cfg: CycleConfig, block: int, interpret: bool
+    weights, xmask=None, xscore=None, *, cfg: CycleConfig, block: int,
+    interpret: bool
 ):
     P = preq.shape[0]
     N = alloc.shape[0]
     Q = qrt.shape[0]
+    has_extras = xmask is not None
     grid = (P // block,)
     node_spec = pl.BlockSpec((N, LANES), lambda i, *_: (0, 0), memory_space=pltpu.VMEM)
     quota_spec = pl.BlockSpec((Q, LANES), lambda i, *_: (0, 0), memory_space=pltpu.VMEM)
     pod_spec = pl.BlockSpec((block, LANES), lambda i, *_: (i, 0), memory_space=pltpu.VMEM)
+    in_specs = (
+        [pod_spec, pod_spec, pod_spec]
+        + [node_spec] * 4
+        + [quota_spec] * 3
+        + [pl.BlockSpec((8, LANES), lambda i, *_: (0, 0), memory_space=pltpu.VMEM)]
+    )
+    operands = [preq, psreq, pest, alloc, usage, req0, flags, qrt, qlim, quse0, weights]
+    if has_extras:
+        # [N, P] with pods on lanes: each grid step streams a (N, block) tile
+        xtra_spec = pl.BlockSpec(
+            (N, block), lambda i, *_: (0, i), memory_space=pltpu.VMEM
+        )
+        in_specs += [xtra_spec, xtra_spec]
+        operands += [xmask, xscore]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=grid,
-        in_specs=[pod_spec, pod_spec, pod_spec]
-        + [node_spec] * 4
-        + [quota_spec] * 3
-        + [pl.BlockSpec((8, LANES), lambda i, *_: (0, 0), memory_space=pltpu.VMEM)],
+        in_specs=in_specs,
         out_specs=[pod_spec, node_spec, node_spec, quota_spec],
         scratch_shapes=[
             pltpu.VMEM((N, LANES), jnp.int32),
@@ -232,7 +257,7 @@ def _run_cycle(
             pltpu.VMEM((Q, LANES), jnp.int32),
         ],
     )
-    kernel = partial(_cycle_kernel, block=block, cfg=cfg)
+    kernel = partial(_cycle_kernel, block=block, cfg=cfg, has_extras=has_extras)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -243,7 +268,7 @@ def _run_cycle(
             jax.ShapeDtypeStruct((Q, LANES), jnp.int32),
         ],
         interpret=interpret,
-    )(qid, pvalid, preq, psreq, pest, alloc, usage, req0, flags, qrt, qlim, quse0, weights)
+    )(qid, pvalid, *operands)
 
 
 @partial(jax.jit, static_argnames=("cfg", "interpret"))
@@ -251,12 +276,18 @@ def greedy_assign_pallas(
     snapshot: ClusterSnapshot,
     cfg: CycleConfig = DEFAULT_CYCLE_CONFIG,
     interpret: bool = False,
+    extra_mask=None,  # bool[P, N] extended-plugin Filter tensor
+    extra_scores=None,  # i64[P, N] extended-plugin Score tensor
 ) -> CycleResult:
     """Drop-in replacement for solver.greedy.greedy_assign on TPU.
 
     Bit-identical placements (same queue order, same integer scores, same
     argmax tie-breaks); i32 internally — sound because MiB/milli units bound
-    every intermediate (documented in model/resources.py).
+    every intermediate (documented in model/resources.py).  The extended
+    plugins' (NUMA/reservation/deviceshare — scheduler/plugins.py) stateless
+    Filter/Score tensors ride the kernel as [N, P] tiles so the full plugin
+    composition stays on the single-kernel path (reference analog: these
+    plugins run inside the Score hot loop, ``nodenumaresource/scoring.go:55``).
     """
     pods, nodes, gangs, quotas = (
         snapshot.pods,
@@ -268,8 +299,11 @@ def greedy_assign_pallas(
     N = nodes.allocatable.shape[0]
 
     order = queue_order(pods.priority, pods.valid)
-    P_pad = -(-P // 8) * 8
-    block = 128 if P_pad % 128 == 0 else 8
+    # pods always pad to 128-blocks: the extended-plugin tiles put pods on
+    # the LANE axis ([N, block]), and a lane tile that is neither 128-wide
+    # nor the full array does not lower on TPU
+    P_pad = -(-P // 128) * 128
+    block = 128
     N_pad = -(-N // 8) * 8
 
     def _pods(a):
@@ -314,6 +348,23 @@ def greedy_assign_pallas(
         )
     )
 
+    if extra_mask is not None or extra_scores is not None:
+        # sorted pod order on the LANE axis, nodes on sublanes: [N_pad, P_pad]
+        if extra_mask is None:
+            extra_mask = jnp.ones((P, N), bool)
+        if extra_scores is None:
+            extra_scores = jnp.zeros((P, N), jnp.int64)
+        xmask = jnp.pad(
+            extra_mask[order].astype(jnp.int32).T,
+            ((0, N_pad - N), (0, P_pad - P)),
+        )
+        xscore = jnp.pad(
+            extra_scores[order].astype(jnp.int32).T,
+            ((0, N_pad - N), (0, P_pad - P)),
+        )
+    else:
+        xmask = xscore = None
+
     chosen, nreq, nest, quse = _run_cycle(
         preq,
         psreq,
@@ -328,6 +379,8 @@ def greedy_assign_pallas(
         qlim,
         quse0,
         weights,
+        xmask,
+        xscore,
         cfg=cfg,
         block=block,
         interpret=interpret,

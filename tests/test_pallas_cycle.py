@@ -84,3 +84,32 @@ class TestPallasCycleParity:
         pallas = greedy_assign_pallas(snap, interpret=True)
         _assert_equal(scan, pallas)
         assert int((np.asarray(scan.assignment) < 0).sum()) > 0
+
+    def test_extended_plugin_tensors(self):
+        """extra_mask/extra_scores ride the kernel as [N, P] tiles and stay
+        bit-identical with the scan path carrying the same tensors."""
+        import jax.numpy as jnp
+
+        snap = _quota_snapshot(pods=40, nodes=12)
+        P = snap.pods.capacity
+        N = snap.nodes.allocatable.shape[0]
+        rng = np.random.default_rng(11)
+        extra_mask = jnp.asarray(rng.random((P, N)) > 0.25)
+        extra_scores = jnp.asarray(rng.integers(0, 60, size=(P, N)), dtype=jnp.int64)
+        want = greedy_assign(snap, extra_mask=extra_mask, extra_scores=extra_scores)
+        got = greedy_assign_pallas(
+            snap, interpret=True, extra_mask=extra_mask, extra_scores=extra_scores
+        )
+        _assert_equal(want, got)
+
+    def test_extended_mask_only(self):
+        import jax.numpy as jnp
+
+        snap = _quota_snapshot(pods=24, nodes=8)
+        P = snap.pods.capacity
+        N = snap.nodes.allocatable.shape[0]
+        rng = np.random.default_rng(5)
+        extra_mask = jnp.asarray(rng.random((P, N)) > 0.5)
+        want = greedy_assign(snap, extra_mask=extra_mask)
+        got = greedy_assign_pallas(snap, interpret=True, extra_mask=extra_mask)
+        _assert_equal(want, got)

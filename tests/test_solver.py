@@ -210,3 +210,32 @@ class TestQuotaZeroRuntime:
         assert int(np.asarray(snap.pods.quota_id)[0]) == -1
         result = greedy_assign(snap)
         assert int(np.asarray(result.assignment)[0]) == 0
+
+
+def test_quota_table_round_trip_feasible():
+    """Regression: build_quota_table_inputs must emit round-trippable
+    quantities — raw axis-unit ints got re-parsed as bytes and divided by
+    MiB again, collapsing every quota's memory runtime to ~1 MiB and
+    rejecting all pods at the bench sizes (BASELINE config #4)."""
+    import numpy as np
+
+    from koordinator_tpu.constraints import build_quota_table_inputs
+    from koordinator_tpu.harness import generators
+    from koordinator_tpu.model import encode_snapshot, resources as res
+    from koordinator_tpu.solver import greedy_assign
+
+    nodes, pods, gangs, quotas = generators.quota_colocation(pods=64, nodes=16)
+    pod_reqs = [res.resource_vector(p["requests"]) for p in pods]
+    qidx = {q["name"]: i for i, q in enumerate(quotas)}
+    qids = [qidx.get(p.get("quota"), -1) for p in pods]
+    total = [0] * res.NUM_RESOURCES
+    for n in nodes:
+        v = res.resource_vector(n["allocatable"])
+        total = [a + b for a, b in zip(total, v)]
+    qdicts = build_quota_table_inputs(quotas, pod_reqs, qids, total)
+    snap = encode_snapshot(nodes, pods, gangs, qdicts)
+    mem = res.RESOURCE_INDEX[res.MEMORY]
+    runtime_mem = int(np.asarray(snap.quotas.runtime)[0, mem])
+    assert runtime_mem > 1024, f"memory runtime collapsed to {runtime_mem} MiB"
+    result = greedy_assign(snap)
+    assert int((np.asarray(result.assignment) >= 0).sum()) > 0
