@@ -4,3 +4,20 @@ from koordinator_tpu.constraints.quota import (  # noqa: F401
     build_quota_table_inputs,
 )
 from koordinator_tpu.constraints.gang import gang_satisfaction  # noqa: F401
+from koordinator_tpu.constraints.quota_manager import (  # noqa: F401
+    DEFAULT_QUOTA,
+    GroupQuotaManager,
+    MultiTreeQuotaManager,
+    QuotaNode,
+    ROOT_QUOTA,
+    SYSTEM_QUOTA,
+    ScaleMinQuota,
+)
+from koordinator_tpu.constraints.quota_enforce import (  # noqa: F401
+    NodeVictims,
+    QuotaOverUsedGroupMonitor,
+    QuotaOverUsedRevokeController,
+    can_preempt,
+    pick_preemption_node,
+    select_victims_on_node,
+)

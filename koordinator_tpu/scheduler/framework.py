@@ -171,6 +171,48 @@ class FrameworkExtender:
                     raise
         return result
 
+    def post_filter_preempt(
+        self, ctx: CycleContext, result: CycleResult
+    ) -> Dict[str, object]:
+        """PostFilter: quota preemption dry run for unschedulable pods
+        (reference elasticquota/preempt.go via the upstream preemption
+        framework).  Requires ctx.extras["preemption"] = {
+          "node_allocatable": {node: dense vec},
+          "node_pods": {node: [pod dicts]},
+          "quota_runtime": {quota: vec}, "quota_used": {quota: vec},
+          "pending_pods": [pod dicts] (each with "quota")}.
+        Returns {pod_name: NodeVictims} for pods that can preempt."""
+        from koordinator_tpu.constraints.quota_enforce import run_quota_preemption
+        from koordinator_tpu.model import resources as res
+
+        from koordinator_tpu.constraints.quota_manager import DEFAULT_QUOTA
+
+        inv = ctx.extras.get("preemption")
+        if not inv:
+            return {}
+        out: Dict[str, object] = {}
+        assignment = np.asarray(result.assignment)
+        for pod in inv.get("pending_pods", ()):
+            # a pod holding ANY placement (assigned or gang-WAITing with
+            # resources reserved) never preempts; only truly unplaced pods
+            # do.  Pods without a cycle index are treated as never placed.
+            idx = pod.get("index")
+            if idx is not None and idx < len(assignment) and assignment[idx] >= 0:
+                continue
+            quota = pod.get("quota") or DEFAULT_QUOTA  # match can_preempt
+            nv = run_quota_preemption(
+                pod,
+                inv["node_allocatable"],
+                inv["node_pods"],
+                inv.get("quota_used", {}).get(quota, [0] * res.NUM_RESOURCES),
+                inv.get("quota_runtime", {}).get(
+                    quota, [1 << 60] * res.NUM_RESOURCES
+                ),
+            )
+            if nv is not None:
+                out[pod["name"]] = nv
+        return out
+
     def run_score_only(self, ctx: CycleContext):
         """Score-only mode for strict plugin parity checks (the reference
         seam at framework_extender.go:216)."""

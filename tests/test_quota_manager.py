@@ -1,0 +1,382 @@
+"""ElasticQuota tree / scaling / revoke / preemption.
+
+The numeric fixtures are PORTED from the reference's own test tables so
+parity is not judged solely by a self-written mirror:
+
+* ``TestRuntimeQuotaCalculator_Iteration4AdjustQuota``
+  (/root/reference/pkg/scheduler/plugins/elasticquota/core/
+  runtime_quota_calculator_test.go:132)
+* ``TestScaleMinQuotaWhenOverRootResInfo_GetScaledMinQuota``
+  (.../core/scale_minquota_when_over_root_res_test.go:28)
+"""
+
+import pytest
+
+from koordinator_tpu.constraints import (
+    GroupQuotaManager,
+    MultiTreeQuotaManager,
+    QuotaGroup,
+    QuotaOverUsedRevokeController,
+    ScaleMinQuota,
+    can_preempt,
+    pick_preemption_node,
+    refresh_runtime,
+    select_victims_on_node,
+)
+from koordinator_tpu.model import resources as res
+
+CPU = res.RESOURCE_INDEX[res.CPU]
+MEM = res.RESOURCE_INDEX[res.MEMORY]
+
+
+def _vec(cpu=0, mem=0):
+    v = [0] * res.NUM_RESOURCES
+    v[CPU] = cpu
+    v[MEM] = mem
+    return v
+
+
+class TestRuntimeFixture:
+    def test_iteration4_adjust_quota(self):
+        """runtime_quota_calculator_test.go:132 — insert(name, sharedWeight,
+        request, min, guarantee, allowLent), total=100 on one dimension."""
+        rows = [  # (weight, request, min)
+            ("node1", 40, 5, 10),
+            ("node2", 60, 20, 15),
+            ("node3", 50, 40, 20),
+            ("node4", 80, 70, 15),
+        ]
+        groups = [
+            QuotaGroup(
+                name=n,
+                min=_vec(cpu=mn),
+                max=_vec(cpu=1 << 40, mem=1 << 40),
+                request=_vec(cpu=req),
+                used=_vec(),
+                shared_weight=w,
+            )
+            for n, w, req, mn in rows
+        ]
+        runtimes = refresh_runtime(groups, _vec(cpu=100))
+        got = [rt[CPU] for rt in runtimes]
+        assert got == [5, 20, 35, 40]
+
+
+class TestScaleMinFixture:
+    """scale_minquota_when_over_root_res_test.go:28, ported verbatim."""
+
+    def _build(self):
+        s = ScaleMinQuota()
+        s.update("100", "1", _vec(50, 50), enable=False)
+        s.update("100", "2", _vec(50, 50), enable=True)
+        s.update("100", "3", _vec(50, 50), enable=True)
+        return s
+
+    def test_unknown_parent_or_sub(self):
+        s = self._build()
+        total = _vec(200, 200)
+        assert s.get_scaled_min(total, "101", "1") == (False, None)
+        assert s.get_scaled_min(total, "101", "11") == (False, None)
+        # sub "1" has scaling disabled
+        assert s.get_scaled_min(total, "100", "1") == (False, None)
+
+    def test_no_scale_needed(self):
+        s = self._build()
+        ok, got = s.get_scaled_min(_vec(200, 200), "100", "2")
+        assert ok and got == _vec(50, 50)
+
+    def test_zero_total(self):
+        s = self._build()
+        ok, got = s.get_scaled_min(_vec(0, 0), "100", "2")
+        assert ok and got == _vec(0, 0)
+
+    def test_partial_scale(self):
+        # total 100 < 150 sum: disable child keeps 50, the two enabled
+        # children split the remaining 50 pro rata -> 25 each
+        s = self._build()
+        assert s.get_scaled_min(_vec(100, 100), "100", "1") == (False, None)
+        ok, got = s.get_scaled_min(_vec(100, 100), "100", "2")
+        assert ok and got == _vec(25, 25)
+        ok, got = s.get_scaled_min(_vec(100, 100), "100", "3")
+        assert ok and got == _vec(25, 25)
+
+    def test_total_below_disabled_sum(self):
+        s = self._build()
+        ok, got = s.get_scaled_min(_vec(50, 50), "100", "2")
+        assert ok and got == _vec(0, 0)
+        ok, got = s.get_scaled_min(_vec(50, 50), "100", "3")
+        assert ok and got == _vec(0, 0)
+
+    def test_update_moves_between_sums(self):
+        """scale_minquota_when_over_root_res_test.go:113 Update."""
+        s = ScaleMinQuota()
+        s.update("100", "1", _vec(50, 50), enable=False)
+        assert s.disable_sums["100"] == _vec(50, 50)
+        assert s.enable_sums["100"] == _vec(0, 0)
+        s.update("100", "1", _vec(40, 40), enable=True)
+        assert s.disable_sums["100"] == _vec(0, 0)
+        assert s.enable_sums["100"] == _vec(40, 40)
+        assert s.original_min["1"] == _vec(40, 40)
+
+
+class TestGroupQuotaManagerTree:
+    def _mgr(self):
+        mgr = GroupQuotaManager()
+        mgr.set_cluster_total(_vec(100_000, 1000 * 1024))  # axis: milli / MiB
+        mgr.update_quota(
+            {"name": "parent", "is_parent": True, "min": {"cpu": "60", "memory": "600Mi"}, "max": {"cpu": "100", "memory": "1000Mi"}}
+        )
+        mgr.update_quota(
+            {"name": "a", "parent": "parent", "min": {"cpu": "20", "memory": "200Mi"}, "max": {"cpu": "80", "memory": "800Mi"}}
+        )
+        mgr.update_quota(
+            {"name": "b", "parent": "parent", "min": {"cpu": "40", "memory": "400Mi"}, "max": {"cpu": "80", "memory": "800Mi"}}
+        )
+        return mgr
+
+    def test_runtime_flows_through_parent(self):
+        mgr = self._mgr()
+        # a requests 70 cpu; b requests nothing -> b lends its min
+        mgr.on_pod_add("a", {"name": "p1", "requests": {"cpu": "70", "memory": "100Mi"}})
+        rt_a = mgr.refresh_runtime("a")
+        # parent runtime = its child demand (70) within min 60/max 100;
+        # a gets its full request since b lends
+        assert rt_a[CPU] == 70 * 1000  # axis units are milli
+        rt_b = mgr.refresh_runtime("b")
+        assert rt_b[CPU] == 0
+
+    def test_no_lend_keeps_min(self):
+        mgr = GroupQuotaManager()
+        mgr.set_cluster_total(_vec(100_000, 1000))
+        mgr.update_quota(
+            {"name": "keep", "min": {"cpu": "40"}, "max": {"cpu": "100"}, "allow_lent_resource": False}
+        )
+        mgr.update_quota({"name": "greedy", "min": {"cpu": "10"}, "max": {"cpu": "100"}})
+        mgr.on_pod_add("greedy", {"name": "g", "requests": {"cpu": "90"}})
+        # keep requests nothing but does NOT lend: runtime stays at min
+        assert mgr.refresh_runtime("keep")[CPU] == 40_000
+        assert mgr.refresh_runtime("greedy")[CPU] == 60_000
+
+    def test_used_aggregates_to_parent(self):
+        mgr = self._mgr()
+        mgr.on_pod_add("a", {"name": "p1", "requests": {"cpu": "10"}}, assigned=True)
+        mgr.on_pod_add("b", {"name": "p2", "requests": {"cpu": "5"}}, assigned=True)
+        assert mgr.nodes["parent"].used[CPU] == 15_000
+
+    def test_migrate_pod(self):
+        mgr = self._mgr()
+        mgr.on_pod_add("a", {"name": "p1", "requests": {"cpu": "10"}}, assigned=True)
+        mgr.migrate_pod("p1", "a", "b")
+        assert mgr.nodes["a"].used[CPU] == 0
+        assert mgr.nodes["b"].used[CPU] == 10_000
+
+    def test_min_scaling_under_shrunken_total(self):
+        mgr = GroupQuotaManager()
+        mgr.set_cluster_total(_vec(100, 100))
+        mgr.update_quota({"name": "fixed", "min": {"cpu": "50m"}, "max": {"cpu": "200m"}})
+        mgr.update_quota(
+            {"name": "elastic", "min": {"cpu": "50m"}, "max": {"cpu": "200m"}, "enable_min_quota_scale": True}
+        )
+        mgr.on_pod_add("fixed", {"name": "f", "requests": {"cpu": "200m"}})
+        mgr.on_pod_add("elastic", {"name": "e", "requests": {"cpu": "200m"}})
+        # total 100m < 50+50 sum: elastic's min scales to 100-50=50... all
+        # of the remainder (single enabled child) -> min stays 50; shrink
+        # the total to force a real cut
+        mgr.set_cluster_total(_vec(60, 100))
+        mgr.refresh_runtime("elastic")
+        assert mgr.nodes["elastic"].auto_scale_min[CPU] == 10  # 60-50 left
+
+
+class TestOveruseRevoke:
+    def _multi(self, runtime_cpu="30"):
+        multi = MultiTreeQuotaManager()
+        mgr = multi.manager_for("")
+        mgr.set_cluster_total(_vec(30_000, 10_000))
+        mgr.update_quota({"name": "t", "min": {"cpu": "0"}, "max": {"cpu": runtime_cpu}})
+        return multi, mgr
+
+    def test_debounce_then_revoke_minimal_set(self):
+        multi, mgr = self._multi()
+        # runtime caps at max=30 cpu; three assigned pods of 15 cpu each
+        for i, prio in enumerate([100, 50, 10]):
+            mgr.on_pod_add(
+                "t",
+                {
+                    "name": f"p{i}",
+                    "priority": prio,
+                    "start_time": i,
+                    "requests": {"cpu": "15"},
+                },
+                assigned=True,
+            )
+        ctl = QuotaOverUsedRevokeController(multi, trigger_evict_duration=300)
+        assert ctl.monitor_all_quotas(now=0.0) == []  # debounce window
+        victims = ctl.monitor_all_quotas(now=301.0)
+        # used 45 > runtime 30: stripping lowest-priority p2 (10) brings
+        # used to 30 <= 30; assign-back keeps it out -> exactly [p2]
+        assert [p["name"] for p in victims] == ["p2"]
+
+    def test_under_used_resets_debounce(self):
+        multi, mgr = self._multi()
+        mgr.on_pod_add(
+            "t", {"name": "ok", "priority": 1, "requests": {"cpu": "10"}}, assigned=True
+        )
+        ctl = QuotaOverUsedRevokeController(multi, trigger_evict_duration=300)
+        assert ctl.monitor_all_quotas(now=0.0) == []
+        assert ctl.monitor_all_quotas(now=400.0) == []  # never over
+
+    def test_non_preemptible_skipped(self):
+        multi, mgr = self._multi()
+        mgr.on_pod_add(
+            "t",
+            {"name": "locked", "priority": 1, "non_preemptible": True, "requests": {"cpu": "25"}},
+            assigned=True,
+        )
+        mgr.on_pod_add(
+            "t", {"name": "soft", "priority": 100, "requests": {"cpu": "20"}}, assigned=True
+        )
+        ctl = QuotaOverUsedRevokeController(multi, trigger_evict_duration=0)
+        ctl.monitor_all_quotas(now=0.0)
+        victims = ctl.monitor_all_quotas(now=1.0)
+        # the low-priority pod is non-preemptible: the higher-priority soft
+        # pod must go instead
+        assert [p["name"] for p in victims] == ["soft"]
+
+    def test_multi_tree_quotas_monitored(self):
+        multi = MultiTreeQuotaManager()
+        t1 = multi.manager_for("tree-1")
+        t1.set_cluster_total(_vec(10_000, 0))
+        t1.update_quota({"name": "q1", "tree": "tree-1", "min": {"cpu": "0"}, "max": {"cpu": "5"}})
+        t1.on_pod_add("q1", {"name": "p", "priority": 1, "requests": {"cpu": "8"}}, assigned=True)
+        ctl = QuotaOverUsedRevokeController(multi, trigger_evict_duration=0)
+        ctl.monitor_all_quotas(now=0.0)
+        victims = ctl.monitor_all_quotas(now=1.0)
+        assert [p["name"] for p in victims] == ["p"]
+
+
+class TestPreemption:
+    def test_can_preempt_rules(self):
+        pod = {"name": "hi", "priority": 100, "quota": "q"}
+        assert can_preempt(pod, {"name": "lo", "priority": 10, "quota": "q"})
+        assert not can_preempt(pod, {"name": "other", "priority": 10, "quota": "z"})
+        assert not can_preempt(pod, {"name": "eq", "priority": 100, "quota": "q"})
+        assert not can_preempt(
+            pod, {"name": "pin", "priority": 10, "quota": "q", "non_preemptible": True}
+        )
+
+    def test_select_victims_minimal(self):
+        pod = {"name": "new", "priority": 100, "quota": "q", "requests": {"cpu": "10"}}
+        node_pods = [
+            {"name": "v1", "priority": 10, "quota": "q", "start_time": 1, "requests": {"cpu": "6"}},
+            {"name": "v2", "priority": 20, "quota": "q", "start_time": 2, "requests": {"cpu": "6"}},
+            {"name": "keep", "priority": 200, "quota": "q", "requests": {"cpu": "4"}},
+        ]
+        alloc = _vec(cpu=16_000)
+        got = select_victims_on_node(
+            pod,
+            "n1",
+            alloc,
+            node_pods,
+            quota_used=_vec(cpu=16_000),
+            quota_runtime=_vec(cpu=30_000),
+        )
+        assert got is not None
+        # removing both candidates frees 12; pod needs 10 with keep's 4
+        # resident (16 cap): reprieve puts back the more important v2
+        # (6+4+10=20 > 16 fails) ... v2 cannot come back, v1 neither
+        names = {v["name"] for v in got.victims}
+        assert names == {"v1", "v2"}
+
+    def test_select_victims_reprieves_when_room(self):
+        pod = {"name": "new", "priority": 100, "quota": "q", "requests": {"cpu": "2"}}
+        node_pods = [
+            {"name": "v1", "priority": 10, "quota": "q", "start_time": 1, "requests": {"cpu": "6"}},
+            {"name": "v2", "priority": 20, "quota": "q", "start_time": 2, "requests": {"cpu": "6"}},
+        ]
+        alloc = _vec(cpu=13_000)
+        got = select_victims_on_node(
+            pod, "n1", alloc, node_pods,
+            quota_used=_vec(cpu=12_000), quota_runtime=_vec(cpu=30_000),
+        )
+        # 13 capacity: v2 (more important) is reprieved (6+2 <= 13) but v1
+        # cannot come back (6+6+2 > 13) -> exactly [v1]
+        assert [v["name"] for v in got.victims] == ["v1"]
+
+    def test_quota_cap_forces_victims(self):
+        # node has plenty of room; the QUOTA cap is what forces eviction
+        pod = {"name": "new", "priority": 100, "quota": "q", "requests": {"cpu": "10"}}
+        node_pods = [
+            {"name": "v1", "priority": 10, "quota": "q", "start_time": 1, "requests": {"cpu": "10"}},
+        ]
+        alloc = _vec(cpu=100_000)
+        got = select_victims_on_node(
+            pod, "n1", alloc, node_pods,
+            quota_used=_vec(cpu=30_000), quota_runtime=_vec(cpu=30_000),
+        )
+        assert [v["name"] for v in got.victims] == ["v1"]
+
+    def test_no_candidates_returns_none(self):
+        pod = {"name": "new", "priority": 1, "quota": "q", "requests": {"cpu": "10"}}
+        node_pods = [
+            {"name": "hi", "priority": 50, "quota": "q", "requests": {"cpu": "10"}}
+        ]
+        assert (
+            select_victims_on_node(
+                pod, "n1", _vec(cpu=10_000), node_pods,
+                quota_used=_vec(cpu=10_000), quota_runtime=_vec(cpu=30_000),
+            )
+            is None
+        )
+
+    def test_pick_node_prefers_fewest_and_lowest(self):
+        from koordinator_tpu.constraints import NodeVictims
+
+        a = NodeVictims("a", [{"priority": 50}, {"priority": 10}])
+        b = NodeVictims("b", [{"priority": 10}])
+        c = NodeVictims("c", [{"priority": 10}], num_violating=1)
+        assert pick_preemption_node([a, b, c]).node == "b"
+        assert pick_preemption_node([]) is None
+
+
+class TestFrameworkPostFilter:
+    def test_post_filter_preempt_for_unschedulable_pod(self):
+        """An unschedulable pending pod with a quota gets a preemption
+        proposal through the FrameworkExtender PostFilter seam."""
+        import numpy as np
+
+        from koordinator_tpu.harness import generators
+        from koordinator_tpu.model import encode_snapshot
+        from koordinator_tpu.scheduler.framework import (
+            CycleContext,
+            FrameworkExtender,
+        )
+        from koordinator_tpu.solver import greedy_assign
+
+        nodes, pods, gangs, quotas = generators.spark_colocation()
+        snap = encode_snapshot(nodes, pods, gangs, quotas)
+        fx = FrameworkExtender()
+        ctx = CycleContext(snapshot=snap)
+        result = greedy_assign(snap)
+        # fabricate one unschedulable pending pod beyond the node capacity,
+        # preemptable because a same-quota lower-priority pod is resident
+        pending = {
+            "name": "starved",
+            "index": 10_000,  # not in the assignment -> treated unschedulable
+            "priority": 100,
+            "quota": "q",
+            "requests": {"cpu": "8"},
+        }
+        ctx.extras["preemption"] = {
+            "pending_pods": [pending],
+            "node_allocatable": {"n1": _vec(cpu=10_000)},
+            "node_pods": {
+                "n1": [
+                    {"name": "victim", "priority": 1, "quota": "q", "requests": {"cpu": "6"}}
+                ]
+            },
+            "quota_used": {"q": _vec(cpu=6_000)},
+            "quota_runtime": {"q": _vec(cpu=20_000)},
+        }
+        got = fx.post_filter_preempt(ctx, result)
+        assert "starved" in got
+        assert [v["name"] for v in got["starved"].victims] == ["victim"]
