@@ -13,6 +13,15 @@ from koordinator_tpu.constraints.quota_manager import (  # noqa: F401
     SYSTEM_QUOTA,
     ScaleMinQuota,
 )
+from koordinator_tpu.constraints.gang_manager import (  # noqa: F401
+    GANG_MODE_NONSTRICT,
+    GANG_MODE_STRICT,
+    Gang,
+    PERMIT_SUCCESS,
+    PERMIT_WAIT,
+    PodGroupController,
+    PodGroupManager,
+)
 from koordinator_tpu.constraints.quota_enforce import (  # noqa: F401
     NodeVictims,
     QuotaOverUsedGroupMonitor,
