@@ -52,8 +52,18 @@ def _importance_key(pod: Mapping):
     return (int(pod.get("priority") or 0), -float(pod.get("start_time") or 0))
 
 
-def _less_equal(used: Sequence[int], runtime: Sequence[int]) -> bool:
+def _less_equal(used: Sequence[int], runtime: Sequence[int], dims=None) -> bool:
+    if dims is not None:
+        return all(used[r] <= runtime[r] for r in dims)
     return all(u <= r for u, r in zip(used, runtime))
+
+
+def _constraining_dims(declared: Sequence[int], runtime: Sequence[int]):
+    """The dims the over-use check compares: declared ones plus any with a
+    nonzero runtime — an undeclared dim whose cluster total is zero must not
+    constrain, or the revoke target is unreachable and every preemptible pod
+    gets evicted (the same mask monitor() uses for its over check)."""
+    return sorted(set(declared) | {r for r in range(R) if runtime[r]})
 
 
 # ---------------------------------------------------------------------------
@@ -107,6 +117,7 @@ class QuotaOverUsedGroupMonitor:
         if node is None:
             return []
         runtime = self.manager.refresh_runtime(self.quota_name)
+        dims = _constraining_dims(node.declared, runtime)
         used = list(node.used)
         # assigned pods, low priority first (:105 sorts by !MoreImportantPod)
         pods = sorted(
@@ -115,20 +126,20 @@ class QuotaOverUsedGroupMonitor:
         )
         try_revoke: List[Mapping] = []
         for pod in pods:
-            if _less_equal(used, runtime):
+            if _less_equal(used, runtime, dims):
                 break
             if pod.get("non_preemptible"):
                 continue  # :114 IsPodNonPreemptible
             used = [u - v for u, v in zip(used, _req(pod))]
             try_revoke.append(pod)
-        if not _less_equal(used, runtime):
+        if not _less_equal(used, runtime, dims):
             return try_revoke  # :123 still over -> evict all tried
         # :131 assign back high -> low while it still fits
         revoke: List[Mapping] = []
         for pod in reversed(try_revoke):
             preq = _req(pod)
             used = [u + v for u, v in zip(used, preq)]
-            if not _less_equal(used, runtime):
+            if not _less_equal(used, runtime, dims):
                 used = [u - v for u, v in zip(used, preq)]
                 revoke.append(pod)
         return revoke

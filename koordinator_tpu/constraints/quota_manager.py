@@ -72,6 +72,19 @@ class ScaleMinQuota:
         self.disable_sums: Dict[str, List[int]] = {}
         self.original_min: Dict[str, List[int]] = {}
         self.enabled: Dict[str, bool] = {}
+        # the parent each sub's min is currently registered under — a
+        # re-parented quota must be subtracted from its OLD parent's sums,
+        # not the new one's (:58 keys sums by the prior parent)
+        self.parent_of: Dict[str, str] = {}
+
+    def _unregister(self, sub: str) -> None:
+        old_parent = self.parent_of.get(sub)
+        if old_parent is None:
+            return
+        target = self.enable_sums if self.enabled[sub] else self.disable_sums
+        target[old_parent] = _sub_nonneg(
+            target[old_parent], self.original_min[sub]
+        )
 
     def update(
         self, parent: str, sub: str, min_quota: Sequence[int], enable: bool
@@ -79,13 +92,19 @@ class ScaleMinQuota:
         """:58 update — move the child's min between the two parent sums."""
         self.enable_sums.setdefault(parent, _zeros())
         self.disable_sums.setdefault(parent, _zeros())
-        if sub in self.enabled:
-            target = self.enable_sums if self.enabled[sub] else self.disable_sums
-            target[parent] = _sub_nonneg(target[parent], self.original_min[sub])
+        self._unregister(sub)
         target = self.enable_sums if enable else self.disable_sums
         target[parent] = _add(target[parent], list(min_quota))
         self.original_min[sub] = list(min_quota)
         self.enabled[sub] = enable
+        self.parent_of[sub] = parent
+
+    def remove(self, sub: str) -> None:
+        """Drop a deleted quota's contribution (delete path of :58)."""
+        self._unregister(sub)
+        self.original_min.pop(sub, None)
+        self.enabled.pop(sub, None)
+        self.parent_of.pop(sub, None)
 
     def get_scaled_min(
         self, new_total: Optional[Sequence[int]], parent: str, sub: str
@@ -207,7 +226,8 @@ class GroupQuotaManager:
     def update_quota(self, quota: Mapping, is_delete: bool = False) -> None:
         name = quota["name"]
         if is_delete:
-            self.nodes.pop(name, None)
+            if self.nodes.pop(name, None) is not None:
+                self.scale_min.remove(name)
         else:
             node = QuotaNode.from_dict(quota)
             old = self.nodes.get(name)

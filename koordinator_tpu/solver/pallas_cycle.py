@@ -271,7 +271,6 @@ def _run_cycle(
     )(qid, pvalid, *operands)
 
 
-@partial(jax.jit, static_argnames=("cfg", "interpret"))
 def greedy_assign_pallas(
     snapshot: ClusterSnapshot,
     cfg: CycleConfig = DEFAULT_CYCLE_CONFIG,
@@ -280,6 +279,35 @@ def greedy_assign_pallas(
     extra_scores=None,  # i64[P, N] extended-plugin Score tensor
 ) -> CycleResult:
     """Drop-in replacement for solver.greedy.greedy_assign on TPU.
+
+    Raises ValueError when ``extra_scores`` exceed the i32 headroom the
+    kernel's accumulation needs — direct callers must not get silent
+    wraparound and divergent placements (the run_cycle dispatcher checks the
+    same bound before routing here; this guards everyone else).
+    """
+    if extra_scores is not None:
+        import numpy as _np
+
+        peak = int(jnp.max(jnp.abs(extra_scores)))
+        if peak >= 2**29:
+            raise ValueError(
+                f"extra_scores magnitude {peak} >= 2^29: out of the Pallas "
+                "kernel's i32 headroom; use the lax.scan path (greedy_assign)"
+            )
+    return _greedy_assign_pallas(
+        snapshot, cfg, interpret, extra_mask, extra_scores
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "interpret"))
+def _greedy_assign_pallas(
+    snapshot: ClusterSnapshot,
+    cfg: CycleConfig = DEFAULT_CYCLE_CONFIG,
+    interpret: bool = False,
+    extra_mask=None,  # bool[P, N] extended-plugin Filter tensor
+    extra_scores=None,  # i64[P, N] extended-plugin Score tensor
+) -> CycleResult:
+    """jit inner of greedy_assign_pallas (magnitude-checked wrapper above).
 
     Bit-identical placements (same queue order, same integer scores, same
     argmax tie-breaks); i32 internally — sound because MiB/milli units bound

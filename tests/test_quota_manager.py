@@ -23,6 +23,7 @@ from koordinator_tpu.constraints import (
     refresh_runtime,
     select_victims_on_node,
 )
+from koordinator_tpu.constraints.quota_manager import ROOT_QUOTA
 from koordinator_tpu.model import resources as res
 
 CPU = res.RESOURCE_INDEX[res.CPU]
@@ -117,6 +118,49 @@ class TestScaleMinFixture:
         assert s.disable_sums["100"] == _vec(0, 0)
         assert s.enable_sums["100"] == _vec(40, 40)
         assert s.original_min["1"] == _vec(40, 40)
+
+    def test_reparent_subtracts_from_old_parent(self):
+        """ADVICE r2: moving a sub to a new parent must remove its min from
+        the OLD parent's sums, not leave a stale contribution there."""
+        s = ScaleMinQuota()
+        s.update("p1", "child", _vec(50, 50), enable=True)
+        s.update("p1", "other", _vec(30, 30), enable=True)
+        s.update("p2", "child", _vec(50, 50), enable=True)
+        assert s.enable_sums["p1"] == _vec(30, 30)  # only "other" remains
+        assert s.enable_sums["p2"] == _vec(50, 50)
+        # sibling under p1 now scales against the corrected sum
+        ok, got = s.get_scaled_min(_vec(30, 30), "p1", "other")
+        assert ok and got == _vec(30, 30)
+
+    def test_remove_drops_contribution(self):
+        s = ScaleMinQuota()
+        s.update("p", "a", _vec(50, 50), enable=True)
+        s.update("p", "b", _vec(50, 50), enable=True)
+        s.remove("a")
+        assert s.enable_sums["p"] == _vec(50, 50)
+        assert "a" not in s.original_min and "a" not in s.parent_of
+        # b no longer shares: full total available to it
+        ok, got = s.get_scaled_min(_vec(50, 50), "p", "b")
+        assert ok and got == _vec(50, 50)
+
+    def test_manager_delete_removes_min_sums(self):
+        """ADVICE r2: update_quota(is_delete=True) must not leave the
+        deleted quota's min inflating the parent sums (over-shrinking the
+        surviving siblings' scaled mins)."""
+        mgr = GroupQuotaManager()
+        mgr.set_cluster_total(_vec(100, 100))
+        for name in ("a", "b"):
+            mgr.update_quota(
+                {
+                    "name": name,
+                    "min": {"cpu": "60m"},
+                    "max": {"cpu": "100m"},
+                    "enable_min_quota_scale": True,
+                }
+            )
+        mgr.update_quota({"name": "a"}, is_delete=True)
+        ok, got = mgr.scale_min.get_scaled_min(_vec(60, 0), ROOT_QUOTA, "b")
+        assert ok and got[CPU] == 60  # no scaling once a's 60 is gone
 
 
 class TestGroupQuotaManagerTree:
