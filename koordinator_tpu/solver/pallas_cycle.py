@@ -61,26 +61,42 @@ def _lanes(a: jnp.ndarray) -> jnp.ndarray:
     return jnp.pad(a.astype(jnp.int32), ((0, 0), (0, LANES - a.shape[1])))
 
 
+# In-kernel i32 scalar constants.  With x64 enabled, a bare Python scalar
+# in jnp.where/floor_divide/etc. enters the traced sub-jaxpr as a weak
+# i64[] argument whose i64->i32 convert has NO Mosaic lowering (it
+# recurses forever in _convert_helper).  Every scalar that reaches kernel
+# math must therefore be a strong i32.
+def _i32(v) -> jnp.ndarray:
+    return jnp.int32(v)
+
+
 def _least_requested(t, cap):
     """Exact ops/scoring.py least_requested_score in i32 (free pre-clamped
     so free * MAX_NODE_SCORE never overflows)."""
-    safe = jnp.maximum(cap, 1)
-    free = jnp.clip(cap - t, 0, None)
-    score = (free * MAX_NODE_SCORE) // safe
-    return jnp.where((cap == 0) | (t > cap), 0, score)
+    safe = jnp.maximum(cap, _i32(1))
+    # jnp.maximum, not jnp.clip: clip's asarray(0) bound is a strong i64
+    # under x64 and i64 does not lower on Mosaic
+    free = jnp.maximum(cap - t, _i32(0))
+    score = (free * _i32(MAX_NODE_SCORE)) // safe
+    return jnp.where((cap == _i32(0)) | (t > cap), _i32(0), score)
 
 
 def _most_requested(t, cap):
-    safe = jnp.maximum(cap, 1)
-    clamped = jnp.clip(t, None, cap)
-    score = (clamped * MAX_NODE_SCORE) // safe
-    return jnp.where(cap == 0, 0, score)
+    safe = jnp.maximum(cap, _i32(1))
+    clamped = jnp.minimum(t, cap)
+    score = (clamped * _i32(MAX_NODE_SCORE)) // safe
+    return jnp.where(cap == _i32(0), _i32(0), score)
 
 
 def _weighted(per_res, w_row, w_sum: int):
     if w_sum == 0:
         return jnp.zeros(per_res.shape[:-1] + (1,), jnp.int32)
-    return jnp.sum(per_res * w_row, axis=-1, keepdims=True) // w_sum
+    # dtype=i32: under x64 jnp.sum accumulates i32 into i64 (numpy
+    # semantics) and i64 does not lower on Mosaic
+    return (
+        jnp.sum(per_res * w_row, axis=-1, keepdims=True, dtype=jnp.int32)
+        // _i32(w_sum)
+    )
 
 
 def _cycle_kernel(
@@ -116,7 +132,7 @@ def _cycle_kernel(
 
     i = pl.program_id(0)
 
-    @pl.when(i == 0)
+    @pl.when(i == _i32(0))
     def _init():
         nreq_ref[:] = req0_ref[:]
         nest_ref[:] = jnp.zeros_like(nest_ref)
@@ -124,8 +140,8 @@ def _cycle_kernel(
 
     alloc = alloc_ref[:]
     n_rows = alloc.shape[0]
-    node_ok = flags_ref[:, 0:1] != 0
-    fresh = flags_ref[:, 1:2] != 0
+    node_ok = flags_ref[:, 0:1] != _i32(0)
+    fresh = flags_ref[:, 1:2] != _i32(0)
     row_iota = lax.broadcasted_iota(jnp.int32, (n_rows, 1), 0)
 
     fit_w_row = w_ref[0:1, :]
@@ -136,37 +152,54 @@ def _cycle_kernel(
     la_w_sum = sum(res.weights_vector(dict(cfg.loadaware.resource_weights)))
 
     def step(j, _):
+        # j MUST stay i32: Mosaic has no i64 lowering, and with x64
+        # enabled an int-typed fori_loop counter arrives as i64 — any
+        # promotion it causes (p, pl.ds indices, lane compares) recurses
+        # forever in _convert_helper at kernel-lowering time.
         p = i * block + j
         req = preq_ref[pl.ds(j, 1), :]  # [1, 128]
         sreq = psreq_ref[pl.ds(j, 1), :]
         est = pest_ref[pl.ds(j, 1), :]
         qid = qid_ref[p]
-        is_valid = pvalid_ref[p] != 0
-        qidx = jnp.maximum(qid, 0)
+        is_valid = pvalid_ref[p] != _i32(0)
+        qidx = jnp.maximum(qid, _i32(0))
 
         nreq = nreq_ref[:]
         # Filter: Fit (only requested resources constrain) + node flags
-        need = req > 0
-        fits = jnp.all(
-            jnp.where(need, nreq + req <= alloc, True), axis=-1, keepdims=True
+        need = req > _i32(0)
+        # i32 violation count, not jnp.all: a bool lane reduction lowers
+        # to an i1 reduce_min Mosaic rejects ("Unsupported element type
+        # for the selected reduction")
+        fviol = jnp.where(
+            need & (nreq + req > alloc), _i32(1), _i32(0)
+        )
+        fits = (
+            jnp.max(fviol, axis=-1, keepdims=True) == _i32(0)
         )
         # ElasticQuota admission on limited dimensions
         quse_row = quse_ref[pl.ds(qidx, 1), :]
-        qok = jnp.all(
-            jnp.where(
-                qlim_ref[pl.ds(qidx, 1), :] != 0,
-                quse_row + req <= qrt_ref[pl.ds(qidx, 1), :],
-                True,
-            )
+        # scalar reduce in i32 (a scalar bool `jnp.all` does not lower on
+        # Mosaic: only 32-bit element types squeeze to scalars)
+        qviol = jnp.where(
+            (qlim_ref[pl.ds(qidx, 1), :] != _i32(0))
+            & (quse_row + req > qrt_ref[pl.ds(qidx, 1), :]),
+            jnp.int32(1),
+            jnp.int32(0),
         )
-        feasible = fits & node_ok & ((qid < 0) | qok) & is_valid
+        qok = jnp.max(qviol) == _i32(0)
+        feasible = fits & node_ok & ((qid < _i32(0)) | qok) & is_valid
         if has_extras:
             # extract this pod's [N, 1] column by one-hot lane reduction
             # (dynamic lane slicing is costly on the VPU; a masked lane
             # sum is a single vector op)
             lane = lax.broadcasted_iota(jnp.int32, (1, block), 1) == j
-            xm = jnp.sum(jnp.where(lane, xmask_ref[:], 0), axis=1, keepdims=True)
-            feasible = feasible & (xm != 0)
+            xm = jnp.sum(
+                jnp.where(lane, xmask_ref[:], _i32(0)),
+                axis=1,
+                keepdims=True,
+                dtype=jnp.int32,
+            )
+            feasible = feasible & (xm != _i32(0))
 
         # Score: NodeResourcesFit + LoadAware, exact integer math
         total = jnp.zeros((n_rows, 1), jnp.int32)
@@ -176,42 +209,47 @@ def _cycle_kernel(
                 per_res = _most_requested(t, alloc)
             else:
                 per_res = _least_requested(t, alloc)
-            total = total + cfg.fit_plugin_weight * _weighted(
+            total = total + _i32(cfg.fit_plugin_weight) * _weighted(
                 per_res, fit_w_row, fit_w_sum
             )
         if cfg.enable_loadaware:
             est_used = usage_ref[:] + nest_ref[:] + est
             per_res = _least_requested(est_used, alloc)
             la = _weighted(per_res, la_w_row, la_w_sum)
-            total = total + cfg.loadaware_plugin_weight * jnp.where(fresh, la, 0)
+            total = total + _i32(cfg.loadaware_plugin_weight) * jnp.where(fresh, la, _i32(0))
         if has_extras:
-            xs = jnp.sum(jnp.where(lane, xscore_ref[:], 0), axis=1, keepdims=True)
+            xs = jnp.sum(
+                jnp.where(lane, xscore_ref[:], _i32(0)),
+                axis=1,
+                keepdims=True,
+                dtype=jnp.int32,
+            )
             total = total + xs
 
         masked = jnp.where(feasible, total, I32_MIN)
         best = jnp.max(masked)
         any_feasible = best > I32_MIN
         # first index achieving the max == jnp.argmax tie-break
-        chosen = jnp.min(jnp.where(masked == best, row_iota, n_rows))
-        chosen = jnp.where(any_feasible, chosen, -1)
+        chosen = jnp.min(jnp.where(masked == best, row_iota, _i32(n_rows)))
+        chosen = jnp.where(any_feasible, chosen, _i32(-1))
 
         # Reserve: commit the pod's resources to the chosen node / quota
-        cidx = jnp.maximum(chosen, 0)
-        take = jnp.where(any_feasible, req, 0)
+        cidx = jnp.maximum(chosen, _i32(0))
+        take = jnp.where(any_feasible, req, _i32(0))
         nreq_ref[pl.ds(cidx, 1), :] = nreq_ref[pl.ds(cidx, 1), :] + take
         nest_ref[pl.ds(cidx, 1), :] = nest_ref[pl.ds(cidx, 1), :] + jnp.where(
-            any_feasible, est, 0
+            any_feasible, est, _i32(0)
         )
         quse_ref[pl.ds(qidx, 1), :] = quse_row + jnp.where(
-            any_feasible & (qid >= 0), req, 0
+            any_feasible & (qid >= _i32(0)), req, _i32(0)
         )
 
         chosen_ref[pl.ds(j, 1), :] = jnp.full((1, LANES), chosen, jnp.int32)
-        return 0
+        return jnp.int32(0)
 
-    lax.fori_loop(0, block, step, 0)
+    lax.fori_loop(jnp.int32(0), jnp.int32(block), step, jnp.int32(0))
 
-    @pl.when(i == pl.num_programs(0) - 1)
+    @pl.when(i == jnp.int32(pl.num_programs(0) - 1))
     def _fin():
         nreq_out_ref[:] = nreq_ref[:]
         nest_out_ref[:] = nest_ref[:]
@@ -229,20 +267,24 @@ def _run_cycle(
     Q = qrt.shape[0]
     has_extras = xmask is not None
     grid = (P // block,)
-    node_spec = pl.BlockSpec((N, LANES), lambda i, *_: (0, 0), memory_space=pltpu.VMEM)
-    quota_spec = pl.BlockSpec((Q, LANES), lambda i, *_: (0, 0), memory_space=pltpu.VMEM)
-    pod_spec = pl.BlockSpec((block, LANES), lambda i, *_: (i, 0), memory_space=pltpu.VMEM)
+    # index maps return strong-i32 zeros: with x64 on, a literal 0 becomes
+    # an i64 constant in the lowered index-map func, which Mosaic rejects
+    # ("failed to legalize operation 'func.func'")
+    _z = np.int32(0)
+    node_spec = pl.BlockSpec((N, LANES), lambda i, *_: (_z, _z), memory_space=pltpu.VMEM)
+    quota_spec = pl.BlockSpec((Q, LANES), lambda i, *_: (_z, _z), memory_space=pltpu.VMEM)
+    pod_spec = pl.BlockSpec((block, LANES), lambda i, *_: (i, _z), memory_space=pltpu.VMEM)
     in_specs = (
         [pod_spec, pod_spec, pod_spec]
         + [node_spec] * 4
         + [quota_spec] * 3
-        + [pl.BlockSpec((8, LANES), lambda i, *_: (0, 0), memory_space=pltpu.VMEM)]
+        + [pl.BlockSpec((8, LANES), lambda i, *_: (_z, _z), memory_space=pltpu.VMEM)]
     )
     operands = [preq, psreq, pest, alloc, usage, req0, flags, qrt, qlim, quse0, weights]
     if has_extras:
         # [N, P] with pods on lanes: each grid step streams a (N, block) tile
         xtra_spec = pl.BlockSpec(
-            (N, block), lambda i, *_: (0, i), memory_space=pltpu.VMEM
+            (N, block), lambda i, *_: (_z, i), memory_space=pltpu.VMEM
         )
         in_specs += [xtra_spec, xtra_spec]
         operands += [xmask, xscore]
