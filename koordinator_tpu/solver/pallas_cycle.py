@@ -70,21 +70,41 @@ def _i32(v) -> jnp.ndarray:
     return jnp.int32(v)
 
 
-def _least_requested(t, cap):
+def _exact_div(v, safe, recip):
+    """Exact nonnegative i32 floor division via f32 reciprocal.
+
+    The VPU has no integer divide — Mosaic emulates ``lax.div`` in
+    software, and ablation on v5e measured it at HALF the whole kernel's
+    runtime (a [N,1] column div costs the same per-vreg as a full
+    [N,128] one).  Every quotient in the score math is bounded by
+    MAX_NODE_SCORE (=100): free/clamped <= cap and weighted sums divide
+    by their weight total, so ``v/safe <= 100`` and the f32 rounding
+    error (rel ~2^-22) is far below the +-1 a single correction step
+    absorbs.  Exactness at floor boundaries is restored by the two
+    corrections; parity tests assert bit-identity with ``//``.
+    """
+    q = (v.astype(jnp.float32) * recip).astype(jnp.int32)
+    r = v - q * safe
+    q = q + jnp.where(r >= safe, _i32(1), _i32(0))
+    q = q - jnp.where(v - q * safe < _i32(0), _i32(1), _i32(0))
+    return q
+
+
+def _least_requested(t, cap, recip):
     """Exact ops/scoring.py least_requested_score in i32 (free pre-clamped
     so free * MAX_NODE_SCORE never overflows)."""
     safe = jnp.maximum(cap, _i32(1))
     # jnp.maximum, not jnp.clip: clip's asarray(0) bound is a strong i64
     # under x64 and i64 does not lower on Mosaic
     free = jnp.maximum(cap - t, _i32(0))
-    score = (free * _i32(MAX_NODE_SCORE)) // safe
+    score = _exact_div(free * _i32(MAX_NODE_SCORE), safe, recip)
     return jnp.where((cap == _i32(0)) | (t > cap), _i32(0), score)
 
 
-def _most_requested(t, cap):
+def _most_requested(t, cap, recip):
     safe = jnp.maximum(cap, _i32(1))
     clamped = jnp.minimum(t, cap)
-    score = (clamped * _i32(MAX_NODE_SCORE)) // safe
+    score = _exact_div(clamped * _i32(MAX_NODE_SCORE), safe, recip)
     return jnp.where(cap == _i32(0), _i32(0), score)
 
 
@@ -93,10 +113,8 @@ def _weighted(per_res, w_row, w_sum: int):
         return jnp.zeros(per_res.shape[:-1] + (1,), jnp.int32)
     # dtype=i32: under x64 jnp.sum accumulates i32 into i64 (numpy
     # semantics) and i64 does not lower on Mosaic
-    return (
-        jnp.sum(per_res * w_row, axis=-1, keepdims=True, dtype=jnp.int32)
-        // _i32(w_sum)
-    )
+    s = jnp.sum(per_res * w_row, axis=-1, keepdims=True, dtype=jnp.int32)
+    return _exact_div(s, _i32(w_sum), np.float32(1.0 / w_sum))
 
 
 def _cycle_kernel(
@@ -150,6 +168,8 @@ def _cycle_kernel(
     # are dropped by weights_vector; the divisor must match the scan path)
     fit_w_sum = sum(res.weights_vector(dict(cfg.fit_resource_weights)))
     la_w_sum = sum(res.weights_vector(dict(cfg.loadaware.resource_weights)))
+    # loop-invariant f32 reciprocal of node capacity for _exact_div
+    recip = 1.0 / jnp.maximum(alloc, _i32(1)).astype(jnp.float32)
 
     def step(j, _):
         # j MUST stay i32: Mosaic has no i64 lowering, and with x64
@@ -206,15 +226,15 @@ def _cycle_kernel(
         if cfg.enable_fit_score:
             t = nreq + sreq
             if cfg.fit_scoring_strategy == MOST_ALLOCATED:
-                per_res = _most_requested(t, alloc)
+                per_res = _most_requested(t, alloc, recip)
             else:
-                per_res = _least_requested(t, alloc)
+                per_res = _least_requested(t, alloc, recip)
             total = total + _i32(cfg.fit_plugin_weight) * _weighted(
                 per_res, fit_w_row, fit_w_sum
             )
         if cfg.enable_loadaware:
             est_used = usage_ref[:] + nest_ref[:] + est
-            per_res = _least_requested(est_used, alloc)
+            per_res = _least_requested(est_used, alloc, recip)
             la = _weighted(per_res, la_w_row, la_w_sum)
             total = total + _i32(cfg.loadaware_plugin_weight) * jnp.where(fresh, la, _i32(0))
         if has_extras:
