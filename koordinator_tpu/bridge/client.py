@@ -212,7 +212,11 @@ class ScorerClient:
             list(zip(entry.node_index, entry.score)) for entry in reply.pods
         ]
 
-    def assign(self) -> Tuple[np.ndarray, np.ndarray, float]:
+    def assign(self) -> Tuple[np.ndarray, np.ndarray, float, str]:
+        """Returns (assignment, status, cycle_ms, path); ``path`` names the
+        device program that ran ("pallas"/"scan"/"shard") so callers can
+        alarm on a degraded-path cycle instead of discovering it in a
+        latency graph."""
         reply = self._call(
             self._assign, pb2.AssignRequest(snapshot_id=self.snapshot_id or "")
         )
@@ -220,4 +224,5 @@ class ScorerClient:
             np.asarray(reply.assignment, np.int32),
             np.asarray(reply.status, np.int32),
             reply.cycle_ms,
+            reply.path,
         )

@@ -228,6 +228,7 @@ def _assign_sharded(
         node_requested=node_requested,
         node_estimated=node_estimated,
         quota_used=quota_used,
+        path="shard",
     )
 
 
@@ -284,5 +285,6 @@ def greedy_assign_sharded(
             node_requested=result.node_requested[:orig_n],
             node_estimated=result.node_estimated[:orig_n],
             quota_used=result.quota_used,
+            path=result.path,
         )
     return result

@@ -492,4 +492,5 @@ def _greedy_assign_pallas(
         node_requested=nreq[:N, :R].astype(jnp.int64),
         node_estimated=nest[:N, :R].astype(jnp.int64),
         quota_used=quse[:nq, :R].astype(jnp.int64),
+        path="pallas",
     )

@@ -63,9 +63,11 @@ class TestBridge:
             priority=[p.get("priority", 0) for p in pods_l],
         )
         assert reply.nodes == len(nodes_l) and reply.pods == len(pods_l)
-        assignment, status, ms = bridge.assign()
+        assignment, status, ms, path = bridge.assign()
         assert len(assignment) == len(pods_l)
         assert ms > 0
+        # degraded-path visibility: the reply must name the device program
+        assert path in ("pallas", "scan", "shard")
 
         # parity: the same cluster through the in-process entry point
         snap = encode_snapshot(
@@ -99,7 +101,7 @@ class TestBridge:
             pod_requests=preq,
             pod_estimated=pest,
         )
-        a1, _, _ = bridge.assign()
+        a1, _, _, _ = bridge.assign()
         # warm cycle: bump usage on one node; client auto-encodes a delta
         nuse2 = nuse.copy()
         nuse2[0, res.RESOURCE_INDEX[res.CPU]] += 1000
@@ -109,7 +111,7 @@ class TestBridge:
             pod_estimated=pest,
         )
         assert reply.nodes == len(nodes_l)
-        a2, _, _ = bridge.assign()
+        a2, _, _, _ = bridge.assign()
         assert len(a2) == len(a1)
 
     def test_tensor_delta_roundtrip(self):
