@@ -133,6 +133,42 @@ def child(platform: str) -> None:
     assigned = int((np.asarray(result.assignment)[:PODS] >= 0).sum())
     assert assigned > 0, "benchmark snapshot scheduled nothing"
     assert result.path == path, f"expected {path} path, ran {result.path}"
+
+    # measured native CPU baseline (BASELINE.md): the sequential per-pod
+    # C++ cycle (native/score_baseline.cpp) on the same snapshot — the
+    # shape of the reference's Go Score hot loop, Go toolchain absent.
+    # Runs AFTER the device measurement so it can never starve the TPU
+    # compile of its timeout budget, and only in the child that already
+    # succeeded (failed attempts never reach it).  Best-effort: a baseline
+    # failure must never kill the bench artifact.
+    cpu_native_ms = None
+    try:
+        import tempfile
+
+        from koordinator_tpu.harness.golden import write_golden
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        native_dir = os.path.join(here, "native")
+        subprocess.run(
+            ["make", "-C", native_dir, "score_baseline"],
+            capture_output=True,
+            timeout=120,
+            check=True,
+        )
+        with tempfile.TemporaryDirectory() as tmp:
+            golden = os.path.join(tmp, "golden.bin")
+            write_golden(golden, nodes, pods, gangs, quotas)
+            out = subprocess.run(
+                [os.path.join(native_dir, "score_baseline"), golden, "3"],
+                capture_output=True,
+                text=True,
+                timeout=120,
+                check=True,
+            )
+        cpu_native_ms = json.loads(out.stdout.splitlines()[0])["value"]
+        phase("cpu_native_baseline", ms=cpu_native_ms)
+    except Exception as exc:  # noqa: BLE001
+        phase("cpu_native_baseline_failed", error=str(exc)[:200])
     print(
         json.dumps(
             {
@@ -144,6 +180,12 @@ def child(platform: str) -> None:
                 "path": result.path,
                 "compile_ms": round(compile_ms, 1),
                 "assigned": assigned,
+                # measured single-thread C++ sequential baseline on this
+                # host (None if the native build was unavailable)
+                "cpu_native_baseline_ms": cpu_native_ms,
+                "vs_cpu_native": (
+                    round(cpu_native_ms / ms, 3) if cpu_native_ms else None
+                ),
             }
         ),
         flush=True,

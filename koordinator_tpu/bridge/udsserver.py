@@ -1,0 +1,133 @@
+"""Raw framed protobuf-over-UDS transport for native (C++) clients.
+
+The image has C++ protobuf but no grpc++ toolchain, so the native side of
+the bridge seam (SURVEY §7.5's host-scheduler shim; the reference proves
+the same boundary style with its UDS CRI proxy,
+reference ``pkg/runtimeproxy/server/cri/criserver.go:93``) speaks a
+minimal length-prefixed framing instead of gRPC.  The RPC *bodies* are
+the very same ``ScorerServicer`` methods the gRPC server serves
+(bridge/server.py) — one servicer, two transports, identical placements.
+
+Frame (both directions, all integers big-endian):
+
+    request:  u8 method (1=Sync, 2=Score, 3=Assign), u32 length, payload
+    reply:    u8 status (0=ok, 1=error), u32 length, payload
+              (serialized reply message, or UTF-8 error string)
+
+One connection may carry any number of sequential request/reply pairs.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+from typing import Optional
+
+from koordinator_tpu.bridge.codegen import pb2
+from koordinator_tpu.bridge.server import ScorerServicer
+from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG
+
+METHOD_SYNC = 1
+METHOD_SCORE = 2
+METHOD_ASSIGN = 3
+
+_MAX_FRAME = 1 << 30
+
+
+def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class RawUdsServer:
+    """Serve a ScorerServicer over the raw framing on a unix socket."""
+
+    def __init__(
+        self,
+        path: str,
+        servicer: Optional[ScorerServicer] = None,
+        cfg: CycleConfig = DEFAULT_CYCLE_CONFIG,
+    ):
+        self.path = path
+        self.servicer = servicer or ScorerServicer(cfg)
+        if os.path.exists(path):
+            os.unlink(path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(path)
+        self._sock.listen(8)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._methods = {
+            METHOD_SYNC: (pb2.SyncRequest, self.servicer.sync),
+            METHOD_SCORE: (pb2.ScoreRequest, self.servicer.score),
+            METHOD_ASSIGN: (pb2.AssignRequest, self.servicer.assign),
+        }
+
+    def start(self) -> "RawUdsServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        finally:
+            if os.path.exists(self.path):
+                os.unlink(self.path)
+
+    # -- internals --
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # socket closed by stop()
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop.is_set():
+                header = _recv_exact(conn, 5)
+                if header is None:
+                    return
+                method, length = struct.unpack(">BI", header)
+                if length > _MAX_FRAME:
+                    self._reply(conn, 1, b"frame too large")
+                    return
+                payload = _recv_exact(conn, length)
+                if payload is None:
+                    return
+                entry = self._methods.get(method)
+                if entry is None:
+                    self._reply(conn, 1, f"unknown method {method}".encode())
+                    continue
+                req_cls, fn = entry
+                try:
+                    req = req_cls.FromString(payload)
+                    reply = fn(req, None)
+                    self._reply(conn, 0, reply.SerializeToString())
+                except Exception as exc:  # surfaced to the client, not lost
+                    self._reply(conn, 1, str(exc).encode())
+
+    @staticmethod
+    def _reply(conn: socket.socket, status: int, payload: bytes) -> None:
+        try:
+            conn.sendall(struct.pack(">BI", status, len(payload)) + payload)
+        except OSError:
+            pass
+
+
+def serve_raw_uds(
+    path: str, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG
+) -> RawUdsServer:
+    return RawUdsServer(path, cfg=cfg).start()

@@ -1,0 +1,117 @@
+"""Golden SyncRequest builder: generator output -> bridge wire message.
+
+One canonical encoding shared by the native integration tests
+(tests/test_native_bridge.py), the bench's CPU-baseline stage (bench.py)
+and any host-side shim: the same bytes a real scheduler would ship over
+the Score/ScoreExtensions seam (SURVEY §7.5; reference boundary
+``pkg/scheduler/frameworkext/framework_extender.go:216``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from koordinator_tpu.bridge.codegen import pb2
+from koordinator_tpu.bridge.state import numpy_to_tensor
+from koordinator_tpu.constraints import build_quota_table_inputs
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.model.snapshot import PriorityClass, estimate_pod
+
+
+def estimate_pods(pods: List[Dict]) -> np.ndarray:
+    """LoadAware estimator output per pod (estimator lives host-side)."""
+    return np.asarray(
+        [
+            estimate_pod(
+                res.resource_vector(p["requests"]),
+                res.resource_vector(p.get("limits", {})),
+                PriorityClass.from_name(p.get("priority_class"))
+                if p.get("priority_class") is not None
+                else PriorityClass.from_priority_value(p.get("priority")),
+            )
+            for p in pods
+        ]
+    )
+
+
+def build_sync_request(
+    nodes: List[Dict],
+    pods: List[Dict],
+    gangs: List[Dict],
+    quotas: List[Dict],
+    node_bucket: int = 0,
+    pod_bucket: int = 0,
+) -> Tuple["pb2.SyncRequest", List[int]]:
+    """Encode generator-style dict lists as a full SyncRequest.
+
+    Returns (request, quota_id per pod).  Quota runtime fair division runs
+    host-side (constraints.build_quota_table_inputs), mirroring where the
+    reference computes runtimeQuota
+    (``elasticquota/core/runtime_quota_calculator.go:126``).
+    """
+    pod_reqs = [res.resource_vector(p["requests"]) for p in pods]
+    qidx = {q["name"]: i for i, q in enumerate(quotas)}
+    qids = [qidx.get(p.get("quota"), -1) for p in pods]
+
+    req = pb2.SyncRequest(node_bucket=node_bucket, pod_bucket=pod_bucket)
+    nalloc = np.asarray([res.resource_vector(n["allocatable"]) for n in nodes])
+    nuse = np.asarray(
+        [res.resource_vector(n.get("usage", {})) for n in nodes]
+    )
+    nreq = np.asarray(
+        [res.resource_vector(n.get("requested", {})) for n in nodes]
+    )
+    req.nodes.allocatable.CopyFrom(numpy_to_tensor(nalloc))
+    req.nodes.requested.CopyFrom(numpy_to_tensor(nreq))
+    req.nodes.usage.CopyFrom(numpy_to_tensor(nuse))
+    req.nodes.names.extend(n["name"] for n in nodes)
+    req.nodes.metric_fresh.extend(
+        bool(n.get("metric_fresh", True)) for n in nodes
+    )
+
+    req.pods.requests.CopyFrom(numpy_to_tensor(np.asarray(pod_reqs)))
+    req.pods.estimated.CopyFrom(numpy_to_tensor(estimate_pods(pods)))
+    req.pods.names.extend(p["name"] for p in pods)
+    req.pods.priority.extend(int(p.get("priority", 0)) for p in pods)
+    gidx = {g["name"]: i for i, g in enumerate(gangs)}
+    req.pods.gang_id.extend(
+        gidx.get(p.get("gang"), -1) for p in pods
+    )
+    req.pods.quota_id.extend(int(q) for q in qids)
+    req.gangs.min_member.extend(int(g["min_member"]) for g in gangs)
+
+    if quotas:
+        total = [0] * res.NUM_RESOURCES
+        for n in nodes:
+            v = res.resource_vector(n["allocatable"])
+            total = [a + b for a, b in zip(total, v)]
+        qdicts = build_quota_table_inputs(quotas, pod_reqs, qids, total)
+        qrt = np.asarray(
+            [res.resource_vector(q["runtime"]) for q in qdicts]
+        )
+        quse = np.asarray(
+            [res.resource_vector(q.get("used", {})) for q in qdicts]
+        )
+        qlim = np.asarray(
+            [
+                [
+                    1 if res.RESOURCE_AXIS[r] in q["runtime"] else 0
+                    for r in range(res.NUM_RESOURCES)
+                ]
+                for q in qdicts
+            ],
+            np.int64,
+        )
+        req.quotas.runtime.CopyFrom(numpy_to_tensor(qrt))
+        req.quotas.used.CopyFrom(numpy_to_tensor(quse))
+        req.quotas.limited.CopyFrom(numpy_to_tensor(qlim))
+    return req, qids
+
+
+def write_golden(path: str, *args, **kwargs) -> "pb2.SyncRequest":
+    req, _ = build_sync_request(*args, **kwargs)
+    with open(path, "wb") as f:
+        f.write(req.SerializeToString())
+    return req

@@ -1,0 +1,198 @@
+// Native host-scheduler client for the BatchedScorer bridge seam.
+//
+// Plays the role SURVEY §7.5 assigns to the host-side shim at the
+// scheduler's Score/ScoreExtensions boundary (the reference proves the
+// seam at pkg/scheduler/frameworkext/framework_extender.go:216, and uses
+// the same UDS transport style for its CRI proxy,
+// pkg/runtimeproxy/server/cri/criserver.go:93).  The toolchain has C++
+// protobuf but no grpc++, so the transport is the raw framing served by
+// koordinator_tpu/bridge/udsserver.py:
+//
+//   request:  u8 method (1=Sync, 2=Score, 3=Assign), u32be len, payload
+//   reply:    u8 status (0=ok, 1=err), u32be len, payload
+//
+// Usage:
+//   scorer_client <socket> <sync_request_file> [top_k]
+//
+// Reads a serialized SyncRequest, syncs it, runs Assign and Score, and
+// prints machine-parseable lines the integration test
+// (tests/test_native_bridge.py) diffs against the in-process solver:
+//
+//   sync <snapshot_id> <nodes> <pods>
+//   assign <i0> <i1> ...
+//   status <s0> <s1> ...
+//   path <pallas|scan|shard>
+//   score <pod> <node>:<score> ...
+
+#include <arpa/inet.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "gen/scorer.pb.h"
+
+namespace kb = koordinator_tpu::bridge;
+
+namespace {
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::write(fd, p, n);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// One framed RPC round trip; returns false and fills `err` on failure.
+bool call(int fd, uint8_t method, const std::string& payload,
+          std::string* reply, std::string* err) {
+  uint8_t header[5];
+  header[0] = method;
+  const uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+  std::memcpy(header + 1, &len, 4);
+  if (!send_all(fd, header, 5) ||
+      !send_all(fd, payload.data(), payload.size())) {
+    *err = "short write";
+    return false;
+  }
+  uint8_t rhead[5];
+  if (!recv_all(fd, rhead, 5)) {
+    *err = "short read (header)";
+    return false;
+  }
+  uint32_t rlen;
+  std::memcpy(&rlen, rhead + 1, 4);
+  rlen = ntohl(rlen);
+  reply->resize(rlen);
+  if (rlen > 0 && !recv_all(fd, reply->data(), rlen)) {
+    *err = "short read (payload)";
+    return false;
+  }
+  if (rhead[0] != 0) {
+    *err = "server error: " + *reply;
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  GOOGLE_PROTOBUF_VERIFY_VERSION;
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <socket> <sync_request_file> [top_k]\n",
+                 argv[0]);
+    return 2;
+  }
+  const char* sock_path = argv[1];
+  const char* sync_file = argv[2];
+  const long top_k = argc > 3 ? std::strtol(argv[3], nullptr, 10) : 4;
+
+  std::ifstream in(sync_file, std::ios::binary);
+  if (!in) {
+    std::fprintf(stderr, "cannot open %s\n", sync_file);
+    return 2;
+  }
+  std::stringstream ss;
+  ss << in.rdbuf();
+  kb::SyncRequest sync_req;
+  if (!sync_req.ParseFromString(ss.str())) {
+    std::fprintf(stderr, "cannot parse SyncRequest from %s\n", sync_file);
+    return 2;
+  }
+
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) {
+    std::perror("socket");
+    return 2;
+  }
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::strncpy(addr.sun_path, sock_path, sizeof(addr.sun_path) - 1);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    std::perror("connect");
+    return 2;
+  }
+
+  std::string reply, err;
+
+  // Sync: ship the cluster view, learn the resident snapshot id.
+  if (!call(fd, 1, sync_req.SerializeAsString(), &reply, &err)) {
+    std::fprintf(stderr, "sync: %s\n", err.c_str());
+    return 1;
+  }
+  kb::SyncReply sync_reply;
+  if (!sync_reply.ParseFromString(reply)) {
+    std::fprintf(stderr, "sync: bad reply\n");
+    return 1;
+  }
+  std::printf("sync %s %lld %lld\n", sync_reply.snapshot_id().c_str(),
+              static_cast<long long>(sync_reply.nodes()),
+              static_cast<long long>(sync_reply.pods()));
+
+  // Assign: one full batched scheduling cycle on the device.
+  kb::AssignRequest assign_req;
+  assign_req.set_snapshot_id(sync_reply.snapshot_id());
+  if (!call(fd, 3, assign_req.SerializeAsString(), &reply, &err)) {
+    std::fprintf(stderr, "assign: %s\n", err.c_str());
+    return 1;
+  }
+  kb::AssignReply assign_reply;
+  if (!assign_reply.ParseFromString(reply)) {
+    std::fprintf(stderr, "assign: bad reply\n");
+    return 1;
+  }
+  std::printf("assign");
+  for (int v : assign_reply.assignment()) std::printf(" %d", v);
+  std::printf("\nstatus");
+  for (int v : assign_reply.status()) std::printf(" %d", v);
+  std::printf("\npath %s\n", assign_reply.path().c_str());
+
+  // Score: NodeScoreLists, the Score/ScoreExtensions boundary payload.
+  kb::ScoreRequest score_req;
+  score_req.set_snapshot_id(sync_reply.snapshot_id());
+  score_req.set_top_k(top_k);
+  if (!call(fd, 2, score_req.SerializeAsString(), &reply, &err)) {
+    std::fprintf(stderr, "score: %s\n", err.c_str());
+    return 1;
+  }
+  kb::ScoreReply score_reply;
+  if (!score_reply.ParseFromString(reply)) {
+    std::fprintf(stderr, "score: bad reply\n");
+    return 1;
+  }
+  for (int p = 0; p < score_reply.pods_size(); ++p) {
+    const auto& entry = score_reply.pods(p);
+    std::printf("score %d", p);
+    for (int i = 0; i < entry.node_index_size(); ++i) {
+      std::printf(" %d:%lld", entry.node_index(i),
+                  static_cast<long long>(entry.score(i)));
+    }
+    std::printf("\n");
+  }
+  ::close(fd);
+  google::protobuf::ShutdownProtobufLibrary();
+  return 0;
+}

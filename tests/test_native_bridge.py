@@ -1,0 +1,159 @@
+"""The native (C++) side of the bridge seam, end to end.
+
+Two binaries built from native/ (C++17 + libprotobuf; the image has no
+grpc++ or Go toolchain, so the raw-UDS framing of bridge/udsserver.py is
+the native transport — the reference proves the same boundary style at
+``pkg/runtimeproxy/server/cri/criserver.go:93``):
+
+* ``scorer_client`` — the host-scheduler shim at the Score/ScoreExtensions
+  boundary (SURVEY §7.5; reference seam
+  ``pkg/scheduler/frameworkext/framework_extender.go:216``).  Syncs a
+  golden snapshot over UDS, runs Assign and Score, and must match the
+  in-process solver exactly.
+* ``score_baseline`` — the measured sequential per-pod CPU baseline
+  (BASELINE.md): an independently written native implementation of the
+  cycle semantics whose placements must agree pod-for-pod with the JAX
+  solver (retiring the Python-oracle self-reference risk).
+"""
+
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.bridge.codegen import pb2
+from koordinator_tpu.bridge.server import ScorerServicer
+from koordinator_tpu.bridge.udsserver import RawUdsServer
+from koordinator_tpu.harness import generators
+from koordinator_tpu.harness.golden import build_sync_request
+from koordinator_tpu.solver import score_cycle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+
+
+def _build(target: str) -> str:
+    path = os.path.join(NATIVE, target)
+    proc = subprocess.run(
+        ["make", "-C", NATIVE, target], capture_output=True, text=True
+    )
+    assert proc.returncode == 0, f"native build failed:\n{proc.stderr}"
+    assert os.path.exists(path)
+    return path
+
+
+def _sync_request(pods=32, nodes=8, seed=7) -> "pb2.SyncRequest":
+    nodes_l, pods_l, _, _ = generators.loadaware_joint(
+        seed=seed, pods=pods, nodes=nodes
+    )
+    req, _ = build_sync_request(
+        nodes_l, pods_l, [], [], node_bucket=nodes, pod_bucket=pods
+    )
+    return req
+
+
+@pytest.fixture(scope="module")
+def golden_file():
+    req = _sync_request()
+    path = os.path.join(tempfile.mkdtemp(), "sync_request.bin")
+    with open(path, "wb") as f:
+        f.write(req.SerializeToString())
+    yield path, req
+    os.unlink(path)
+
+
+@pytest.fixture(scope="module")
+def inprocess(golden_file):
+    """The same snapshot through an in-process servicer (no transport)."""
+    _, req = golden_file
+    sv = ScorerServicer()
+    sv.sync(req)
+    return sv
+
+
+class TestNativeScorerClient:
+    def test_cpp_client_matches_inprocess(self, golden_file, inprocess):
+        path, req = golden_file
+        binary = _build("scorer_client")
+        sock = os.path.join(tempfile.mkdtemp(), "scorer.sock")
+        server = RawUdsServer(sock).start()
+        try:
+            proc = subprocess.run(
+                [binary, sock, path, "4"],
+                capture_output=True,
+                text=True,
+                timeout=300,
+            )
+        finally:
+            server.stop()
+        assert proc.returncode == 0, proc.stderr
+        lines = proc.stdout.strip().splitlines()
+        out = {}
+        score_lines = {}
+        for line in lines:
+            key, _, rest = line.partition(" ")
+            if key == "score":
+                pid, _, entries = rest.partition(" ")
+                score_lines[int(pid)] = entries
+            else:
+                out[key] = rest
+
+        # Sync round-tripped through C++ protobuf
+        snap = inprocess.state.snapshot()
+        assert out["sync"].split()[0] == "s1"
+
+        # Assign parity with the in-process cycle + path visibility
+        direct = inprocess.assign(pb2.AssignRequest(snapshot_id="s1"))
+        got_assign = [int(v) for v in out["assign"].split()]
+        assert got_assign == list(direct.assignment)
+        got_status = [int(v) for v in out["status"].split()]
+        assert got_status == list(direct.status)
+        assert out["path"] in ("pallas", "scan", "shard")
+
+        # Score parity: top-4 NodeScoreLists == score_cycle's
+        scores, feasible = score_cycle(snap)
+        scores = np.asarray(scores)
+        feasible = np.asarray(feasible)
+        P = len(req.pods.names)
+        assert set(score_lines) == set(range(P))
+        for p in range(P):
+            entries = [
+                tuple(int(x) for x in e.split(":"))
+                for e in score_lines[p].split()
+                if e
+            ]
+            masked = np.where(
+                feasible[p], scores[p], np.iinfo(np.int64).min
+            )
+            k = min(4, masked.shape[0])
+            want_idx = np.argsort(-masked, stable=True)[:k]
+            want = [
+                (int(i), int(scores[p, i])) for i in want_idx if feasible[p, i]
+            ]
+            # top-k set equality modulo equal-score ordering
+            assert sorted(entries) == sorted(want), f"pod {p}"
+
+
+class TestNativeBaseline:
+    def test_sequential_baseline_parity_and_timing(self, golden_file, inprocess):
+        path, req = golden_file
+        binary = _build("score_baseline")
+        proc = subprocess.run(
+            [binary, path, "2"], capture_output=True, text=True, timeout=300
+        )
+        assert proc.returncode == 0, proc.stderr
+        js, assign_line = proc.stdout.strip().splitlines()
+        import json
+
+        metrics = json.loads(js)
+        assert metrics["metric"] == "cpu_baseline_cycle_ms"
+        assert metrics["value"] > 0
+        assert metrics["pods"] == len(req.pods.names)
+
+        got = [int(v) for v in assign_line.split()[1:]]
+        direct = inprocess.assign(pb2.AssignRequest(snapshot_id="s1"))
+        assert got == list(direct.assignment), (
+            "native sequential baseline diverged from the JAX solver"
+        )
