@@ -62,9 +62,21 @@ def child(platform: str) -> None:
     n_dev = len(jax.devices())
     phase("init", backend=backend, devices=n_dev, ms=_ms(t0))
 
-    t0 = time.perf_counter()
+    # fixed dispatch+transfer floor of the platform (the tunneled axon
+    # backend pays a network round trip per materialized result, measured
+    # ~68ms; real non-tunneled TPU deployments pay microseconds) — reported
+    # so device-kernel time can be read net of transport
     import numpy as np
 
+    _trivial = jax.jit(lambda x: x + 1)
+    _x = jax.numpy.zeros(8)
+    np.asarray(_trivial(_x))
+    rtt_ms = min(
+        _timed(lambda: np.asarray(_trivial(_x))) for _ in range(5)
+    )
+    phase("rtt_floor", ms=round(rtt_ms, 2))
+
+    t0 = time.perf_counter()
     import koordinator_tpu  # noqa: F401  (enables x64)
     from koordinator_tpu.constraints import build_quota_table_inputs
     from koordinator_tpu.harness import generators
@@ -143,29 +155,7 @@ def child(platform: str) -> None:
     # failure must never kill the bench artifact.
     cpu_native_ms = None
     try:
-        import tempfile
-
-        from koordinator_tpu.harness.golden import write_golden
-
-        here = os.path.dirname(os.path.abspath(__file__))
-        native_dir = os.path.join(here, "native")
-        subprocess.run(
-            ["make", "-C", native_dir, "score_baseline"],
-            capture_output=True,
-            timeout=120,
-            check=True,
-        )
-        with tempfile.TemporaryDirectory() as tmp:
-            golden = os.path.join(tmp, "golden.bin")
-            write_golden(golden, nodes, pods, gangs, quotas)
-            out = subprocess.run(
-                [os.path.join(native_dir, "score_baseline"), golden, "3"],
-                capture_output=True,
-                text=True,
-                timeout=120,
-                check=True,
-            )
-        cpu_native_ms = json.loads(out.stdout.splitlines()[0])["value"]
+        cpu_native_ms, _ = _native_baseline(nodes, pods, gangs, quotas)
         phase("cpu_native_baseline", ms=cpu_native_ms)
     except Exception as exc:  # noqa: BLE001
         phase("cpu_native_baseline_failed", error=str(exc)[:200])
@@ -186,28 +176,364 @@ def child(platform: str) -> None:
                 "vs_cpu_native": (
                     round(cpu_native_ms / ms, 3) if cpu_native_ms else None
                 ),
+                # per-call transport floor of this platform; subtract for
+                # net device-kernel time
+                "rtt_floor_ms": round(rtt_ms, 2),
             }
         ),
         flush=True,
     )
 
 
+def _native_baseline(nodes, pods, gangs, quotas, iters=3):
+    """Build + run the C++ sequential baseline on a golden snapshot.
+
+    Returns (ms, native_assignment list).  Raises on any failure — callers
+    decide whether that is fatal (parity checks) or best-effort (metrics).
+    """
+    import tempfile
+
+    from koordinator_tpu.harness.golden import write_golden
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    native_dir = os.path.join(here, "native")
+    subprocess.run(
+        ["make", "-C", native_dir, "score_baseline"],
+        capture_output=True,
+        timeout=120,
+        check=True,
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        golden = os.path.join(tmp, "golden.bin")
+        write_golden(golden, nodes, pods, gangs, quotas)
+        out = subprocess.run(
+            [os.path.join(native_dir, "score_baseline"), golden, str(iters)],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            check=True,
+        )
+    lines = out.stdout.splitlines()
+    ms = json.loads(lines[0])["value"]
+    assign = [int(v) for v in lines[1].split()[1:]]
+    return ms, assign
+
+
 def _ms(t0: float) -> float:
     return (time.perf_counter() - t0) * 1000.0
 
 
-def _spawn(flag, platform, env_extra, timeout):
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return _ms(t0)
+
+
+def child_config(platform: str, config: str) -> None:
+    """Per-config measurement (BASELINE.md's remaining targets): spark
+    3-node exact-score parity, gang 5k x 500, LowNodeLoad rebalance on the
+    10k x 2k snapshot.  Prints one JSON line."""
+
+    def phase(name, **kw):
+        print(json.dumps({"phase": name, **kw}), flush=True)
+
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    backend = jax.default_backend()
+    phase("init", backend=backend)
+
+    import numpy as np
+
+    import koordinator_tpu  # noqa: F401
+    from koordinator_tpu.harness import generators
+    from koordinator_tpu.model import encode_snapshot, resources as res
+    from koordinator_tpu.model.snapshot import PriorityClass, estimate_pod
+
+    def _est(p):
+        return estimate_pod(
+            res.resource_vector(p["requests"]),
+            res.resource_vector(p.get("limits", {})),
+            PriorityClass.from_name(p.get("priority_class"))
+            if p.get("priority_class") is not None
+            else PriorityClass.from_priority_value(p.get("priority")),
+        )
+
+    if config == "spark":
+        # BASELINE config #1: exact NodeScoreList parity on the 3-node
+        # spark-jobs example (reference examples/spark-jobs), scored by the
+        # device kernel vs the sequential reference oracle
+        from koordinator_tpu.harness.reference import ReferenceCycle
+        from koordinator_tpu.solver import score_cycle
+
+        nodes, pods, gangs, quotas = generators.spark_colocation()
+        snap = encode_snapshot(nodes, pods, gangs, [])
+        scores, feasible = score_cycle(snap)
+        scores_np = np.asarray(scores)
+        feasible_np = np.asarray(feasible)
+
+        oracle = ReferenceCycle(
+            [res.resource_vector(n["allocatable"]) for n in nodes],
+            [[0] * res.NUM_RESOURCES for _ in nodes],
+            [res.resource_vector(n.get("usage", {})) for n in nodes],
+            [bool(n.get("metric_fresh", True)) for n in nodes],
+        )
+        P, N = len(pods), len(nodes)
+        parity = True
+        for p in range(P):
+            req = res.resource_vector(pods[p]["requests"])
+            est = _est(pods[p])
+            for n in range(N):
+                want = oracle.combined_score(n, req, est)
+                want_ok = oracle.fit_ok(n, req) and oracle.loadaware_filter_ok(n)
+                if int(scores_np[p, n]) != want or bool(
+                    feasible_np[p, n]
+                ) != bool(want_ok):
+                    parity = False
+                    phase(
+                        "parity_mismatch",
+                        pod=p,
+                        node=n,
+                        got=int(scores_np[p, n]),
+                        want=want,
+                    )
+        assert parity, "spark NodeScoreList parity failed"
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            s, f = score_cycle(snap)
+            np.asarray(s)
+            times.append(_ms(t0))
+        print(
+            json.dumps(
+                {
+                    "metric": "spark_3node_score_ms",
+                    "value": round(min(times), 3),
+                    "unit": "ms",
+                    "parity": "exact",
+                    "backend": backend,
+                }
+            ),
+            flush=True,
+        )
+        return
+
+    if config == "gang":
+        # BASELINE config #3: Coscheduling gang masks at 5k pods x 500
+        # nodes (minMember=8), full cycle on the device
+        from koordinator_tpu.solver import run_cycle
+
+        nodes, pods, gangs, quotas = generators.gang_batch(
+            pods=5000, nodes=500, min_member=8
+        )
+        snap = encode_snapshot(
+            nodes, pods, gangs, [], node_bucket=500, pod_bucket=5000
+        )
+        from koordinator_tpu.solver import pallas_inputs_fit_i32
+
+        i32_ok = bool(pallas_inputs_fit_i32(snap))
+        t0 = time.perf_counter()
+        result = run_cycle(snap, i32_ok=i32_ok)
+        np.asarray(result.assignment)
+        compile_ms = _ms(t0)
+        phase("compile", ms=compile_ms, path=result.path)
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            result = run_cycle(snap, i32_ok=i32_ok)
+            np.asarray(result.assignment)
+            times.append(_ms(t0))
+        assignment = np.asarray(result.assignment)[: len(pods)]
+        status = np.asarray(result.status)[: len(pods)]
+        # gang all-or-nothing invariant: members of a gang below minMember
+        # are WAIT_GANG, satisfied gangs' assigned members are ASSIGNED
+        gang_ids = np.asarray(
+            [
+                int(p["gang"].split("-")[1]) if "gang" in p else -1
+                for p in pods
+            ]
+        )
+        violations = 0
+        for g in range(len(gangs)):
+            members = gang_ids == g
+            placed = members & (assignment >= 0)
+            if placed.sum() >= gangs[g]["min_member"]:
+                violations += int((status[placed] != 0).sum())
+            else:
+                violations += int((status[placed] != 2).sum())
+        assert violations == 0, f"{violations} gang-status violations"
+        print(
+            json.dumps(
+                {
+                    "metric": "gang_5kpod_500node_ms",
+                    "value": round(min(times), 2),
+                    "unit": "ms",
+                    "backend": backend,
+                    "path": result.path,
+                    "assigned": int((assignment >= 0).sum()),
+                    "gangs_ok": True,
+                }
+            ),
+            flush=True,
+        )
+        return
+
+    if config == "loadaware":
+        # BASELINE config #2: LoadAware + Fit joint cycle, 1k pods x 200
+        # nodes, with the measured native sequential baseline for speedup
+        from koordinator_tpu.solver import run_cycle
+
+        nodes, pods, gangs, quotas = generators.loadaware_joint(
+            pods=1000, nodes=200
+        )
+        snap = encode_snapshot(
+            nodes, pods, gangs, [], node_bucket=200, pod_bucket=1000
+        )
+        from koordinator_tpu.solver import pallas_inputs_fit_i32
+
+        i32_ok = bool(pallas_inputs_fit_i32(snap))
+        t0 = time.perf_counter()
+        result = run_cycle(snap, i32_ok=i32_ok)
+        np.asarray(result.assignment)
+        phase("compile", ms=_ms(t0), path=result.path)
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            result = run_cycle(snap, i32_ok=i32_ok)
+            np.asarray(result.assignment)
+            times.append(_ms(t0))
+        cpu_ms = None
+        native_assign = None
+        try:
+            cpu_ms, native_assign = _native_baseline(
+                nodes, pods, gangs, quotas
+            )
+        except Exception as exc:  # noqa: BLE001
+            phase("cpu_native_baseline_failed", error=str(exc)[:200])
+        if native_assign is not None:
+            # placement parity native vs device — OUTSIDE the best-effort
+            # try: a real divergence must fail the bench, not be logged as
+            # a baseline hiccup while still publishing the speedup
+            got = np.asarray(result.assignment)[: len(pods)].tolist()
+            assert native_assign == got, "native/device placement divergence"
+        ms = min(times)
+        print(
+            json.dumps(
+                {
+                    "metric": "loadaware_1kpod_200node_ms",
+                    "value": round(ms, 2),
+                    "unit": "ms",
+                    "backend": backend,
+                    "path": result.path,
+                    "assigned": int(
+                        (np.asarray(result.assignment)[: len(pods)] >= 0).sum()
+                    ),
+                    "cpu_native_baseline_ms": cpu_ms,
+                    "vs_cpu_native": round(cpu_ms / ms, 3) if cpu_ms else None,
+                }
+            ),
+            flush=True,
+        )
+        return
+
+    if config == "rebalance":
+        # BASELINE config #5: LowNodeLoad Balance tick over the same
+        # 10k x 2k cluster, pods placed by the scheduling cycle
+        from koordinator_tpu.constraints import build_quota_table_inputs
+        from koordinator_tpu.descheduler.evictions import PodEvictor
+        from koordinator_tpu.descheduler.lownodeload import (
+            LowNodeLoadArgs,
+            NodePool,
+            balance,
+        )
+        from koordinator_tpu.solver import run_cycle
+
+        nodes, pods, gangs, quotas = generators.quota_colocation(
+            pods=PODS, nodes=NODES
+        )
+        pod_reqs = [res.resource_vector(p["requests"]) for p in pods]
+        qidx = {q["name"]: i for i, q in enumerate(quotas)}
+        qids = [qidx.get(p.get("quota"), -1) for p in pods]
+        total = [0] * res.NUM_RESOURCES
+        for n in nodes:
+            v = res.resource_vector(n["allocatable"])
+            total = [a + b for a, b in zip(total, v)]
+        qdicts = build_quota_table_inputs(quotas, pod_reqs, qids, total)
+        snap = encode_snapshot(
+            nodes, pods, gangs, qdicts, node_bucket=NODES, pod_bucket=PODS
+        )
+        result = run_cycle(snap)
+        assignment = np.asarray(result.assignment)[: len(pods)]
+        phase("cycle", path=result.path)
+
+        node_dicts = [
+            {
+                "name": n["name"],
+                "allocatable": n["allocatable"],
+                "usage": n.get("usage", {}),
+                "pods": [],
+            }
+            for n in nodes
+        ]
+        for p, a in enumerate(assignment):
+            if a >= 0:
+                node_dicts[a]["pods"].append(
+                    {
+                        "name": pods[p]["name"],
+                        "namespace": "default",
+                        "requests": pods[p]["requests"],
+                        "priority": pods[p].get("priority", 0),
+                    }
+                )
+        args = LowNodeLoadArgs(
+            node_pools=[
+                NodePool(
+                    low_thresholds={"cpu": 20, "memory": 20},
+                    high_thresholds={"cpu": 50, "memory": 50},
+                )
+            ],
+            dry_run=True,
+        )
+        times = []
+        plans = []
+        for _ in range(3):
+            evictor = PodEvictor(dry_run=True)
+            t0 = time.perf_counter()
+            plans = balance(args, node_dicts, evictor)
+            times.append(_ms(t0))
+        print(
+            json.dumps(
+                {
+                    "metric": "rebalance_10kpod_2knode_ms",
+                    "value": round(min(times), 2),
+                    "unit": "ms",
+                    "backend": backend,
+                    "planned_evictions": len(plans),
+                }
+            ),
+            flush=True,
+        )
+        return
+
+    raise SystemExit(f"unknown config {config!r}")
+
+
+def _spawn(flag, platform, env_extra, timeout, config=None):
     """Run a child stage; returns (ok, final_json_line, err_string)."""
     env = dict(os.environ, **env_extra)
+    argv = [
+        sys.executable,
+        os.path.abspath(__file__),
+        flag,
+        "--platform",
+        platform,
+    ]
+    if config:
+        argv += ["--config", config]
     try:
         proc = subprocess.run(
-            [
-                sys.executable,
-                os.path.abspath(__file__),
-                flag,
-                "--platform",
-                platform,
-            ],
+            argv,
             env=env,
             timeout=timeout,
             capture_output=True,
@@ -218,6 +544,12 @@ def _spawn(flag, platform, env_extra, timeout):
         out = e.stdout or b""
         if isinstance(out, bytes):
             out = out.decode(errors="replace")
+        # a child that already printed its metric line but hung in a later
+        # best-effort stage (e.g. the native baseline) still produced a
+        # valid artifact — never discard a finished measurement
+        finals = [l for l in out.splitlines() if l.startswith('{"metric"')]
+        if finals:
+            return True, finals[-1], ""
         phases = [l for l in out.splitlines() if l.startswith('{"phase"')]
         return (
             False,
@@ -312,10 +644,49 @@ def main() -> int:
     ap.add_argument("--child", action="store_true")
     ap.add_argument("--probe", action="store_true")
     ap.add_argument("--platform", default="default", choices=["default", "cpu"])
+    ap.add_argument(
+        "--config",
+        default=None,
+        choices=["spark", "loadaware", "gang", "rebalance"],
+        help="measure a secondary BASELINE config instead of the headline "
+        "10k x 2k quota_colocation cycle (driver contract: no args prints "
+        "exactly the one headline JSON line)",
+    )
     args = ap.parse_args()
     if args.probe:
         probe(args.platform)
         return 0
+    if args.config and args.child:
+        child_config(args.platform, args.config)
+        return 0
+    if args.config:
+        # same probe/timeout machinery as the headline parent
+        errors = []
+        ok, out, err = _spawn("--probe", "default", {}, PROBE_TIMEOUT)
+        tpu_alive = ok and '"probe": "cpu"' not in (out or "")
+        if not ok:
+            errors.append(err)
+        if tpu_alive:
+            ok, out, err = _spawn(
+                "--child", "default", {}, TPU_TIMEOUT, config=args.config
+            )
+            if ok:
+                print(out)
+                return 0
+            errors.append(err)
+        ok, out, err = _spawn(
+            "--child", "cpu", _CPU_ENV, CPU_TIMEOUT, config=args.config
+        )
+        if ok:
+            print(out)
+            return 0
+        errors.append(err)
+        print(
+            json.dumps(
+                {"metric": args.config, "value": -1, "error": "; ".join(errors)}
+            )
+        )
+        return 1
     if args.child:
         child(args.platform)
         return 0

@@ -116,12 +116,19 @@ def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None, i32_ok=Non
             )
             # materialize before returning: with async dispatch (and lazy
             # materialization on tunneled platforms) a runtime fault would
-            # otherwise surface at the caller, outside this fallback
-            jax.block_until_ready(result.assignment)
+            # otherwise surface at the caller, outside this fallback.  Hand
+            # the host copy back in the result — on a tunneled platform a
+            # device->host read costs a network round trip (~68ms measured),
+            # and every caller's next move is np.asarray(assignment).
+            import dataclasses
+
             import numpy as _np
 
-            _np.asarray(result.assignment)
-            return result
+            # np.asarray both forces execution and surfaces runtime faults;
+            # an extra block_until_ready would cost a second round trip here
+            return dataclasses.replace(
+                result, assignment=_np.asarray(result.assignment)
+            )
         except Exception:
             _PALLAS_UNSUPPORTED.add(bucket)
             logging.getLogger(__name__).exception(

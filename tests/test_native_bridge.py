@@ -128,7 +128,9 @@ class TestNativeScorerClient:
                 feasible[p], scores[p], np.iinfo(np.int64).min
             )
             k = min(4, masked.shape[0])
-            want_idx = np.argsort(-masked, stable=True)[:k]
+            # negate in float64 (exact for these small scores): -int64.min
+            # wraps in int64 and would rank infeasible sentinels first
+            want_idx = np.argsort(-masked.astype(np.float64), stable=True)[:k]
             want = [
                 (int(i), int(scores[p, i])) for i in want_idx if feasible[p, i]
             ]
