@@ -71,6 +71,29 @@ def numpy_to_tensor(
     return t
 
 
+def _pad_rows_to(a, rows):
+    """Pad (or keep) leading axis to ``rows`` regardless of current length
+    (mirrors the length-agnostic padded()/_pad2 helpers — a stale mirror
+    after a node-count change must never produce a wrong-shaped tensor)."""
+    a = np.asarray(a)
+    if a.shape[0] >= rows:
+        return a[:rows]
+    pad = [(0, rows - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+    return np.pad(a, pad)
+
+
+def _pc_column(explicit, priority, P, pb):
+    from koordinator_tpu.model.snapshot import PriorityClass
+
+    col = np.full(pb, int(PriorityClass.NONE), np.int32)
+    if explicit is not None:
+        col[: len(explicit)] = explicit[:pb]
+    else:
+        for i in range(P):
+            col[i] = int(PriorityClass.from_priority_value(int(priority[i])))
+    return col
+
+
 class ResidentState:
     """Numpy mirrors + the device ClusterSnapshot built from them."""
 
@@ -79,10 +102,14 @@ class ResidentState:
         self.node_requested: Optional[np.ndarray] = None
         self.node_usage: Optional[np.ndarray] = None
         self.node_fresh: Optional[np.ndarray] = None
+        self.node_agg: Optional[np.ndarray] = None  # [N, A, R]
+        self.node_agg_fresh: Optional[np.ndarray] = None
+        self.node_prod: Optional[np.ndarray] = None
         self.node_names: tuple = ()
         self.pod_requests: Optional[np.ndarray] = None
         self.pod_estimated: Optional[np.ndarray] = None
         self.pod_priority: Optional[np.ndarray] = None
+        self.pod_priority_class: Optional[np.ndarray] = None
         self.pod_gang: Optional[np.ndarray] = None
         self.pod_quota: Optional[np.ndarray] = None
         self.pod_names: tuple = ()
@@ -108,12 +135,19 @@ class ResidentState:
         self.node_usage = upd(self.node_usage, n.usage)
         if n.metric_fresh:
             self.node_fresh = np.asarray(list(n.metric_fresh), dtype=bool)
+        self.node_agg = upd(self.node_agg, n.agg_usage)
+        self.node_agg_fresh = upd(self.node_agg_fresh, n.agg_fresh)
+        self.node_prod = upd(self.node_prod, n.prod_usage)
         if n.names:
             self.node_names = tuple(n.names)
         self.pod_requests = upd(self.pod_requests, p.requests)
         self.pod_estimated = upd(self.pod_estimated, p.estimated)
         if p.priority:
             self.pod_priority = np.asarray(list(p.priority), dtype=np.int64)
+        if p.priority_class:
+            self.pod_priority_class = np.asarray(
+                list(p.priority_class), dtype=np.int32
+            )
         if p.gang_id:
             self.pod_gang = np.asarray(list(p.gang_id), dtype=np.int32)
         if p.quota_id:
@@ -248,12 +282,36 @@ class ResidentState:
                 ),
                 metric_fresh=jnp.asarray(fresh),
                 valid=jnp.asarray(nvalid),
+                agg_usage=(
+                    jnp.asarray(_pad_rows_to(self.node_agg, nb))
+                    if self.node_agg is not None and self.node_agg.size
+                    else None
+                ),
+                agg_fresh=(
+                    jnp.asarray(
+                        _pad_rows_to(self.node_agg_fresh, nb).astype(bool)
+                    )
+                    if self.node_agg_fresh is not None
+                    and self.node_agg_fresh.size
+                    else None
+                ),
+                prod_usage=(
+                    padded(self.node_prod, nb)
+                    if self.node_prod is not None and self.node_prod.size
+                    else None
+                ),
                 names=self.node_names,
             ),
             pods=PodBatch(
                 requests=padded(self.pod_requests, pb),
                 estimated=padded(est, pb),
-                priority_class=jnp.zeros(pb, jnp.int32),
+                # explicit classes from the wire, else derived from the
+                # priority value bands (apis/extension/priority.go:84);
+                # padding is NONE — zeros would mean PROD and wrongly put
+                # padded pods on the prod filter/score path
+                priority_class=jnp.asarray(_pc_column(
+                    self.pod_priority_class, prio, P, pb
+                )),
                 qos=jnp.zeros(pb, jnp.int32),
                 priority=jnp.asarray(pprio),
                 gang_id=jnp.asarray(pgang),

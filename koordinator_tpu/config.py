@@ -14,6 +14,7 @@ from koordinator_tpu.model.snapshot import (
     DEFAULT_ESTIMATED_SCALING_FACTORS,
     DEFAULT_RESOURCE_WEIGHTS,
     DEFAULT_USAGE_THRESHOLDS,
+    PERCENTILES,
 )
 
 LEAST_ALLOCATED = "LeastAllocated"
@@ -31,6 +32,24 @@ def _freeze(m: ResMap) -> Tuple[Tuple[str, int], ...]:
 
 
 @dataclasses.dataclass(frozen=True)
+class AggregatedArgs:
+    """reference config.LoadAwareSchedulingAggregatedArgs (types.go:66):
+    filter/score against an aggregated usage percentile instead of the
+    instantaneous NodeUsage.  Durations are a host-side concern (the
+    snapshot carries one aggregation window's percentiles)."""
+
+    usage_thresholds: ResMap = ()
+    usage_aggregation_type: str = "p99"
+    score_aggregation_type: str = ""  # "" = score on plain NodeUsage
+
+    def __post_init__(self):
+        object.__setattr__(self, "usage_thresholds", _freeze(self.usage_thresholds))
+        for t in (self.usage_aggregation_type, self.score_aggregation_type):
+            if t and t not in PERCENTILES:
+                raise ValueError(f"unknown aggregation type {t!r}")
+
+
+@dataclasses.dataclass(frozen=True)
 class LoadAwareArgs:
     """reference config.LoadAwareSchedulingArgs (types.go:30)."""
 
@@ -39,12 +58,23 @@ class LoadAwareArgs:
     estimated_scaling_factors: ResMap = _freeze(DEFAULT_ESTIMATED_SCALING_FACTORS)
     filter_expired_node_metrics: bool = True
     node_metric_expiration_seconds: int = 180
+    # aggregated-percentile profile (load_aware.go:150-224 filter path,
+    # :311 scoreWithAggregation); None = plain instantaneous usage
+    aggregated: "AggregatedArgs | None" = None
+    # prod-pod usage thresholds: PriorityProd pods filter against the sum
+    # of prod pods' usage instead of whole-node usage (:226 filterProdUsage)
+    prod_usage_thresholds: ResMap = ()
+    # PriorityProd pods score against prod-pods usage (:291)
+    score_according_prod_usage: bool = False
 
     def __post_init__(self):
         object.__setattr__(self, "resource_weights", _freeze(self.resource_weights))
         object.__setattr__(self, "usage_thresholds", _freeze(self.usage_thresholds))
         object.__setattr__(
             self, "estimated_scaling_factors", _freeze(self.estimated_scaling_factors)
+        )
+        object.__setattr__(
+            self, "prod_usage_thresholds", _freeze(self.prod_usage_thresholds)
         )
 
 
@@ -76,8 +106,19 @@ class CycleConfig:
         )
 
     def loadaware_thresholds_arr(self) -> jnp.ndarray:
+        """Filter thresholds: the aggregated profile's when configured
+        (load_aware.go:157-162), else the plain usage thresholds."""
+        agg = self.loadaware.aggregated
+        if agg is not None and agg.usage_thresholds:
+            src = agg.usage_thresholds
+        else:
+            src = self.loadaware.usage_thresholds
+        return jnp.asarray(res.weights_vector(dict(src)), jnp.int64)
+
+    def prod_thresholds_arr(self) -> jnp.ndarray:
         return jnp.asarray(
-            res.weights_vector(dict(self.loadaware.usage_thresholds)), jnp.int64
+            res.weights_vector(dict(self.loadaware.prod_usage_thresholds)),
+            jnp.int64,
         )
 
     def fit_weights_arr(self) -> jnp.ndarray:

@@ -17,7 +17,7 @@ from koordinator_tpu.bridge.codegen import pb2
 from koordinator_tpu.bridge.state import numpy_to_tensor
 from koordinator_tpu.constraints import build_quota_table_inputs
 from koordinator_tpu.model import resources as res
-from koordinator_tpu.model.snapshot import PriorityClass, estimate_pod
+from koordinator_tpu.model.snapshot import PERCENTILES, PriorityClass, estimate_pod
 
 
 def estimate_pods(pods: List[Dict]) -> np.ndarray:
@@ -70,11 +70,34 @@ def build_sync_request(
     req.nodes.metric_fresh.extend(
         bool(n.get("metric_fresh", True)) for n in nodes
     )
+    if any("agg_usage" in n for n in nodes):
+        agg = np.zeros((len(nodes), len(PERCENTILES), res.NUM_RESOURCES), np.int64)
+        agg_fresh = np.zeros((len(nodes), len(PERCENTILES)), np.int64)
+        for i, n in enumerate(nodes):
+            for a, pct in enumerate(PERCENTILES):
+                if pct in n.get("agg_usage", {}):
+                    agg[i, a] = res.resource_vector(n["agg_usage"][pct])
+                    agg_fresh[i, a] = 1
+        req.nodes.agg_usage.CopyFrom(numpy_to_tensor(agg))
+        req.nodes.agg_fresh.CopyFrom(numpy_to_tensor(agg_fresh))
+    if any("prod_usage" in n for n in nodes):
+        prod = np.asarray(
+            [res.resource_vector(n.get("prod_usage", {})) for n in nodes]
+        )
+        req.nodes.prod_usage.CopyFrom(numpy_to_tensor(prod))
 
     req.pods.requests.CopyFrom(numpy_to_tensor(np.asarray(pod_reqs)))
     req.pods.estimated.CopyFrom(numpy_to_tensor(estimate_pods(pods)))
     req.pods.names.extend(p["name"] for p in pods)
     req.pods.priority.extend(int(p.get("priority", 0)) for p in pods)
+    req.pods.priority_class.extend(
+        int(
+            PriorityClass.from_name(p["priority_class"])
+            if p.get("priority_class") is not None
+            else PriorityClass.from_priority_value(p.get("priority"))
+        )
+        for p in pods
+    )
     gidx = {g["name"]: i for i, g in enumerate(gangs)}
     req.pods.gang_id.extend(
         gidx.get(p.get("gang"), -1) for p in pods

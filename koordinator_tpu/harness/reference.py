@@ -80,6 +80,8 @@ class ReferenceCycle:
         quota_runtime: Optional[Dict[int, List[int]]] = None,
         quota_used: Optional[Dict[int, List[int]]] = None,
         quota_limited: Optional[Dict[int, List[bool]]] = None,
+        agg_usage: Optional[Sequence[Optional[Dict[str, Sequence[int]]]]] = None,
+        prod_usage: Optional[Sequence[Sequence[int]]] = None,
     ):
         self.alloc = [list(v) for v in node_allocatable]
         self.requested = [list(v) for v in node_requested]
@@ -91,8 +93,22 @@ class ReferenceCycle:
         self.quota_used = quota_used or {}
         self.quota_limited = quota_limited or {}
         self.la_weights = res.weights_vector(dict(cfg.loadaware.resource_weights))
-        self.la_thresholds = res.weights_vector(dict(cfg.loadaware.usage_thresholds))
+        agg = cfg.loadaware.aggregated
+        if agg is not None and dict(agg.usage_thresholds):
+            thr_src = agg.usage_thresholds
+        else:
+            thr_src = cfg.loadaware.usage_thresholds
+        self.la_thresholds = res.weights_vector(dict(thr_src))
+        self.prod_thresholds = res.weights_vector(
+            dict(cfg.loadaware.prod_usage_thresholds)
+        )
         self.fit_weights = res.weights_vector(dict(cfg.fit_resource_weights))
+        # per-node optional {"p50": vec, ...} aggregated usage and prod-pods
+        # usage sum (load_aware.go:150-226,291-311)
+        self.agg_usage = list(agg_usage) if agg_usage is not None else None
+        self.prod_usage = (
+            [list(v) for v in prod_usage] if prod_usage is not None else None
+        )
 
     # --- Filter -----------------------------------------------------------
     def fit_ok(self, n: int, pod_req: Sequence[int]) -> bool:
@@ -101,15 +117,42 @@ class ReferenceCycle:
                 return False
         return True
 
-    def loadaware_filter_ok(self, n: int) -> bool:
-        # load_aware.go:173-224
+    def loadaware_filter_ok(self, n: int, is_prod: bool = False) -> bool:
+        # load_aware.go:150-258: prod pods with ProdUsageThresholds check
+        # the prod-pods usage sum INSTEAD; aggregated profiles check the
+        # selected percentile (missing aggregates pass); stale metric passes
         if not self.fresh[n]:
             return True
+        if is_prod and any(self.prod_thresholds):
+            # the prod branch is taken on config + pod class alone
+            # (load_aware.go:151); no prod metrics -> pass
+            # (filterProdUsage:227 returns nil on empty PodsMetric)
+            if self.prod_usage is None:
+                return True
+            for r in range(res.NUM_RESOURCES):
+                threshold = self.prod_thresholds[r]
+                if threshold == 0 or self.alloc[n][r] == 0:
+                    continue
+                if (
+                    usage_percent(self.prod_usage[n][r], self.alloc[n][r])
+                    >= threshold
+                ):
+                    return False
+            return True
+        agg = self.cfg.loadaware.aggregated
+        usage = self.usage[n]
+        if agg is not None and self.agg_usage is not None:
+            node_agg = self.agg_usage[n]
+            if node_agg is None:
+                return True  # getTargetAggregatedUsage nil -> pass
+            usage = node_agg.get(agg.usage_aggregation_type)
+            if usage is None:
+                return True  # this percentile not reported -> pass
         for r in range(res.NUM_RESOURCES):
             threshold = self.la_thresholds[r]
             if threshold == 0 or self.alloc[n][r] == 0:
                 continue
-            if usage_percent(self.usage[n][r], self.alloc[n][r]) >= threshold:
+            if usage_percent(usage[r], self.alloc[n][r]) >= threshold:
                 return False
         return True
 
@@ -133,12 +176,33 @@ class ReferenceCycle:
         )
 
     # --- Score ------------------------------------------------------------
-    def loadaware_score(self, n: int, pod_est: Sequence[int]) -> int:
+    def loadaware_score(
+        self, n: int, pod_est: Sequence[int], is_prod: bool = False
+    ) -> int:
         if not self.fresh[n]:
             return 0
+        usage = self.usage[n]
+        if (
+            is_prod
+            and self.cfg.loadaware.score_according_prod_usage
+            and self.prod_usage is not None
+        ):
+            usage = self.prod_usage[n]
+        else:
+            agg = self.cfg.loadaware.aggregated
+            if (
+                agg is not None
+                and agg.score_aggregation_type
+                and self.agg_usage is not None
+                and self.agg_usage[n] is not None
+            ):
+                # missing percentile -> plain NodeUsage
+                usage = self.agg_usage[n].get(
+                    agg.score_aggregation_type, self.usage[n]
+                )
         per_res = [
             least_requested_score(
-                self.usage[n][r] + self.estimated[n][r] + pod_est[r], self.alloc[n][r]
+                usage[r] + self.estimated[n][r] + pod_est[r], self.alloc[n][r]
             )
             for r in range(res.NUM_RESOURCES)
         ]
@@ -158,7 +222,11 @@ class ReferenceCycle:
         return weighted_score(per_res, self.fit_weights)
 
     def combined_score(
-        self, n: int, pod_req: Sequence[int], pod_est: Sequence[int]
+        self,
+        n: int,
+        pod_req: Sequence[int],
+        pod_est: Sequence[int],
+        is_prod: bool = False,
     ) -> int:
         total = 0
         if self.cfg.enable_fit_score:
@@ -166,12 +234,18 @@ class ReferenceCycle:
                 n, nonzero_request(pod_req)
             )
         if self.cfg.enable_loadaware:
-            total += self.cfg.loadaware_plugin_weight * self.loadaware_score(n, pod_est)
+            total += self.cfg.loadaware_plugin_weight * self.loadaware_score(
+                n, pod_est, is_prod
+            )
         return total
 
     # --- One pod ----------------------------------------------------------
     def schedule_one(
-        self, pod_req: Sequence[int], pod_est: Sequence[int], quota_id: int = -1
+        self,
+        pod_req: Sequence[int],
+        pod_est: Sequence[int],
+        quota_id: int = -1,
+        is_prod: bool = False,
     ) -> Tuple[int, List[int]]:
         """Filter+Score+Reserve for one pod; returns (node or -1, score row)."""
         n_nodes = len(self.alloc)
@@ -182,9 +256,12 @@ class ReferenceCycle:
             feasible = (
                 quota_fits
                 and self.fit_ok(n, pod_req)
-                and (not self.cfg.enable_loadaware or self.loadaware_filter_ok(n))
+                and (
+                    not self.cfg.enable_loadaware
+                    or self.loadaware_filter_ok(n, is_prod)
+                )
             )
-            s = self.combined_score(n, pod_req, pod_est)
+            s = self.combined_score(n, pod_req, pod_est, is_prod)
             scores[n] = s
             if feasible and (best_score is None or s > best_score):
                 best, best_score = n, s
@@ -204,6 +281,7 @@ class ReferenceCycle:
         pod_estimated: Sequence[Sequence[int]],
         priorities: Optional[Sequence[int]] = None,
         quota_ids: Optional[Sequence[int]] = None,
+        is_prod: Optional[Sequence[bool]] = None,
     ) -> List[int]:
         """Sequential cycle over the batch in queue order (priority desc)."""
         n_pods = len(pod_requests)
@@ -214,5 +292,10 @@ class ReferenceCycle:
         assignment = [-1] * n_pods
         for i in order:
             qid = quota_ids[i] if quota_ids else -1
-            assignment[i], _ = self.schedule_one(pod_requests[i], pod_estimated[i], qid)
+            assignment[i], _ = self.schedule_one(
+                pod_requests[i],
+                pod_estimated[i],
+                qid,
+                bool(is_prod[i]) if is_prod is not None else False,
+            )
         return assignment

@@ -45,6 +45,11 @@ DEFAULT_USAGE_THRESHOLDS = {res.CPU: 65, res.MEMORY: 95}
 DEFAULT_ESTIMATED_SCALING_FACTORS = {res.CPU: 85, res.MEMORY: 70}
 DEFAULT_NODE_METRIC_EXPIRATION_SECONDS = 180
 
+# NodeMetric aggregated-usage percentiles carried by NodeBatch.agg_usage
+# (reference slov1alpha1 AggregationType; the statesinformer aggregates
+# these four windows, ``impl/states_nodemetric.go:324``)
+PERCENTILES = ("p50", "p90", "p95", "p99")
+
 
 class PriorityClass(enum.IntEnum):
     """Koordinator priority bands (reference apis/extension/priority.go:29)."""
@@ -139,6 +144,16 @@ class NodeBatch:
     usage: jnp.ndarray  # i64[N, R] measured usage from NodeMetric
     metric_fresh: jnp.ndarray  # bool[N] NodeMetric exists and is not expired
     valid: jnp.ndarray  # bool[N] padding mask
+    # LoadAware aggregated/prod extensions (reference
+    # ``plugins/loadaware/load_aware.go:150-226,291-311``; None = the node
+    # source reported no such data and the plain tensors apply):
+    # aggregated usage percentiles, axis order config.PERCENTILES
+    agg_usage: "jnp.ndarray | None" = None  # i64[N, A, R]
+    # which (node, percentile) cells carry real data — a node may report
+    # only some percentiles; missing ones fall back like the reference's
+    # nil getTargetAggregatedUsage (filter passes, score uses plain usage)
+    agg_fresh: "jnp.ndarray | None" = None  # bool[N, A]
+    prod_usage: "jnp.ndarray | None" = None  # i64[N, R] sum of prod pods' usage
     names: Tuple[str, ...] = ()
 
     @property
@@ -215,7 +230,19 @@ class ClusterSnapshot:
 # Snapshot containers cross the jit boundary: register as pytrees with the
 # host-side name tuples as static aux data.
 for _cls, _data in (
-    (NodeBatch, ["allocatable", "requested", "usage", "metric_fresh", "valid"]),
+    (
+        NodeBatch,
+        [
+            "allocatable",
+            "requested",
+            "usage",
+            "metric_fresh",
+            "valid",
+            "agg_usage",
+            "agg_fresh",
+            "prod_usage",
+        ],
+    ),
     (
         PodBatch,
         [
@@ -337,12 +364,26 @@ def encode_snapshot(
     node_usage = np.zeros((n_bucket, R), np.int64)
     node_fresh = np.zeros((n_bucket,), bool)
     node_valid = np.zeros((n_bucket,), bool)
+    n_pct = len(PERCENTILES)
+    node_agg = np.zeros((n_bucket, n_pct, R), np.int64)
+    node_agg_fresh = np.zeros((n_bucket, n_pct), bool)
+    node_prod = np.zeros((n_bucket, R), np.int64)
     for i, nd in enumerate(nodes):
         node_alloc[i] = res.resource_vector(nd.get("allocatable", {}))
         node_req[i] = res.resource_vector(nd.get("requested", {}))
         node_usage[i] = res.resource_vector(nd.get("usage", {}))
         node_fresh[i] = bool(nd.get("metric_fresh", True))
         node_valid[i] = True
+        # aggregated percentile usage: {"p50": {res: qty}, ...} — nodes
+        # whose koordlet reported AggregatedNodeUsages
+        agg = nd.get("agg_usage")
+        if agg:
+            for a, pct in enumerate(PERCENTILES):
+                if pct in agg:
+                    node_agg[i, a] = res.resource_vector(agg[pct])
+                    node_agg_fresh[i, a] = True
+        if nd.get("prod_usage") is not None:
+            node_prod[i] = res.resource_vector(nd["prod_usage"])
 
     pod_req = np.zeros((p_bucket, R), np.int64)
     pod_est = np.zeros((p_bucket, R), np.int64)
@@ -407,6 +448,9 @@ def encode_snapshot(
             usage=jnp.asarray(node_usage),
             metric_fresh=jnp.asarray(node_fresh),
             valid=jnp.asarray(node_valid),
+            agg_usage=jnp.asarray(node_agg),
+            agg_fresh=jnp.asarray(node_agg_fresh),
+            prod_usage=jnp.asarray(node_prod),
             names=tuple(nd.get("name", f"node-{i}") for i, nd in enumerate(nodes)),
         ),
         pods=PodBatch(

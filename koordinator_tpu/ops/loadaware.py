@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from koordinator_tpu.model.snapshot import PERCENTILES
 from koordinator_tpu.ops.scoring import least_requested_score, weighted_resource_score
 
 
@@ -67,3 +68,86 @@ def loadaware_filter_mask(
     checked = (thresholds[None, :] > 0) & (node_allocatable > 0)
     exceeded = jnp.any(checked & (pct >= thresholds[None, :]), axis=-1)
     return ~exceeded | ~metric_fresh
+
+
+def _threshold_mask(node_usage, node_allocatable, thresholds, metric_fresh):
+    pct = usage_percent(node_usage, node_allocatable)
+    checked = (thresholds[None, :] > 0) & (node_allocatable > 0)
+    exceeded = jnp.any(checked & (pct >= thresholds[None, :]), axis=-1)
+    return ~exceeded | ~metric_fresh
+
+
+def loadaware_node_masks(nodes, cfg):
+    """Per-node Filter masks -> (mask_default bool[N], mask_prod bool[N]).
+
+    Reference ``load_aware.go:150-226``:
+
+    * with an aggregated profile, non-prod pods filter against the selected
+      usage percentile and the profile's thresholds; nodes that reported no
+      aggregates pass (``getTargetAggregatedUsage`` nil -> continue)
+    * PriorityProd pods with ProdUsageThresholds configured filter against
+      the node's prod-pods usage sum INSTEAD of whole-node usage
+    * expired/missing NodeMetric always passes (Filter skips those nodes)
+    """
+    thr = cfg.loadaware_thresholds_arr()
+    agg = cfg.loadaware.aggregated
+    if agg is not None and nodes.agg_usage is not None:
+        a = PERCENTILES.index(agg.usage_aggregation_type)
+        mask_default = _threshold_mask(
+            nodes.agg_usage[:, a], nodes.allocatable, thr, nodes.metric_fresh
+        )
+        if nodes.agg_fresh is not None:
+            # a (node, percentile) cell with no data passes the filter
+            # (getTargetAggregatedUsage nil -> continue)
+            mask_default = mask_default | ~nodes.agg_fresh[:, a]
+    else:
+        mask_default = _threshold_mask(
+            nodes.usage, nodes.allocatable, thr, nodes.metric_fresh
+        )
+    if dict(cfg.loadaware.prod_usage_thresholds):
+        # the prod branch is selected by CONFIG + pod class alone
+        # (load_aware.go:151); a node with no prod-pods metrics passes
+        # (filterProdUsage:227 returns nil on empty PodsMetric), which
+        # zeros reproduce exactly
+        pu = (
+            nodes.prod_usage
+            if nodes.prod_usage is not None
+            else jnp.zeros_like(nodes.usage)
+        )
+        mask_prod = _threshold_mask(
+            pu,
+            nodes.allocatable,
+            cfg.prod_thresholds_arr(),
+            nodes.metric_fresh,
+        )
+    else:
+        mask_prod = mask_default
+    return mask_default, mask_prod
+
+
+def select_score_usage(nodes, cfg):
+    """Score-phase usage tensors -> (usage_nonprod i64[N, R], usage_prod or
+    None).
+
+    Reference ``load_aware.go:291-327``: non-prod pods score against the
+    score-aggregation percentile when configured (plain NodeUsage for nodes
+    without aggregates), PriorityProd pods score against the prod-pods
+    usage sum when ScoreAccordingProdUsage is set.
+    """
+    agg = cfg.loadaware.aggregated
+    usage = nodes.usage
+    if (
+        agg is not None
+        and agg.score_aggregation_type
+        and nodes.agg_usage is not None
+    ):
+        a = PERCENTILES.index(agg.score_aggregation_type)
+        sel = nodes.agg_usage[:, a]
+        if nodes.agg_fresh is not None:
+            # missing percentile -> plain NodeUsage for that node
+            sel = jnp.where(nodes.agg_fresh[:, a, None], sel, usage)
+        usage = sel
+    prod = None
+    if cfg.loadaware.score_according_prod_usage and nodes.prod_usage is not None:
+        prod = nodes.prod_usage
+    return usage, prod

@@ -42,7 +42,11 @@ from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG
 from koordinator_tpu.constraints.gang import gang_satisfaction
 from koordinator_tpu.model.snapshot import ClusterSnapshot
 from koordinator_tpu.ops.fit import nonzero_requests
-from koordinator_tpu.ops.loadaware import loadaware_filter_mask
+from koordinator_tpu.ops.loadaware import (
+    loadaware_node_masks,
+    select_score_usage,
+)
+from koordinator_tpu.model.snapshot import PriorityClass
 from koordinator_tpu.solver.greedy import (
     STATUS_ASSIGNED,
     STATUS_UNSCHEDULABLE,
@@ -99,7 +103,23 @@ def _assign_sharded(
     order = queue_order(pods.priority, pods.valid)
     score_requests = nonzero_requests(pods.requests)
 
-    la_thresh = cfg.loadaware_thresholds_arr()
+    # LoadAware masks + score-usage selection (aggregated/prod profiles,
+    # load_aware.go:150-226,291-311) are node-local: compute once host-side
+    # and shard them with the node axis
+    mask_default, mask_prod = loadaware_node_masks(nodes, cfg)
+    if not cfg.enable_loadaware:
+        mask_default = jnp.ones_like(mask_default)
+        mask_prod = mask_default
+    node_ok_default = nodes.valid & mask_default
+    node_ok_prod = nodes.valid & mask_prod
+    usage_np, usage_prod = select_score_usage(nodes, cfg)
+    prod_sensitive = cfg.enable_loadaware and (
+        usage_prod is not None
+        or bool(dict(cfg.loadaware.prod_usage_thresholds))
+    )
+    if usage_prod is None:
+        usage_prod = usage_np
+    is_prod_pods = pods.priority_class == int(PriorityClass.PROD)
 
     node_spec = P(ax, None)
     flag_spec = P(ax)
@@ -109,8 +129,10 @@ def _assign_sharded(
     operands = [
         nodes.allocatable,
         nodes.requested,
-        nodes.usage,
-        nodes.valid,
+        usage_np,
+        usage_prod,
+        node_ok_default,
+        node_ok_prod,
         nodes.metric_fresh,
         order,
         pods.requests,
@@ -118,13 +140,14 @@ def _assign_sharded(
         pods.estimated,
         pods.quota_id,
         pods.valid,
+        is_prod_pods,
         quotas.runtime,
         quotas.limited,
         quotas.used,
     ]
     in_specs = [
-        node_spec, node_spec, node_spec, flag_spec, flag_spec,
-        rep, rep, rep, rep, rep, rep, rep, rep, rep,
+        node_spec, node_spec, node_spec, node_spec, flag_spec, flag_spec,
+        flag_spec, rep, rep, rep, rep, rep, rep, rep, rep, rep, rep,
     ]
     if has_mask:
         operands.append(extra_mask)
@@ -134,8 +157,8 @@ def _assign_sharded(
         in_specs.append(pn_spec)
 
     def body(
-        alloc, req0, usage, valid, fresh,
-        order, preq, psreq, pest, pqid, pvalid, qrt, qlim, quse0,
+        alloc, req0, usage, uprod, node_ok_def, node_ok_pr, fresh,
+        order, preq, psreq, pest, pqid, pvalid, pprod, qrt, qlim, quse0,
         *extras,
     ):
         xmask = extras[0] if has_mask else None
@@ -144,17 +167,18 @@ def _assign_sharded(
         offset = lax.axis_index(ax).astype(jnp.int64) * n_loc
         gidx = offset + jnp.arange(n_loc, dtype=jnp.int64)
 
-        la_mask = loadaware_filter_mask(usage, alloc, la_thresh, fresh)
-        if not cfg.enable_loadaware:
-            la_mask = jnp.ones_like(la_mask)
-        node_ok = valid & la_mask
-
         def step(state, p):
             node_requested, node_estimated, quota_used = state
             req = preq[p]
             est = pest[p]
             qid = pqid[p]
             q = jnp.maximum(qid, 0)
+            if prod_sensitive:
+                node_ok_p = jnp.where(pprod[p], node_ok_pr, node_ok_def)
+                usage_p = jnp.where(pprod[p], uprod, usage)
+            else:
+                node_ok_p = node_ok_def
+                usage_p = usage
 
             # same step semantics as greedy_assign, on the local node shard
             feasible, total = step_feasible_scores(
@@ -162,9 +186,9 @@ def _assign_sharded(
                 node_estimated,
                 quota_used,
                 alloc,
-                usage,
+                usage_p,
                 fresh,
-                node_ok,
+                node_ok_p,
                 req,
                 psreq[p],
                 est,

@@ -33,7 +33,11 @@ from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG, MOST_ALLOC
 from koordinator_tpu.constraints.gang import gang_satisfaction
 from koordinator_tpu.model.snapshot import ClusterSnapshot
 from koordinator_tpu.ops.fit import fit_mask, nonzero_requests
-from koordinator_tpu.ops.loadaware import loadaware_filter_mask, loadaware_scores
+from koordinator_tpu.ops.loadaware import (
+    loadaware_node_masks,
+    select_score_usage,
+)
+from koordinator_tpu.model.snapshot import PriorityClass
 from koordinator_tpu.ops.scoring import (
     least_requested_score,
     most_requested_score,
@@ -108,7 +112,16 @@ def _combined_scores(
             per_res, cfg.fit_weights_arr()
         )
     if cfg.enable_loadaware:
-        est_used = nodes.usage + node_estimated + pod_estimated[..., None, :]
+        usage_np, usage_prod = select_score_usage(nodes, cfg)
+        usage_sel = usage_np[None, :, :]
+        if usage_prod is not None:
+            is_prod = (
+                snapshot.pods.priority_class == int(PriorityClass.PROD)
+            )
+            usage_sel = jnp.where(
+                is_prod[:, None, None], usage_prod[None, :, :], usage_sel
+            )
+        est_used = usage_sel + node_estimated + pod_estimated[..., None, :]
         per_res = least_requested_score(est_used, nodes.allocatable)
         la = weighted_resource_score(per_res, cfg.loadaware_weights_arr())
         la = jnp.where(nodes.metric_fresh, la, 0)
@@ -181,13 +194,12 @@ def score_cycle(snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONF
         pods.requests, nodes.requested, nodes.allocatable, nodes.valid, pods.valid
     )
     if cfg.enable_loadaware:
-        la_mask = loadaware_filter_mask(
-            nodes.usage,
-            nodes.allocatable,
-            cfg.loadaware_thresholds_arr(),
-            nodes.metric_fresh,
+        mask_default, mask_prod = loadaware_node_masks(nodes, cfg)
+        is_prod = pods.priority_class == int(PriorityClass.PROD)
+        la_mask = jnp.where(
+            is_prod[:, None], mask_prod[None, :], mask_default[None, :]
         )
-        feasible = feasible & la_mask[None, :]
+        feasible = feasible & la_mask
     zero_nr = jnp.zeros_like(nodes.requested)
     scores = _combined_scores(
         snapshot,
@@ -228,16 +240,17 @@ def greedy_assign(
     order = queue_order(pods.priority, pods.valid)
     score_requests = _fit_score_requests(pods.requests)
 
-    la_mask = loadaware_filter_mask(
-        nodes.usage,
-        nodes.allocatable,
-        cfg.loadaware_thresholds_arr(),
-        nodes.metric_fresh,
-    )
+    mask_default, mask_prod = loadaware_node_masks(nodes, cfg)
     if not cfg.enable_loadaware:
-        la_mask = jnp.ones_like(la_mask)
-
-    node_ok = nodes.valid & la_mask
+        mask_default = jnp.ones_like(mask_default)
+        mask_prod = mask_default
+    node_ok_default = nodes.valid & mask_default
+    node_ok_prod = nodes.valid & mask_prod
+    usage_np, usage_prod = select_score_usage(nodes, cfg)
+    prod_sensitive = cfg.enable_loadaware and (
+        usage_prod is not None
+        or bool(dict(cfg.loadaware.prod_usage_thresholds))
+    )
 
     def step(state, p):
         node_requested, node_estimated, quota_used = state
@@ -245,15 +258,26 @@ def greedy_assign(
         est = pods.estimated[p]
         qid = pods.quota_id[p]
         q = jnp.maximum(qid, 0)
+        if prod_sensitive:
+            is_prod_p = pods.priority_class[p] == int(PriorityClass.PROD)
+            node_ok_p = jnp.where(is_prod_p, node_ok_prod, node_ok_default)
+            usage_p = (
+                jnp.where(is_prod_p, usage_prod, usage_np)
+                if usage_prod is not None
+                else usage_np
+            )
+        else:
+            node_ok_p = node_ok_default
+            usage_p = usage_np
 
         feasible, scores = step_feasible_scores(
             node_requested,
             node_estimated,
             quota_used,
             nodes.allocatable,
-            nodes.usage,
+            usage_p,
             nodes.metric_fresh,
-            node_ok,
+            node_ok_p,
             req,
             score_requests[p],
             est,

@@ -39,7 +39,11 @@ from koordinator_tpu.constraints.gang import gang_satisfaction
 from koordinator_tpu.model import resources as res
 from koordinator_tpu.model.snapshot import MAX_NODE_SCORE, ClusterSnapshot
 from koordinator_tpu.ops.fit import nonzero_requests
-from koordinator_tpu.ops.loadaware import loadaware_filter_mask
+from koordinator_tpu.ops.loadaware import (
+    loadaware_node_masks,
+    select_score_usage,
+)
+from koordinator_tpu.model.snapshot import PriorityClass
 from koordinator_tpu.solver.greedy import (
     STATUS_ASSIGNED,
     STATUS_UNSCHEDULABLE,
@@ -121,25 +125,34 @@ def _cycle_kernel(
     # scalar prefetch (SMEM)
     qid_ref,  # i32[P] quota id per sorted pod (-1 = none)
     pvalid_ref,  # i32[P]
+    pprod_ref,  # i32[P] 1 = PriorityProd pod (prod filter/score selection)
     # inputs (VMEM)
     preq_ref,  # i32[B, 128] pod requests (sorted)
     psreq_ref,  # i32[B, 128] nonzero-default score requests
     pest_ref,  # i32[B, 128] estimator output
     alloc_ref,  # i32[N, 128]
-    usage_ref,  # i32[N, 128]
+    usage_ref,  # i32[N, 128] score usage (aggregated pre-selected on host)
     req0_ref,  # i32[N, 128] initial node requested
-    flags_ref,  # i32[N, 128] lane0 = valid & la_mask, lane1 = metric_fresh
+    flags_ref,  # i32[N, 128] lane0 = valid & la_mask, lane1 = metric_fresh,
+    # lane2 = valid & prod la_mask
     qrt_ref,  # i32[Q, 128] quota runtime
     qlim_ref,  # i32[Q, 128] quota limited mask
     quse0_ref,  # i32[Q, 128] initial quota used
     w_ref,  # i32[8, 128] row0 = fit weights, row1 = loadaware weights
-    *rest,  # optional: xmask_ref i32[N, B], xscore_ref i32[N, B] — the
+    *rest,  # optional: uprod_ref i32[N, 128] (prod-pods usage, has_prod);
+    # optional: xmask_ref i32[N, B], xscore_ref i32[N, B] — the
     # extended-plugin (NUMA/reservation/deviceshare) tensors, pods on the
     # lane axis so each step extracts a [N, 1] column — then outputs/scratch
     block: int,
     cfg: CycleConfig,
     has_extras: bool,
+    has_prod: bool,
 ):
+    if has_prod:
+        uprod_ref = rest[0]
+        rest = rest[1:]
+    else:
+        uprod_ref = None
     if has_extras:
         xmask_ref, xscore_ref = rest[0], rest[1]
         rest = rest[2:]
@@ -183,6 +196,18 @@ def _cycle_kernel(
         qid = qid_ref[p]
         is_valid = pvalid_ref[p] != _i32(0)
         qidx = jnp.maximum(qid, _i32(0))
+        if has_prod:
+            is_prod = pprod_ref[p] != _i32(0)
+            # select the i32 flag lanes, compare after: a select over i1
+            # vectors has no Mosaic legalization ('arith.select')
+            node_ok_p = (
+                jnp.where(is_prod, flags_ref[:, 2:3], flags_ref[:, 0:1])
+                != _i32(0)
+            )
+            usage_p = jnp.where(is_prod, uprod_ref[:], usage_ref[:])
+        else:
+            node_ok_p = node_ok
+            usage_p = usage_ref[:]
 
         nreq = nreq_ref[:]
         # Filter: Fit (only requested resources constrain) + node flags
@@ -207,7 +232,7 @@ def _cycle_kernel(
             jnp.int32(0),
         )
         qok = jnp.max(qviol) == _i32(0)
-        feasible = fits & node_ok & ((qid < _i32(0)) | qok) & is_valid
+        feasible = fits & node_ok_p & ((qid < _i32(0)) | qok) & is_valid
         if has_extras:
             # extract this pod's [N, 1] column by one-hot lane reduction
             # (dynamic lane slicing is costly on the VPU; a masked lane
@@ -233,7 +258,7 @@ def _cycle_kernel(
                 per_res, fit_w_row, fit_w_sum
             )
         if cfg.enable_loadaware:
-            est_used = usage_ref[:] + nest_ref[:] + est
+            est_used = usage_p + nest_ref[:] + est
             per_res = _least_requested(est_used, alloc, recip)
             la = _weighted(per_res, la_w_row, la_w_sum)
             total = total + _i32(cfg.loadaware_plugin_weight) * jnp.where(fresh, la, _i32(0))
@@ -278,14 +303,15 @@ def _cycle_kernel(
 
 @partial(jax.jit, static_argnames=("cfg", "block", "interpret"))
 def _run_cycle(
-    preq, psreq, pest, qid, pvalid, alloc, usage, req0, flags, qrt, qlim, quse0,
-    weights, xmask=None, xscore=None, *, cfg: CycleConfig, block: int,
-    interpret: bool
+    preq, psreq, pest, qid, pvalid, pprod, alloc, usage, req0, flags, qrt,
+    qlim, quse0, weights, uprod=None, xmask=None, xscore=None, *,
+    cfg: CycleConfig, block: int, interpret: bool
 ):
     P = preq.shape[0]
     N = alloc.shape[0]
     Q = qrt.shape[0]
     has_extras = xmask is not None
+    has_prod = uprod is not None
     grid = (P // block,)
     # index maps return strong-i32 zeros: with x64 on, a literal 0 becomes
     # an i64 constant in the lowered index-map func, which Mosaic rejects
@@ -301,6 +327,9 @@ def _run_cycle(
         + [pl.BlockSpec((8, LANES), lambda i, *_: (_z, _z), memory_space=pltpu.VMEM)]
     )
     operands = [preq, psreq, pest, alloc, usage, req0, flags, qrt, qlim, quse0, weights]
+    if has_prod:
+        in_specs += [node_spec]
+        operands += [uprod]
     if has_extras:
         # [N, P] with pods on lanes: each grid step streams a (N, block) tile
         xtra_spec = pl.BlockSpec(
@@ -309,7 +338,7 @@ def _run_cycle(
         in_specs += [xtra_spec, xtra_spec]
         operands += [xmask, xscore]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=grid,
         in_specs=in_specs,
         out_specs=[pod_spec, node_spec, node_spec, quota_spec],
@@ -319,7 +348,13 @@ def _run_cycle(
             pltpu.VMEM((Q, LANES), jnp.int32),
         ],
     )
-    kernel = partial(_cycle_kernel, block=block, cfg=cfg, has_extras=has_extras)
+    kernel = partial(
+        _cycle_kernel,
+        block=block,
+        cfg=cfg,
+        has_extras=has_extras,
+        has_prod=has_prod,
+    )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -330,7 +365,7 @@ def _run_cycle(
             jax.ShapeDtypeStruct((Q, LANES), jnp.int32),
         ],
         interpret=interpret,
-    )(qid, pvalid, *operands)
+    )(qid, pvalid, pprod, *operands)
 
 
 def greedy_assign_pallas(
@@ -405,22 +440,35 @@ def _greedy_assign_pallas(
     qid = jnp.pad(pods.quota_id[order].astype(jnp.int32), (0, P_pad - P))
     pvalid = jnp.pad(pods.valid[order].astype(jnp.int32), (0, P_pad - P))
 
-    la_mask = loadaware_filter_mask(
-        nodes.usage,
-        nodes.allocatable,
-        cfg.loadaware_thresholds_arr(),
-        nodes.metric_fresh,
-    )
+    # LoadAware masks + score-usage selection (aggregated/prod profiles):
+    # aggregated percentiles are selected host-side (static config), only
+    # the prod-vs-default choice is per-pod and rides into the kernel
+    mask_default, mask_prod = loadaware_node_masks(nodes, cfg)
     if not cfg.enable_loadaware:
-        la_mask = jnp.ones_like(la_mask)
+        mask_default = jnp.ones_like(mask_default)
+        mask_prod = mask_default
+    usage_np, usage_prod = select_score_usage(nodes, cfg)
+    prod_sensitive = cfg.enable_loadaware and (
+        usage_prod is not None
+        or bool(dict(cfg.loadaware.prod_usage_thresholds))
+    )
+    is_prod = pods.priority_class == int(PriorityClass.PROD)
+    pprod = jnp.pad(is_prod[order].astype(jnp.int32), (0, P_pad - P))
     flags = jnp.stack(
         [
-            (nodes.valid & la_mask).astype(jnp.int32),
+            (nodes.valid & mask_default).astype(jnp.int32),
             nodes.metric_fresh.astype(jnp.int32),
+            (nodes.valid & mask_prod).astype(jnp.int32),
         ],
         axis=1,
     )
     flags = _pad_rows(jnp.pad(flags, ((0, 0), (0, LANES - flags.shape[1]))), N_pad)
+    if prod_sensitive:
+        uprod = _pad_rows(
+            _lanes(usage_prod if usage_prod is not None else usage_np), N_pad
+        )
+    else:
+        uprod = None
 
     Q = max(8, quotas.runtime.shape[0])
     Q = -(-Q // 8) * 8
@@ -461,14 +509,16 @@ def _greedy_assign_pallas(
         pest,
         qid,
         pvalid,
+        pprod,
         _pad_rows(_lanes(nodes.allocatable), N_pad),
-        _pad_rows(_lanes(nodes.usage), N_pad),
+        _pad_rows(_lanes(usage_np), N_pad),
         _pad_rows(_lanes(nodes.requested), N_pad),
         flags,
         qrt,
         qlim,
         quse0,
         weights,
+        uprod,
         xmask,
         xscore,
         cfg=cfg,
