@@ -296,7 +296,11 @@ class ResidentState:
                     else None
                 ),
                 prod_usage=(
-                    padded(self.node_prod, nb)
+                    jnp.asarray(
+                        _pad_rows_to(
+                            np.asarray(self.node_prod, np.int64), nb
+                        )
+                    )
                     if self.node_prod is not None and self.node_prod.size
                     else None
                 ),

@@ -44,9 +44,17 @@ class AggregatedArgs:
 
     def __post_init__(self):
         object.__setattr__(self, "usage_thresholds", _freeze(self.usage_thresholds))
-        for t in (self.usage_aggregation_type, self.score_aggregation_type):
-            if t and t not in PERCENTILES:
-                raise ValueError(f"unknown aggregation type {t!r}")
+        # the filter aggregation type is mandatory (the profile exists to
+        # select a percentile); only the score type may be empty (= score
+        # on plain NodeUsage)
+        if self.usage_aggregation_type not in PERCENTILES:
+            raise ValueError(
+                f"unknown usage_aggregation_type {self.usage_aggregation_type!r}"
+            )
+        if self.score_aggregation_type and self.score_aggregation_type not in PERCENTILES:
+            raise ValueError(
+                f"unknown score_aggregation_type {self.score_aggregation_type!r}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

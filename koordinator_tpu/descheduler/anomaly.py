@@ -82,7 +82,10 @@ class BasicDetector:
         self._clock = clock
         self._state = State.OK
         self.counter = Counter()
-        self._expiration = self._clock() + self.timeout
+        # seeded on the FIRST observation, in the caller's time base: the
+        # constructor's wall clock and a caller-driven simulated ``now``
+        # would otherwise mix bases and roll generations spuriously
+        self._expiration: Optional[float] = None
 
     def state(self, now: Optional[float] = None) -> State:
         self._maybe_roll_generation(now)
@@ -106,10 +109,13 @@ class BasicDetector:
         """Back to OK with cleared counters (reference ``Reset``)."""
         self.counter.clear()
         self._set_state(State.OK)
-        self._expiration = self._clock() + self.timeout
+        self._expiration = None
 
     def _maybe_roll_generation(self, now: Optional[float] = None):
         now = self._clock() if now is None else now
+        if self._expiration is None:
+            self._expiration = now + self.timeout
+            return
         if now >= self._expiration:
             self.counter.clear()
             self._expiration = now + self.timeout

@@ -70,6 +70,12 @@ class PodEvictor:
     def total_evicted(self) -> int:
         return len(self.evicted)
 
+    def reset(self) -> None:
+        """Per-tick counter reset (descheduler.go:269 evictionLimiter.Reset
+        before running the profiles); the eviction audit trail persists."""
+        self.node_counts.clear()
+        self.namespace_counts.clear()
+
     def evict(self, pod: Mapping, node: str, reason: str = "") -> bool:
         ns = pod.get("namespace", "default")
         if self.max_pods_per_node is not None and self.node_counts.get(node, 0) >= self.max_pods_per_node:

@@ -70,11 +70,8 @@ def loadaware_filter_mask(
     return ~exceeded | ~metric_fresh
 
 
-def _threshold_mask(node_usage, node_allocatable, thresholds, metric_fresh):
-    pct = usage_percent(node_usage, node_allocatable)
-    checked = (thresholds[None, :] > 0) & (node_allocatable > 0)
-    exceeded = jnp.any(checked & (pct >= thresholds[None, :]), axis=-1)
-    return ~exceeded | ~metric_fresh
+# one implementation of the threshold check: loadaware_filter_mask above
+_threshold_mask = loadaware_filter_mask
 
 
 def loadaware_node_masks(nodes, cfg):

@@ -1,0 +1,248 @@
+"""Descheduler runtime: profiles, plugin registry, and the ticking loop.
+
+Reference: ``pkg/descheduler/descheduler.go:241,259`` (Start /
+deschedulerOnce), ``framework/runtime/framework.go:121,310,330``.
+End-to-end: two ticks drive LowNodeLoad -> MigrationController ->
+PodEvictor through an actual eviction with the anomaly debounce engaged
+(first overutilized observation does not evict; the second does).
+"""
+
+from typing import List
+
+import pytest
+
+from koordinator_tpu.descheduler.anomaly import BasicDetector
+from koordinator_tpu.descheduler.evictions import PodEvictor
+from koordinator_tpu.descheduler.lownodeload import LowNodeLoadArgs, NodePool
+from koordinator_tpu.descheduler.migration import (
+    MigrationController,
+    MigrationControllerArgs,
+)
+from koordinator_tpu.descheduler.runtime import (
+    Descheduler,
+    DeschedulerProfile,
+    PluginSet,
+    Status,
+)
+
+Gi = 1024 * 1024 * 1024
+
+
+def _pod(name, cpu="2000m", mem=4 * Gi, namespace="default"):
+    return {
+        "name": name,
+        "namespace": namespace,
+        "requests": {"cpu": cpu, "memory": mem},
+        "usage": {"cpu": cpu, "memory": mem},
+        "priority": 5000,
+        # bare pods are never evictable (upstream DefaultEvictor)
+        "owner_references": [{"kind": "ReplicaSet", "name": "rs-web"}],
+    }
+
+
+def _cluster() -> List[dict]:
+    # one hot node (90% cpu) + three cold nodes
+    hot_pods = [_pod(f"hot-{i}") for i in range(7)]
+    nodes = [
+        {
+            "name": "hot",
+            "allocatable": {"cpu": "16000m", "memory": 64 * Gi},
+            "usage": {"cpu": "14400m", "memory": 30 * Gi},
+            "pods": hot_pods,
+        }
+    ]
+    for i in range(3):
+        nodes.append(
+            {
+                "name": f"cold-{i}",
+                "allocatable": {"cpu": "16000m", "memory": 64 * Gi},
+                "usage": {"cpu": "1600m", "memory": 4 * Gi},
+                "pods": [],
+            }
+        )
+    return nodes
+
+
+def _profile(consecutive=2):
+    return DeschedulerProfile(
+        name="koord-descheduler",
+        plugins=PluginSet(balance=["LowNodeLoad"]),
+        plugin_config={
+            "LowNodeLoad": LowNodeLoadArgs(
+                node_pools=[
+                    NodePool(
+                        low_thresholds={"cpu": 30, "memory": 30},
+                        high_thresholds={"cpu": 70, "memory": 70},
+                        consecutive_abnormalities=consecutive,
+                    )
+                ]
+            )
+        },
+    )
+
+
+class TestDeschedulerLoop:
+    def test_two_ticks_evict_with_anomaly_debounce(self):
+        """Ticks 1-2 observe the overload (debounce: no eviction); tick 3
+        confirms the anomaly and drives jobs through the
+        MigrationController into real evictions."""
+        nodes = _cluster()
+        evictor = PodEvictor()
+        migration = MigrationController(
+            args=MigrationControllerArgs(
+                default_job_mode="EvictDirectly",
+                max_concurrent_reclaims_per_node=2,
+            ),
+            evict=lambda pod: evictor.evict(
+                pod, pod.get("node", ""), reason="migration"
+            ),
+        )
+        # ticks 10s apart, inside the 60s anomaly generation window
+        clock = iter([100.0, 110.0, 120.0]).__next__
+        d = Descheduler(
+            [_profile(consecutive=2)],
+            nodes_fn=lambda: nodes,
+            evictor=evictor,
+            migration=migration,
+            clock=clock,
+        )
+
+        # the reference condition is consecutiveAbnormalities > N
+        # (filterRealAbnormalNodes, low_node_load.go:273): with N=2 the
+        # detector arms on ticks 1-2 and trips on tick 3
+        for tick in (1, 2):
+            status = d.descheduler_once()
+            assert status.ok
+            assert evictor.total_evicted() == 0, f"tick {tick} must debounce"
+            assert not migration.jobs
+
+        status = d.descheduler_once()
+        assert status.ok
+        # anomaly confirmed -> LowNodeLoad plans evictions, the
+        # MigrationController arbitrates (2 per node cap) and evicts
+        assert migration.jobs, "expected PodMigrationJobs"
+        assert evictor.total_evicted() == 2  # per-node concurrency cap
+        assert all(r.node == "hot" for r in evictor.evicted)
+
+    def test_single_node_cluster_aborts_tick(self):
+        d = Descheduler(
+            [_profile()],
+            nodes_fn=lambda: [_cluster()[0]],
+        )
+        status = d.descheduler_once()
+        assert not status.ok
+        assert "cluster size" in status.err
+
+    def test_node_selector_and_unschedulable_filtered(self):
+        nodes = _cluster()
+        nodes[1]["unschedulable"] = True
+        nodes[2]["labels"] = {"pool": "other"}
+        d = Descheduler(
+            [_profile()],
+            nodes_fn=lambda: nodes,
+            node_selector={"pool": "web"},
+        )
+        assert len(d._ready_nodes()) == 0
+
+    def test_deschedule_plugins_run_before_balance(self):
+        order = []
+
+        def desched_factory(fw, args):
+            return lambda nodes: order.append("deschedule")
+
+        def balance_factory(fw, args):
+            return lambda nodes: order.append("balance")
+
+        registry = {"D": desched_factory, "B": balance_factory}
+        profiles = [
+            DeschedulerProfile(name="p1", plugins=PluginSet(deschedule=["D"], balance=["B"])),
+            DeschedulerProfile(name="p2", plugins=PluginSet(deschedule=["D"], balance=["B"])),
+        ]
+        d = Descheduler(profiles, nodes_fn=_cluster, registry=registry)
+        assert d.descheduler_once().ok
+        # ALL deschedule phases precede ANY balance phase (descheduler.go:271-283)
+        assert order == ["deschedule", "deschedule", "balance", "balance"]
+
+    def test_plugin_error_aggregated_not_fatal_to_others(self):
+        ran = []
+
+        def boom(fw, args):
+            def run(nodes):
+                raise RuntimeError("boom")
+
+            return run
+
+        def ok_plugin(fw, args):
+            return lambda nodes: ran.append(True)
+
+        registry = {"Boom": boom, "OK": ok_plugin}
+        d = Descheduler(
+            [
+                DeschedulerProfile(
+                    plugins=PluginSet(deschedule=["Boom", "OK"], balance=[])
+                )
+            ],
+            nodes_fn=_cluster,
+            registry=registry,
+        )
+        status = d.descheduler_once()
+        assert not status.ok and "Boom" in status.err
+        assert ran == [True], "later plugins still ran (error aggregation)"
+
+    def test_start_runs_once_with_zero_interval(self):
+        calls = []
+
+        def factory(fw, args):
+            return lambda nodes: calls.append(1)
+
+        d = Descheduler(
+            [DeschedulerProfile(plugins=PluginSet(balance=["P"]))],
+            nodes_fn=_cluster,
+            registry={"P": factory},
+            descheduling_interval=0,
+        )
+        d.start()
+        assert len(calls) == 1
+
+    def test_start_ticks_at_interval(self):
+        calls = []
+        slept = []
+
+        def factory(fw, args):
+            return lambda nodes: calls.append(1)
+
+        d = Descheduler(
+            [DeschedulerProfile(plugins=PluginSet(balance=["P"]))],
+            nodes_fn=_cluster,
+            registry={"P": factory},
+            descheduling_interval=120.0,
+        )
+        d.start(max_ticks=3, sleep=slept.append)
+        assert len(calls) == 3
+        assert slept == [120.0, 120.0]
+
+    def test_unknown_plugin_rejected(self):
+        with pytest.raises(ValueError, match="unknown balance plugin"):
+            Descheduler(
+                [DeschedulerProfile(plugins=PluginSet(balance=["Nope"]))],
+                nodes_fn=_cluster,
+            )
+
+    def test_restart_adaptor_plugin_evicts_through_framework(self):
+        nodes = _cluster()
+        nodes[0]["pods"][0]["containers"] = [{"restart_count": 200}]
+        evictor = PodEvictor()
+        d = Descheduler(
+            [
+                DeschedulerProfile(
+                    plugins=PluginSet(
+                        deschedule=["RemovePodsHavingTooManyRestarts"],
+                        balance=[],
+                    )
+                )
+            ],
+            nodes_fn=lambda: nodes,
+            evictor=evictor,
+        )
+        assert d.descheduler_once().ok
+        assert [r.pod for r in evictor.evicted] == ["hot-0"]
