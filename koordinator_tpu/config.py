@@ -44,17 +44,17 @@ class AggregatedArgs:
 
     def __post_init__(self):
         object.__setattr__(self, "usage_thresholds", _freeze(self.usage_thresholds))
-        # the filter aggregation type is mandatory (the profile exists to
-        # select a percentile); only the score type may be empty (= score
-        # on plain NodeUsage)
-        if self.usage_aggregation_type not in PERCENTILES:
+        # either half may be disabled: empty usage type = plain-usage
+        # filtering (score-only profile), empty score type = plain-usage
+        # scoring — but a configured filter (thresholds) needs a percentile
+        if dict(self.usage_thresholds) and self.usage_aggregation_type not in PERCENTILES:
             raise ValueError(
-                f"unknown usage_aggregation_type {self.usage_aggregation_type!r}"
+                "aggregated usage_thresholds need a valid "
+                f"usage_aggregation_type, got {self.usage_aggregation_type!r}"
             )
-        if self.score_aggregation_type and self.score_aggregation_type not in PERCENTILES:
-            raise ValueError(
-                f"unknown score_aggregation_type {self.score_aggregation_type!r}"
-            )
+        for t in (self.usage_aggregation_type, self.score_aggregation_type):
+            if t and t not in PERCENTILES:
+                raise ValueError(f"unknown aggregation type {t!r}")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -126,6 +126,12 @@ class MigrationController:
         self.jobs: Dict[str, MigrationJob] = {}
 
     def submit(self, job: MigrationJob) -> MigrationJob:
+        """Idempotent: a live job with the same name wins — replanning the
+        same pod next tick must not clobber an in-flight job's reservation
+        state or restart its TTL."""
+        existing = self.jobs.get(job.name)
+        if existing is not None and existing.phase in (PENDING, RUNNING):
+            return existing
         job.mode = job.mode or self.args.default_job_mode
         self.jobs[job.name] = job
         return job

@@ -90,6 +90,7 @@ class Framework:
         self.evictor = evictor
         self.migration = migration
         self.dry_run = dry_run
+        self.planned_only: List[Mapping] = []  # dry-run audit trail
         self.detectors: Dict[str, BasicDetector] = {}
         self._deschedule = []
         self._balance = []
@@ -103,9 +104,19 @@ class Framework:
             self._balance.append((name, registry[name](self, profile.plugin_config.get(name))))
 
     # -- Evictor handle (evictorProxy, framework.go:294): plugins call
-    # this; it routes through the MigrationController when configured --
+    # this; it routes through the MigrationController when the profile's
+    # evict plugin set enables it --
     def evict(self, pod: Mapping, node: str, reason: str = "") -> bool:
-        if self.migration is not None:
+        if self.dry_run:
+            # evictorProxy dry-run: report the decision, touch nothing
+            self.planned_only.append(
+                {"pod": pod.get("name"), "node": node, "reason": reason}
+            )
+            return True
+        if (
+            self.migration is not None
+            and "MigrationController" in self.profile.plugins.evict
+        ):
             job = self.migration.submit(
                 MigrationJob(
                     name=f"mj-{pod.get('namespace', 'default')}-{pod.get('name')}",
@@ -172,19 +183,18 @@ class _EvictorAdapter:
         return self.fw.evict(pod, node, reason=reason)
 
 
-def _deschedule_adaptor(plugin_fn, needs_args=False):
+def _deschedule_adaptor(reason: str, select):
     """Wrap the k8s-descheduler adaptor plugins (k8s_plugins.py) as
-    Deschedule plugins evicting through the framework."""
+    Deschedule plugins evicting through the framework.  ``select(pods,
+    nodes, args)`` returns the victims; ``reason`` names the plugin in
+    the eviction audit trail."""
 
     def factory(fw: Framework, args):
         def run(nodes):
             for nd in nodes:
                 pods = nd.get("pods", [])
-                victims = (
-                    plugin_fn(pods, args) if needs_args else plugin_fn(pods)
-                )
-                for pod in victims:
-                    fw.evict(pod, nd["name"], reason=plugin_fn.__name__)
+                for pod in select(pods, nodes, args):
+                    fw.evict(pod, nd["name"], reason=reason)
 
         return run
 
@@ -194,17 +204,25 @@ def _deschedule_adaptor(plugin_fn, needs_args=False):
 DEFAULT_REGISTRY: Dict[str, Callable] = {
     "LowNodeLoad": _low_node_load,
     "RemovePodsHavingTooManyRestarts": _deschedule_adaptor(
-        lambda pods, args: remove_pods_having_too_many_restarts(
+        "RemovePodsHavingTooManyRestarts",
+        lambda pods, nodes, args: remove_pods_having_too_many_restarts(
             pods, args or TooManyRestartsArgs()
         ),
-        needs_args=True,
     ),
-    "RemoveDuplicates": _deschedule_adaptor(remove_duplicates),
+    "RemoveDuplicates": _deschedule_adaptor(
+        "RemoveDuplicates", lambda pods, nodes, args: remove_duplicates(pods)
+    ),
     "RemovePodsViolatingNodeAffinity": _deschedule_adaptor(
-        remove_pods_violating_node_affinity
+        "RemovePodsViolatingNodeAffinity",
+        lambda pods, nodes, args: remove_pods_violating_node_affinity(
+            pods, nodes
+        ),
     ),
     "RemovePodsViolatingInterPodAntiAffinity": _deschedule_adaptor(
-        remove_pods_violating_interpod_antiaffinity
+        "RemovePodsViolatingInterPodAntiAffinity",
+        lambda pods, nodes, args: remove_pods_violating_interpod_antiaffinity(
+            pods
+        ),
     ),
 }
 

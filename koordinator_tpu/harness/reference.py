@@ -141,7 +141,11 @@ class ReferenceCycle:
             return True
         agg = self.cfg.loadaware.aggregated
         usage = self.usage[n]
-        if agg is not None and self.agg_usage is not None:
+        if (
+            agg is not None
+            and agg.usage_aggregation_type
+            and self.agg_usage is not None
+        ):
             node_agg = self.agg_usage[n]
             if node_agg is None:
                 return True  # getTargetAggregatedUsage nil -> pass

@@ -88,7 +88,11 @@ def loadaware_node_masks(nodes, cfg):
     """
     thr = cfg.loadaware_thresholds_arr()
     agg = cfg.loadaware.aggregated
-    if agg is not None and nodes.agg_usage is not None:
+    if (
+        agg is not None
+        and agg.usage_aggregation_type
+        and nodes.agg_usage is not None
+    ):
         a = PERCENTILES.index(agg.usage_aggregation_type)
         mask_default = _threshold_mask(
             nodes.agg_usage[:, a], nodes.allocatable, thr, nodes.metric_fresh
