@@ -285,6 +285,7 @@ class Descheduler:
         self.evictor.reset()
         for fw in self.frameworks:
             fw._now = now
+            fw.planned_only.clear()  # per-tick dry-run decisions
         # ALL profiles' Deschedule plugins run before ANY Balance plugin;
         # one broken profile must not stall the others or the migration
         # reconcile (errors aggregate, like the framework's plugin loops)

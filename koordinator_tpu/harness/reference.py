@@ -143,6 +143,7 @@ class ReferenceCycle:
         usage = self.usage[n]
         if (
             agg is not None
+            and dict(agg.usage_thresholds)
             and agg.usage_aggregation_type
             and self.agg_usage is not None
         ):

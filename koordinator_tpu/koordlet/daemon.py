@@ -43,9 +43,22 @@ class Daemon:
         auditor: Optional[Auditor] = None,
         metrics: Optional[MetricsRegistry] = None,
         report_interval_seconds: float = 60.0,
+        storage_dir: Optional[str] = None,
     ):
         self.fs = fs or SysFS()
-        self.cache = cache or MetricCache()
+        if cache is not None:
+            self.cache = cache
+        elif storage_dir:
+            # durable metrics (the reference embeds a Prometheus TSDB,
+            # tsdb_storage.go:105): a koordlet restart replays the WAL so
+            # the NodeMetric aggregation window survives
+            from koordinator_tpu.koordlet.metriccache import (
+                PersistentMetricCache,
+            )
+
+            self.cache = PersistentMetricCache(storage_dir)
+        else:
+            self.cache = MetricCache()
         self.informer = informer or StatesInformer()
         self.advisor = MetricsAdvisor(list(collectors))
         self.qos = QOSManager(list(strategies))

@@ -14,8 +14,11 @@ snapshot (``save``/``load``).
 
 from __future__ import annotations
 
+import ast
 import dataclasses
+import glob
 import os
+import struct
 import threading
 from typing import Dict, Iterable, List, Mapping, Optional, Tuple
 
@@ -191,3 +194,164 @@ class MetricCache:
                     s.append(float(t), float(v))
                 self._series[tuple(key)] = s
         return True
+
+
+# ---------------------------------------------------------------------------
+# Durable storage: WAL segments (tsdb_storage.go analog)
+# ---------------------------------------------------------------------------
+
+# record layout: u32 key-id, f64 ts, f64 value (little-endian)
+_REC = struct.Struct("<Iqd")  # ts stored as int64 milliseconds
+_KEYDEF = 0xFFFFFFFF  # key-id sentinel: record body is a key definition
+
+
+class PersistentMetricCache(MetricCache):
+    """MetricCache whose appends land in append-only WAL segments and whose
+    constructor replays them — a koordlet restart keeps the NodeMetric
+    aggregation window intact (the role the reference's embedded Prometheus
+    TSDB directory plays, ``metriccache/tsdb_storage.go:105``).
+
+    Segments rotate at ``segment_bytes``; on rotation, segments whose
+    newest sample is older than ``retention_seconds`` are deleted (TSDB
+    block retention).  Records are fixed-width binary; series keys are
+    interned once per segment stream via key-definition records, so the
+    steady-state write is 20 bytes per sample.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        capacity_per_series: int = 4096,
+        segment_bytes: int = 4 << 20,
+        retention_seconds: float = 24 * 3600.0,
+    ):
+        super().__init__(capacity_per_series=capacity_per_series)
+        self.directory = directory
+        self.segment_bytes = segment_bytes
+        self.retention_seconds = retention_seconds
+        self._key_ids: Dict[Tuple, int] = {}
+        self._next_key = 0
+        self._segment_newest: Dict[str, float] = {}
+        os.makedirs(directory, exist_ok=True)
+        self._replay()
+        # startup retention sweep: a crash-looping daemon that never fills
+        # a segment would otherwise accumulate WAL files forever
+        newest_any = max(self._segment_newest.values(), default=0.0)
+        self._sweep(newest_any)
+        existing = self._segments()
+        last_index = (
+            int(existing[-1].rsplit("-", 1)[1].split(".")[0])
+            if existing
+            else -1
+        )
+        if (
+            existing
+            and os.path.getsize(existing[-1]) < segment_bytes
+        ):
+            # reuse the under-sized active segment (its key table is
+            # already interned and its ids match the replayed _key_ids)
+            self._seg_index = last_index
+            self._fh = open(existing[-1], "ab")
+        else:
+            self._seg_index = last_index + 1
+            self._fh = open(self._segment_path(self._seg_index), "ab")
+            # re-intern the key table into the fresh segment so every
+            # segment is self-describing (replay never needs another one)
+            for key, kid in sorted(
+                self._key_ids.items(), key=lambda kv: kv[1]
+            ):
+                self._fh.write(self._keydef_record(kid, key))
+            self._fh.flush()
+
+    # -- write path --
+    def append(self, metric, value, *, ts, labels=None):
+        super().append(metric, value, ts=ts, labels=labels)
+        key = _series_key(metric, labels or {})
+        with self._lock:
+            kid = self._key_ids.get(key)
+            if kid is None:
+                kid = self._next_key
+                self._next_key += 1
+                self._key_ids[key] = kid
+                self._fh.write(self._keydef_record(kid, key))
+            self._fh.write(_REC.pack(kid, int(ts * 1000), float(value)))
+            self._fh.flush()
+            seg = self._segment_path(self._seg_index)
+            self._segment_newest[seg] = max(
+                self._segment_newest.get(seg, 0.0), float(ts)
+            )
+            if self._fh.tell() >= self.segment_bytes:
+                self._rotate(float(ts))
+
+    def close(self):
+        with self._lock:
+            self._fh.close()
+
+    # -- internals --
+    def _segment_path(self, index: int) -> str:
+        return os.path.join(self.directory, f"segment-{index:08d}.wal")
+
+    def _segments(self) -> List[str]:
+        return sorted(glob.glob(os.path.join(self.directory, "segment-*.wal")))
+
+    @staticmethod
+    def _keydef_record(kid: int, key: Tuple) -> bytes:
+        blob = repr(key).encode()
+        return _REC.pack(_KEYDEF, kid, float(len(blob))) + blob
+
+    def _rotate(self, now: float):
+        self._fh.close()
+        self._seg_index += 1
+        self._fh = open(self._segment_path(self._seg_index), "ab")
+        for key, kid in sorted(self._key_ids.items(), key=lambda kv: kv[1]):
+            self._fh.write(self._keydef_record(kid, key))
+        self._fh.flush()
+        self._sweep(now)
+
+    def _sweep(self, now: float):
+        """Drop whole segments whose newest sample has aged out (TSDB
+        block retention)."""
+        active = self._segment_path(getattr(self, "_seg_index", -1))
+        for seg in self._segments():
+            if seg == active:
+                continue
+            newest = self._segment_newest.get(seg)
+            if newest is not None and now - newest > self.retention_seconds:
+                os.unlink(seg)
+                self._segment_newest.pop(seg, None)
+
+    def _replay(self):
+        for seg in self._segments():
+            keymap: Dict[int, Tuple] = {}
+            newest = 0.0
+            try:
+                with open(seg, "rb") as fh:
+                    data = fh.read()
+            except OSError:
+                continue
+            off = 0
+            while off + _REC.size <= len(data):
+                kid, ts_ms, value = _REC.unpack_from(data, off)
+                off += _REC.size
+                if kid == _KEYDEF:
+                    blob_len = int(value)
+                    blob = data[off : off + blob_len]
+                    off += blob_len
+                    try:
+                        key = tuple(ast.literal_eval(blob.decode()))
+                    except (ValueError, SyntaxError):
+                        break  # torn key record: stop at the tear
+                    keymap[ts_ms] = key  # ts field carries the key id here
+                    if key not in self._key_ids:
+                        self._key_ids[key] = self._next_key
+                        self._next_key += 1
+                    continue
+                key = keymap.get(kid)
+                if key is None:
+                    continue  # unknown id (foreign tear): skip
+                ts = ts_ms / 1000.0
+                newest = max(newest, ts)
+                metric = key[0]
+                labels = dict(key[1:])
+                MetricCache.append(self, metric, value, ts=ts, labels=labels)
+            self._segment_newest[seg] = newest

@@ -90,6 +90,7 @@ def loadaware_node_masks(nodes, cfg):
     agg = cfg.loadaware.aggregated
     if (
         agg is not None
+        and dict(agg.usage_thresholds)
         and agg.usage_aggregation_type
         and nodes.agg_usage is not None
     ):

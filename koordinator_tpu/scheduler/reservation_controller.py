@@ -25,7 +25,7 @@ import dataclasses
 import time
 from typing import Callable, Dict, List, Mapping, Optional, Sequence
 
-from koordinator_tpu.model.resources import parse_quantity
+from koordinator_tpu.model.resources import format_quantity, parse_quantity
 
 # ReservationPhase (reference apis/scheduling/v1alpha1/reservation_types.go)
 PENDING = "Pending"
@@ -82,12 +82,17 @@ class Reservation:
         )
 
     def as_dict(self) -> Dict:
-        """encode_reservations input row."""
+        """encode_reservations input row.  ``allocated`` holds axis-unit
+        integers (computed by _sync_status); render them as quantities so
+        encode_reservations' parse round-trips exactly (resources.py
+        format_quantity contract)."""
         return {
             "name": self.name,
             "node": self.node,
             "allocatable": self.allocatable or self.requests,
-            "allocated": self.allocated,
+            "allocated": {
+                k: format_quantity(v, k) for k, v in self.allocated.items()
+            },
             "owners": list(self.owners),
             "allocate_policy": self.allocate_policy,
             "allocate_once": self.allocate_once,

@@ -1,0 +1,123 @@
+"""PersistentMetricCache: WAL segments, restart replay, rotation, retention.
+
+Reference role: the embedded Prometheus TSDB directory
+(``pkg/koordlet/metriccache/tsdb_storage.go:105``) — a koordlet restart
+must keep the NodeMetric aggregation window intact (round-2 review item).
+"""
+
+import os
+
+import pytest
+
+from koordinator_tpu.koordlet.metriccache import (
+    AGG_AVG,
+    AGG_COUNT,
+    AGG_P95,
+    NODE_CPU_USAGE,
+    POD_CPU_USAGE,
+    PersistentMetricCache,
+)
+
+
+@pytest.fixture()
+def tsdb_dir(tmp_path):
+    return str(tmp_path / "tsdb")
+
+
+def test_restart_keeps_aggregation_window(tsdb_dir):
+    c = PersistentMetricCache(tsdb_dir)
+    for i in range(100):
+        c.append(NODE_CPU_USAGE, float(i), ts=1000.0 + i)
+        c.append(
+            POD_CPU_USAGE, float(i) / 2, ts=1000.0 + i, labels={"pod": "p1"}
+        )
+    before = c.query(NODE_CPU_USAGE, start=1000.0, end=1100.0, agg=AGG_P95)
+    c.close()
+
+    # koordlet restart: a new cache over the same directory
+    c2 = PersistentMetricCache(tsdb_dir)
+    assert (
+        c2.query(NODE_CPU_USAGE, start=1000.0, end=1100.0, agg=AGG_P95)
+        == before
+    )
+    assert (
+        c2.query(NODE_CPU_USAGE, start=1000.0, end=1100.0, agg=AGG_COUNT)
+        == 100
+    )
+    assert c2.query(
+        POD_CPU_USAGE,
+        start=1000.0,
+        end=1100.0,
+        agg=AGG_AVG,
+        labels={"pod": "p1"},
+    ) == pytest.approx(sum(i / 2 for i in range(100)) / 100)
+    # and appends keep working after replay
+    c2.append(NODE_CPU_USAGE, 999.0, ts=1101.0)
+    assert (
+        c2.query(NODE_CPU_USAGE, start=1101.0, end=1102.0, agg=AGG_AVG)
+        == 999.0
+    )
+    c2.close()
+
+
+def test_segment_rotation_and_retention(tsdb_dir):
+    c = PersistentMetricCache(
+        tsdb_dir, segment_bytes=2048, retention_seconds=50.0
+    )
+    for i in range(400):
+        c.append(NODE_CPU_USAGE, float(i), ts=float(i))
+    segs = [f for f in os.listdir(tsdb_dir) if f.endswith(".wal")]
+    assert len(segs) > 1, "rotation must have produced multiple segments"
+    # early segments hold samples older than ts=350-50: retention dropped
+    # at least the first one
+    assert "segment-00000000.wal" not in segs
+    c.close()
+    # replay after retention still answers over the surviving window
+    c2 = PersistentMetricCache(tsdb_dir, segment_bytes=2048)
+    assert c2.query(NODE_CPU_USAGE, start=380.0, end=400.0, agg=AGG_COUNT) > 0
+    c2.close()
+
+
+def test_torn_tail_tolerated(tsdb_dir):
+    c = PersistentMetricCache(tsdb_dir)
+    for i in range(10):
+        c.append(NODE_CPU_USAGE, float(i), ts=float(i))
+    c.close()
+    # simulate a crash mid-write: truncate the active segment mid-record
+    seg = sorted(
+        os.path.join(tsdb_dir, f)
+        for f in os.listdir(tsdb_dir)
+        if f.endswith(".wal")
+    )[-1]
+    size = os.path.getsize(seg)
+    with open(seg, "r+b") as fh:
+        fh.truncate(size - 7)
+    c2 = PersistentMetricCache(tsdb_dir)
+    # the intact prefix replays (9 of 10 samples)
+    assert c2.query(NODE_CPU_USAGE, start=0.0, end=10.0, agg=AGG_COUNT) == 9
+    c2.close()
+
+
+def test_every_segment_self_describing(tsdb_dir):
+    """Key tables are re-interned into each new segment, so deleting old
+    segments (retention) never orphans newer ones."""
+    c = PersistentMetricCache(tsdb_dir, segment_bytes=1024)
+    for i in range(200):
+        c.append(NODE_CPU_USAGE, float(i), ts=float(i), labels={"n": "x"})
+    c.close()
+    segs = sorted(
+        os.path.join(tsdb_dir, f)
+        for f in os.listdir(tsdb_dir)
+        if f.endswith(".wal")
+    )
+    # drop everything but the last two segments
+    for seg in segs[:-2]:
+        os.unlink(seg)
+    c2 = PersistentMetricCache(tsdb_dir, segment_bytes=1024)
+    assert (
+        c2.query(
+            NODE_CPU_USAGE, start=0.0, end=300.0, agg=AGG_COUNT, labels={"n": "x"}
+        )
+        > 0
+    )
+    c2.close()
