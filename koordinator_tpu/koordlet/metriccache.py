@@ -232,6 +232,7 @@ class PersistentMetricCache(MetricCache):
         self._key_ids: Dict[Tuple, int] = {}
         self._next_key = 0
         self._segment_newest: Dict[str, float] = {}
+        self._segment_valid_bytes: Dict[str, int] = {}
         os.makedirs(directory, exist_ok=True)
         self._replay()
         # startup retention sweep: a crash-looping daemon that never fills
@@ -249,7 +250,14 @@ class PersistentMetricCache(MetricCache):
             and os.path.getsize(existing[-1]) < segment_bytes
         ):
             # reuse the under-sized active segment (its key table is
-            # already interned and its ids match the replayed _key_ids)
+            # already interned and its ids match the replayed _key_ids).
+            # A torn tail from a crash mid-write MUST be truncated first:
+            # appending after partial-record garbage would shift the
+            # fixed-stride replay off alignment on the next restart.
+            valid = self._segment_valid_bytes.get(existing[-1])
+            if valid is not None and valid < os.path.getsize(existing[-1]):
+                with open(existing[-1], "r+b") as fh:
+                    fh.truncate(valid)
             self._seg_index = last_index
             self._fh = open(existing[-1], "ab")
         else:
@@ -330,6 +338,7 @@ class PersistentMetricCache(MetricCache):
             except OSError:
                 continue
             off = 0
+            valid_off = 0
             while off + _REC.size <= len(data):
                 kid, ts_ms, value = _REC.unpack_from(data, off)
                 off += _REC.size
@@ -345,8 +354,10 @@ class PersistentMetricCache(MetricCache):
                     if key not in self._key_ids:
                         self._key_ids[key] = self._next_key
                         self._next_key += 1
+                    valid_off = off
                     continue
                 key = keymap.get(kid)
+                valid_off = off
                 if key is None:
                     continue  # unknown id (foreign tear): skip
                 ts = ts_ms / 1000.0
@@ -355,3 +366,4 @@ class PersistentMetricCache(MetricCache):
                 labels = dict(key[1:])
                 MetricCache.append(self, metric, value, ts=ts, labels=labels)
             self._segment_newest[seg] = newest
+            self._segment_valid_bytes[seg] = valid_off

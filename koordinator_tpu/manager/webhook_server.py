@@ -1,0 +1,292 @@
+"""Webhook serving machinery: HTTPS admission server + cert management.
+
+The reference's webhook framework (``pkg/webhook/server.go:80
+SetupWithManager``) brings three pieces the decision logic alone lacks:
+
+* **cert generation** — a self-signed CA + server certificate written to
+  the cert dir (``pkg/webhook/util/generator``): here via the
+  ``cryptography`` package, SANs covering the service DNS names.
+* **cert rotation** — certs are re-generated before expiry and the
+  server re-wraps its socket so new connections use the fresh cert
+  (``pkg/webhook/util/controller`` keeps the webhook configuration's
+  caBundle in sync; ``ca_bundle()`` is that output).
+* **the admission HTTP surface** — ``/mutate-pod``, ``/validate-pod``,
+  ``/validate-quota``, ``/validate-node`` endpoints speaking the
+  AdmissionReview JSON envelope, dispatching to the existing handlers
+  (manager/profile.py mutating, manager/validating.py validating);
+  mutating replies carry an RFC-6902 JSON patch like the real thing.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+import json
+import os
+import ssl
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
+
+from koordinator_tpu.manager.profile import mutate_by_profiles
+from koordinator_tpu.manager.validating import (
+    validate_node_colocation,
+    validate_pod,
+    validate_quota_tree,
+)
+
+DEFAULT_CERT_VALIDITY_DAYS = 365  # generator.NewSelfSignedCert default
+DEFAULT_ROTATE_BEFORE = 30 * 24 * 3600.0  # rotate within 30d of expiry
+
+
+# ---------------------------------------------------------------------------
+# Cert generation / rotation (pkg/webhook/util/generator analog)
+# ---------------------------------------------------------------------------
+
+
+class CertManager:
+    """Self-signed CA + server cert in ``cert_dir``; rotation regenerates
+    both when the server cert nears expiry."""
+
+    def __init__(
+        self,
+        cert_dir: str,
+        dns_names: Tuple[str, ...] = ("koord-webhook-service",),
+        validity_days: int = DEFAULT_CERT_VALIDITY_DAYS,
+        rotate_before_seconds: float = DEFAULT_ROTATE_BEFORE,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.cert_dir = cert_dir
+        self.dns_names = dns_names
+        self.validity_days = validity_days
+        self.rotate_before = rotate_before_seconds
+        self.clock = clock
+        self.rotations = 0
+        os.makedirs(cert_dir, exist_ok=True)
+
+    @property
+    def ca_path(self) -> str:
+        return os.path.join(self.cert_dir, "ca.crt")
+
+    @property
+    def cert_path(self) -> str:
+        return os.path.join(self.cert_dir, "tls.crt")
+
+    @property
+    def key_path(self) -> str:
+        return os.path.join(self.cert_dir, "tls.key")
+
+    def ensure(self) -> bool:
+        """Generate certs if absent or near expiry; returns True when new
+        certs were written (the caller re-wraps its TLS socket)."""
+        if not os.path.exists(self.cert_path) or self._near_expiry():
+            self._generate()
+            return True
+        return False
+
+    def ca_bundle(self) -> str:
+        """base64 CA cert — what the webhook-configuration controller
+        patches into ValidatingWebhookConfiguration.caBundle."""
+        with open(self.ca_path, "rb") as fh:
+            return base64.b64encode(fh.read()).decode()
+
+    def _near_expiry(self) -> bool:
+        from cryptography import x509
+
+        try:
+            with open(self.cert_path, "rb") as fh:
+                cert = x509.load_pem_x509_certificate(fh.read())
+        except (OSError, ValueError):
+            return True
+        expires = cert.not_valid_after_utc.timestamp()
+        return self.clock() >= expires - self.rotate_before
+
+    def _generate(self) -> None:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+
+        now = datetime.datetime.fromtimestamp(
+            self.clock(), tz=datetime.timezone.utc
+        )
+        until = now + datetime.timedelta(days=self.validity_days)
+
+        ca_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        ca_name = x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, "koordinator-webhook-ca")]
+        )
+        ca_cert = (
+            x509.CertificateBuilder()
+            .subject_name(ca_name)
+            .issuer_name(ca_name)
+            .public_key(ca_key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(until)
+            .add_extension(x509.BasicConstraints(ca=True, path_length=0), True)
+            .sign(ca_key, hashes.SHA256())
+        )
+
+        key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(
+                x509.Name(
+                    [x509.NameAttribute(NameOID.COMMON_NAME, self.dns_names[0])]
+                )
+            )
+            .issuer_name(ca_name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(until)
+            .add_extension(
+                x509.SubjectAlternativeName(
+                    [x509.DNSName(n) for n in self.dns_names]
+                    + [x509.DNSName("localhost")]
+                ),
+                False,
+            )
+            .sign(ca_key, hashes.SHA256())
+        )
+
+        with open(self.ca_path, "wb") as fh:
+            fh.write(ca_cert.public_bytes(serialization.Encoding.PEM))
+        with open(self.cert_path, "wb") as fh:
+            fh.write(cert.public_bytes(serialization.Encoding.PEM))
+        with open(self.key_path, "wb") as fh:
+            fh.write(
+                key.private_bytes(
+                    serialization.Encoding.PEM,
+                    serialization.PrivateFormat.TraditionalOpenSSL,
+                    serialization.NoEncryption(),
+                )
+            )
+        self.rotations += 1
+
+
+# ---------------------------------------------------------------------------
+# Admission endpoints (AdmissionReview envelope)
+# ---------------------------------------------------------------------------
+
+
+def _json_patch(original: Mapping, mutated: Mapping) -> List[Dict]:
+    """Top-level RFC-6902 replace/add ops for changed keys (the reference
+    computes the patch from the mutated object the same way)."""
+    ops = []
+    for key, value in mutated.items():
+        if key not in original:
+            ops.append({"op": "add", "path": f"/{key}", "value": value})
+        elif original[key] != value:
+            ops.append({"op": "replace", "path": f"/{key}", "value": value})
+    return ops
+
+
+def admission_response(uid: str, allowed: bool, errs=(), patch=None) -> Dict:
+    resp: Dict = {"uid": uid, "allowed": allowed}
+    if errs:
+        resp["status"] = {"message": "; ".join(errs)}
+    if patch:
+        resp["patchType"] = "JSONPatch"
+        resp["patch"] = base64.b64encode(json.dumps(patch).encode()).decode()
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview", "response": resp}
+
+
+class WebhookServer:
+    """HTTPS admission server with managed certs.
+
+    ``profiles_fn`` supplies the live ClusterColocationProfiles for the
+    mutating path (the reference watches them as CRs).
+    """
+
+    def __init__(
+        self,
+        cert_dir: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        profiles_fn: Callable[[], List[Mapping]] = lambda: [],
+        cert_manager: Optional[CertManager] = None,
+    ):
+        self.certs = cert_manager or CertManager(cert_dir)
+        self.profiles_fn = profiles_fn
+        self.certs.ensure()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    review = json.loads(self.rfile.read(length) or b"{}")
+                    body = outer.handle(self.path, review)
+                    code = 200
+                except Exception as exc:  # malformed review -> 400
+                    body = {"error": str(exc)}
+                    code = 400
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._wrap_tls()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    def _wrap_tls(self):
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.certs.cert_path, self.certs.key_path)
+        self._ssl_context = ctx
+        self._httpd.socket = ctx.wrap_socket(
+            self._httpd.socket, server_side=True
+        )
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "WebhookServer":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def rotate_if_needed(self) -> bool:
+        """Cert rotation tick: regenerate near-expiry certs and reload the
+        TLS context so NEW connections use them."""
+        if self.certs.ensure():
+            self._ssl_context.load_cert_chain(
+                self.certs.cert_path, self.certs.key_path
+            )
+            return True
+        return False
+
+    # -- dispatch --
+    def handle(self, path: str, review: Mapping) -> Dict:
+        req = review.get("request") or {}
+        uid = req.get("uid", "")
+        obj = req.get("object") or {}
+        if path == "/mutate-pod":
+            mutated = mutate_by_profiles(obj, self.profiles_fn())
+            return admission_response(
+                uid, True, patch=_json_patch(obj, mutated)
+            )
+        if path == "/validate-pod":
+            errs = validate_pod(obj)
+            return admission_response(uid, not errs, errs)
+        if path == "/validate-quota":
+            errs = validate_quota_tree(obj.get("quotas") or [obj])
+            return admission_response(uid, not errs, errs)
+        if path == "/validate-node":
+            errs = validate_node_colocation(obj)
+            return admission_response(uid, not errs, errs)
+        raise ValueError(f"unknown webhook path {path!r}")

@@ -65,6 +65,19 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.koord_perf_close.argtypes = [ctypes.c_int]
+        lib.koord_perf_open_single.restype = ctypes.c_int
+        lib.koord_perf_open_single.argtypes = [
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_uint,
+            ctypes.c_ulonglong,
+            ctypes.c_int,
+        ]
+        lib.koord_perf_read_single.restype = ctypes.c_int
+        lib.koord_perf_read_single.argtypes = [
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
         lib.koord_read_files.restype = ctypes.c_int
         lib.koord_read_files.argtypes = [
             ctypes.c_char_p,
@@ -125,6 +138,64 @@ class PerfCPIGroup:
         if rc < 0:
             raise OSError(-rc, os.strerror(-rc))
         return int(out[0]), int(out[1])
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            self._lib.koord_perf_close(self._fd)
+            self._fd = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# raw perf_event_attr constants for PerfSingleReader (linux/perf_event.h)
+PERF_TYPE_HARDWARE = 0
+PERF_TYPE_SOFTWARE = 1
+PERF_COUNT_HW_CPU_CYCLES = 0
+PERF_COUNT_HW_INSTRUCTIONS = 1
+PERF_COUNT_HW_CACHE_MISSES = 3
+PERF_COUNT_SW_CPU_CLOCK = 0
+PERF_COUNT_SW_TASK_CLOCK = 1
+PERF_COUNT_SW_PAGE_FAULTS = 2
+PERF_COUNT_SW_CONTEXT_SWITCHES = 3
+
+
+class PerfSingleReader:
+    """Non-grouped single-event perf reader (the reference's
+    ``pkg/koordlet/util/perf/`` hodgesds/perf-utils path; the grouped CPI
+    reader above covers ``perf_group``).  ``target`` is a pid, or a cgroup
+    dir fd with ``is_cgroup=True``."""
+
+    def __init__(
+        self,
+        target: int,
+        event_type: int = PERF_TYPE_SOFTWARE,
+        config: int = PERF_COUNT_SW_TASK_CLOCK,
+        cpu: int = -1,
+        is_cgroup: bool = False,
+    ):
+        lib = _load()
+        if lib is None:
+            raise OSError("native library unavailable")
+        fd = lib.koord_perf_open_single(
+            target, cpu, event_type, config, 1 if is_cgroup else 0
+        )
+        if fd < 0:
+            raise OSError(-fd, os.strerror(-fd))
+        self._fd = fd
+        self._lib = lib
+
+    def read(self) -> int:
+        out = ctypes.c_uint64()
+        rc = self._lib.koord_perf_read_single(
+            self._fd, ctypes.byref(out)
+        )
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        return int(out.value)
 
     def close(self) -> None:
         if self._fd >= 0:

@@ -1,0 +1,196 @@
+"""koord-scheduler app/server: CLI, leader election, serving, the seam.
+
+Mirrors ``cmd/koord-scheduler/app/server.go``:
+
+* ``NewSchedulerCommand`` (:79) -> ``build_arg_parser``/``main``: flags
+  for the component config, lease path/identity, sockets and ports.
+* ``Setup`` (:331) -> ``SchedulerServer``: loads the component config
+  (scheduler/config_api.py), builds the scorer servicer (the device-side
+  scheduling seam) and the REST service API.
+* ``Run`` (:155) -> ``start``/``run_forever``: healthz + /metrics +
+  services API over HTTP, the bridge scorer on UDS (gRPC + raw framing
+  for native clients), all gated by **leader election** (:225): only the
+  leader serves Assign — followers answer Score/healthz but refuse to
+  place pods, exactly the split the reference gets by only running the
+  scheduling loop on the elected leader.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from koordinator_tpu.bridge.server import ScorerServicer, make_server
+from koordinator_tpu.bridge.udsserver import RawUdsServer
+from koordinator_tpu.config import DEFAULT_CYCLE_CONFIG
+from koordinator_tpu.leaderelection import LeaderElector
+from koordinator_tpu.scheduler.config_api import load_config
+from koordinator_tpu.scheduler.services import APIService
+
+
+class _LeaderGatedServicer(ScorerServicer):
+    """Assign requires leadership; Score/Sync serve on any replica (they
+    are read-only against the resident snapshot)."""
+
+    def __init__(self, cfg, is_leader):
+        super().__init__(cfg)
+        self._is_leader = is_leader
+
+    def assign(self, req, ctx=None):
+        if not self._is_leader():
+            raise PermissionError(
+                "not the leader: this replica does not place pods"
+            )
+        return super().assign(req, ctx)
+
+
+class SchedulerServer:
+    def __init__(
+        self,
+        *,
+        config_path: Optional[str] = None,
+        lease_path: str = "/tmp/koord-scheduler/leader.lease",
+        identity: Optional[str] = None,
+        uds_path: str = "/tmp/koord-scheduler/scorer.sock",
+        http_host: str = "127.0.0.1",
+        http_port: int = 0,
+        enable_grpc: bool = True,
+    ):
+        cfg = DEFAULT_CYCLE_CONFIG
+        self.profiles = []
+        if config_path:
+            with open(config_path) as fh:
+                self.profiles = load_config(fh.read())
+            if self.profiles:
+                cfg = self.profiles[0].cycle
+        self.cfg = cfg
+        self.elector = LeaderElector(
+            lease_path,
+            identity or f"{socket.gethostname()}-{os.getpid()}",
+        )
+        self.servicer = _LeaderGatedServicer(
+            cfg, lambda: self.elector.is_leader
+        )
+        self.api = APIService()
+        self.uds_path = uds_path
+        self.enable_grpc = enable_grpc
+        self._raw_server: Optional[RawUdsServer] = None
+        self._grpc_server = None
+        self._elector_thread: Optional[threading.Thread] = None
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, {"ok": True, "leader": outer.elector.is_leader})
+                    return
+                if self.path == "/metrics":
+                    body = (
+                        "# TYPE koord_scheduler_leader gauge\n"
+                        f"koord_scheduler_leader {int(outer.elector.is_leader)}\n"
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                path, _, query = self.path.partition("?")
+                q = dict(
+                    kv.split("=", 1) for kv in query.split("&") if "=" in kv
+                )
+                code, doc = outer.api.dispatch(path, q)
+                self._reply(code, doc)
+
+            def _reply(self, code, doc):
+                data = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer((http_host, http_port), Handler)
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    @property
+    def http_port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "SchedulerServer":
+        os.makedirs(os.path.dirname(self.uds_path) or ".", exist_ok=True)
+        self._raw_server = RawUdsServer(
+            self.uds_path + ".raw", servicer=self.servicer
+        ).start()
+        if self.enable_grpc:
+            self._grpc_server = make_server(servicer=self.servicer)
+            self._grpc_server.add_insecure_port(f"unix://{self.uds_path}")
+            self._grpc_server.start()
+        self._http_thread.start()
+        self._elector_thread = threading.Thread(
+            target=self.elector.run, daemon=True
+        )
+        self._elector_thread.start()
+        return self
+
+    def stop(self):
+        self.elector.stop()
+        if self._elector_thread:
+            self._elector_thread.join(timeout=5)
+        if self._raw_server:
+            self._raw_server.stop()
+        if self._grpc_server:
+            self._grpc_server.stop(0)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="koord-scheduler")
+    ap.add_argument("--config", help="component config YAML", default=None)
+    ap.add_argument(
+        "--lease", default="/tmp/koord-scheduler/leader.lease",
+        help="leader-election lease file (shared dir across replicas)",
+    )
+    ap.add_argument("--identity", default=None)
+    ap.add_argument(
+        "--uds", default="/tmp/koord-scheduler/scorer.sock",
+        help="scorer UDS path (gRPC; <path>.raw serves the native framing)",
+    )
+    ap.add_argument("--http-host", default="127.0.0.1")
+    ap.add_argument("--http-port", type=int, default=10251)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    server = SchedulerServer(
+        config_path=args.config,
+        lease_path=args.lease,
+        identity=args.identity,
+        uds_path=args.uds,
+        http_host=args.http_host,
+        http_port=args.http_port,
+    ).start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

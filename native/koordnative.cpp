@@ -110,6 +110,54 @@ int koord_perf_close(int leader_fd) {
 }
 
 // ---------------------------------------------------------------------------
+// perf single events (non-grouped readers)
+// ---------------------------------------------------------------------------
+
+// Opens ONE hardware/software counter for `pid`/`cpu` — the reference's
+// non-grouped perf readers (pkg/koordlet/util/perf/, hodgesds/perf-utils)
+// used by collectors that sample a single event.  `type` and `config` are
+// the raw perf_event_attr fields (PERF_TYPE_* / PERF_COUNT_*).  Returns
+// the fd or -errno.
+int koord_perf_open_single(int pid, int cpu, unsigned int type,
+                           unsigned long long config, int is_cgroup_fd) {
+#if defined(__linux__)
+  struct perf_event_attr attr;
+  memset(&attr, 0, sizeof(attr));
+  attr.size = sizeof(attr);
+  attr.type = type;
+  attr.config = config;
+  attr.inherit = 1;
+  unsigned long flags = is_cgroup_fd ? PERF_FLAG_PID_CGROUP : 0;
+  int fd = (int)syscall(__NR_perf_event_open, &attr, pid, cpu, -1, flags);
+  if (fd < 0) return -errno;
+  if (ioctl(fd, PERF_EVENT_IOC_ENABLE, 0) != 0) {
+    int err = errno;
+    close(fd);
+    return -err;
+  }
+  return fd;
+#else
+  (void)pid; (void)cpu; (void)type; (void)config; (void)is_cgroup_fd;
+  return -ENOSYS;
+#endif
+}
+
+// Reads the single counter value. Returns 0 or -errno.
+int koord_perf_read_single(int fd, uint64_t *out) {
+#if defined(__linux__)
+  uint64_t value;
+  ssize_t n = read(fd, &value, sizeof(value));
+  if (n < 0) return -errno;
+  if ((size_t)n < sizeof(value)) return -EIO;
+  *out = value;
+  return 0;
+#else
+  (void)fd; (void)out;
+  return -ENOSYS;
+#endif
+}
+
+// ---------------------------------------------------------------------------
 // batched small-file reader
 // ---------------------------------------------------------------------------
 

@@ -121,3 +121,30 @@ def test_every_segment_self_describing(tsdb_dir):
         > 0
     )
     c2.close()
+
+
+def test_torn_tail_then_append_then_restart(tsdb_dir):
+    """The reused active segment must truncate a torn tail before
+    appending — otherwise replay after the NEXT restart misaligns on the
+    garbage and drops everything appended post-crash."""
+    c = PersistentMetricCache(tsdb_dir)
+    for i in range(10):
+        c.append(NODE_CPU_USAGE, float(i), ts=float(i))
+    c.close()
+    seg = sorted(
+        os.path.join(tsdb_dir, f)
+        for f in os.listdir(tsdb_dir)
+        if f.endswith(".wal")
+    )[-1]
+    with open(seg, "r+b") as fh:
+        fh.truncate(os.path.getsize(seg) - 7)  # crash mid-record
+
+    c2 = PersistentMetricCache(tsdb_dir)  # replays 9, truncates the tear
+    for i in range(10, 15):
+        c2.append(NODE_CPU_USAGE, float(i), ts=float(i))
+    c2.close()
+
+    c3 = PersistentMetricCache(tsdb_dir)
+    # 9 surviving pre-crash samples + 5 post-crash appends, all intact
+    assert c3.query(NODE_CPU_USAGE, start=0.0, end=20.0, agg=AGG_COUNT) == 14
+    c3.close()

@@ -53,3 +53,27 @@ class TestLibrary:
         # collection behind a feature gate the same way)
         got = native.read_self_cpi()
         assert got is None or (got[0] > 0 and got[1] > 0)
+
+
+class TestPerfSingleReader:
+    def test_single_event_reader_monotonic(self):
+        """Non-grouped perf reader (reference pkg/koordlet/util/perf/):
+        a software task-clock counter on self must be monotonic."""
+        from koordinator_tpu.native import (
+            PERF_COUNT_SW_TASK_CLOCK,
+            PERF_TYPE_SOFTWARE,
+            PerfSingleReader,
+        )
+
+        try:
+            r = PerfSingleReader(0, PERF_TYPE_SOFTWARE, PERF_COUNT_SW_TASK_CLOCK)
+        except OSError:
+            pytest.skip("perf_event_open unavailable in this sandbox")
+        try:
+            v1 = r.read()
+            for _ in range(10000):
+                pass
+            v2 = r.read()
+            assert v2 >= v1 >= 0
+        finally:
+            r.close()
