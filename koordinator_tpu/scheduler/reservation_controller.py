@@ -64,6 +64,9 @@ class Reservation:
     expires_at: Optional[float] = None  # spec.Expires wins over TTL
     allocate_once: bool = False
     allocate_policy: str = "Default"
+    # reserve-pod priority (NewReservePod propagates it so reservations
+    # compete/preempt at their own priority, util/reservation.go:165)
+    priority: int = 0
     creation_time: float = 0.0
 
     phase: str = PENDING
@@ -231,6 +234,42 @@ class ReservationController:
                 del self.reservations[name]
                 deleted.append(name)
         return deleted
+
+    # -- reservation-as-pod scheduling (eventhandlers) ----------------------
+    def pending_reserve_pods(self) -> List[Dict]:
+        """Pending reservations as reserve-pod dicts for the scheduling
+        cycle (reference ``eventhandlers/reservation_handler.go:188``
+        enqueues Reservations as pods built by ``NewReservePod``,
+        ``util/reservation.go:53``): the pod carries the reservation's
+        requests/priority plus the reserve-pod annotations."""
+        out = []
+        for r in self.reservations.values():
+            if r.phase != PENDING:
+                continue
+            out.append(
+                {
+                    "name": f"reserve-pod-{r.name}",
+                    "requests": dict(r.requests),
+                    "priority": r.priority,
+                    "annotations": {
+                        "scheduling.koordinator.sh/reserve-pod": "true",
+                        "scheduling.koordinator.sh/reservation-name": r.name,
+                    },
+                }
+            )
+        return out
+
+    def on_reserve_pod_assigned(
+        self, reservation_name: str, node: str, now: Optional[float] = None
+    ) -> None:
+        """The cycle placed a reserve pod: the reservation becomes
+        Available on that node (SetReservationAvailable via the scheduler's
+        reservation error-handler/bind flow).  Only a still-Pending
+        reservation binds — a late callback must not resurrect an expired
+        or already-bound one."""
+        r = self.reservations.get(reservation_name)
+        if r is not None and r.phase == PENDING:
+            self.mark_available(reservation_name, node, now)
 
     # -- snapshot feed ------------------------------------------------------
     def active_reservations(self) -> List[Dict]:

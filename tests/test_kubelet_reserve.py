@@ -1,0 +1,105 @@
+"""Kubelet stub sync surface + reservation-as-pod scheduling path.
+
+Reference: ``statesinformer/impl/kubelet_stub.go`` (pod list from the
+kubelet endpoint) and ``frameworkext/eventhandlers/reservation_handler.go``
+(Reservations enqueued as reserve pods; binding marks them Available).
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+
+from koordinator_tpu.koordlet.kubelet_stub import KubeletStub
+from koordinator_tpu.model import encode_snapshot
+from koordinator_tpu.scheduler.reservation_controller import (
+    AVAILABLE,
+    Reservation,
+    ReservationController,
+)
+from koordinator_tpu.solver import run_cycle
+
+
+class TestKubeletStub:
+    def test_pod_list_with_bearer_token(self):
+        seen = {}
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                seen["auth"] = self.headers.get("Authorization")
+                seen["path"] = self.path
+                body = json.dumps(
+                    {"items": [{"metadata": {"name": "p1"}}]}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            stub = KubeletStub(
+                port=httpd.server_address[1], scheme="http", token="tok123"
+            )
+            pods = stub.get_all_pods()
+            assert pods == [{"metadata": {"name": "p1"}}]
+            assert seen["auth"] == "Bearer tok123"
+            assert seen["path"] == "/pods"
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+class TestReservationAsPod:
+    def test_pending_reservation_scheduled_and_available(self):
+        """The full reservation-as-pod flow: a Pending reservation enters
+        the cycle as a reserve pod, the solver places it, and the
+        controller marks it Available on the chosen node."""
+        c = ReservationController(clock=lambda: 0.0)
+        c.create(
+            Reservation(
+                name="r1",
+                requests={"cpu": "4000m", "memory": "8192Mi"},
+                owners=[{"label_selector": {"app": "web"}}],
+                ttl_seconds=None,
+            )
+        )
+        reserve_pods = c.pending_reserve_pods()
+        assert len(reserve_pods) == 1
+        assert (
+            reserve_pods[0]["annotations"][
+                "scheduling.koordinator.sh/reserve-pod"
+            ]
+            == "true"
+        )
+
+        nodes = [
+            {
+                "name": f"n{i}",
+                "allocatable": {"cpu": "8000m", "memory": "32768Mi", "pods": 110},
+                "usage": {"cpu": f"{1000 * (i + 1)}m", "memory": "4096Mi"},
+            }
+            for i in range(3)
+        ]
+        snap = encode_snapshot(nodes, reserve_pods)
+        result = run_cycle(snap)
+        chosen = int(np.asarray(result.assignment)[0])
+        assert chosen >= 0
+
+        c.on_reserve_pod_assigned("r1", nodes[chosen]["name"])
+        r = c.reservations["r1"]
+        assert r.phase == AVAILABLE
+        assert r.node == nodes[chosen]["name"]
+        # it now feeds the next cycle's ReservationTable
+        assert c.active_reservations()[0]["node"] == nodes[chosen]["name"]
+
+    def test_available_reservations_not_reenqueued(self):
+        c = ReservationController(clock=lambda: 0.0)
+        c.create(Reservation(name="r1", requests={"cpu": "1"}, ttl_seconds=None))
+        c.mark_available("r1", "n0")
+        assert c.pending_reserve_pods() == []

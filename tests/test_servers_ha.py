@@ -15,6 +15,7 @@ import ssl
 import threading
 import time
 import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
 
 import pytest
 
@@ -308,3 +309,83 @@ class TestCRIProxyBoundary:
             client.close()
             proxy.stop()
             runtime.stop()
+
+
+class TestDockerProxy:
+    def test_create_intercepted_others_pass_through(self):
+        from koordinator_tpu.koordlet.runtimehooks import HookRegistry
+        from koordinator_tpu.runtimeproxy_docker import DockerProxyServer
+
+        received = []
+
+        class FakeDockerd(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _respond(self, doc):
+                data = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b"{}"
+                received.append((self.path, json.loads(body or b"{}")))
+                self._respond({"Id": "c1"})
+
+            def do_GET(self):
+                received.append((self.path, None))
+                self._respond({"Containers": []})
+
+        backend = HTTPServer(("127.0.0.1", 0), FakeDockerd)
+        threading.Thread(target=backend.serve_forever, daemon=True).start()
+
+        registry = HookRegistry()
+
+        def pre_create(ctx):
+            ctx.cfs_quota_us = 50000
+            ctx.cpuset_cpus = "0-3"
+            ctx.env["KOORD_BVT"] = "-1"
+
+        registry.register("PreCreateContainer", "test", pre_create)
+        proxy = DockerProxyServer(
+            registry, ("127.0.0.1", backend.server_address[1])
+        ).start()
+        try:
+            import urllib.request
+
+            base = f"http://127.0.0.1:{proxy.port}"
+            # create is intercepted: hooks mutate HostConfig + Env
+            req = urllib.request.Request(
+                f"{base}/v1.43/containers/create",
+                data=json.dumps(
+                    {
+                        "Labels": {"io.kubernetes.pod.uid": "u1"},
+                        "HostConfig": {"CpuShares": 512},
+                    }
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                assert json.loads(r.read())["Id"] == "c1"
+            path, doc = received[-1]
+            assert path == "/v1.43/containers/create"
+            assert doc["HostConfig"]["CpuQuota"] == 50000
+            assert doc["HostConfig"]["CpusetCpus"] == "0-3"
+            assert doc["HostConfig"]["CpuShares"] == 512  # untouched
+            assert "KOORD_BVT=-1" in doc["Env"]
+
+            # non-create requests pass through untouched
+            with urllib.request.urlopen(
+                f"{base}/v1.43/containers/json", timeout=5
+            ) as r:
+                assert json.loads(r.read()) == {"Containers": []}
+            assert received[-1] == ("/v1.43/containers/json", None)
+        finally:
+            proxy.stop()
+            backend.shutdown()
+            backend.server_close()

@@ -1,0 +1,134 @@
+"""Informer-level transformers (pkg/util/transformer): deprecated resource
+renames, node-reservation allocatable trim, informer field drop."""
+
+from koordinator_tpu.transformers import (
+    transform_cluster,
+    transform_elastic_quota,
+    transform_node,
+    transform_pod,
+)
+
+
+class TestPodTransform:
+    def test_deprecated_batch_renamed(self):
+        pod = {
+            "name": "p",
+            "requests": {"koordinator.sh/batch-cpu": "4000", "memory": 1024},
+            "limits": {"koordinator.sh/batch-memory": "2Gi"},
+        }
+        out = transform_pod(pod)
+        assert out["requests"] == {
+            "kubernetes.io/batch-cpu": "4000",
+            "memory": 1024,
+        }
+        assert out["limits"] == {"kubernetes.io/batch-memory": "2Gi"}
+
+    def test_canonical_wins_when_both_present(self):
+        pod = {
+            "name": "p",
+            "requests": {
+                "koordinator.sh/batch-cpu": "1000",
+                "kubernetes.io/batch-cpu": "2000",
+            },
+        }
+        out = transform_pod(pod)
+        # replaceAndErase: the deprecated entry is erased, never overwrites
+        assert out["requests"] == {"kubernetes.io/batch-cpu": "2000"}
+
+    def test_deprecated_device_renamed(self):
+        pod = {"name": "p", "requests": {"kubernetes.io/gpu-core": 100}}
+        out = transform_pod(pod)
+        assert out["requests"] == {"koordinator.sh/gpu-core": 100}
+
+    def test_trim_fields_dropped(self):
+        out = transform_pod({"name": "p", "managed_fields": [{"huge": 1}]})
+        assert "managed_fields" not in out
+
+
+class TestNodeTransform:
+    def test_reservation_trims_allocatable(self):
+        node = {
+            "name": "n",
+            "allocatable": {"cpu": "16000m", "memory": "65536Mi"},
+            "annotations": {
+                "node.koordinator.sh/reservation": (
+                    '{"resources": {"cpu": "2000m", "memory": "4096Mi"}}'
+                )
+            },
+        }
+        out = transform_node(node)
+        assert out["allocatable"]["cpu"] == "14000m"
+        assert out["allocatable"]["memory"] == "61440Mi"
+
+    def test_non_default_apply_policy_skips_trim(self):
+        node = {
+            "name": "n",
+            "allocatable": {"cpu": "16000m"},
+            "annotations": {
+                "node.koordinator.sh/reservation": (
+                    '{"resources": {"cpu": "2000m"},'
+                    ' "applyPolicy": "ReservedCPUsOnly"}'
+                )
+            },
+        }
+        assert transform_node(node)["allocatable"]["cpu"] == "16000m"
+
+    def test_trim_never_negative(self):
+        node = {
+            "name": "n",
+            "allocatable": {"cpu": "1000m"},
+            "annotations": {
+                "node.koordinator.sh/reservation": (
+                    '{"resources": {"cpu": "2000m"}}'
+                )
+            },
+        }
+        assert transform_node(node)["allocatable"]["cpu"] == "0m"
+
+    def test_bad_annotation_keeps_node(self):
+        node = {
+            "name": "n",
+            "allocatable": {"cpu": "1000m"},
+            "annotations": {"node.koordinator.sh/reservation": "{broken"},
+        }
+        assert transform_node(node)["allocatable"]["cpu"] == "1000m"
+
+
+class TestQuotaAndCluster:
+    def test_quota_min_max_renamed(self):
+        q = {
+            "name": "q",
+            "min": {"koordinator.sh/batch-cpu": "1000"},
+            "max": {"kubernetes.io/rdma": 2},
+        }
+        out = transform_elastic_quota(q)
+        assert out["min"] == {"kubernetes.io/batch-cpu": "1000"}
+        assert out["max"] == {"koordinator.sh/rdma": 2}
+
+    def test_transform_cluster_feeds_encode(self):
+        from koordinator_tpu.model import encode_snapshot, resources as res
+        import numpy as np
+
+        nodes = [
+            {
+                "name": "n0",
+                "allocatable": {"cpu": "8000m", "memory": "32768Mi"},
+                "annotations": {
+                    "node.koordinator.sh/reservation": (
+                        '{"resources": {"cpu": "1000m"}}'
+                    )
+                },
+            }
+        ]
+        pods = [
+            {
+                "name": "p0",
+                "requests": {"koordinator.sh/batch-cpu": "500"},
+            }
+        ]
+        tn, tp, _ = transform_cluster(nodes, pods)
+        snap = encode_snapshot(tn, tp)
+        cpu = res.RESOURCE_INDEX[res.CPU]
+        bcpu = res.RESOURCE_INDEX[res.BATCH_CPU]
+        assert int(np.asarray(snap.nodes.allocatable)[0, cpu]) == 7000
+        assert int(np.asarray(snap.pods.requests)[0, bcpu]) == 500
