@@ -24,6 +24,7 @@ class KubeletStub:
         token: Optional[str] = None,
         token_path: Optional[str] = None,
         insecure_skip_verify: bool = True,
+        ca_path: Optional[str] = None,
         timeout_seconds: float = 10.0,
     ):
         self.base = f"{scheme}://{address}:{port}"
@@ -31,7 +32,9 @@ class KubeletStub:
         self._token = token
         self._token_path = token_path
         if scheme == "https":
-            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            # create_default_context loads the system trust store, so the
+            # verifying mode actually works; ca_path pins the cluster CA
+            ctx = ssl.create_default_context(cafile=ca_path)
             if insecure_skip_verify:
                 # kubelet serving certs are cluster-internal; the reference
                 # defaults to InsecureSkipVerify for the same reason

@@ -59,23 +59,60 @@ class DockerProxyServer:
                 pass
 
             def _proxy(self, body: Optional[bytes]):
-                conn = http.client.HTTPConnection(*outer.backend, timeout=30)
-                headers = {
-                    k: v
-                    for k, v in self.headers.items()
-                    if k.lower() not in _HOP_HEADERS
-                }
-                conn.request(self.command, self.path, body=body, headers=headers)
-                resp = conn.getresponse()
-                data = resp.read()
+                try:
+                    conn = http.client.HTTPConnection(
+                        *outer.backend, timeout=30
+                    )
+                    headers = {
+                        k: v
+                        for k, v in self.headers.items()
+                        if k.lower() not in _HOP_HEADERS
+                    }
+                    conn.request(
+                        self.command, self.path, body=body, headers=headers
+                    )
+                    resp = conn.getresponse()
+                except OSError as exc:
+                    # backend down: a structured 502, not a TCP reset
+                    self._error(502, f"runtime backend unavailable: {exc}")
+                    return
+                length = resp.getheader("Content-Length")
                 self.send_response(resp.status)
                 for k, v in resp.getheaders():
                     if k.lower() not in _HOP_HEADERS:
                         self.send_header(k, v)
+                if length is not None:
+                    self.send_header("Content-Length", length)
+                    self.end_headers()
+                    remaining = int(length)
+                    while remaining > 0:
+                        chunk = resp.read(min(65536, remaining))
+                        if not chunk:
+                            break
+                        self.wfile.write(chunk)
+                        remaining -= len(chunk)
+                else:
+                    # unbounded/streaming endpoint (events, logs?follow):
+                    # stream chunks through, close-delimited — never buffer
+                    # the whole body (it may never end)
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    while True:
+                        chunk = resp.read(65536)
+                        if not chunk:
+                            break
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+                    self.close_connection = True
+                conn.close()
+
+            def _error(self, code: int, message: str):
+                data = json.dumps({"message": message}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
-                conn.close()
 
             def do_GET(self):
                 self._proxy(None)
@@ -84,7 +121,11 @@ class DockerProxyServer:
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
                 if _CREATE_RE.match(self.path.split("?")[0]):
-                    body = outer._intercept_create(body)
+                    try:
+                        body = outer._intercept_create(body)
+                    except Exception as exc:  # FAIL policy: structured 500
+                        self._error(500, f"hook chain failed: {exc}")
+                        return
                 self._proxy(body)
 
             do_DELETE = do_GET
@@ -113,7 +154,9 @@ class DockerProxyServer:
         except ValueError:
             return body  # passthrough on unparseable body
         labels = doc.get("Labels") or {}
-        host_config = doc.setdefault("HostConfig", {})
+        # explicit JSON null must not crash the interposer
+        host_config = doc.get("HostConfig") or {}
+        doc["HostConfig"] = host_config
         ctx = ContainerContext(
             pod_uid=labels.get("io.kubernetes.pod.uid", ""),
             container_name=labels.get("io.kubernetes.container.name", ""),
@@ -140,7 +183,8 @@ class DockerProxyServer:
             host_config["CpusetCpus"] = ctx.cpuset_cpus
         if ctx.memory_limit_bytes is not None:
             host_config["Memory"] = ctx.memory_limit_bytes
-        env = doc.setdefault("Env", [])
+        env = doc.get("Env") or []
+        doc["Env"] = env
         for k, v in ctx.env.items():
             env.append(f"{k}={v}")
         return json.dumps(doc).encode()

@@ -243,8 +243,14 @@ class ReservationController:
         ``util/reservation.go:53``): the pod carries the reservation's
         requests/priority plus the reserve-pod annotations."""
         out = []
+        now = self.clock()
         for r in self.reservations.values():
             if r.phase != PENDING:
+                continue
+            if self._needs_expiration(r, now):
+                # expiry is lazily applied: a dead reservation must not be
+                # enqueued even if no sync pass ran yet
+                self.expire(r, now)
                 continue
             out.append(
                 {
@@ -268,8 +274,13 @@ class ReservationController:
         reservation binds — a late callback must not resurrect an expired
         or already-bound one."""
         r = self.reservations.get(reservation_name)
-        if r is not None and r.phase == PENDING:
-            self.mark_available(reservation_name, node, now)
+        if r is None or r.phase != PENDING:
+            return
+        check_now = self.clock() if now is None else now
+        if self._needs_expiration(r, check_now):
+            self.expire(r, check_now)  # late bind of a dead reservation
+            return
+        self.mark_available(reservation_name, node, now)
 
     # -- snapshot feed ------------------------------------------------------
     def active_reservations(self) -> List[Dict]:

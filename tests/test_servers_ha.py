@@ -389,3 +389,68 @@ class TestDockerProxy:
             proxy.stop()
             backend.shutdown()
             backend.server_close()
+
+
+class TestManagerServer:
+    def test_leader_reconciles_all_controllers(self, tmp_path):
+        import time as _time
+
+        from koordinator_tpu.manager.server import ClusterView, ManagerServer
+
+        nodes = [
+            {
+                "name": "n0",
+                "allocatable": {"cpu": "16000m", "memory": "65536Mi"},
+                "labels": {},
+            }
+        ]
+        pods = [
+            {
+                "name": "hp",
+                "node": "n0",
+                "requests": {"cpu": "4000m", "memory": "8192Mi"},
+                "priority_class": "koord-prod",
+            }
+        ]
+        metrics = {
+            "n0": {
+                "system_usage": {"cpu": "1000m", "memory": "2048Mi"},
+                "pod_metrics": {
+                    "default/hp": {"cpu": "3000m", "memory": "4096Mi"}
+                },
+                "update_time": _time.time(),
+            }
+        }
+        cluster = ClusterView(
+            nodes_fn=lambda: nodes,
+            pods_fn=lambda: pods,
+            node_metrics_fn=lambda: metrics,
+            quota_profiles_fn=lambda: [
+                {
+                    "name": "tenant-a",
+                    "node_selector": {},
+                    "ratio": {"cpu": 50, "memory": 50},
+                }
+            ],
+        )
+        s = ManagerServer(
+            cluster,
+            lease_path=str(tmp_path / "leader.lease"),
+            resync_seconds=0.01,
+        ).start()
+        try:
+            deadline = time.time() + 10
+            while s.reconciles < 1 and time.time() < deadline:
+                time.sleep(0.05)
+            assert s.reconciles >= 1
+            # every controller produced output
+            assert "n0" in cluster.nodemetric_specs
+            ext = cluster.node_extended_resources["n0"]
+            assert ext.get("kubernetes.io/batch-cpu", 0) > 0
+            assert "n0" in cluster.nodeslos
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{s.http_port}/healthz", timeout=5
+            ) as r:
+                assert json.loads(r.read())["leader"]
+        finally:
+            s.stop()
