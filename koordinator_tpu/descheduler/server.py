@@ -25,6 +25,7 @@ from koordinator_tpu.descheduler.runtime import (
     DeschedulerProfile,
     PluginSet,
 )
+from koordinator_tpu.httpserving import HTTPLifecycle
 from koordinator_tpu.leaderelection import LeaderElector
 
 
@@ -82,6 +83,7 @@ class DeschedulerServer:
                     self.end_headers()
 
         self._httpd = ThreadingHTTPServer((http_host, http_port), Handler)
+        self._http = HTTPLifecycle(self._httpd)
 
     @property
     def http_port(self) -> int:
@@ -105,18 +107,17 @@ class DeschedulerServer:
         for target in (
             lambda: self.elector.run(),
             lambda: self._loop(sleep),
-            self._httpd.serve_forever,
         ):
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
+        self._http.start()
         return self
 
     def stop(self):
         self._stop.set()
         self.elector.stop()
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._http.stop()
         for t in self._threads[:2]:
             t.join(timeout=5)
 

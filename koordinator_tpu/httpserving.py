@@ -1,0 +1,36 @@
+"""Shared lifecycle for the daemons' HTTP servers.
+
+``BaseServer.shutdown()`` blocks on an event only ``serve_forever()``'s
+``finally`` sets, so calling it when the serving loop never ran deadlocks
+— and checking a started-inside-the-thread flag instead is a TOCTOU race
+(stop() between ``thread.start()`` and the loop's first iteration would
+``server_close()`` a socket ``serve_forever()`` is about to use).  The
+flag here flips BEFORE ``thread.start()``: once the thread is started,
+``serve_forever()`` is guaranteed to run eventually and release
+``shutdown()``.
+"""
+
+from __future__ import annotations
+
+import threading
+from socketserver import BaseServer
+
+
+class HTTPLifecycle:
+    """Owns the serve thread + safe shutdown for one http.server."""
+
+    def __init__(self, httpd: BaseServer):
+        self.httpd = httpd
+        self._started = False
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+
+    def start(self) -> None:
+        self._started = True  # before thread.start(): shutdown() may block
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._started:
+            self.httpd.shutdown()
+        self.httpd.server_close()

@@ -21,6 +21,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Mapping, Optional
 
+from koordinator_tpu.httpserving import HTTPLifecycle
 from koordinator_tpu.leaderelection import LeaderElector
 from koordinator_tpu.manager.nodemetric import reconcile_nodemetrics
 from koordinator_tpu.manager.noderesource import calculate_batch_resource
@@ -100,6 +101,7 @@ class ManagerServer:
                 self.wfile.write(data)
 
         self._httpd = ThreadingHTTPServer((http_host, http_port), Handler)
+        self._http = HTTPLifecycle(self._httpd)
 
     @property
     def http_port(self) -> int:
@@ -174,18 +176,17 @@ class ManagerServer:
         for target in (
             lambda: self.elector.run(),
             self._loop,
-            self._httpd.serve_forever,
         ):
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
+        self._http.start()
         return self
 
     def stop(self):
         self._stop.set()
         self.elector.stop()
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._http.stop()
         if self.webhook is not None:
             self.webhook.stop()
         for t in self._threads[:2]:

@@ -29,6 +29,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Mapping, Optional, Tuple
 
+from koordinator_tpu.httpserving import HTTPLifecycle
 from koordinator_tpu.manager.profile import mutate_by_profiles
 from koordinator_tpu.manager.validating import (
     validate_node_colocation,
@@ -236,9 +237,7 @@ class WebhookServer:
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._wrap_tls()
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
-        )
+        self._http = HTTPLifecycle(self._httpd)
 
     def _wrap_tls(self):
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -253,12 +252,11 @@ class WebhookServer:
         return self._httpd.server_address[1]
 
     def start(self) -> "WebhookServer":
-        self._thread.start()
+        self._http.start()
         return self
 
     def stop(self):
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._http.stop()
 
     def rotate_if_needed(self) -> bool:
         """Cert rotation tick: regenerate near-expiry certs and reload the

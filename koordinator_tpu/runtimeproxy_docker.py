@@ -17,6 +17,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Mapping, Optional, Tuple
 
+from koordinator_tpu.httpserving import HTTPLifecycle
 from koordinator_tpu.koordlet.runtimehooks import ContainerContext, HookRegistry
 from koordinator_tpu.runtimeproxy import FailurePolicy
 
@@ -131,21 +132,18 @@ class DockerProxyServer:
             do_DELETE = do_GET
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
-        )
+        self._http = HTTPLifecycle(self._httpd)
 
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
 
     def start(self) -> "DockerProxyServer":
-        self._thread.start()
+        self._http.start()
         return self
 
     def stop(self):
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._http.stop()
 
     # -- create interception (docker/handler.go HandleCreateContainer) --
     def _intercept_create(self, body: bytes) -> bytes:

@@ -26,6 +26,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from koordinator_tpu.bridge.server import ScorerServicer, make_server
+from koordinator_tpu.httpserving import HTTPLifecycle
 from koordinator_tpu.bridge.udsserver import RawUdsServer
 from koordinator_tpu.config import DEFAULT_CYCLE_CONFIG
 from koordinator_tpu.leaderelection import LeaderElector
@@ -120,9 +121,7 @@ class SchedulerServer:
                 self.wfile.write(data)
 
         self._httpd = ThreadingHTTPServer((http_host, http_port), Handler)
-        self._http_thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
-        )
+        self._http = HTTPLifecycle(self._httpd)
 
     @property
     def http_port(self) -> int:
@@ -137,7 +136,7 @@ class SchedulerServer:
             self._grpc_server = make_server(servicer=self.servicer)
             self._grpc_server.add_insecure_port(f"unix://{self.uds_path}")
             self._grpc_server.start()
-        self._http_thread.start()
+        self._http.start()
         self._elector_thread = threading.Thread(
             target=self.elector.run, daemon=True
         )
@@ -152,8 +151,7 @@ class SchedulerServer:
             self._raw_server.stop()
         if self._grpc_server:
             self._grpc_server.stop(0)
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._http.stop()
 
 
 def build_arg_parser() -> argparse.ArgumentParser:

@@ -1,0 +1,199 @@
+"""Cluster-in-a-process e2e smoke: every binary's role, wired together.
+
+The reference's e2e layer runs kind clusters (SURVEY §4); this is the
+in-process equivalent smoke: koord-manager computes batch overcommit,
+the webhook mutates a BE pod onto batch resources, koord-scheduler
+places the mix through the bridge seam, a reservation goes
+Pending → scheduled → Available, and koord-descheduler's LowNodeLoad
+evicts from the hot node through the MigrationController.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.bridge.codegen import pb2
+from koordinator_tpu.harness.golden import build_sync_request
+from koordinator_tpu.manager.profile import mutate_by_profiles
+from koordinator_tpu.manager.server import ClusterView, ManagerServer
+from koordinator_tpu.model import resources as res
+
+Gi = 1024 * 1024 * 1024
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    nodes = [
+        {
+            "name": f"n{i}",
+            "allocatable": {"cpu": "16000m", "memory": "65536Mi", "pods": 110},
+            "usage": {"cpu": f"{1000 * (i + 1)}m", "memory": "8192Mi"},
+            "labels": {},
+        }
+        for i in range(4)
+    ]
+    prod_pods = [
+        {
+            "name": f"prod-{i}",
+            "node": f"n{i % 4}",
+            "requests": {"cpu": "2000m", "memory": "4096Mi"},
+            "priority_class": "koord-prod",
+            "priority": 9500,
+        }
+        for i in range(8)
+    ]
+    metrics = {
+        n["name"]: {
+            "system_usage": {"cpu": "500m", "memory": "1024Mi"},
+            "pod_metrics": {},
+            "update_time": time.time(),
+        }
+        for n in nodes
+    }
+    return nodes, prod_pods, metrics
+
+
+def test_full_stack_smoke(tmp_path, cluster):
+    nodes, prod_pods, metrics = cluster
+
+    # ---- koord-manager: batch overcommit -> node extended resources ----
+    view = ClusterView(
+        nodes_fn=lambda: nodes,
+        pods_fn=lambda: prod_pods,
+        node_metrics_fn=lambda: metrics,
+    )
+    manager = ManagerServer(
+        view, lease_path=str(tmp_path / "mgr.lease"), resync_seconds=3600
+    )
+    manager.reconcile_once()
+    batch = view.node_extended_resources["n0"]
+    assert batch.get("kubernetes.io/batch-cpu", 0) > 0
+    for nd in nodes:
+        ext = view.node_extended_resources[nd["name"]]
+        # as_extended_resources emits axis units ready for re-parse:
+        # batch-cpu a bare milli int, batch-memory an "NMi" string
+        nd["allocatable"] = {**nd["allocatable"], **ext}
+
+    # ---- webhook: a BE pod is mutated onto batch resources ----
+    profiles = [
+        {
+            "name": "be-profile",
+            "spec": {
+                "selector": {"matchLabels": {"app": "batch-job"}},
+                "labels": {"koordinator.sh/qosClass": "BE"},
+                "priorityClassName": "koord-batch",
+            },
+        }
+    ]
+    be_pod = {
+        "name": "be-0",
+        "labels": {"app": "batch-job"},
+        "requests": {"cpu": "1000m", "memory": "2048Mi"},
+        "priority": 5500,
+    }
+    mutated = mutate_by_profiles(be_pod, profiles)
+    # resource translation moved the BE pod onto batch resources
+    assert "kubernetes.io/batch-cpu" in mutated["requests"]
+
+    # ---- koord-scheduler: the mix placed through the bridge seam ----
+    from koordinator_tpu.scheduler.server import SchedulerServer
+
+    sched = SchedulerServer(
+        lease_path=str(tmp_path / "sched.lease"),
+        uds_path=str(tmp_path / "scorer.sock"),
+        enable_grpc=False,
+    )
+    sched.elector.is_leader = True  # unit-style: elected synchronously
+
+    pending = [dict(p, node=None) for p in prod_pods[:4]] + [mutated]
+    req, _ = build_sync_request(nodes, pending, [], [])
+    sched.servicer.sync(req)
+    reply = sched.servicer.assign(pb2.AssignRequest(snapshot_id="s1"))
+    assignment = list(reply.assignment)
+    assert len(assignment) == len(pending)
+    assert all(a >= 0 for a in assignment), "everything must place"
+    assert reply.path in ("pallas", "scan")
+
+    # ---- reservation: Pending -> scheduled -> Available ----
+    from koordinator_tpu.scheduler.reservation_controller import (
+        AVAILABLE,
+        Reservation,
+        ReservationController,
+    )
+
+    rc = ReservationController(clock=lambda: 0.0)
+    rc.create(
+        Reservation(
+            name="web-reserve",
+            requests={"cpu": "4000m", "memory": "8192Mi"},
+            owners=[{"label_selector": {"app": "web"}}],
+            ttl_seconds=None,
+        )
+    )
+    reserve_pods = rc.pending_reserve_pods()
+    req2, _ = build_sync_request(nodes, reserve_pods, [], [])
+    sv2 = sched.servicer
+    sv2.sync(req2)
+    r2 = sv2.assign(pb2.AssignRequest(snapshot_id="s2"))
+    chosen = list(r2.assignment)[0]
+    assert chosen >= 0
+    rc.on_reserve_pod_assigned("web-reserve", nodes[chosen]["name"])
+    assert rc.reservations["web-reserve"].phase == AVAILABLE
+
+    # ---- koord-descheduler: hot node rebalanced via migration ----
+    from koordinator_tpu.descheduler.evictions import PodEvictor
+    from koordinator_tpu.descheduler.migration import (
+        MigrationController,
+        MigrationControllerArgs,
+    )
+    from koordinator_tpu.descheduler.lownodeload import (
+        LowNodeLoadArgs,
+        NodePool,
+    )
+    from koordinator_tpu.descheduler.runtime import (
+        Descheduler,
+        DeschedulerProfile,
+        PluginSet,
+    )
+
+    nodes[0]["usage"] = {"cpu": "15000m", "memory": "20480Mi"}
+    nodes[0]["pods"] = [
+        {
+            "name": f"victim-{i}",
+            "namespace": "default",
+            "requests": {"cpu": "3000m", "memory": "4096Mi"},
+            "usage": {"cpu": "3000m", "memory": "4096Mi"},
+            "priority": 5000,
+            "owner_references": [{"kind": "ReplicaSet", "name": "rs"}],
+        }
+        for i in range(4)
+    ]
+    for nd in nodes[1:]:
+        nd["pods"] = []
+    evictor = PodEvictor()
+    migration = MigrationController(
+        args=MigrationControllerArgs(default_job_mode="EvictDirectly"),
+        evict=lambda pod: evictor.evict(pod, pod.get("node", ""), reason="m"),
+    )
+    profile = DeschedulerProfile(
+        plugins=PluginSet(balance=["LowNodeLoad"]),
+        plugin_config={
+            "LowNodeLoad": LowNodeLoadArgs(
+                node_pools=[
+                    NodePool(
+                        low_thresholds={"cpu": 30, "memory": 30},
+                        high_thresholds={"cpu": 70, "memory": 70},
+                        consecutive_abnormalities=1,
+                    )
+                ]
+            )
+        },
+    )
+    d = Descheduler(
+        [profile], nodes_fn=lambda: nodes, evictor=evictor, migration=migration
+    )
+    status = d.descheduler_once()
+    assert status.ok
+    assert evictor.total_evicted() >= 1
+    assert all(r.node == "n0" for r in evictor.evicted)
