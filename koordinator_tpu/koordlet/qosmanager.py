@@ -391,43 +391,138 @@ class CgroupReconcileStrategy(QOSStrategy):
         self.executor.update_batch(updates, now)
 
 
+def calculate_cat_l3_mask(cbm: int, start_percent: int, end_percent: int) -> str:
+    """reference ``util/system/resctrl.go:558 CalculateCatL3MaskValue``:
+    contiguous way mask covering [start% * ways, end% * ways), hex.
+
+    The root cbm must be a full mask (all ones): x86 CAT requires
+    contiguous '1' bits and the root group exposes every way."""
+    import math
+
+    if cbm <= 0 or bin(cbm + 1).count("1") != 1:
+        raise ValueError(f"illegal cbm {cbm:#x}")
+    if start_percent < 0 or end_percent > 100 or end_percent <= start_percent:
+        raise ValueError(
+            f"illegal l3 cat percent: start {start_percent}, end {end_percent}"
+        )
+    ways = cbm.bit_length()
+    start_way = math.ceil(ways * start_percent / 100)
+    end_way = math.ceil(ways * end_percent / 100)
+    return format((1 << end_way) - (1 << start_way), "x")
+
+
 class ResctrlStrategy(QOSStrategy):
     """L3 cache / memory-bandwidth isolation groups (reference
     qosmanager/plugins/resctrl + resourceexecutor/resctrl_updater.go):
-    write schemata per QoS group from NodeSLO percentages."""
+    create the LS/BE/LSR groups, write L3 way-interval + MB percent
+    schemata from NodeSLO, and bind each QoS class's tasks into its
+    group's tasks file (appending one pid per write, duplicates dropped —
+    ``resctrl_updater.go:143-146``)."""
 
     name = "resctrl"
 
+    # QoS class -> resctrl group (reference init: LSR/LS share LS by default)
+    GROUPS = ("LSR", "LS", "BE")
+
     def __init__(
         self, informer: StatesInformer, executor: ResourceUpdateExecutor, *,
-        cbm_bits: int = 12, num_l3: int = 1
+        cbm: int = 0xFFF, num_l3: int = 1
     ):
         self.informer = informer
         self.executor = executor
-        self.cbm_bits = cbm_bits
+        self.cbm = cbm
         self.num_l3 = num_l3
+        self._bound_tasks: dict = {g: set() for g in self.GROUPS}
 
     def enabled(self) -> bool:
         slo = self.informer.get_node_slo()
         return (slo.get("resctrlQOS") or {}).get("enable", False)
 
-    def _schemata(self, percent: int) -> str:
-        bits = max(1, self.cbm_bits * percent // 100)
-        mask = (1 << bits) - 1
-        l3 = ";".join(f"{i}={mask:x}" for i in range(self.num_l3))
-        return f"L3:{l3}\n"
+    def _root(self) -> str:
+        return f"{self.executor.fs.root}/sys/fs/resctrl"
+
+    def _schemata(self, qos_cfg: Mapping) -> str:
+        start = int(qos_cfg.get("catRangeStartPercent", 0))
+        end = int(qos_cfg.get("catRangeEndPercent", 100))
+        mask = calculate_cat_l3_mask(self.cbm, start, end)
+        l3 = ";".join(f"{i}={mask}" for i in range(self.num_l3))
+        lines = [f"L3:{l3}"]
+        mba = qos_cfg.get("mbaPercent")
+        if mba is not None:
+            mb = ";".join(f"{i}={int(mba)}" for i in range(self.num_l3))
+            lines.append(f"MB:{mb}")
+        return "\n".join(lines) + "\n"
 
     def tick(self, now: float) -> None:
+        import os
+
         slo = self.informer.get_node_slo()
         cfg = slo.get("resctrlQOS") or {}
-        for group, key in (("LS", "lsClass"), ("BE", "beClass")):
-            percent = int(
-                ((cfg.get(key) or {}).get("resctrlQOS") or {}).get(
-                    "catRangeEndPercent", 100
-                )
+        class_key = {"LSR": "lsrClass", "LS": "lsClass", "BE": "beClass"}
+        for group in self.GROUPS:
+            qos_cfg = (cfg.get(class_key[group]) or {}).get("resctrlQOS")
+            if qos_cfg is None and group == "LSR":
+                # LSR falls back to the LS class config (reference default)
+                qos_cfg = (cfg.get("lsClass") or {}).get("resctrlQOS")
+            if qos_cfg is None:
+                qos_cfg = {}
+            gdir = f"{self._root()}/{group}"
+            os.makedirs(gdir, exist_ok=True)  # resctrl group = mkdir
+            try:
+                schemata = self._schemata(qos_cfg)
+            except ValueError:
+                # malformed NodeSLO percentages must not kill the daemon
+                # loop: skip this group's update, keep the others running
+                continue
+            self.executor.fs.write(f"{gdir}/schemata", schemata)
+            # task binding: one pid per appending write() call — the
+            # kernel interface binds per write, duplicates error out and
+            # are skipped (resctrl_updater.go:143-146)
+            pids = set(self._group_tasks(group))
+            # prune: a pid that left the group (pod exit) must re-bind if
+            # the kernel recycles it for a new pod
+            self._bound_tasks[group] &= pids
+            tasks_path = f"{gdir}/tasks"
+            for pid in sorted(pids - self._bound_tasks[group]):
+                if self._append_task(tasks_path, pid):
+                    self._bound_tasks[group].add(pid)
+
+    @staticmethod
+    def _append_task(path: str, pid: int) -> bool:
+        """One pid per O_APPEND write, never a truncate-rewrite; a failed
+        write (task exited mid-bind, EPERM) is retried next tick."""
+        try:
+            with open(path, "a") as fh:
+                fh.write(f"{pid}\n")
+            return True
+        except OSError:
+            return False
+
+    def _group_tasks(self, group: str):
+        """All pids of pods in the group's koord QoS class, read from each
+        pod's cgroup.procs (the reference walks the pod cgroup dirs the
+        same way, ``resctrl.go`` task collection)."""
+        from koordinator_tpu.koordlet.sysfs import pod_cgroup_dir
+
+        out = []
+        for pod in self.informer.get_all_pods():
+            koord_qos = pod.koord_qos or "LS"
+            if koord_qos == "LSE":  # LSE never shares a CAT group
+                continue
+            target = "LSR" if koord_qos == "LSR" else (
+                "BE" if koord_qos == "BE" else "LS"
             )
-            path = f"{self.executor.fs.root}/sys/fs/resctrl/{group}/schemata"
-            self.executor.fs.write(path, self._schemata(percent))
+            if target != group:
+                continue
+            procs = self.executor.fs.read(
+                f"{self.executor.fs.root}/sys/fs/cgroup/"
+                f"{pod_cgroup_dir(pod.qos, pod.uid)}/cgroup.procs"
+            )
+            if procs:
+                out.extend(
+                    int(line) for line in procs.split() if line.isdigit()
+                )
+        return out
 
 
 class BlkIOReconcileStrategy(QOSStrategy):

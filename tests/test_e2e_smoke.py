@@ -10,16 +10,12 @@ evicts from the hot node through the MigrationController.
 
 import time
 
-import numpy as np
 import pytest
 
 from koordinator_tpu.bridge.codegen import pb2
 from koordinator_tpu.harness.golden import build_sync_request
 from koordinator_tpu.manager.profile import mutate_by_profiles
 from koordinator_tpu.manager.server import ClusterView, ManagerServer
-from koordinator_tpu.model import resources as res
-
-Gi = 1024 * 1024 * 1024
 
 
 @pytest.fixture()

@@ -443,3 +443,166 @@ class TestDaemon:
         write_proc(fs, "stat", "cpu  200 0 200 800 0 0 0 0\n")
         out = d.run_once(30.0)
         assert out["node_metric"] is not None
+
+
+class TestResctrlFull:
+    def test_cat_mask_matches_reference_examples(self):
+        from koordinator_tpu.koordlet.qosmanager import calculate_cat_l3_mask
+
+        # reference resctrl.go:573-579 worked examples
+        assert calculate_cat_l3_mask(0x3FF, 10, 80) == "fe"
+        assert calculate_cat_l3_mask(0x7FF, 10, 50) == "3c"
+        assert calculate_cat_l3_mask(0x7FF, 0, 30) == "f"
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            calculate_cat_l3_mask(0x5FF, 0, 50)  # non-contiguous cbm
+        with _pytest.raises(ValueError):
+            calculate_cat_l3_mask(0x3FF, 50, 50)  # empty interval
+
+    def test_groups_schemata_and_task_binding(self, tmp_path):
+        import os
+
+        from koordinator_tpu.koordlet.collectors import PodMeta
+        from koordinator_tpu.koordlet.qosmanager import ResctrlStrategy
+        from koordinator_tpu.koordlet.resourceexecutor import (
+            ResourceUpdateExecutor,
+        )
+        from koordinator_tpu.koordlet.statesinformer import StatesInformer
+        from koordinator_tpu.koordlet.sysfs import SysFS, pod_cgroup_dir
+
+        root = str(tmp_path)
+        fs = SysFS(root=root)
+        informer = StatesInformer()
+        informer.set_node_slo(
+            {
+                "resctrlQOS": {
+                    "enable": True,
+                    "lsClass": {
+                        "resctrlQOS": {
+                            "catRangeStartPercent": 0,
+                            "catRangeEndPercent": 80,
+                            "mbaPercent": 100,
+                        }
+                    },
+                    "beClass": {
+                        "resctrlQOS": {
+                            "catRangeStartPercent": 0,
+                            "catRangeEndPercent": 30,
+                            "mbaPercent": 50,
+                        }
+                    },
+                }
+            }
+        )
+        be_pod = PodMeta(name="be", uid="u-be", qos="BestEffort", koord_qos="BE")
+        informer.set_pods([be_pod])
+        procs_path = (
+            f"{root}/sys/fs/cgroup/{pod_cgroup_dir('BestEffort', 'u-be')}"
+            f"/cgroup.procs"
+        )
+        os.makedirs(os.path.dirname(procs_path), exist_ok=True)
+        with open(procs_path, "w") as fh:
+            fh.write("42\n17\n")
+
+        strategy = ResctrlStrategy(
+            informer, ResourceUpdateExecutor(fs), cbm=0x3FF
+        )
+        assert strategy.enabled()
+        strategy.tick(0.0)
+
+        # full schemata model: way-interval L3 masks + MB lines per group
+        with open(f"{root}/sys/fs/resctrl/BE/schemata") as fh:
+            be = fh.read()
+        assert "L3:0=7" in be  # 0-30% of 10 ways -> 0b111
+        assert "MB:0=50" in be
+        with open(f"{root}/sys/fs/resctrl/LS/schemata") as fh:
+            ls = fh.read()
+        assert "L3:0=ff" in ls  # 0-80% of 10 ways -> 0xff
+        # task binding: the BE pod's pids landed in the BE tasks file
+        with open(f"{root}/sys/fs/resctrl/BE/tasks") as fh:
+            tasks = fh.read().split()
+        assert tasks == ["17", "42"]
+        # re-tick: no duplicate appends
+        strategy.tick(1.0)
+        with open(f"{root}/sys/fs/resctrl/BE/tasks") as fh:
+            assert fh.read().split() == ["17", "42"]
+
+    def test_bad_percent_range_skips_group_not_daemon(self, tmp_path):
+        from koordinator_tpu.koordlet.qosmanager import ResctrlStrategy
+        from koordinator_tpu.koordlet.resourceexecutor import (
+            ResourceUpdateExecutor,
+        )
+        from koordinator_tpu.koordlet.statesinformer import StatesInformer
+        from koordinator_tpu.koordlet.sysfs import SysFS
+
+        informer = StatesInformer()
+        informer.set_node_slo(
+            {
+                "resctrlQOS": {
+                    "enable": True,
+                    "lsClass": {
+                        "resctrlQOS": {
+                            "catRangeStartPercent": 50,
+                            "catRangeEndPercent": 50,  # invalid: empty
+                        }
+                    },
+                    "beClass": {
+                        "resctrlQOS": {"catRangeEndPercent": 30}
+                    },
+                }
+            }
+        )
+        fs = SysFS(root=str(tmp_path))
+        strategy = ResctrlStrategy(
+            informer, ResourceUpdateExecutor(fs), cbm=0x3FF
+        )
+        strategy.tick(0.0)  # must not raise
+        # the valid group still got its schemata
+        with open(f"{tmp_path}/sys/fs/resctrl/BE/schemata") as fh:
+            assert "L3:0=7" in fh.read()
+
+    def test_recycled_pid_rebinds(self, tmp_path):
+        import os
+
+        from koordinator_tpu.koordlet.collectors import PodMeta
+        from koordinator_tpu.koordlet.qosmanager import ResctrlStrategy
+        from koordinator_tpu.koordlet.resourceexecutor import (
+            ResourceUpdateExecutor,
+        )
+        from koordinator_tpu.koordlet.statesinformer import StatesInformer
+        from koordinator_tpu.koordlet.sysfs import SysFS, pod_cgroup_dir
+
+        root = str(tmp_path)
+        fs = SysFS(root=root)
+        informer = StatesInformer()
+        informer.set_node_slo({"resctrlQOS": {"enable": True}})
+        pod = PodMeta(name="be", uid="u1", qos="BestEffort", koord_qos="BE")
+        informer.set_pods([pod])
+        procs = (
+            f"{root}/sys/fs/cgroup/{pod_cgroup_dir('BestEffort', 'u1')}"
+            f"/cgroup.procs"
+        )
+        os.makedirs(os.path.dirname(procs), exist_ok=True)
+        with open(procs, "w") as fh:
+            fh.write("100\n")
+        strategy = ResctrlStrategy(
+            informer, ResourceUpdateExecutor(fs), cbm=0x3FF
+        )
+        strategy.tick(0.0)
+        # the pod exits (pid gone), then a NEW pod gets recycled pid 100
+        informer.set_pods([])
+        strategy.tick(1.0)
+        pod2 = PodMeta(name="be2", uid="u2", qos="BestEffort", koord_qos="BE")
+        informer.set_pods([pod2])
+        procs2 = (
+            f"{root}/sys/fs/cgroup/{pod_cgroup_dir('BestEffort', 'u2')}"
+            f"/cgroup.procs"
+        )
+        os.makedirs(os.path.dirname(procs2), exist_ok=True)
+        with open(procs2, "w") as fh:
+            fh.write("100\n")
+        strategy.tick(2.0)
+        with open(f"{root}/sys/fs/resctrl/BE/tasks") as fh:
+            # bound once for each pod generation: the recycled pid re-bound
+            assert fh.read().split() == ["100", "100"]
