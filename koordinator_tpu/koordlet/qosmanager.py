@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 import time
 from typing import Callable, Dict, List, Mapping, Optional, Sequence
 
@@ -397,8 +398,6 @@ def calculate_cat_l3_mask(cbm: int, start_percent: int, end_percent: int) -> str
 
     The root cbm must be a full mask (all ones): x86 CAT requires
     contiguous '1' bits and the root group exposes every way."""
-    import math
-
     if cbm <= 0 or bin(cbm + 1).count("1") != 1:
         raise ValueError(f"illegal cbm {cbm:#x}")
     if start_percent < 0 or end_percent > 100 or end_percent <= start_percent:
@@ -408,6 +407,13 @@ def calculate_cat_l3_mask(cbm: int, start_percent: int, end_percent: int) -> str
     ways = cbm.bit_length()
     start_way = math.ceil(ways * start_percent / 100)
     end_way = math.ceil(ways * end_percent / 100)
+    if end_way <= start_way:
+        # a narrow interval rounding to the same way boundary would yield
+        # an empty CBM the kernel rejects with EINVAL
+        raise ValueError(
+            f"empty l3 way interval: start {start_percent}%, end "
+            f"{end_percent}% both round to way {start_way} of {ways}"
+        )
     return format((1 << end_way) - (1 << start_way), "x")
 
 
@@ -432,7 +438,6 @@ class ResctrlStrategy(QOSStrategy):
         self.executor = executor
         self.cbm = cbm
         self.num_l3 = num_l3
-        self._bound_tasks: dict = {g: set() for g in self.GROUPS}
 
     def enabled(self) -> bool:
         slo = self.informer.get_node_slo()
@@ -454,8 +459,6 @@ class ResctrlStrategy(QOSStrategy):
         return "\n".join(lines) + "\n"
 
     def tick(self, now: float) -> None:
-        import os
-
         slo = self.informer.get_node_slo()
         cfg = slo.get("resctrlQOS") or {}
         class_key = {"LSR": "lsrClass", "LS": "lsClass", "BE": "beClass"}
@@ -476,16 +479,18 @@ class ResctrlStrategy(QOSStrategy):
                 continue
             self.executor.fs.write(f"{gdir}/schemata", schemata)
             # task binding: one pid per appending write() call — the
-            # kernel interface binds per write, duplicates error out and
-            # are skipped (resctrl_updater.go:143-146)
+            # kernel interface binds per write (resctrl_updater.go:143-146).
+            # Membership truth lives in the group's tasks file (the kernel
+            # drops dead pids itself), so re-reading it each tick handles
+            # pid recycling with no cache to go stale.
             pids = set(self._group_tasks(group))
-            # prune: a pid that left the group (pod exit) must re-bind if
-            # the kernel recycles it for a new pod
-            self._bound_tasks[group] &= pids
             tasks_path = f"{gdir}/tasks"
-            for pid in sorted(pids - self._bound_tasks[group]):
-                if self._append_task(tasks_path, pid):
-                    self._bound_tasks[group].add(pid)
+            bound = set()
+            current = self.executor.fs.read(tasks_path)
+            if current:
+                bound = {int(t) for t in current.split() if t.isdigit()}
+            for pid in sorted(pids - bound):
+                self._append_task(tasks_path, pid)
 
     @staticmethod
     def _append_task(path: str, pid: int) -> bool:
@@ -502,8 +507,6 @@ class ResctrlStrategy(QOSStrategy):
         """All pids of pods in the group's koord QoS class, read from each
         pod's cgroup.procs (the reference walks the pod cgroup dirs the
         same way, ``resctrl.go`` task collection)."""
-        from koordinator_tpu.koordlet.sysfs import pod_cgroup_dir
-
         out = []
         for pod in self.informer.get_all_pods():
             koord_qos = pod.koord_qos or "LS"
@@ -514,8 +517,9 @@ class ResctrlStrategy(QOSStrategy):
             )
             if target != group:
                 continue
-            procs = self.executor.fs.read(
-                f"{self.executor.fs.root}/sys/fs/cgroup/"
+            fs = self.executor.fs
+            procs = fs.read(
+                f"{fs.root}/{fs.cgroup_mount}/"
                 f"{pod_cgroup_dir(pod.qos, pod.uid)}/cgroup.procs"
             )
             if procs:

@@ -76,6 +76,31 @@ class StatesInformer:
         with self._lock:
             return list(self._pods)
 
+    def sync_from_kubelet(self, stub) -> int:
+        """Pull the authoritative pod list from the kubelet endpoint
+        (reference ``impl/states_pods.go`` syncPods via the kubelet stub)
+        and refresh the informer's pod view.  Returns the pod count."""
+        items = stub.get_all_pods()
+        pods: List[PodMeta] = []
+        specs: Dict[str, Dict] = {}
+        for item in items:
+            meta = item.get("metadata") or {}
+            status = item.get("status") or {}
+            labels = meta.get("labels") or {}
+            uid = meta.get("uid", meta.get("name", ""))
+            pods.append(
+                PodMeta(
+                    name=meta.get("name", ""),
+                    uid=uid,
+                    qos=status.get("qosClass", "Burstable"),
+                    koord_qos=labels.get("koordinator.sh/qosClass", ""),
+                    namespace=meta.get("namespace", "default"),
+                )
+            )
+            specs[uid] = item.get("spec") or {}
+        self.set_pods(pods, specs)
+        return len(pods)
+
     def get_pod_spec(self, uid: str) -> Dict:
         with self._lock:
             return dict(self._pod_specs.get(uid, {}))

@@ -590,9 +590,16 @@ class TestResctrlFull:
             informer, ResourceUpdateExecutor(fs), cbm=0x3FF
         )
         strategy.tick(0.0)
-        # the pod exits (pid gone), then a NEW pod gets recycled pid 100
+        with open(f"{root}/sys/fs/resctrl/BE/tasks") as fh:
+            assert fh.read().split() == ["100"]
+        # the pod exits: the KERNEL drops the dead pid from the tasks file
+        # (membership truth lives there, not in a userspace cache)
         informer.set_pods([])
+        with open(f"{root}/sys/fs/resctrl/BE/tasks", "w") as fh:
+            fh.write("")
         strategy.tick(1.0)
+        # a NEW pod starts with recycled pid 100 — re-bound because the
+        # tasks file no longer lists it
         pod2 = PodMeta(name="be2", uid="u2", qos="BestEffort", koord_qos="BE")
         informer.set_pods([pod2])
         procs2 = (
@@ -604,5 +611,4 @@ class TestResctrlFull:
             fh.write("100\n")
         strategy.tick(2.0)
         with open(f"{root}/sys/fs/resctrl/BE/tasks") as fh:
-            # bound once for each pod generation: the recycled pid re-bound
-            assert fh.read().split() == ["100", "100"]
+            assert fh.read().split() == ["100"]

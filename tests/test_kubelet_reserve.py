@@ -103,3 +103,34 @@ class TestReservationAsPod:
         c.create(Reservation(name="r1", requests={"cpu": "1"}, ttl_seconds=None))
         c.mark_available("r1", "n0")
         assert c.pending_reserve_pods() == []
+
+
+class TestInformerKubeletSync:
+    def test_sync_from_kubelet_refreshes_pod_view(self):
+        class FakeStub:
+            def get_all_pods(self):
+                return [
+                    {
+                        "metadata": {
+                            "name": "p1",
+                            "uid": "u1",
+                            "namespace": "ns",
+                            "labels": {"koordinator.sh/qosClass": "BE"},
+                        },
+                        "status": {"qosClass": "BestEffort"},
+                        "spec": {"nodeName": "n0"},
+                    }
+                ]
+
+        from koordinator_tpu.koordlet.statesinformer import StatesInformer
+
+        informer = StatesInformer()
+        events = []
+        informer.register_callback(events.append)
+        assert informer.sync_from_kubelet(FakeStub()) == 1
+        (pod,) = informer.get_all_pods()
+        assert (pod.name, pod.uid, pod.qos, pod.koord_qos, pod.namespace) == (
+            "p1", "u1", "BestEffort", "BE", "ns"
+        )
+        assert informer.get_pod_spec("u1") == {"nodeName": "n0"}
+        assert "pods" in events
