@@ -117,3 +117,119 @@ class Daemon:
             t.join(timeout=5)
         if self.predict is not None:
             self.predict.checkpoint_all()
+        close = getattr(self.cache, "close", None)
+        if close is not None:
+            close()  # the WAL handle belongs to the Daemon that built it
+
+
+def build_default_daemon(
+    *,
+    cgroup_root: str = "/",
+    storage_dir: Optional[str] = None,
+    audit_dir: Optional[str] = None,
+) -> Daemon:
+    """Wire the reference's default module set (koordlet.go:126-178):
+    metriccache -> statesinformer -> the metricsadvisor collector battery
+    -> qosmanager strategies -> audit/metrics, against the host sysfs.
+    Everything goes through the Daemon constructor so MetricsAdvisor's
+    enabled() gate applies to the default battery too."""
+    from koordinator_tpu.koordlet.collectors import (
+        BEResourceCollector,
+        DeviceCollector,
+        NodeResourceCollector,
+        PSICollector,
+        SysResourceCollector,
+    )
+    from koordinator_tpu.koordlet.qosmanager import (
+        BlkIOReconcileStrategy,
+        CgroupReconcileStrategy,
+        CPUBurstStrategy,
+        CPUSuppressStrategy,
+        ResctrlStrategy,
+    )
+    from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+
+    fs = SysFS(root=cgroup_root)
+    informer = StatesInformer()
+    executor = ResourceUpdateExecutor(fs)
+    if storage_dir:
+        from koordinator_tpu.koordlet.metriccache import PersistentMetricCache
+
+        cache = PersistentMetricCache(storage_dir)
+    else:
+        cache = MetricCache()
+    return Daemon(
+        fs=fs,
+        cache=cache,
+        informer=informer,
+        collectors=[
+            NodeResourceCollector(fs, cache),
+            PSICollector(fs, cache),
+            BEResourceCollector(fs, cache),
+            SysResourceCollector(cache),
+            DeviceCollector(cache),
+        ],
+        strategies=[
+            CPUSuppressStrategy(informer, cache, executor),
+            CPUBurstStrategy(informer, executor),
+            CgroupReconcileStrategy(informer, executor),
+            ResctrlStrategy(informer, executor),
+            BlkIOReconcileStrategy(informer, executor),
+        ],
+        reporter=NodeMetricReporter(cache, informer),
+        auditor=Auditor(audit_dir) if audit_dir else None,
+    )
+
+
+def main(argv=None) -> int:
+    """koordlet CLI (cmd/koordlet/main.go): the node agent + /metrics
+    and /events HTTP exposition."""
+    import argparse
+    from wsgiref.simple_server import make_server
+
+    from koordinator_tpu.httpserving import HTTPLifecycle
+
+    ap = argparse.ArgumentParser(prog="koordlet")
+    ap.add_argument("--cgroup-root", default="/")
+    ap.add_argument(
+        "--storage-dir", default=None,
+        help="durable metric WAL dir (restart keeps aggregation windows)",
+    )
+    ap.add_argument("--audit-dir", default=None)
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--http-host", default="127.0.0.1")
+    ap.add_argument("--http-port", type=int, default=9316)
+    args = ap.parse_args(argv)
+
+    daemon = build_default_daemon(
+        cgroup_root=args.cgroup_root,
+        storage_dir=args.storage_dir,
+        audit_dir=args.audit_dir,
+    )
+
+    def app(environ, start_response):
+        path = environ.get("PATH_INFO", "")
+        if path == "/metrics":
+            return daemon.metrics.wsgi_app(environ, start_response)
+        if path == "/events" and daemon.auditor is not None:
+            return daemon.auditor.wsgi_app(environ, start_response)
+        start_response("404 Not Found", [("Content-Type", "text/plain")])
+        return [b"not found"]
+
+    # bind BEFORE the tick loop starts: a port conflict must be a clean
+    # no-op, never a daemon left mutating cgroups with no teardown path
+    http = HTTPLifecycle(make_server(args.http_host, args.http_port, app))
+    daemon.start(args.interval)
+    http.start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        daemon.shutdown()
+        http.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

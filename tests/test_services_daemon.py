@@ -276,3 +276,41 @@ class TestK8sAdaptorPlugins:
         )
         assert evicted_names == ["ok"]
         assert "ds" in result.skipped
+
+
+class TestKoordletCLI:
+    def test_build_default_daemon_full_battery(self, tmp_path):
+        """cmd/koordlet/main.go analog: the default wiring carries the
+        collector battery, qos strategies, reporter, durable cache and
+        ticks end to end against a fake sysfs root."""
+        from koordinator_tpu.koordlet.daemon import build_default_daemon
+        from koordinator_tpu.koordlet.metriccache import PersistentMetricCache
+
+        d = build_default_daemon(
+            cgroup_root=str(tmp_path / "root"),
+            storage_dir=str(tmp_path / "tsdb"),
+            audit_dir=str(tmp_path / "audit"),
+        )
+        try:
+            assert isinstance(d.cache, PersistentMetricCache)
+            assert len(d.advisor.collectors) >= 4
+            assert {s.name for s in d.qos.strategies} >= {
+                "cpusuppress",
+                "cpuburst",
+                "cgreconcile",
+                "resctrl",
+                "blkio",
+            }
+            out = d.run_once(0.0)
+            assert "collectors" in out and "strategies" in out
+            assert d.reporter is not None
+        finally:
+            d.shutdown()  # closes the WAL cache it owns
+
+    def test_cli_arg_surface(self):
+        from koordinator_tpu.koordlet import daemon as mod
+
+        # main() parses its own argv; --help must exist and exit cleanly
+        with pytest.raises(SystemExit) as exc:
+            mod.main(["--help"])
+        assert exc.value.code == 0
