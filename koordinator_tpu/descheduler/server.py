@@ -25,7 +25,11 @@ from koordinator_tpu.descheduler.runtime import (
     DeschedulerProfile,
     PluginSet,
 )
-from koordinator_tpu.httpserving import HTTPLifecycle
+from koordinator_tpu.httpserving import (
+    HTTPLifecycle,
+    format_thread_stacks,
+    reply_text,
+)
 from koordinator_tpu.leaderelection import LeaderElector
 
 
@@ -66,6 +70,9 @@ class DeschedulerServer:
                 pass
 
             def do_GET(self):
+                if self.path == "/debug/stacks":
+                    reply_text(self, format_thread_stacks())
+                    return
                 if self.path == "/healthz":
                     doc = {
                         "ok": True,

@@ -12,8 +12,30 @@ flag here flips BEFORE ``thread.start()``: once the thread is started,
 
 from __future__ import annotations
 
+import sys
 import threading
+import traceback
 from socketserver import BaseServer
+
+
+def format_thread_stacks() -> str:
+    """Live stack dump of every thread — the per-binary net/http/pprof
+    analog (reference serves pprof on each daemon,
+    cmd/koord-scheduler/app/server.go:287 etc.)."""
+    lines = []
+    for tid, frame in sys._current_frames().items():
+        lines.append(f"Thread {tid}:\n")
+        lines.extend(traceback.format_stack(frame))
+    return "".join(lines)
+
+
+def reply_text(handler, body: str, code: int = 200) -> None:
+    data = body.encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "text/plain")
+    handler.send_header("Content-Length", str(len(data)))
+    handler.end_headers()
+    handler.wfile.write(data)
 
 
 class HTTPLifecycle:

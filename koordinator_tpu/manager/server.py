@@ -21,7 +21,11 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Mapping, Optional
 
-from koordinator_tpu.httpserving import HTTPLifecycle
+from koordinator_tpu.httpserving import (
+    HTTPLifecycle,
+    format_thread_stacks,
+    reply_text,
+)
 from koordinator_tpu.leaderelection import LeaderElector
 from koordinator_tpu.manager.nodemetric import reconcile_nodemetrics
 from koordinator_tpu.manager.noderesource import calculate_batch_resource
@@ -87,6 +91,9 @@ class ManagerServer:
                 pass
 
             def do_GET(self):
+                if self.path == "/debug/stacks":
+                    reply_text(self, format_thread_stacks())
+                    return
                 doc = {
                     "ok": outer.last_error is None,
                     "leader": outer.elector.is_leader,

@@ -26,7 +26,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from koordinator_tpu.bridge.server import ScorerServicer, make_server
-from koordinator_tpu.httpserving import HTTPLifecycle
+from koordinator_tpu.httpserving import (
+    HTTPLifecycle,
+    format_thread_stacks,
+    reply_text,
+)
 from koordinator_tpu.bridge.udsserver import RawUdsServer
 from koordinator_tpu.config import DEFAULT_CYCLE_CONFIG
 from koordinator_tpu.leaderelection import LeaderElector
@@ -94,16 +98,15 @@ class SchedulerServer:
                 if self.path == "/healthz":
                     self._reply(200, {"ok": True, "leader": outer.elector.is_leader})
                     return
+                if self.path == "/debug/stacks":
+                    reply_text(self, format_thread_stacks())
+                    return
                 if self.path == "/metrics":
-                    body = (
+                    reply_text(
+                        self,
                         "# TYPE koord_scheduler_leader gauge\n"
-                        f"koord_scheduler_leader {int(outer.elector.is_leader)}\n"
-                    ).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/plain")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                        f"koord_scheduler_leader {int(outer.elector.is_leader)}\n",
+                    )
                     return
                 path, _, query = self.path.partition("?")
                 q = dict(
