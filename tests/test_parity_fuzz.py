@@ -1,0 +1,179 @@
+"""Randomized cross-implementation parity fuzz.
+
+Every feature dimension the cycle supports — quotas, gangs, stale
+metrics, prod/aggregated LoadAware profiles, mixed priority bands — is
+sampled randomly and the Pallas kernel (interpret) must match the
+lax.scan path bit-for-bit on assignments AND post-cycle state.  This is
+the drift alarm for the three-implementation invariant the framework
+maintains (scan / Pallas / shard_map, plus the C++ baseline in
+tests/test_native_bridge.py).
+"""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.config import AggregatedArgs, CycleConfig, LoadAwareArgs
+from koordinator_tpu.constraints import build_quota_table_inputs
+from koordinator_tpu.model import encode_snapshot, resources as res
+from koordinator_tpu.model.snapshot import PERCENTILES
+from koordinator_tpu.solver import greedy_assign
+from koordinator_tpu.solver.pallas_cycle import greedy_assign_pallas
+
+Gi = 1024 * 1024 * 1024
+
+
+def _random_cluster(rng, n_nodes, n_pods, with_agg, with_prod):
+    nodes = []
+    for i in range(n_nodes):
+        cpu = int(rng.choice([8000, 16000, 32000]))
+        mem = int(rng.choice([32, 64, 128])) * Gi
+        nd = {
+            "name": f"n{i}",
+            "allocatable": {"cpu": f"{cpu}m", "memory": mem, "pods": 110},
+            "requested": {
+                "cpu": f"{int(rng.randint(0, cpu // 2))}m",
+                "memory": int(rng.randint(0, mem // 2)),
+            },
+            "usage": {
+                "cpu": f"{int(rng.randint(0, cpu))}m",
+                "memory": int(rng.randint(0, mem)),
+            },
+            "metric_fresh": bool(rng.rand() > 0.15),
+        }
+        if with_prod and rng.rand() > 0.3:
+            nd["prod_usage"] = {
+                "cpu": f"{int(rng.randint(0, cpu))}m",
+                "memory": int(rng.randint(0, mem)),
+            }
+        if with_agg and rng.rand() > 0.3:
+            nd["agg_usage"] = {
+                pct: {
+                    "cpu": f"{int(rng.randint(0, cpu))}m",
+                    "memory": int(rng.randint(0, mem)),
+                }
+                for pct in PERCENTILES
+                if rng.rand() > 0.25  # some percentiles missing
+            }
+        nodes.append(nd)
+
+    pods = []
+    bands = [("koord-prod", 9500), ("koord-mid", 7500), ("koord-batch", 5500)]
+    for i in range(n_pods):
+        pc, prio = bands[int(rng.randint(0, len(bands)))]
+        pod = {
+            "name": f"p{i}",
+            "requests": {
+                "cpu": f"{int(rng.randint(50, 4000))}m",
+                "memory": int(rng.randint(1, 8)) * Gi // 2,
+                "pods": 1,
+            },
+            "priority_class": pc,
+            "priority": prio + int(rng.randint(0, 100)),
+        }
+        if rng.rand() > 0.5:
+            pod["limits"] = {
+                "cpu": f"{int(rng.randint(4000, 8000))}m",
+                "memory": 8 * Gi,
+            }
+        pods.append(pod)
+
+    gangs = []
+    if rng.rand() > 0.5:
+        n_gangs = int(rng.randint(1, 4))
+        gangs = [
+            {"name": f"g{k}", "min_member": int(rng.randint(2, 6))}
+            for k in range(n_gangs)
+        ]
+        for i, p in enumerate(pods):
+            if rng.rand() > 0.6:
+                p["gang"] = f"g{i % n_gangs}"
+
+    quotas = []
+    if rng.rand() > 0.4:
+        total_cpu = sum(
+            res.parse_quantity(n["allocatable"]["cpu"], "cpu") for n in nodes
+        )
+        n_q = int(rng.randint(1, 5))
+        for k in range(n_q):
+            quotas.append(
+                {
+                    "name": f"q{k}",
+                    "min": {"cpu": f"{total_cpu // (2 * n_q)}m"},
+                    "max": {"cpu": f"{total_cpu // n_q}m"},
+                    "shared_weight": int(rng.randint(1, 4)),
+                    "used": {},
+                }
+            )
+        for i, p in enumerate(pods):
+            if rng.rand() > 0.4:
+                p["quota"] = f"q{i % n_q}"
+    return nodes, pods, gangs, quotas
+
+
+def _random_cfg(rng, with_agg, with_prod):
+    kwargs = {}
+    if with_agg:
+        kwargs["aggregated"] = AggregatedArgs(
+            usage_thresholds={res.CPU: int(rng.randint(50, 95))},
+            usage_aggregation_type=str(
+                rng.choice(list(PERCENTILES))
+            ),
+            score_aggregation_type=str(
+                rng.choice(list(PERCENTILES) + [""])
+            ),
+        )
+    if with_prod:
+        kwargs["prod_usage_thresholds"] = {res.CPU: int(rng.randint(40, 90))}
+        kwargs["score_according_prod_usage"] = bool(rng.rand() > 0.5)
+    la = LoadAwareArgs(**kwargs)
+    return CycleConfig(
+        loadaware=la,
+        fit_scoring_strategy=str(
+            rng.choice(["LeastAllocated", "MostAllocated"])
+        ),
+        fit_plugin_weight=int(rng.randint(1, 4)),
+        loadaware_plugin_weight=int(rng.randint(1, 4)),
+        enable_loadaware=bool(rng.rand() > 0.2),
+    )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_scan_pallas_parity_fuzz(seed):
+    rng = np.random.RandomState(seed)
+    with_agg = bool(rng.rand() > 0.5)
+    with_prod = bool(rng.rand() > 0.5)
+    nodes, pods, gangs, quotas = _random_cluster(
+        rng,
+        n_nodes=int(rng.randint(4, 24)),
+        n_pods=int(rng.randint(8, 64)),
+        with_agg=with_agg,
+        with_prod=with_prod,
+    )
+    qdicts = []
+    qids = [-1] * len(pods)
+    if quotas:
+        pod_reqs = [res.resource_vector(p["requests"]) for p in pods]
+        qidx = {q["name"]: i for i, q in enumerate(quotas)}
+        qids = [qidx.get(p.get("quota"), -1) for p in pods]
+        total = [0] * res.NUM_RESOURCES
+        for n in nodes:
+            v = res.resource_vector(n["allocatable"])
+            total = [a + b for a, b in zip(total, v)]
+        qdicts = build_quota_table_inputs(quotas, pod_reqs, qids, total)
+    snap = encode_snapshot(nodes, pods, gangs, qdicts)
+    cfg = _random_cfg(rng, with_agg, with_prod)
+
+    want = greedy_assign(snap, cfg)
+    got = greedy_assign_pallas(snap, cfg, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(got.assignment), np.asarray(want.assignment), err_msg=f"seed={seed}"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.status), np.asarray(want.status)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.node_requested), np.asarray(want.node_requested)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.quota_used), np.asarray(want.quota_used)
+    )
