@@ -55,6 +55,23 @@ from koordinator_tpu.solver.greedy import (
 LANES = 128
 I32_MIN = np.int32(np.iinfo(np.int32).min)
 
+# node flags ride the usage buffer's spare lanes (resources occupy only
+# the first NUM_RESOURCES of 128; the LoadAware weight rows are zero
+# beyond that, so flag lanes never contribute to any score)
+FLAG_LANE_OK = 120  # valid & loadaware default mask
+FLAG_LANE_FRESH = 121  # metric_fresh
+FLAG_LANE_PROD_OK = 122  # valid & prod-threshold mask
+# the initial node-requested vector rides alloc's spare lanes (one roll
+# at init recovers it) — a dedicated req0 buffer cost 1MB of scoped VMEM
+REQ0_LANE_OFFSET = 32
+# the packing scheme silently corrupts real lanes if the resource axis
+# ever grows into the borrowed regions — fail loudly instead
+assert res.NUM_RESOURCES <= REQ0_LANE_OFFSET
+assert REQ0_LANE_OFFSET + res.NUM_RESOURCES <= FLAG_LANE_OK
+# combined extended-plugin tensor: score where feasible, sentinel where
+# masked out (scores are magnitude-guarded < 2^29, far from the sentinel)
+XCOMB_INFEASIBLE = I32_MIN
+
 
 def _pad_rows(a: jnp.ndarray, rows: int) -> jnp.ndarray:
     return jnp.pad(a, ((0, rows - a.shape[0]),) + ((0, 0),) * (a.ndim - 1))
@@ -131,18 +148,19 @@ def _cycle_kernel(
     psreq_ref,  # i32[B, 128] nonzero-default score requests
     pest_ref,  # i32[B, 128] estimator output
     alloc_ref,  # i32[N, 128]
-    usage_ref,  # i32[N, 128] score usage (aggregated pre-selected on host)
-    req0_ref,  # i32[N, 128] initial node requested
-    flags_ref,  # i32[N, 128] lane0 = valid & la_mask, lane1 = metric_fresh,
-    # lane2 = valid & prod la_mask
+    usage_ref,  # i32[N, 128] score usage (aggregated pre-selected on host);
+    # spare lanes carry the node flags (FLAG_LANE_OK/FRESH/PROD_OK) — a
+    # dedicated flags buffer cost 1MB of the 16MB scoped-VMEM budget for
+    # three booleans per node
     qrt_ref,  # i32[Q, 128] quota runtime
     qlim_ref,  # i32[Q, 128] quota limited mask
     quse0_ref,  # i32[Q, 128] initial quota used
     w_ref,  # i32[8, 128] row0 = fit weights, row1 = loadaware weights
     *rest,  # optional: uprod_ref i32[N, 128] (prod-pods usage, has_prod);
-    # optional: xmask_ref i32[N, B], xscore_ref i32[N, B] — the
-    # extended-plugin (NUMA/reservation/deviceshare) tensors, pods on the
-    # lane axis so each step extracts a [N, 1] column — then outputs/scratch
+    # optional: xcomb_ref i32[N, B] — the combined extended-plugin tensor
+    # (NUMA/reservation/deviceshare): score where feasible,
+    # XCOMB_INFEASIBLE where masked, pods on the lane axis so each step
+    # extracts a [N, 1] column — then outputs
     block: int,
     cfg: CycleConfig,
     has_extras: bool,
@@ -154,25 +172,37 @@ def _cycle_kernel(
     else:
         uprod_ref = None
     if has_extras:
-        xmask_ref, xscore_ref = rest[0], rest[1]
-        rest = rest[2:]
+        xcomb_ref = rest[0]
+        rest = rest[1:]
     else:
-        xmask_ref = xscore_ref = None
-    (chosen_ref, nreq_out_ref, nest_out_ref, quse_out_ref,
-     nreq_ref, nest_ref, quse_ref) = rest
+        xcomb_ref = None
+    # the node/quota state carries IN the output refs (constant index
+    # maps persist across grid steps): no separate scratch copies — at
+    # benchmark scale the duplicated state alone overflowed the 16MB
+    # scoped-VMEM limit once the extended-plugin tiles joined
+    (chosen_ref, nreq_ref, nest_ref, quse_ref) = rest
 
     i = pl.program_id(0)
 
     @pl.when(i == _i32(0))
     def _init():
-        nreq_ref[:] = req0_ref[:]
+        # output buffers are NOT initialized on hardware (the standard
+        # revisited-block contract: only what the kernel wrote persists),
+        # so EVERY carried state needs an explicit i==0 init.  The initial
+        # requested state rides alloc's spare lanes: one roll brings lanes
+        # [REQ0_LANE_OFFSET, +R) down to [0, R), the rest zeroes.
+        lane = lax.broadcasted_iota(jnp.int32, alloc_ref.shape, 1)
+        rolled = pltpu.roll(alloc_ref[:], _i32(LANES - REQ0_LANE_OFFSET), 1)
+        nreq_ref[:] = jnp.where(
+            lane < _i32(res.NUM_RESOURCES), rolled, _i32(0)
+        )
         nest_ref[:] = jnp.zeros_like(nest_ref)
         quse_ref[:] = quse0_ref[:]
 
     alloc = alloc_ref[:]
     n_rows = alloc.shape[0]
-    node_ok = flags_ref[:, 0:1] != _i32(0)
-    fresh = flags_ref[:, 1:2] != _i32(0)
+    node_ok = usage_ref[:, FLAG_LANE_OK : FLAG_LANE_OK + 1] != _i32(0)
+    fresh = usage_ref[:, FLAG_LANE_FRESH : FLAG_LANE_FRESH + 1] != _i32(0)
     row_iota = lax.broadcasted_iota(jnp.int32, (n_rows, 1), 0)
 
     fit_w_row = w_ref[0:1, :]
@@ -201,7 +231,11 @@ def _cycle_kernel(
             # select the i32 flag lanes, compare after: a select over i1
             # vectors has no Mosaic legalization ('arith.select')
             node_ok_p = (
-                jnp.where(is_prod, flags_ref[:, 2:3], flags_ref[:, 0:1])
+                jnp.where(
+                    is_prod,
+                    usage_ref[:, FLAG_LANE_PROD_OK : FLAG_LANE_PROD_OK + 1],
+                    usage_ref[:, FLAG_LANE_OK : FLAG_LANE_OK + 1],
+                )
                 != _i32(0)
             )
             usage_p = jnp.where(is_prod, uprod_ref[:], usage_ref[:])
@@ -236,15 +270,15 @@ def _cycle_kernel(
         if has_extras:
             # extract this pod's [N, 1] column by one-hot lane reduction
             # (dynamic lane slicing is costly on the VPU; a masked lane
-            # sum is a single vector op)
+            # sum is a single vector op); the sentinel encodes the mask
             lane = lax.broadcasted_iota(jnp.int32, (1, block), 1) == j
-            xm = jnp.sum(
-                jnp.where(lane, xmask_ref[:], _i32(0)),
+            xv = jnp.sum(
+                jnp.where(lane, xcomb_ref[:], _i32(0)),
                 axis=1,
                 keepdims=True,
                 dtype=jnp.int32,
             )
-            feasible = feasible & (xm != _i32(0))
+            feasible = feasible & (xv != _i32(XCOMB_INFEASIBLE))
 
         # Score: NodeResourcesFit + LoadAware, exact integer math
         total = jnp.zeros((n_rows, 1), jnp.int32)
@@ -263,13 +297,9 @@ def _cycle_kernel(
             la = _weighted(per_res, la_w_row, la_w_sum)
             total = total + _i32(cfg.loadaware_plugin_weight) * jnp.where(fresh, la, _i32(0))
         if has_extras:
-            xs = jnp.sum(
-                jnp.where(lane, xscore_ref[:], _i32(0)),
-                axis=1,
-                keepdims=True,
-                dtype=jnp.int32,
+            total = total + jnp.where(
+                xv == _i32(XCOMB_INFEASIBLE), _i32(0), xv
             )
-            total = total + xs
 
         masked = jnp.where(feasible, total, I32_MIN)
         best = jnp.max(masked)
@@ -294,23 +324,17 @@ def _cycle_kernel(
 
     lax.fori_loop(jnp.int32(0), jnp.int32(block), step, jnp.int32(0))
 
-    @pl.when(i == jnp.int32(pl.num_programs(0) - 1))
-    def _fin():
-        nreq_out_ref[:] = nreq_ref[:]
-        nest_out_ref[:] = nest_ref[:]
-        quse_out_ref[:] = quse_ref[:]
-
 
 @partial(jax.jit, static_argnames=("cfg", "block", "interpret"))
 def _run_cycle(
-    preq, psreq, pest, qid, pvalid, pprod, alloc, usage, req0, flags, qrt,
-    qlim, quse0, weights, uprod=None, xmask=None, xscore=None, *,
+    preq, psreq, pest, qid, pvalid, pprod, alloc, usage, qrt,
+    qlim, quse0, weights, uprod=None, xcomb=None, *,
     cfg: CycleConfig, block: int, interpret: bool
 ):
     P = preq.shape[0]
     N = alloc.shape[0]
     Q = qrt.shape[0]
-    has_extras = xmask is not None
+    has_extras = xcomb is not None
     has_prod = uprod is not None
     grid = (P // block,)
     # index maps return strong-i32 zeros: with x64 on, a literal 0 becomes
@@ -322,11 +346,11 @@ def _run_cycle(
     pod_spec = pl.BlockSpec((block, LANES), lambda i, *_: (i, _z), memory_space=pltpu.VMEM)
     in_specs = (
         [pod_spec, pod_spec, pod_spec]
-        + [node_spec] * 4
+        + [node_spec] * 2
         + [quota_spec] * 3
         + [pl.BlockSpec((8, LANES), lambda i, *_: (_z, _z), memory_space=pltpu.VMEM)]
     )
-    operands = [preq, psreq, pest, alloc, usage, req0, flags, qrt, qlim, quse0, weights]
+    operands = [preq, psreq, pest, alloc, usage, qrt, qlim, quse0, weights]
     if has_prod:
         in_specs += [node_spec]
         operands += [uprod]
@@ -335,19 +359,15 @@ def _run_cycle(
         xtra_spec = pl.BlockSpec(
             (N, block), lambda i, *_: (_z, i), memory_space=pltpu.VMEM
         )
-        in_specs += [xtra_spec, xtra_spec]
-        operands += [xmask, xscore]
+        in_specs += [xtra_spec]
+        operands += [xcomb]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=grid,
         in_specs=in_specs,
         out_specs=[pod_spec, node_spec, node_spec, quota_spec],
-        scratch_shapes=[
-            pltpu.VMEM((N, LANES), jnp.int32),
-            pltpu.VMEM((N, LANES), jnp.int32),
-            pltpu.VMEM((Q, LANES), jnp.int32),
-        ],
     )
+
     kernel = partial(
         _cycle_kernel,
         block=block,
@@ -454,15 +474,6 @@ def _greedy_assign_pallas(
     )
     is_prod = pods.priority_class == int(PriorityClass.PROD)
     pprod = jnp.pad(is_prod[order].astype(jnp.int32), (0, P_pad - P))
-    flags = jnp.stack(
-        [
-            (nodes.valid & mask_default).astype(jnp.int32),
-            nodes.metric_fresh.astype(jnp.int32),
-            (nodes.valid & mask_prod).astype(jnp.int32),
-        ],
-        axis=1,
-    )
-    flags = _pad_rows(jnp.pad(flags, ((0, 0), (0, LANES - flags.shape[1]))), N_pad)
     if prod_sensitive:
         uprod = _pad_rows(
             _lanes(usage_prod if usage_prod is not None else usage_np), N_pad
@@ -487,22 +498,44 @@ def _greedy_assign_pallas(
     )
 
     if extra_mask is not None or extra_scores is not None:
-        # sorted pod order on the LANE axis, nodes on sublanes: [N_pad, P_pad]
+        # sorted pod order on the LANE axis, nodes on sublanes: [N_pad,
+        # P_pad]; ONE combined tensor — score where feasible, sentinel
+        # where masked (halves the streamed VMEM tiles)
         if extra_mask is None:
             extra_mask = jnp.ones((P, N), bool)
         if extra_scores is None:
             extra_scores = jnp.zeros((P, N), jnp.int64)
-        xmask = jnp.pad(
-            extra_mask[order].astype(jnp.int32).T,
-            ((0, N_pad - N), (0, P_pad - P)),
+        comb = jnp.where(
+            extra_mask,
+            extra_scores.astype(jnp.int32),
+            jnp.int32(XCOMB_INFEASIBLE),
         )
-        xscore = jnp.pad(
-            extra_scores[order].astype(jnp.int32).T,
+        xcomb = jnp.pad(
+            comb[order].T,
             ((0, N_pad - N), (0, P_pad - P)),
+            constant_values=np.int32(XCOMB_INFEASIBLE),
         )
     else:
-        xmask = xscore = None
+        xcomb = None
 
+    usage_with_flags = _pad_rows(_lanes(usage_np), N_pad)
+    n_gap = N_pad - mask_default.shape[0]
+    for flag_lane, vec in (
+        (FLAG_LANE_OK, nodes.valid & mask_default),
+        (FLAG_LANE_FRESH, nodes.metric_fresh),
+        (FLAG_LANE_PROD_OK, nodes.valid & mask_prod),
+    ):
+        usage_with_flags = usage_with_flags.at[:, flag_lane].set(
+            jnp.pad(vec.astype(jnp.int32), (0, n_gap))
+        )
+    # the initial requested vector rides alloc's spare lanes
+    alloc_packed = _pad_rows(_lanes(nodes.allocatable), N_pad)
+    req0 = _pad_rows(_lanes(nodes.requested), N_pad)
+    alloc_packed = lax.dynamic_update_slice(
+        alloc_packed,
+        req0[:, : res.NUM_RESOURCES],
+        (0, REQ0_LANE_OFFSET),
+    )
     chosen, nreq, nest, quse = _run_cycle(
         preq,
         psreq,
@@ -510,17 +543,14 @@ def _greedy_assign_pallas(
         qid,
         pvalid,
         pprod,
-        _pad_rows(_lanes(nodes.allocatable), N_pad),
-        _pad_rows(_lanes(usage_np), N_pad),
-        _pad_rows(_lanes(nodes.requested), N_pad),
-        flags,
+        alloc_packed,
+        usage_with_flags,
         qrt,
         qlim,
         quse0,
         weights,
         uprod,
-        xmask,
-        xscore,
+        xcomb,
         cfg=cfg,
         block=block,
         interpret=interpret,
