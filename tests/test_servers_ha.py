@@ -608,3 +608,192 @@ class TestRawUdsConcurrency:
             c0.close()
         finally:
             server.stop()
+
+
+class TestRemoteHookDispatch:
+    def test_proxy_forwards_hooks_to_koordlet_process(self, tmp_path):
+        """The reference's delivery split: the CRI proxy dispatches hook
+        RPCs to koordlet's hook server instead of running them in-process
+        (apis/runtime/v1alpha1/api.proto:148; runtimehooks/proxyserver)."""
+        from koordinator_tpu.koordlet.hookserver import (
+            HookServer,
+            RemoteHookRegistry,
+        )
+        from koordinator_tpu.koordlet.runtimehooks import (
+            ContainerContext,
+            HookRegistry,
+        )
+        from koordinator_tpu.runtimeproxy import CRIRequest
+        from koordinator_tpu.runtimeproxy_server import (
+            CRIProxyClient,
+            CRIProxyServer,
+            FakeRuntimeServer,
+        )
+
+        # koordlet side: the real registry + hook server
+        registry = HookRegistry()
+
+        def group_identity(ctx: ContainerContext):
+            if ctx.qos == "BE":
+                ctx.cfs_quota_us = 20000
+                ctx.env["KOORD_QOS"] = "BE"
+
+        registry.register("PreCreateContainer", "groupidentity", group_identity)
+        hook_sock = str(tmp_path / "koordlet-hooks.sock")
+        hook_server = HookServer(hook_sock, registry).start()
+
+        # proxy side: a REMOTE registry — no hook code in this process
+        backend = FakeRuntimeServer(str(tmp_path / "containerd.sock")).start()
+        remote = RemoteHookRegistry(hook_sock)
+        proxy = CRIProxyServer(
+            str(tmp_path / "proxy.sock"), backend.path, remote
+        ).start()
+        client = CRIProxyClient(str(tmp_path / "proxy.sock"))
+        try:
+            client.call(
+                CRIRequest(
+                    call="RunPodSandbox",
+                    pod_uid="u1",
+                    labels={"koordinator.sh/qosClass": "BE"},
+                )
+            )
+            resp = client.call(
+                CRIRequest(
+                    call="CreateContainer",
+                    pod_uid="u1",
+                    container_name="c1",
+                    labels={"koordinator.sh/qosClass": "BE"},
+                )
+            )
+            # mutations crossed BOTH process boundaries
+            assert resp["cpu_quota"] == 20000
+            assert resp["env"]["KOORD_QOS"] == "BE"
+        finally:
+            client.close()
+            proxy.stop()
+            remote.close()
+            backend.stop()
+            hook_server.stop()
+
+    def test_concurrent_clients_get_their_own_mutations(self, tmp_path):
+        """Replies must match requests per thread: a shared hook
+        connection handed containers each other's quotas (review repro)."""
+        from koordinator_tpu.koordlet.hookserver import (
+            HookServer,
+            RemoteHookRegistry,
+        )
+        from koordinator_tpu.koordlet.runtimehooks import HookRegistry
+        from koordinator_tpu.runtimeproxy import CRIRequest
+        from koordinator_tpu.runtimeproxy_server import (
+            CRIProxyClient,
+            CRIProxyServer,
+            FakeRuntimeServer,
+        )
+
+        registry = HookRegistry()
+
+        def per_container_quota(ctx):
+            # deterministic per-container mutation to detect crosstalk
+            ctx.cfs_quota_us = 1000 + int(ctx.container_name.split("-")[1])
+
+        registry.register("PreCreateContainer", "q", per_container_quota)
+        hook_sock = str(tmp_path / "hooks.sock")
+        hook_server = HookServer(hook_sock, registry).start()
+        backend = FakeRuntimeServer(str(tmp_path / "containerd.sock")).start()
+        remote = RemoteHookRegistry(hook_sock)
+        proxy = CRIProxyServer(
+            str(tmp_path / "proxy.sock"), backend.path, remote
+        ).start()
+
+        errors = []
+
+        def worker(base):
+            try:
+                c = CRIProxyClient(str(tmp_path / "proxy.sock"))
+                for k in range(20):
+                    cid = base * 1000 + k
+                    resp = c.call(
+                        CRIRequest(
+                            call="CreateContainer",
+                            pod_uid=f"u{base}",
+                            container_name=f"c-{cid}",
+                        )
+                    )
+                    if resp["cpu_quota"] != 1000 + cid:
+                        errors.append((cid, resp["cpu_quota"]))
+                c.close()
+            except Exception as exc:
+                errors.append(exc)
+
+        ts = [threading.Thread(target=worker, args=(b,)) for b in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        try:
+            assert not errors, errors[:5]
+        finally:
+            proxy.stop()
+            remote.close()
+            backend.stop()
+            hook_server.stop()
+
+    def test_fail_policy_surfaces_hook_errors(self, tmp_path):
+        from koordinator_tpu.koordlet.hookserver import RemoteHookRegistry
+        from koordinator_tpu.runtimeproxy import CRIRequest, FailurePolicy
+        from koordinator_tpu.runtimeproxy_server import (
+            CRIProxyClient,
+            CRIProxyServer,
+            FakeRuntimeServer,
+        )
+
+        backend = FakeRuntimeServer(str(tmp_path / "containerd.sock")).start()
+        remote = RemoteHookRegistry(str(tmp_path / "nobody.sock"))
+        proxy = CRIProxyServer(
+            str(tmp_path / "proxy.sock"),
+            backend.path,
+            remote,
+            failure_policy=FailurePolicy.FAIL,
+        ).start()
+        client = CRIProxyClient(str(tmp_path / "proxy.sock"))
+        try:
+            resp = client.call(
+                CRIRequest(call="CreateContainer", pod_uid="u1")
+            )
+            # FAIL policy: the client receives an error frame, nothing is
+            # forwarded to the runtime
+            assert "error" in resp
+            assert backend.calls == []
+        finally:
+            client.close()
+            proxy.stop()
+            remote.close()
+            backend.stop()
+
+    def test_unreachable_hook_server_honors_failure_policy(self, tmp_path):
+        from koordinator_tpu.koordlet.hookserver import RemoteHookRegistry
+        from koordinator_tpu.runtimeproxy import CRIRequest
+        from koordinator_tpu.runtimeproxy_server import (
+            CRIProxyClient,
+            CRIProxyServer,
+            FakeRuntimeServer,
+        )
+
+        backend = FakeRuntimeServer(str(tmp_path / "containerd.sock")).start()
+        remote = RemoteHookRegistry(str(tmp_path / "nobody-home.sock"))
+        proxy = CRIProxyServer(
+            str(tmp_path / "proxy.sock"), backend.path, remote
+        ).start()
+        client = CRIProxyClient(str(tmp_path / "proxy.sock"))
+        try:
+            # Ignore policy: the request passes through untouched
+            resp = client.call(
+                CRIRequest(call="CreateContainer", pod_uid="u1")
+            )
+            assert resp["handled_by"] == "fake-runtime"
+            assert resp.get("cpu_quota") is None
+        finally:
+            client.close()
+            proxy.stop()
+            remote.close()
+            backend.stop()
