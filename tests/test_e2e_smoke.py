@@ -193,3 +193,103 @@ def test_full_stack_smoke(tmp_path, cluster):
     assert status.ok
     assert evictor.total_evicted() >= 1
     assert all(r.node == "n0" for r in evictor.evicted)
+
+
+def test_reservation_first_migration(cluster):
+    """The reference's ReservationFirst migration mode end to end
+    (controllers/migration/controller.go:241): the MigrationController
+    creates a Reservation for the victim, waits for the scheduler to bind
+    it, and only then evicts — so the pod's capacity is guaranteed at the
+    destination before the disruption."""
+    from koordinator_tpu.bridge.server import ScorerServicer
+    from koordinator_tpu.descheduler.evictions import PodEvictor
+    from koordinator_tpu.descheduler.migration import (
+        MigrationController,
+        MigrationControllerArgs,
+        MigrationJob,
+    )
+    from koordinator_tpu.scheduler.reservation_controller import (
+        AVAILABLE,
+        Reservation,
+        ReservationController,
+    )
+
+    nodes, prod_pods, metrics = cluster
+    evictor = PodEvictor()
+    rc = ReservationController(clock=lambda: 0.0)
+    servicer = ScorerServicer()
+
+    def create_reservation(job: MigrationJob):
+        """Migration's reservation factory: creates the Reservation only;
+        the scheduler binds it on a LATER cycle (async, like the real
+        apiserver flow)."""
+        name = f"migrate-{job.pod['name']}"
+        rc.create(
+            Reservation(
+                name=name,
+                requests=dict(job.pod.get("requests") or {}),
+                ttl_seconds=None,
+            )
+        )
+        return name
+
+    def schedule_pending_reservations(exclude_node):
+        # the scheduler cycle places pending reserve pods (source node
+        # taken out of the candidate set, like the reference's
+        # anti-affinity to the source)
+        candidates = [nd for nd in nodes if nd["name"] != exclude_node]
+        reserve_pods = rc.pending_reserve_pods()
+        if not reserve_pods:
+            return
+        req, _ = build_sync_request(candidates, reserve_pods, [], [])
+        servicer.sync(req)
+        reply = servicer.assign(
+            pb2.AssignRequest(snapshot_id=f"s{servicer._generation}")
+        )
+        for pod, chosen in zip(reserve_pods, reply.assignment):
+            if chosen >= 0:
+                rc.on_reserve_pod_assigned(
+                    pod["annotations"][
+                        "scheduling.koordinator.sh/reservation-name"
+                    ],
+                    candidates[chosen]["name"],
+                )
+
+    migration = MigrationController(
+        args=MigrationControllerArgs(default_job_mode="ReservationFirst"),
+        create_reservation=create_reservation,
+        reservation_bound=lambda name: rc.reservations[name].phase
+        == AVAILABLE,
+        evict=lambda pod: evictor.evict(
+            pod, pod.get("node", ""), reason="reservation-first migration"
+        ),
+    )
+
+    victim = {
+        "name": "victim-0",
+        "namespace": "default",
+        "node": "n0",
+        "requests": {"cpu": "2000m", "memory": "4096Mi"},
+    }
+    migration.submit(
+        MigrationJob(name="mj-victim-0", pod=victim, creation_time=0.0)
+    )
+    # tick 1: the reservation exists but is NOT yet bound — the job must
+    # WAIT, not evict (controller.go:587 wait-for-bound)
+    migration.reconcile(now=1.0)
+    job = migration.jobs["mj-victim-0"]
+    assert job.phase == "Running"
+    assert job.reason == "WaitForReservationBound"
+    assert evictor.total_evicted() == 0
+
+    # the scheduler binds the reserve pod between ticks
+    schedule_pending_reservations(exclude_node="n0")
+
+    # tick 2: bound -> evict -> Succeeded
+    migration.reconcile(now=2.0)
+    job = migration.jobs["mj-victim-0"]
+    assert job.phase == "Succeeded", (job.phase, job.reason)
+    # the reservation was bound on a DIFFERENT node before the eviction
+    r = rc.reservations[job.reservation_name]
+    assert r.phase == AVAILABLE and r.node != "n0"
+    assert [e.pod for e in evictor.evicted] == ["victim-0"]
