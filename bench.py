@@ -437,6 +437,63 @@ def child_config(platform: str, config: str) -> None:
         )
         return
 
+    if config == "extras":
+        # the composed extended-plugin cycle: NUMA/reservation/deviceshare
+        # Filter/Score tensors riding the kernel at benchmark scale
+        import jax.numpy as jnp
+
+        from koordinator_tpu.constraints import build_quota_table_inputs
+        from koordinator_tpu.solver import greedy_assign
+        from koordinator_tpu.solver.pallas_cycle import greedy_assign_pallas
+
+        nodes, pods, gangs, quotas = generators.quota_colocation(
+            pods=PODS, nodes=NODES
+        )
+        pod_reqs = [res.resource_vector(p["requests"]) for p in pods]
+        qidx = {q["name"]: i for i, q in enumerate(quotas)}
+        qids = [qidx.get(p.get("quota"), -1) for p in pods]
+        total = [0] * res.NUM_RESOURCES
+        for n in nodes:
+            v = res.resource_vector(n["allocatable"])
+            total = [a + b for a, b in zip(total, v)]
+        qdicts = build_quota_table_inputs(quotas, pod_reqs, qids, total)
+        snap = encode_snapshot(
+            nodes, pods, gangs, qdicts, node_bucket=NODES, pod_bucket=PODS
+        )
+        P = snap.pods.capacity
+        N = snap.nodes.allocatable.shape[0]
+        rng = np.random.RandomState(0)
+        xmask = jnp.asarray(rng.rand(P, N) > 0.1)
+        xscore = jnp.asarray(rng.randint(0, 100, (P, N)).astype(np.int64))
+        run = (
+            greedy_assign_pallas if backend != "cpu" else greedy_assign
+        )
+        t0 = time.perf_counter()
+        result = run(snap, extra_mask=xmask, extra_scores=xscore)
+        np.asarray(result.assignment)
+        phase("compile", ms=_ms(t0), path=result.path)
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            result = run(snap, extra_mask=xmask, extra_scores=xscore)
+            np.asarray(result.assignment)
+            times.append(_ms(t0))
+        assignment = np.asarray(result.assignment)[: len(pods)]
+        print(
+            json.dumps(
+                {
+                    "metric": "extras_10kpod_2knode_ms",
+                    "value": round(min(times), 2),
+                    "unit": "ms",
+                    "backend": backend,
+                    "path": result.path,
+                    "assigned": int((assignment >= 0).sum()),
+                }
+            ),
+            flush=True,
+        )
+        return
+
     if config == "rebalance":
         # BASELINE config #5: LowNodeLoad Balance tick over the same
         # 10k x 2k cluster, pods placed by the scheduling cycle
@@ -647,7 +704,7 @@ def main() -> int:
     ap.add_argument(
         "--config",
         default=None,
-        choices=["spark", "loadaware", "gang", "rebalance"],
+        choices=["spark", "loadaware", "gang", "extras", "rebalance"],
         help="measure a secondary BASELINE config instead of the headline "
         "10k x 2k quota_colocation cycle (driver contract: no args prints "
         "exactly the one headline JSON line)",
