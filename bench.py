@@ -47,6 +47,27 @@ TPU_TIMEOUT = int(os.environ.get("KOORD_BENCH_TPU_TIMEOUT", "600"))
 CPU_TIMEOUT = int(os.environ.get("KOORD_BENCH_CPU_TIMEOUT", "900"))
 
 
+def _quota_snapshot(encode_snapshot, generators, res, build_quota_table_inputs):
+    """The headline 10k x 2k quota_colocation snapshot — ONE recipe shared
+    by the headline child, the extras config, and the rebalance config so
+    every number in BASELINE.md measures the same cluster."""
+    nodes, pods, gangs, quotas = generators.quota_colocation(
+        pods=PODS, nodes=NODES
+    )
+    pod_reqs = [res.resource_vector(p["requests"]) for p in pods]
+    qidx = {q["name"]: i for i, q in enumerate(quotas)}
+    qids = [qidx.get(p.get("quota"), -1) for p in pods]
+    total = [0] * res.NUM_RESOURCES
+    for n in nodes:
+        v = res.resource_vector(n["allocatable"])
+        total = [a + b for a, b in zip(total, v)]
+    qdicts = build_quota_table_inputs(quotas, pod_reqs, qids, total)
+    snap = encode_snapshot(
+        nodes, pods, gangs, qdicts, node_bucket=NODES, pod_bucket=PODS
+    )
+    return snap, nodes, pods, gangs, quotas, qdicts
+
+
 def child(platform: str) -> None:
     """Measurement process: prints phase lines then the final JSON line."""
 
@@ -83,17 +104,8 @@ def child(platform: str) -> None:
     from koordinator_tpu.model import encode_snapshot, resources as res
     from koordinator_tpu.solver import pallas_inputs_fit_i32
 
-    nodes, pods, gangs, quotas = generators.quota_colocation(pods=PODS, nodes=NODES)
-    pod_reqs = [res.resource_vector(p["requests"]) for p in pods]
-    qidx = {q["name"]: i for i, q in enumerate(quotas)}
-    qids = [qidx.get(p.get("quota"), -1) for p in pods]
-    total = [0] * res.NUM_RESOURCES
-    for n in nodes:
-        v = res.resource_vector(n["allocatable"])
-        total = [a + b for a, b in zip(total, v)]
-    qdicts = build_quota_table_inputs(quotas, pod_reqs, qids, total)
-    snap = encode_snapshot(
-        nodes, pods, gangs, qdicts, node_bucket=NODES, pod_bucket=PODS
+    snap, nodes, pods, gangs, quotas, qdicts = _quota_snapshot(
+        encode_snapshot, generators, res, build_quota_table_inputs
     )
     phase("snapshot", ms=_ms(t0))
 
@@ -231,8 +243,10 @@ def _timed(fn) -> float:
 
 def child_config(platform: str, config: str) -> None:
     """Per-config measurement (BASELINE.md's remaining targets): spark
-    3-node exact-score parity, gang 5k x 500, LowNodeLoad rebalance on the
-    10k x 2k snapshot.  Prints one JSON line."""
+    3-node exact-score parity, LoadAware joint 1k x 200, gang 5k x 500,
+    the composed extended-plugin cycle (extras), and the LowNodeLoad
+    rebalance — the last three on the 10k x 2k snapshot.  Prints one
+    JSON line."""
 
     def phase(name, **kw):
         print(json.dumps({"phase": name, **kw}), flush=True)
@@ -446,20 +460,13 @@ def child_config(platform: str, config: str) -> None:
         from koordinator_tpu.solver import greedy_assign
         from koordinator_tpu.solver.pallas_cycle import greedy_assign_pallas
 
-        nodes, pods, gangs, quotas = generators.quota_colocation(
-            pods=PODS, nodes=NODES
+        from koordinator_tpu.solver import pallas_inputs_fit_i32
+
+        snap, nodes, pods, gangs, quotas, qdicts = _quota_snapshot(
+            encode_snapshot, generators, res, build_quota_table_inputs
         )
-        pod_reqs = [res.resource_vector(p["requests"]) for p in pods]
-        qidx = {q["name"]: i for i, q in enumerate(quotas)}
-        qids = [qidx.get(p.get("quota"), -1) for p in pods]
-        total = [0] * res.NUM_RESOURCES
-        for n in nodes:
-            v = res.resource_vector(n["allocatable"])
-            total = [a + b for a, b in zip(total, v)]
-        qdicts = build_quota_table_inputs(quotas, pod_reqs, qids, total)
-        snap = encode_snapshot(
-            nodes, pods, gangs, qdicts, node_bucket=NODES, pod_bucket=PODS
-        )
+        if backend != "cpu":
+            assert pallas_inputs_fit_i32(snap), "snapshot out of i32 range"
         P = snap.pods.capacity
         N = snap.nodes.allocatable.shape[0]
         rng = np.random.RandomState(0)
@@ -479,6 +486,8 @@ def child_config(platform: str, config: str) -> None:
             np.asarray(result.assignment)
             times.append(_ms(t0))
         assignment = np.asarray(result.assignment)[: len(pods)]
+        assert int((assignment >= 0).sum()) > 0, "extras cycle assigned nothing"
+        assert result.path == ("pallas" if backend != "cpu" else "scan")
         print(
             json.dumps(
                 {
@@ -506,19 +515,8 @@ def child_config(platform: str, config: str) -> None:
         )
         from koordinator_tpu.solver import run_cycle
 
-        nodes, pods, gangs, quotas = generators.quota_colocation(
-            pods=PODS, nodes=NODES
-        )
-        pod_reqs = [res.resource_vector(p["requests"]) for p in pods]
-        qidx = {q["name"]: i for i, q in enumerate(quotas)}
-        qids = [qidx.get(p.get("quota"), -1) for p in pods]
-        total = [0] * res.NUM_RESOURCES
-        for n in nodes:
-            v = res.resource_vector(n["allocatable"])
-            total = [a + b for a, b in zip(total, v)]
-        qdicts = build_quota_table_inputs(quotas, pod_reqs, qids, total)
-        snap = encode_snapshot(
-            nodes, pods, gangs, qdicts, node_bucket=NODES, pod_bucket=PODS
+        snap, nodes, pods, gangs, quotas, qdicts = _quota_snapshot(
+            encode_snapshot, generators, res, build_quota_table_inputs
         )
         result = run_cycle(snap)
         assignment = np.asarray(result.assignment)[: len(pods)]
