@@ -148,11 +148,13 @@ def child(platform: str) -> None:
     phase("compile", ms=compile_ms, path=path)
 
     times = []
-    for _ in range(3):
+    for _ in range(6):
         t0 = time.perf_counter()
         result = run()
         np.asarray(result.assignment)
         times.append(_ms(t0))
+    # min over 6 reps: the tunneled backend adds tens of ms of per-call
+    # jitter; the min tracks the device+transport floor stably
     ms = min(times)
     assigned = int((np.asarray(result.assignment)[:PODS] >= 0).sum())
     assert assigned > 0, "benchmark snapshot scheduled nothing"
