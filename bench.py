@@ -168,11 +168,25 @@ def child(platform: str) -> None:
     # succeeded (failed attempts never reach it).  Best-effort: a baseline
     # failure must never kill the bench artifact.
     cpu_native_ms = None
+    cpu_native_mt_ms = None
+    hw_threads = None
     try:
-        cpu_native_ms, _ = _native_baseline(nodes, pods, gangs, quotas)
+        cpu_native_ms, _, _ = _native_baseline(nodes, pods, gangs, quotas)
         phase("cpu_native_baseline", ms=cpu_native_ms)
     except Exception as exc:  # noqa: BLE001
         phase("cpu_native_baseline_failed", error=str(exc)[:200])
+    try:
+        # the 16-way node-loop fan-out (the reference's Parallelizer
+        # width).  On a host with < 16 cores this measures honest
+        # oversubscription, not speedup — hw_concurrency is recorded so
+        # the reader can tell; BASELINE.md carries the extrapolation.
+        cpu_native_mt_ms, _, mt_info = _native_baseline(
+            nodes, pods, gangs, quotas, iters=2, threads=16
+        )
+        hw_threads = mt_info.get("hw_concurrency")
+        phase("cpu_native_mt", ms=cpu_native_mt_ms, hw_concurrency=hw_threads)
+    except Exception as exc:  # noqa: BLE001
+        phase("cpu_native_mt_failed", error=str(exc)[:200])
     print(
         json.dumps(
             {
@@ -190,6 +204,15 @@ def child(platform: str) -> None:
                 "vs_cpu_native": (
                     round(cpu_native_ms / ms, 3) if cpu_native_ms else None
                 ),
+                # 16-thread node-loop fan-out on this host (honest only
+                # when cpu_hw_concurrency >= 16; see BASELINE.md)
+                "cpu_native_mt_ms": cpu_native_mt_ms,
+                "vs_cpu_native_mt": (
+                    round(cpu_native_mt_ms / ms, 3)
+                    if cpu_native_mt_ms
+                    else None
+                ),
+                "cpu_hw_concurrency": hw_threads,
                 # per-call transport floor of this platform; subtract for
                 # net device-kernel time
                 "rtt_floor_ms": round(rtt_ms, 2),
@@ -199,11 +222,14 @@ def child(platform: str) -> None:
     )
 
 
-def _native_baseline(nodes, pods, gangs, quotas, iters=3):
-    """Build + run the C++ sequential baseline on a golden snapshot.
+def _native_baseline(nodes, pods, gangs, quotas, iters=3, threads=1):
+    """Build + run the C++ baseline (sequential per-pod cycle; node loop
+    fanned out over ``threads`` OpenMP threads when > 1, the reference's
+    Parallelizer shape at framework_extender.go:216) on a golden snapshot.
 
-    Returns (ms, native_assignment list).  Raises on any failure — callers
-    decide whether that is fatal (parity checks) or best-effort (metrics).
+    Returns (ms, native_assignment list, info dict with threads and the
+    host's hw_concurrency).  Raises on any failure — callers decide
+    whether that is fatal (parity checks) or best-effort (metrics).
     """
     import tempfile
 
@@ -221,16 +247,21 @@ def _native_baseline(nodes, pods, gangs, quotas, iters=3):
         golden = os.path.join(tmp, "golden.bin")
         write_golden(golden, nodes, pods, gangs, quotas)
         out = subprocess.run(
-            [os.path.join(native_dir, "score_baseline"), golden, str(iters)],
+            [
+                os.path.join(native_dir, "score_baseline"),
+                golden,
+                str(iters),
+                str(threads),
+            ],
             capture_output=True,
             text=True,
-            timeout=120,
+            timeout=300,
             check=True,
         )
     lines = out.stdout.splitlines()
-    ms = json.loads(lines[0])["value"]
+    info = json.loads(lines[0])
     assign = [int(v) for v in lines[1].split()[1:]]
-    return ms, assign
+    return info["value"], assign, info
 
 
 def _ms(t0: float) -> float:
@@ -422,7 +453,7 @@ def child_config(platform: str, config: str) -> None:
         cpu_ms = None
         native_assign = None
         try:
-            cpu_ms, native_assign = _native_baseline(
+            cpu_ms, native_assign, _ = _native_baseline(
                 nodes, pods, gangs, quotas
             )
         except Exception as exc:  # noqa: BLE001

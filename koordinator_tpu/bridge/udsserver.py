@@ -33,7 +33,13 @@ METHOD_SYNC = 1
 METHOD_SCORE = 2
 METHOD_ASSIGN = 3
 
-_MAX_FRAME = 1 << 30
+# Sized to the largest realistic SyncRequest (10k pods x 2k nodes of i64
+# request/capacity vectors serializes to a few MB); anything larger is a
+# malformed or hostile frame, not a workload.
+_MAX_FRAME = 64 << 20
+# One thread per connection; bound concurrent connections so a local
+# misbehaving client cannot spawn unbounded threads/buffers.
+_MAX_CONNS = 32
 
 
 def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
@@ -63,6 +69,7 @@ class RawUdsServer:
         self._sock.bind(path)
         self._sock.listen(8)
         self._stop = threading.Event()
+        self._conn_slots = threading.BoundedSemaphore(_MAX_CONNS)
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._methods = {
             METHOD_SYNC: (pb2.SyncRequest, self.servicer.sync),
@@ -89,12 +96,21 @@ class RawUdsServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return  # socket closed by stop()
+            if not self._conn_slots.acquire(timeout=1.0):
+                conn.close()  # saturated: shed instead of queueing unbounded
+                continue
             t = threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             )
             t.start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            self._serve_conn_inner(conn)
+        finally:
+            self._conn_slots.release()
+
+    def _serve_conn_inner(self, conn: socket.socket) -> None:
         with conn:
             while not self._stop.is_set():
                 header = _recv_exact(conn, 5)

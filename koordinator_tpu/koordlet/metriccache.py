@@ -216,6 +216,12 @@ class PersistentMetricCache(MetricCache):
     block retention).  Records are fixed-width binary; series keys are
     interned once per segment stream via key-definition records, so the
     steady-state write is 20 bytes per sample.
+
+    Durability contract: every append is flushed (survives process
+    restart); sealed segments are fsync'd at rotation (survive host
+    crash).  The tail of the *active* segment rides the page cache and
+    can lose recent samples to power loss — same trade the reference's
+    head-block WAL makes before TSDB block cut.
     """
 
     def __init__(
@@ -245,6 +251,12 @@ class PersistentMetricCache(MetricCache):
             if existing
             else -1
         )
+        if existing and os.path.getsize(existing[-1]) >= segment_bytes:
+            # the last segment is full but the writer died before rotating:
+            # it is being sealed implicitly here, so give it the same fsync
+            # a normal rotation would have
+            with open(existing[-1], "rb") as fh:
+                os.fsync(fh.fileno())
         if (
             existing
             and os.path.getsize(existing[-1]) < segment_bytes
@@ -308,7 +320,18 @@ class PersistentMetricCache(MetricCache):
         return _REC.pack(_KEYDEF, kid, float(len(blob))) + blob
 
     def _rotate(self, now: float):
+        # fsync before sealing: flush() alone leaves the segment in the
+        # page cache, so a host crash (not just a process restart) could
+        # drop the tail of an otherwise "durable" sealed segment.  The
+        # directory is fsync'd too so the new segment's dirent survives.
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
         self._fh.close()
+        dirfd = os.open(self.directory, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
         self._seg_index += 1
         self._fh = open(self._segment_path(self._seg_index), "ab")
         for key, kid in sorted(self._key_ids.items(), key=lambda kv: kv[1]):

@@ -174,14 +174,17 @@ class CertManager:
 
 
 def _json_patch(original: Mapping, mutated: Mapping) -> List[Dict]:
-    """Top-level RFC-6902 replace/add ops for changed keys (the reference
-    computes the patch from the mutated object the same way)."""
+    """Top-level RFC-6902 add/replace/remove ops for changed keys (the
+    reference computes the patch from the mutated object the same way)."""
     ops = []
     for key, value in mutated.items():
         if key not in original:
             ops.append({"op": "add", "path": f"/{key}", "value": value})
         elif original[key] != value:
             ops.append({"op": "replace", "path": f"/{key}", "value": value})
+    for key in original:
+        if key not in mutated:
+            ops.append({"op": "remove", "path": f"/{key}"})
     return ops
 
 

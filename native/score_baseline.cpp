@@ -23,7 +23,14 @@
 // implementation agreeing pod-for-pod (which also retires the
 // Python-oracle self-reference risk flagged in round 2).
 //
-// Usage: score_baseline <sync_request_file> [iters]
+// The inner node loop optionally fans out over OpenMP threads, matching
+// the reference's 16-goroutine Parallelizer inside RunScorePlugins
+// (framework_extender.go:216): the per-pod sequence stays sequential
+// (Reserve mutates the assign-cache between pods, exactly like the
+// reference), but each pod's Filter+Score scan over nodes is chunked
+// across threads with a first-index tie-break-preserving reduction.
+//
+// Usage: score_baseline <sync_request_file> [iters] [threads]
 // Output line 1: {"metric": "cpu_baseline_cycle_ms", ...}
 // Output line 2: assign <i0> <i1> ...
 
@@ -36,7 +43,12 @@
 #include <numeric>
 #include <sstream>
 #include <string>
+#include <thread>
 #include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
 
 #include "gen/scorer.pb.h"
 
@@ -99,6 +111,11 @@ int main(int argc, char** argv) {
     return 2;
   }
   const int iters = argc > 2 ? std::atoi(argv[2]) : 3;
+  int threads = argc > 3 ? std::atoi(argv[3]) : 1;
+  if (threads < 1) threads = 1;
+#ifndef _OPENMP
+  threads = 1;
+#endif
 
   std::ifstream in(argv[1], std::ios::binary);
   std::stringstream ss;
@@ -159,68 +176,68 @@ int main(int argc, char** argv) {
     std::vector<int64_t> quse = quse0.data;   // [Q, R]
     std::fill(assignment.begin(), assignment.end(), -1);
 
-    const auto t0 = std::chrono::steady_clock::now();
-    for (int64_t oi = 0; oi < P; ++oi) {
-      const int64_t p = order[oi];
-      const int64_t* pr = &preq.data[p * R];
-      const int64_t* pe = &pest.data[p * R];
-      const int32_t qid = quota_id[p];
-
-      // ElasticQuota admission is node-invariant: check once per pod
-      bool quota_ok = true;
-      if (qid >= 0 && qid < Q) {
+    // Filter + Score over a contiguous node range [n0, n1) for pod p,
+    // returning (best_score, chosen) with the in-range first-index
+    // tie-break.  Called on the whole range single-threaded, or per
+    // thread chunk under OpenMP.
+    const auto scan_range = [&](int64_t p, const int64_t* pr,
+                                const int64_t* pe, int64_t n0, int64_t n1) {
+      (void)p;
+      int64_t best_score = INT64_MIN;
+      int64_t chosen = -1;
+      for (int64_t n = n0; n < n1; ++n) {
+        if (!node_ok[n]) continue;
+        const int64_t* nr = &nreq[n * R];
+        bool fits = true;
         for (int64_t r = 0; r < R; ++r) {
-          if (qlim.at(qid, r) != 0 &&
-              quse[qid * R + r] + pr[r] > qrt.at(qid, r)) {
-            quota_ok = false;
+          if (pr[r] > 0 && nr[r] + pr[r] > alloc.at(n, r)) {
+            fits = false;
             break;
           }
         }
-      }
+        if (!fits) continue;
 
-      int64_t best_score = INT64_MIN;
-      int64_t chosen = -1;
-      if (quota_ok) {
-        for (int64_t n = 0; n < N; ++n) {
-          if (!node_ok[n]) continue;
-          const int64_t* nr = &nreq[n * R];
-          bool fits = true;
-          for (int64_t r = 0; r < R; ++r) {
-            if (pr[r] > 0 && nr[r] + pr[r] > alloc.at(n, r)) {
-              fits = false;
-              break;
-            }
-          }
-          if (!fits) continue;
-
-          // NodeResourcesFit least-allocated on nonzero-default requests
-          const int64_t sreq_cpu = pr[kCpu] ? pr[kCpu] : kNonzeroCpu;
-          const int64_t sreq_mem = pr[kMem] ? pr[kMem] : kNonzeroMem;
-          int64_t fit = (kWCpu * least_requested(nr[kCpu] + sreq_cpu,
-                                                 alloc.at(n, kCpu)) +
-                         kWMem * least_requested(nr[kMem] + sreq_mem,
-                                                 alloc.at(n, kMem))) /
-                        kWSum;
-          // LoadAware estimated-usage scoring, zero when metric stale
-          int64_t la = 0;
-          if (fresh[n]) {
-            const int64_t* ne = &nest[n * R];
-            la = (kWCpu * least_requested(
-                              usage.at(n, kCpu) + ne[kCpu] + pe[kCpu],
-                              alloc.at(n, kCpu)) +
-                  kWMem * least_requested(
-                              usage.at(n, kMem) + ne[kMem] + pe[kMem],
-                              alloc.at(n, kMem))) /
-                 kWSum;
-          }
-          const int64_t total = fit + la;
-          if (total > best_score) {  // strict >: first-index tie-break
-            best_score = total;
-            chosen = n;
-          }
+        // NodeResourcesFit least-allocated on nonzero-default requests
+        const int64_t sreq_cpu = pr[kCpu] ? pr[kCpu] : kNonzeroCpu;
+        const int64_t sreq_mem = pr[kMem] ? pr[kMem] : kNonzeroMem;
+        int64_t fit = (kWCpu * least_requested(nr[kCpu] + sreq_cpu,
+                                               alloc.at(n, kCpu)) +
+                       kWMem * least_requested(nr[kMem] + sreq_mem,
+                                               alloc.at(n, kMem))) /
+                      kWSum;
+        // LoadAware estimated-usage scoring, zero when metric stale
+        int64_t la = 0;
+        if (fresh[n]) {
+          const int64_t* ne = &nest[n * R];
+          la = (kWCpu * least_requested(
+                            usage.at(n, kCpu) + ne[kCpu] + pe[kCpu],
+                            alloc.at(n, kCpu)) +
+                kWMem * least_requested(
+                            usage.at(n, kMem) + ne[kMem] + pe[kMem],
+                            alloc.at(n, kMem))) /
+               kWSum;
+        }
+        const int64_t total = fit + la;
+        if (total > best_score) {  // strict >: first-index tie-break
+          best_score = total;
+          chosen = n;
         }
       }
+      return std::pair<int64_t, int64_t>(best_score, chosen);
+    };
 
+    const auto quota_admits = [&](int32_t qid, const int64_t* pr) {
+      if (qid < 0 || qid >= Q) return true;
+      for (int64_t r = 0; r < R; ++r) {
+        if (qlim.at(qid, r) != 0 &&
+            quse[qid * R + r] + pr[r] > qrt.at(qid, r))
+          return false;
+      }
+      return true;
+    };
+
+    const auto commit = [&](int64_t p, int64_t chosen, const int64_t* pr,
+                            const int64_t* pe, int32_t qid) {
       assignment[p] = static_cast<int32_t>(chosen);
       if (chosen >= 0) {
         for (int64_t r = 0; r < R; ++r) {
@@ -230,6 +247,61 @@ int main(int argc, char** argv) {
         if (qid >= 0 && qid < Q)
           for (int64_t r = 0; r < R; ++r) quse[qid * R + r] += pr[r];
       }
+    };
+
+    const auto t0 = std::chrono::steady_clock::now();
+    if (threads == 1) {
+      for (int64_t oi = 0; oi < P; ++oi) {
+        const int64_t p = order[oi];
+        const int64_t* pr = &preq.data[p * R];
+        const int64_t* pe = &pest.data[p * R];
+        const int32_t qid = quota_id[p];
+        int64_t chosen = -1;
+        // ElasticQuota admission is node-invariant: check once per pod
+        if (quota_admits(qid, pr)) chosen = scan_range(p, pr, pe, 0, N).second;
+        commit(p, chosen, pr, pe, qid);
+      }
+    } else {
+#ifdef _OPENMP
+      // Parallel node fan-out per pod (the reference's Parallelizer shape,
+      // framework_extender.go:216): contiguous chunks in node order so a
+      // tid-ascending strict-> reduction preserves the global first-index
+      // tie-break.  The per-pod commit stays sequential in one `single`.
+      std::vector<std::pair<int64_t, int64_t>> tbest(threads,
+                                                     {INT64_MIN, -1});
+#pragma omp parallel num_threads(threads)
+      {
+        const int tid = omp_get_thread_num();
+        const int T = omp_get_num_threads();
+        const int64_t chunk = (N + T - 1) / T;
+        const int64_t n0 = std::min<int64_t>(N, tid * chunk);
+        const int64_t n1 = std::min<int64_t>(N, n0 + chunk);
+        for (int64_t oi = 0; oi < P; ++oi) {
+          const int64_t p = order[oi];
+          const int64_t* pr = &preq.data[p * R];
+          const int64_t* pe = &pest.data[p * R];
+          const int32_t qid = quota_id[p];
+          // node-invariant admission: computed redundantly per thread
+          // (cheaper than broadcasting a flag through another barrier)
+          std::pair<int64_t, int64_t> local{INT64_MIN, -1};
+          if (quota_admits(qid, pr)) local = scan_range(p, pr, pe, n0, n1);
+          tbest[tid] = local;
+#pragma omp barrier
+#pragma omp single
+          {
+            int64_t best_score = INT64_MIN;
+            int64_t chosen = -1;
+            for (int t = 0; t < T; ++t) {
+              if (tbest[t].second >= 0 && tbest[t].first > best_score) {
+                best_score = tbest[t].first;
+                chosen = tbest[t].second;
+              }
+            }
+            commit(p, chosen, pr, pe, qid);
+          }  // implicit barrier: workers see the committed state
+        }
+      }
+#endif
     }
     const std::chrono::duration<double, std::milli> dt =
         std::chrono::steady_clock::now() - t0;
@@ -239,11 +311,12 @@ int main(int argc, char** argv) {
   int64_t assigned = 0;
   for (int32_t a : assignment) assigned += a >= 0;
   std::printf(
-      "{\"metric\": \"cpu_baseline_cycle_ms\", \"value\": %.2f, "
+      "{\"metric\": \"cpu_baseline_cycle_ms\", \"value\": %.4f, "
       "\"unit\": \"ms\", \"pods\": %lld, \"nodes\": %lld, "
-      "\"assigned\": %lld}\n",
+      "\"assigned\": %lld, \"threads\": %d, \"hw_concurrency\": %u}\n",
       best_ms, static_cast<long long>(P), static_cast<long long>(N),
-      static_cast<long long>(assigned));
+      static_cast<long long>(assigned), threads,
+      std::thread::hardware_concurrency());
   std::printf("assign");
   for (int32_t a : assignment) std::printf(" %d", a);
   std::printf("\n");

@@ -395,3 +395,22 @@ class TestReviewRegressions:
         pod = {"name": "p", "requests": {"cpu": "2", "memory": Gi}, "priority_class": "koord-free"}
         out = profile.mutate_by_profiles(pod, [])
         assert "cpu" in out["requests"] and res.BATCH_CPU not in out["requests"]
+
+
+class TestJsonPatch:
+    def test_add_replace_remove_ops(self):
+        from koordinator_tpu.manager.webhook_server import _json_patch
+
+        original = {"labels": {"a": "1"}, "scheduler": "default", "gone": True}
+        mutated = {"labels": {"a": "2"}, "scheduler": "default", "new": 1}
+        ops = {(op["op"], op["path"]) for op in _json_patch(original, mutated)}
+        assert ops == {
+            ("replace", "/labels"),
+            ("add", "/new"),
+            ("remove", "/gone"),
+        }
+
+    def test_no_change_is_empty(self):
+        from koordinator_tpu.manager.webhook_server import _json_patch
+
+        assert _json_patch({"x": 1}, {"x": 1}) == []

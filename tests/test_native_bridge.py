@@ -159,3 +159,25 @@ class TestNativeBaseline:
         assert got == list(direct.assignment), (
             "native sequential baseline diverged from the JAX solver"
         )
+
+    def test_threaded_node_loop_bit_parity(self, golden_file, inprocess):
+        """The 4-thread node-loop fan-out (reference Parallelizer shape,
+        framework_extender.go:216) must reproduce the single-thread
+        placements exactly — the chunked reduction preserves the global
+        first-index tie-break."""
+        path, req = golden_file
+        binary = _build("score_baseline")
+        proc = subprocess.run(
+            [binary, path, "1", "4"], capture_output=True, text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr
+        import json
+
+        js, assign_line = proc.stdout.strip().splitlines()
+        assert json.loads(js)["threads"] == 4
+        got = [int(v) for v in assign_line.split()[1:]]
+        direct = inprocess.assign(pb2.AssignRequest(snapshot_id="s1"))
+        assert got == list(direct.assignment), (
+            "threaded baseline diverged from the single-thread placements"
+        )
