@@ -22,6 +22,8 @@ Design notes
   ``jax.sharding.Mesh`` (see ``koordinator_tpu.parallel.mesh``).
 """
 
+import os
+
 import jax
 
 # Exact int64 score parity with the reference's Go integer math requires x64.
@@ -29,5 +31,21 @@ import jax
 # to HBM bandwidth, so this costs little; the f32 fast path in ops/ avoids it
 # where parity is not required.
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: the cycle kernels take 10-20s to compile
+# per shape bucket, but a scheduler must be ready at informer-sync speed
+# (reference analog: cmd/koord-scheduler/app/server.go:206-220).  With the
+# cache a fresh process reuses the traced executable and the first cycle
+# runs in well under a second.  Opt out with KOORD_XLA_CACHE=0 or point
+# KOORD_XLA_CACHE at a different directory.
+_cache = os.environ.get("KOORD_XLA_CACHE", "")
+if _cache != "0":
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        _cache or os.path.expanduser("~/.cache/koordinator_tpu/xla"),
+    )
+    # cache every compile that costs more than a second; keep the default
+    # for tiny jits (caching them would churn small files for no win)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 __version__ = "0.1.0"

@@ -322,21 +322,22 @@ class PersistentMetricCache(MetricCache):
     def _rotate(self, now: float):
         # fsync before sealing: flush() alone leaves the segment in the
         # page cache, so a host crash (not just a process restart) could
-        # drop the tail of an otherwise "durable" sealed segment.  The
-        # directory is fsync'd too so the new segment's dirent survives.
+        # drop the tail of an otherwise "durable" sealed segment.
         self._fh.flush()
         os.fsync(self._fh.fileno())
         self._fh.close()
-        dirfd = os.open(self.directory, os.O_RDONLY)
-        try:
-            os.fsync(dirfd)
-        finally:
-            os.close(dirfd)
         self._seg_index += 1
         self._fh = open(self._segment_path(self._seg_index), "ab")
         for key, kid in sorted(self._key_ids.items(), key=lambda kv: kv[1]):
             self._fh.write(self._keydef_record(kid, key))
         self._fh.flush()
+        # fsync the directory AFTER creating the new segment so its dirent
+        # (and the sealed predecessor's) survives a host crash
+        dirfd = os.open(self.directory, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
         self._sweep(now)
 
     def _sweep(self, now: float):

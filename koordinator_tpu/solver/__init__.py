@@ -8,11 +8,45 @@ from koordinator_tpu.solver.greedy import (  # noqa: F401
 )
 
 
-# (backend, node-bucket, pod-bucket) combos where the Pallas cycle kernel
-# failed to lower/run; keyed by shape bucket so an oversized cycle (VMEM
-# overflow) doesn't blacklist normal-sized cycles, while a broken combo
-# pays the failed trace once, not once per scheduling cycle.
-_PALLAS_UNSUPPORTED = set()
+# (variant, backend, node-bucket, pod-bucket, extras) combos where a Pallas
+# cycle kernel failed to lower/run, with retry backoff state.  Keyed by
+# shape bucket so an oversized cycle (VMEM overflow) doesn't demote
+# normal-sized cycles.  Demotion is NOT process-lifetime (round-3 review):
+# a transient backend error (e.g. a tunnel hiccup mid-trace) retries after
+# an exponentially growing number of scan-path cycles, and the demotion
+# state is inspectable via ``pallas_demotions()``.
+_PALLAS_FAILURES = {}  # bucket -> [fail_count, cycles_until_retry]
+_RETRY_BASE = 4  # first retry after 4 demoted cycles, then 16, 64, ... 256
+_RETRY_CAP = 256
+
+
+def pallas_demotions():
+    """Snapshot of demoted kernel buckets -> (failures, cycles until the
+    next retry).  Surfaced so daemons can export it as a metric instead of
+    the demotion being visible only in a log line."""
+    return {k: tuple(v) for k, v in _PALLAS_FAILURES.items()}
+
+
+def _demoted(bucket) -> bool:
+    """True while the bucket should keep using the scan path; decrements
+    the retry counter so the kernel is re-attempted periodically."""
+    state = _PALLAS_FAILURES.get(bucket)
+    if state is None:
+        return False
+    if state[1] <= 0:
+        return False  # retry window open: attempt the kernel again
+    state[1] -= 1
+    return True
+
+
+def _record_failure(bucket) -> None:
+    state = _PALLAS_FAILURES.setdefault(bucket, [0, 0])
+    state[0] += 1
+    state[1] = min(_RETRY_CAP, _RETRY_BASE ** min(state[0], 4))
+
+
+def _record_success(bucket) -> None:
+    _PALLAS_FAILURES.pop(bucket, None)
 
 # The kernel's scoring multiplies clamped free capacity by MAX_NODE_SCORE
 # (=100) in i32, so scored tensors need that much headroom below 2^31
@@ -70,9 +104,10 @@ def pallas_inputs_fit_i32(snapshot) -> bool:
 def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None, i32_ok=None):
     """Backend-dispatched scheduling cycle.
 
-    On TPU the single-kernel Pallas cycle (solver/pallas_cycle.py) runs the
-    per-pod loop in VMEM; elsewhere (and when extended-plugin tensors are
-    composed in) the lax.scan path runs.  Both are bit-identical
+    On TPU the dense-layout single-kernel Pallas cycle
+    (solver/pallas_dense.py) runs the per-pod loop in VMEM, with the
+    first-generation wide-layout kernel (solver/pallas_cycle.py) as a
+    fallback; elsewhere the lax.scan path runs.  All are bit-identical
     (tests/test_pallas_cycle.py).
 
     ``i32_ok``: callers that already know whether the snapshot fits the
@@ -87,7 +122,7 @@ def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None, i32_ok=Non
         cfg = DEFAULT_CYCLE_CONFIG
     backend = jax.default_backend()
     has_extras = extra_mask is not None or extra_scores is not None
-    bucket = (
+    shape_key = (
         backend,
         int(snapshot.nodes.allocatable.shape[0]),
         int(snapshot.pods.capacity),
@@ -101,39 +136,49 @@ def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None, i32_ok=Non
         extras_ok = int(jnp.max(jnp.abs(extra_scores))) < 2**29
     if (
         backend != "cpu"
-        and bucket not in _PALLAS_UNSUPPORTED
-        # data-dependent, not shape-dependent: no blacklisting on failure
+        # data-dependent, not shape-dependent: no demotion on failure
         and extras_ok
         and (i32_ok if i32_ok is not None else pallas_inputs_fit_i32(snapshot))
     ):
+        import dataclasses
         import logging
 
+        import numpy as _np
+
         from koordinator_tpu.solver.pallas_cycle import greedy_assign_pallas
+        from koordinator_tpu.solver.pallas_dense import greedy_assign_dense
 
-        try:
-            result = greedy_assign_pallas(
-                snapshot, cfg, extra_mask=extra_mask, extra_scores=extra_scores
-            )
-            # materialize before returning: with async dispatch (and lazy
-            # materialization on tunneled platforms) a runtime fault would
-            # otherwise surface at the caller, outside this fallback.  Hand
-            # the host copy back in the result — on a tunneled platform a
-            # device->host read costs a network round trip (~68ms measured),
-            # and every caller's next move is np.asarray(assignment).
-            import dataclasses
-
-            import numpy as _np
-
-            # np.asarray both forces execution and surfaces runtime faults;
-            # an extra block_until_ready would cost a second round trip here
-            return dataclasses.replace(
-                result, assignment=_np.asarray(result.assignment)
-            )
-        except Exception:
-            _PALLAS_UNSUPPORTED.add(bucket)
-            logging.getLogger(__name__).exception(
-                "pallas cycle kernel failed for %r; "
-                "falling back to the lax.scan path for this shape bucket",
-                bucket,
-            )
+        for variant, fn in (("dense", greedy_assign_dense),
+                            ("wide", greedy_assign_pallas)):
+            bucket = (variant,) + shape_key
+            if _demoted(bucket):
+                continue
+            try:
+                result = fn(
+                    snapshot,
+                    cfg,
+                    extra_mask=extra_mask,
+                    extra_scores=extra_scores,
+                )
+                # materialize before returning: with async dispatch (and
+                # lazy materialization on tunneled platforms) a runtime
+                # fault would otherwise surface at the caller, outside this
+                # fallback.  np.asarray both forces execution and surfaces
+                # faults; the host copy rides back in the result because
+                # every caller's next move is np.asarray(assignment) and a
+                # tunneled device->host read costs a round trip (~68ms).
+                result = dataclasses.replace(
+                    result, assignment=_np.asarray(result.assignment)
+                )
+                _record_success(bucket)
+                return result
+            except Exception:
+                _record_failure(bucket)
+                logging.getLogger(__name__).exception(
+                    "pallas %s cycle kernel failed for %r; demoting this "
+                    "shape bucket (retry after %d cycles)",
+                    variant,
+                    bucket,
+                    _PALLAS_FAILURES[bucket][1],
+                )
     return greedy_assign(snapshot, cfg, extra_mask=extra_mask, extra_scores=extra_scores)

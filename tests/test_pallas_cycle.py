@@ -14,7 +14,14 @@ from koordinator_tpu.constraints import build_quota_table_inputs
 from koordinator_tpu.harness import generators
 from koordinator_tpu.model import encode_snapshot, resources as res
 from koordinator_tpu.solver import greedy_assign
-from koordinator_tpu.solver.pallas_cycle import greedy_assign_pallas
+from koordinator_tpu.solver.pallas_cycle import greedy_assign_pallas as _wide
+from koordinator_tpu.solver.pallas_dense import greedy_assign_dense as _dense
+
+
+@pytest.fixture(params=["wide", "dense"])
+def greedy_assign_pallas(request):
+    """Both kernel layouts must hold the same bit-parity contract."""
+    return _wide if request.param == "wide" else _dense
 
 
 def _quota_snapshot(pods=48, nodes=16, **buckets):
@@ -49,35 +56,35 @@ def _assert_equal(scan, pallas):
 
 
 class TestPallasCycleParity:
-    def test_quota_colocation_default_cfg(self):
+    def test_quota_colocation_default_cfg(self, greedy_assign_pallas):
         snap = _quota_snapshot()
         _assert_equal(greedy_assign(snap), greedy_assign_pallas(snap, interpret=True))
 
-    def test_most_allocated_strategy(self):
+    def test_most_allocated_strategy(self, greedy_assign_pallas):
         snap = _quota_snapshot(pods=32, nodes=8)
         cfg = CycleConfig(fit_scoring_strategy="MostAllocated")
         _assert_equal(
             greedy_assign(snap, cfg), greedy_assign_pallas(snap, cfg, interpret=True)
         )
 
-    def test_loadaware_disabled(self):
+    def test_loadaware_disabled(self, greedy_assign_pallas):
         snap = _quota_snapshot(pods=32, nodes=8)
         cfg = CycleConfig(enable_loadaware=False)
         _assert_equal(
             greedy_assign(snap, cfg), greedy_assign_pallas(snap, cfg, interpret=True)
         )
 
-    def test_gangs_and_overload(self):
+    def test_gangs_and_overload(self, greedy_assign_pallas):
         nodes_l, pods_l, gangs = generators.loadaware_joint(seed=3, pods=40, nodes=6)[:3]
         snap = encode_snapshot(nodes_l, pods_l, gangs, [])
         _assert_equal(greedy_assign(snap), greedy_assign_pallas(snap, interpret=True))
 
-    def test_unpadded_bucket_shapes(self):
+    def test_unpadded_bucket_shapes(self, greedy_assign_pallas):
         # bucket sizes not multiples of 8/128 must still agree
         snap = _quota_snapshot(pods=21, nodes=5, node_bucket=5, pod_bucket=21)
         _assert_equal(greedy_assign(snap), greedy_assign_pallas(snap, interpret=True))
 
-    def test_scarce_capacity_leaves_unscheduled(self):
+    def test_scarce_capacity_leaves_unscheduled(self, greedy_assign_pallas):
         nodes_l, pods_l, gangs = generators.loadaware_joint(seed=7, pods=64, nodes=2)[:3]
         snap = encode_snapshot(nodes_l, pods_l, gangs, [])
         scan = greedy_assign(snap)
@@ -85,7 +92,7 @@ class TestPallasCycleParity:
         _assert_equal(scan, pallas)
         assert int((np.asarray(scan.assignment) < 0).sum()) > 0
 
-    def test_extended_plugin_tensors(self):
+    def test_extended_plugin_tensors(self, greedy_assign_pallas):
         """extra_mask/extra_scores ride the kernel as [N, P] tiles and stay
         bit-identical with the scan path carrying the same tensors."""
         import jax.numpy as jnp
@@ -102,7 +109,7 @@ class TestPallasCycleParity:
         )
         _assert_equal(want, got)
 
-    def test_extended_mask_only(self):
+    def test_extended_mask_only(self, greedy_assign_pallas):
         import jax.numpy as jnp
 
         snap = _quota_snapshot(pods=24, nodes=8)

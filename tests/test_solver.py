@@ -239,3 +239,43 @@ def test_quota_table_round_trip_feasible():
     assert runtime_mem > 1024, f"memory runtime collapsed to {runtime_mem} MiB"
     result = greedy_assign(snap)
     assert int((np.asarray(result.assignment) >= 0).sum()) > 0
+
+
+class TestPallasDemotionBackoff:
+    """run_cycle's kernel-failure demotion must retry with backoff, not
+    demote a shape bucket for the process lifetime (round-3 review)."""
+
+    def test_retry_window_reopens(self):
+        from koordinator_tpu import solver
+
+        bucket = ("dense", "tpu", 2000, 10000, False)
+        try:
+            solver._record_failure(bucket)
+            fails, wait = solver.pallas_demotions()[bucket]
+            assert fails == 1 and wait == 4
+            # 4 demoted cycles ride the scan path...
+            assert all(solver._demoted(bucket) for _ in range(4))
+            # ...then the retry window opens
+            assert not solver._demoted(bucket)
+            # a second failure backs off exponentially
+            solver._record_failure(bucket)
+            _, wait2 = solver.pallas_demotions()[bucket]
+            assert wait2 == 16
+            # success clears the state entirely
+            solver._record_success(bucket)
+            assert bucket not in solver.pallas_demotions()
+            assert not solver._demoted(bucket)
+        finally:
+            solver._record_success(bucket)
+
+    def test_backoff_is_capped(self):
+        from koordinator_tpu import solver
+
+        bucket = ("wide", "tpu", 16, 64, True)
+        try:
+            for _ in range(10):
+                solver._record_failure(bucket)
+            _, wait = solver.pallas_demotions()[bucket]
+            assert wait == solver._RETRY_CAP
+        finally:
+            solver._record_success(bucket)
