@@ -48,24 +48,14 @@ CPU_TIMEOUT = int(os.environ.get("KOORD_BENCH_CPU_TIMEOUT", "900"))
 
 
 def _quota_snapshot(encode_snapshot, generators, res, build_quota_table_inputs):
-    """The headline 10k x 2k quota_colocation snapshot — ONE recipe shared
-    by the headline child, the extras config, and the rebalance config so
-    every number in BASELINE.md measures the same cluster."""
-    nodes, pods, gangs, quotas = generators.quota_colocation(
-        pods=PODS, nodes=NODES
-    )
-    pod_reqs = [res.resource_vector(p["requests"]) for p in pods]
-    qidx = {q["name"]: i for i, q in enumerate(quotas)}
-    qids = [qidx.get(p.get("quota"), -1) for p in pods]
-    total = [0] * res.NUM_RESOURCES
-    for n in nodes:
-        v = res.resource_vector(n["allocatable"])
-        total = [a + b for a, b in zip(total, v)]
-    qdicts = build_quota_table_inputs(quotas, pod_reqs, qids, total)
-    snap = encode_snapshot(
-        nodes, pods, gangs, qdicts, node_bucket=NODES, pod_bucket=PODS
-    )
-    return snap, nodes, pods, gangs, quotas, qdicts
+    """The headline 10k x 2k quota_colocation snapshot — the ONE recipe
+    (harness.generators.quota_colocation_snapshot) shared by the headline
+    child, the extras/rebalance configs, the multichip dryrun, and the
+    parity tests, so every number in BASELINE.md measures the same
+    cluster.  (The module args are kept for call-site stability; the
+    recipe lives in the harness now.)"""
+    del encode_snapshot, res, build_quota_table_inputs
+    return generators.quota_colocation_snapshot(pods=PODS, nodes=NODES)
 
 
 def child(platform: str) -> None:
@@ -197,6 +187,8 @@ def child(platform: str) -> None:
                     ms=cpu_native_mt_ms,
                     hw_concurrency=hw_threads,
                 )
+            else:
+                phase("cpu_native_mt_failed", error="baseline prepare failed")
         except Exception as exc:  # noqa: BLE001
             phase("cpu_native_mt_failed", error=str(exc)[:200])
     print(

@@ -165,3 +165,43 @@ CONFIGS = {
     "gang_batch": gang_batch,
     "quota_colocation": quota_colocation,
 }
+
+
+def quota_colocation_snapshot(
+    seed: int = 0,
+    pods: int = 10000,
+    nodes: int = 2000,
+    tenants: int = 16,
+    node_bucket=None,
+    pod_bucket=None,
+):
+    """The encoded quota_colocation snapshot — ONE recipe shared by
+    bench.py, the multichip dryrun, and the parity tests so every consumer
+    measures the same cluster (resource vectors, quota-id mapping, cluster
+    totals, quota-table inputs).
+
+    Returns (snapshot, node_list, pod_list, gangs, quotas, quota_dicts).
+    """
+    from koordinator_tpu.constraints import build_quota_table_inputs
+    from koordinator_tpu.model import encode_snapshot, resources as res
+
+    node_list, pod_list, gangs, quotas = quota_colocation(
+        seed=seed, pods=pods, nodes=nodes, tenants=tenants
+    )
+    pod_reqs = [res.resource_vector(p["requests"]) for p in pod_list]
+    qidx = {q["name"]: i for i, q in enumerate(quotas)}
+    qids = [qidx.get(p.get("quota"), -1) for p in pod_list]
+    total = [0] * res.NUM_RESOURCES
+    for n in node_list:
+        v = res.resource_vector(n["allocatable"])
+        total = [a + b for a, b in zip(total, v)]
+    qdicts = build_quota_table_inputs(quotas, pod_reqs, qids, total)
+    snap = encode_snapshot(
+        node_list,
+        pod_list,
+        gangs,
+        qdicts,
+        node_bucket=node_bucket or nodes,
+        pod_bucket=pod_bucket or pods,
+    )
+    return snap, node_list, pod_list, gangs, quotas, qdicts

@@ -5,4 +5,5 @@ from koordinator_tpu.parallel.mesh import (  # noqa: F401
 )
 from koordinator_tpu.parallel.shard_assign import (  # noqa: F401
     greedy_assign_sharded,
+    greedy_assign_waves,
 )

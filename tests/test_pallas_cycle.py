@@ -25,18 +25,9 @@ def greedy_assign_pallas(request):
 
 
 def _quota_snapshot(pods=48, nodes=16, **buckets):
-    nodes_l, pods_l, gangs, quotas = generators.quota_colocation(
-        pods=pods, nodes=nodes
-    )
-    pod_reqs = [res.resource_vector(p["requests"]) for p in pods_l]
-    qidx = {q["name"]: i for i, q in enumerate(quotas)}
-    qids = [qidx.get(p.get("quota"), -1) for p in pods_l]
-    total = [0] * res.NUM_RESOURCES
-    for n in nodes_l:
-        v = res.resource_vector(n["allocatable"])
-        total = [a + b for a, b in zip(total, v)]
-    qdicts = build_quota_table_inputs(quotas, pod_reqs, qids, total)
-    return encode_snapshot(nodes_l, pods_l, gangs, qdicts, **buckets)
+    return generators.quota_colocation_snapshot(
+        pods=pods, nodes=nodes, **buckets
+    )[0]
 
 
 def _assert_equal(scan, pallas):
