@@ -1,0 +1,134 @@
+"""Generate the golden wire fixtures for go/scorerclient/golden_test.go.
+
+Runs the REAL Python servicer (bridge/server.py) on a small
+quota+gang snapshot and records, for each RPC of the raw-UDS seam
+(bridge/udsserver.py framing):
+
+* the request bytes the Python protobuf runtime produces (the Go
+  marshaler must match them byte-for-byte),
+* the reply bytes the servicer produces (the Go unmarshaler must decode
+  them to the values in expected.json).
+
+Usage (from the repo root, CPU backend is fine):
+
+    JAX_PLATFORMS=cpu python go/gen_fixtures.py
+
+Outputs are committed under go/scorerclient/testdata/ so the Go test
+runs in CI with no Python present.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import koordinator_tpu  # noqa: F401,E402
+from koordinator_tpu.bridge.codegen import pb2  # noqa: E402
+from koordinator_tpu.bridge.server import ScorerServicer  # noqa: E402
+from koordinator_tpu.harness import generators  # noqa: E402
+from koordinator_tpu.harness.golden import build_sync_request  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "scorerclient", "testdata")
+TOP_K = 4
+
+
+def tensor_json(t: "pb2.Tensor") -> dict:
+    return {
+        "shape": list(t.shape),
+        "data": np.frombuffer(t.data, "<i8").tolist(),
+    }
+
+
+def main() -> None:
+    os.makedirs(OUT, exist_ok=True)
+    nodes, pods, _, quotas = generators.quota_colocation(pods=32, nodes=8)
+    gangs = [{"name": "gang-0", "min_member": 2}]
+    pods[0]["gang"] = "gang-0"
+    pods[1]["gang"] = "gang-0"
+    req, _ = build_sync_request(
+        nodes, pods, gangs, quotas, node_bucket=8, pod_bucket=32
+    )
+
+    sv = ScorerServicer()
+    sync_reply = sv.sync(req)
+    score_req = pb2.ScoreRequest(
+        snapshot_id=sync_reply.snapshot_id, top_k=TOP_K, flat=True
+    )
+    score_reply = sv.score(score_req)
+    assign_req = pb2.AssignRequest(snapshot_id=sync_reply.snapshot_id)
+    assign_reply = sv.assign(assign_req)
+
+    blobs = {
+        "sync_request.bin": req.SerializeToString(),
+        "sync_reply.bin": sync_reply.SerializeToString(),
+        "score_request.bin": score_req.SerializeToString(),
+        "score_reply.bin": score_reply.SerializeToString(),
+        "assign_request.bin": assign_req.SerializeToString(),
+        "assign_reply.bin": assign_reply.SerializeToString(),
+    }
+    for name, data in blobs.items():
+        with open(os.path.join(OUT, name), "wb") as f:
+            f.write(data)
+
+    expected = {
+        "top_k": TOP_K,
+        "sync_request": {
+            "node_bucket": req.node_bucket,
+            "pod_bucket": req.pod_bucket,
+            "nodes": {
+                "names": list(req.nodes.names),
+                "metric_fresh": list(req.nodes.metric_fresh),
+                "allocatable": tensor_json(req.nodes.allocatable),
+                "requested": tensor_json(req.nodes.requested),
+                "usage": tensor_json(req.nodes.usage),
+            },
+            "pods": {
+                "names": list(req.pods.names),
+                "requests": tensor_json(req.pods.requests),
+                "estimated": tensor_json(req.pods.estimated),
+                "priority": list(req.pods.priority),
+                "gang_id": list(req.pods.gang_id),
+                "quota_id": list(req.pods.quota_id),
+                "priority_class": list(req.pods.priority_class),
+            },
+            "gangs": {"min_member": list(req.gangs.min_member)},
+            "quotas": {
+                "runtime": tensor_json(req.quotas.runtime),
+                "used": tensor_json(req.quotas.used),
+                "limited": tensor_json(req.quotas.limited),
+            },
+        },
+        "sync_reply": {
+            "snapshot_id": sync_reply.snapshot_id,
+            "nodes": sync_reply.nodes,
+            "pods": sync_reply.pods,
+        },
+        "score_reply": {
+            "pod_index": np.frombuffer(
+                score_reply.flat.pod_index, "<i4"
+            ).tolist(),
+            "counts": np.frombuffer(score_reply.flat.counts, "<i4").tolist(),
+            "node_index": np.frombuffer(
+                score_reply.flat.node_index, "<i4"
+            ).tolist(),
+            "score": np.frombuffer(score_reply.flat.score, "<i8").tolist(),
+        },
+        "assign_reply": {
+            "assignment": list(assign_reply.assignment),
+            "status": list(assign_reply.status),
+            "path": assign_reply.path,
+        },
+    }
+    with open(os.path.join(OUT, "expected.json"), "w") as f:
+        json.dump(expected, f, indent=1, sort_keys=True)
+    print(f"wrote {len(blobs)} fixtures + expected.json to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

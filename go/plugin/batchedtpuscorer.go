@@ -1,0 +1,309 @@
+// Package plugin implements BatchedTPUScorer — a kube-scheduler
+// framework plugin at the Score/ScoreExtensions seam (the boundary the
+// reference extends at
+// reference pkg/scheduler/frameworkext/framework_extender.go:216, with
+// the per-plugin Score signature of
+// reference pkg/scheduler/plugins/loadaware/load_aware.go:269) that
+// delegates the whole batched scoring computation to the koordinator_tpu
+// sidecar over the raw-UDS protobuf framing (go/scorerclient).
+//
+// Flow per scheduling cycle:
+//
+//   - PreScore syncs the current cluster view (nodes from the cycle's
+//     snapshot, the ONE pod being scheduled) to the sidecar and fetches
+//     the pod's full node-score row with one flat Score RPC; scores land
+//     in CycleState.
+//   - Score returns the cached value for its node — O(1), no RPC in the
+//     per-node hot loop the framework fans out over 16 goroutines.
+//   - NormalizeScore is the identity: the sidecar's combined
+//     Fit+LoadAware scores are already on the framework's 0..100 scale
+//     per plugin weight (model/snapshot.py MAX_NODE_SCORE).
+//
+// Registration mirrors the reference's plugin wiring
+// (reference cmd/koord-scheduler/main.go:45):
+//
+//	app.NewSchedulerCommand(
+//	    app.WithPlugin(plugin.Name, plugin.New),
+//	)
+package plugin
+
+import (
+	"context"
+	"fmt"
+	"os"
+	"sync"
+
+	v1 "k8s.io/api/core/v1"
+	"k8s.io/apimachinery/pkg/runtime"
+	"k8s.io/kubernetes/pkg/scheduler/framework"
+
+	"github.com/koordinator-tpu/koordinator-tpu/go/scorerclient"
+)
+
+// Name is the plugin's registration name.
+const Name = "BatchedTPUScorer"
+
+// Dense resource axis of model/resources.py (RESOURCE_AXIS): cpu in
+// milli, byte-denominated resources in MiB.
+const (
+	axisCPU    = 0
+	axisMemory = 1
+	axisEphem  = 2
+	axisPods   = 3
+	numAxes    = 13
+)
+
+const mib = int64(1) << 20
+
+type stateKey string
+
+const scoresKey stateKey = Name + "/scores"
+
+type podScores struct {
+	scores map[string]int64 // node name -> combined score
+}
+
+func (p *podScores) Clone() framework.StateData { return p }
+
+// Scorer is the BatchedTPUScorer plugin.
+type Scorer struct {
+	handle framework.Handle
+	mu     sync.Mutex
+	client *scorerclient.Client
+	socket string
+}
+
+var (
+	_ framework.PreScorePlugin = &Scorer{}
+	_ framework.ScorePlugin    = &Scorer{}
+	_ framework.ScoreExtensions = &Scorer{}
+)
+
+// New builds the plugin; the sidecar socket comes from
+// KOORD_TPU_SCORER_SOCKET (default /var/run/koordinator-tpu/scorer.sock).
+func New(_ runtime.Object, handle framework.Handle) (framework.Plugin, error) {
+	socket := os.Getenv("KOORD_TPU_SCORER_SOCKET")
+	if socket == "" {
+		socket = "/var/run/koordinator-tpu/scorer.sock"
+	}
+	return &Scorer{handle: handle, socket: socket}, nil
+}
+
+func (s *Scorer) Name() string { return Name }
+
+func (s *Scorer) ensureClient() (*scorerclient.Client, error) {
+	s.mu.Lock()
+	defer s.mu.Unlock()
+	if s.client != nil {
+		return s.client, nil
+	}
+	c, err := scorerclient.Dial(s.socket)
+	if err != nil {
+		return nil, err
+	}
+	s.client = c
+	return c, nil
+}
+
+// dropClient discards a client whose connection errored so the next
+// cycle re-dials (the sidecar may have restarted); without this one
+// broken fd would disable scoring until the scheduler restarts.
+func (s *Scorer) dropClient(c *scorerclient.Client) {
+	s.mu.Lock()
+	defer s.mu.Unlock()
+	if s.client == c {
+		s.client.Close()
+		s.client = nil
+	}
+}
+
+func resourceVector(rl v1.ResourceList) []int64 {
+	vec := make([]int64, numAxes)
+	for name, q := range rl {
+		switch name {
+		case v1.ResourceCPU:
+			vec[axisCPU] = q.MilliValue()
+		case v1.ResourceMemory:
+			vec[axisMemory] = q.Value() / mib
+		case v1.ResourceEphemeralStorage:
+			vec[axisEphem] = q.Value() / mib
+		case v1.ResourcePods:
+			vec[axisPods] = q.Value()
+		}
+	}
+	return vec
+}
+
+func nodeInfoVectors(infos []*framework.NodeInfo) (names []string, alloc, requested, usage []int64) {
+	for _, ni := range infos {
+		names = append(names, ni.Node().Name)
+		alloc = append(alloc, resourceVector(ni.Node().Status.Allocatable)...)
+		req := make([]int64, numAxes)
+		req[axisCPU] = ni.Requested.MilliCPU
+		req[axisMemory] = ni.Requested.Memory / mib
+		req[axisEphem] = ni.Requested.EphemeralStorage / mib
+		req[axisPods] = int64(len(ni.Pods))
+		requested = append(requested, req...)
+		// without a NodeMetric feed usage mirrors requested (the sidecar
+		// zeroes LoadAware terms for nodes it has no fresh metric for)
+		usage = append(usage, req...)
+	}
+	return
+}
+
+func podVector(pod *v1.Pod) []int64 {
+	vec := make([]int64, numAxes)
+	for _, c := range pod.Spec.Containers {
+		v := resourceVector(c.Resources.Requests)
+		for i := range vec {
+			vec[i] += v[i]
+		}
+	}
+	return vec
+}
+
+// PreScore ships the cycle's cluster view + the pod to the sidecar and
+// caches the pod's node-score row in CycleState.
+func (s *Scorer) PreScore(
+	ctx context.Context,
+	state *framework.CycleState,
+	pod *v1.Pod,
+	nodes []*v1.Node,
+) *framework.Status {
+	client, err := s.ensureClient()
+	if err != nil {
+		return framework.AsStatus(fmt.Errorf("scorer sidecar: %w", err))
+	}
+	infos, err := s.handle.SnapshotSharedLister().NodeInfos().List()
+	if err != nil {
+		return framework.AsStatus(err)
+	}
+	// restrict to the cycle's feasible nodes, in their order
+	byName := make(map[string]*framework.NodeInfo, len(infos))
+	for _, ni := range infos {
+		byName[ni.Node().Name] = ni
+	}
+	selected := make([]*framework.NodeInfo, 0, len(nodes))
+	for _, n := range nodes {
+		if ni, ok := byName[n.Name]; ok {
+			selected = append(selected, ni)
+		}
+	}
+	names, alloc, requested, usage := nodeInfoVectors(selected)
+	n := int64(len(names))
+	fresh := make([]bool, n)
+	podVec := podVector(pod)
+
+	req := &scorerclient.SyncRequest{
+		Nodes: scorerclient.NodeTable{
+			Names: names,
+			Allocatable: scorerclient.Tensor{
+				Shape: []int64{n, numAxes},
+				Data:  scorerclient.LEInt64Bytes(alloc),
+			},
+			Requested: scorerclient.Tensor{
+				Shape: []int64{n, numAxes},
+				Data:  scorerclient.LEInt64Bytes(requested),
+			},
+			Usage: scorerclient.Tensor{
+				Shape: []int64{n, numAxes},
+				Data:  scorerclient.LEInt64Bytes(usage),
+			},
+			MetricFresh: fresh,
+		},
+		Pods: scorerclient.PodTable{
+			Names: []string{pod.Name},
+			Requests: scorerclient.Tensor{
+				Shape: []int64{1, numAxes},
+				Data:  scorerclient.LEInt64Bytes(podVec),
+			},
+			Estimated: scorerclient.Tensor{
+				Shape: []int64{1, numAxes},
+				Data:  scorerclient.LEInt64Bytes(podVec),
+			},
+			Priority: []int64{podPriority(pod)},
+			GangID:   []int32{-1},
+			QuotaID:  []int32{-1},
+		},
+	}
+	if _, err := client.Sync(req); err != nil {
+		s.dropClient(client)
+		return framework.AsStatus(fmt.Errorf("sync: %w", err))
+	}
+	reply, err := client.ScoreFlat(0)
+	if err != nil {
+		s.dropClient(client)
+		return framework.AsStatus(fmt.Errorf("score: %w", err))
+	}
+	scores := make(map[string]int64, len(names))
+	off := 0
+	for g, p := range reply.Flat.PodIndex {
+		c := int(reply.Flat.Counts[g])
+		if p == 0 { // single-pod table: group 0 is our pod
+			for i := off; i < off+c; i++ {
+				ni := reply.Flat.NodeIndex[i]
+				if int(ni) < len(names) {
+					scores[names[ni]] = reply.Flat.Score[i]
+				}
+			}
+		}
+		off += c
+	}
+	state.Write(framework.StateKey(scoresKey), &podScores{scores: scores})
+	return nil
+}
+
+func podPriority(pod *v1.Pod) int64 {
+	if pod.Spec.Priority != nil {
+		return int64(*pod.Spec.Priority)
+	}
+	return 0
+}
+
+// Score serves the cached row — the framework's 16-goroutine per-node
+// fan-out (framework_extender.go:216) hits only this map lookup.
+func (s *Scorer) Score(
+	ctx context.Context,
+	state *framework.CycleState,
+	pod *v1.Pod,
+	nodeName string,
+) (int64, *framework.Status) {
+	data, err := state.Read(framework.StateKey(scoresKey))
+	if err != nil {
+		return 0, framework.AsStatus(err)
+	}
+	ps, ok := data.(*podScores)
+	if !ok {
+		return 0, framework.AsStatus(fmt.Errorf("unexpected state type %T", data))
+	}
+	score, ok := ps.scores[nodeName]
+	if !ok {
+		// infeasible for this pod per the sidecar's Filter masks
+		return 0, nil
+	}
+	return score, nil
+}
+
+func (s *Scorer) ScoreExtensions() framework.ScoreExtensions { return s }
+
+// NormalizeScore clamps to the framework range; the sidecar's combined
+// plugin scores are already 0..MaxNodeScore-scaled per plugin weight.
+func (s *Scorer) NormalizeScore(
+	ctx context.Context,
+	state *framework.CycleState,
+	pod *v1.Pod,
+	scores framework.NodeScoreList,
+) *framework.Status {
+	var max int64
+	for _, ns := range scores {
+		if ns.Score > max {
+			max = ns.Score
+		}
+	}
+	if max > framework.MaxNodeScore {
+		for i := range scores {
+			scores[i].Score = scores[i].Score * framework.MaxNodeScore / max
+		}
+	}
+	return nil
+}

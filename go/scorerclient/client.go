@@ -1,0 +1,113 @@
+package scorerclient
+
+import (
+	"encoding/binary"
+	"fmt"
+	"io"
+	"net"
+)
+
+// Method bytes of the raw framing (bridge/udsserver.py).
+const (
+	MethodSync   = 1
+	MethodScore  = 2
+	MethodAssign = 3
+)
+
+// MaxFrame mirrors the server's 64 MiB cap.
+const MaxFrame = 64 << 20
+
+// Client speaks the length-prefixed framing of bridge/udsserver.py over
+// any net.Conn (a unix socket in production; an in-memory pipe in the
+// golden tests):
+//
+//	request: u8 method, u32 BE length, payload
+//	reply:   u8 status (0 ok, 1 error), u32 BE length, payload
+type Client struct {
+	conn net.Conn
+	// SnapshotID of the last acknowledged Sync ("s<generation>").
+	SnapshotID string
+}
+
+// Dial connects to the scorer's unix socket.
+func Dial(socketPath string) (*Client, error) {
+	conn, err := net.Dial("unix", socketPath)
+	if err != nil {
+		return nil, err
+	}
+	return &Client{conn: conn}, nil
+}
+
+// NewClient wraps an existing connection (test seam).
+func NewClient(conn net.Conn) *Client { return &Client{conn: conn} }
+
+func (c *Client) Close() error { return c.conn.Close() }
+
+func (c *Client) call(method byte, payload []byte) ([]byte, error) {
+	hdr := make([]byte, 5)
+	hdr[0] = method
+	binary.BigEndian.PutUint32(hdr[1:], uint32(len(payload)))
+	if _, err := c.conn.Write(append(hdr, payload...)); err != nil {
+		return nil, err
+	}
+	if _, err := io.ReadFull(c.conn, hdr[:5]); err != nil {
+		return nil, err
+	}
+	status := hdr[0]
+	length := binary.BigEndian.Uint32(hdr[1:5])
+	if length > MaxFrame {
+		return nil, fmt.Errorf("reply frame %d exceeds cap", length)
+	}
+	body := make([]byte, length)
+	if _, err := io.ReadFull(c.conn, body); err != nil {
+		return nil, err
+	}
+	if status != 0 {
+		return nil, fmt.Errorf("scorer error: %s", string(body))
+	}
+	return body, nil
+}
+
+// Sync ships the cluster snapshot and records the acknowledged id.
+func (c *Client) Sync(req *SyncRequest) (*SyncReply, error) {
+	body, err := c.call(MethodSync, req.Marshal())
+	if err != nil {
+		return nil, err
+	}
+	reply, err := UnmarshalSyncReply(body)
+	if err != nil {
+		return nil, err
+	}
+	c.SnapshotID = reply.SnapshotID
+	return reply, nil
+}
+
+// ScoreFlat requests the flat top-k layout (scorer.proto FlatScores) —
+// the O(1)-assembly path on both ends.
+func (c *Client) ScoreFlat(topK int64) (*ScoreReply, error) {
+	req := ScoreRequest{SnapshotID: c.SnapshotID, TopK: topK, Flat: true}
+	body, err := c.call(MethodScore, req.Marshal())
+	if err != nil {
+		return nil, err
+	}
+	reply, err := UnmarshalScoreReply(body)
+	if err != nil {
+		return nil, err
+	}
+	if !reply.HasFlat {
+		// a pre-flat server ignored the flag and sent legacy lists;
+		// empty flat arrays would read as "no feasible node anywhere"
+		return nil, fmt.Errorf("scorer did not return the flat layout (server too old?)")
+	}
+	return reply, nil
+}
+
+// Assign runs the full batched scheduling cycle.
+func (c *Client) Assign() (*AssignReply, error) {
+	req := AssignRequest{SnapshotID: c.SnapshotID}
+	body, err := c.call(MethodAssign, req.Marshal())
+	if err != nil {
+		return nil, err
+	}
+	return UnmarshalAssignReply(body)
+}

@@ -1,0 +1,5 @@
+module github.com/koordinator-tpu/koordinator-tpu/go/scorerclient
+
+go 1.21
+
+require google.golang.org/protobuf v1.33.0

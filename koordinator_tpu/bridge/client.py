@@ -212,6 +212,36 @@ class ScorerClient:
             list(zip(entry.node_index, entry.score)) for entry in reply.pods
         ]
 
+    def score_flat(
+        self, top_k: int = 0
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Flat top-k layout: (pod_index, counts, node_index, score) numpy
+        arrays decoded straight from the packed reply bytes — the O(1)
+        assembly path on both ends (round-3 review #8).  Entry group g
+        (pod pod_index[g]) covers counts[g] consecutive entries."""
+        reply = self._call(
+            self._score,
+            pb2.ScoreRequest(
+                snapshot_id=self.snapshot_id or "", top_k=top_k, flat=True
+            ),
+        )
+        if not reply.HasField("flat"):
+            # a pre-flat server ignores the unknown request flag and sends
+            # legacy lists; empty arrays here would read as "no feasible
+            # node for any pod" — fail loudly instead
+            raise RuntimeError(
+                "scorer did not return the flat layout (server too old?); "
+                "use score() for the legacy per-pod lists"
+            )
+        # .copy(): frombuffer over proto bytes is read-only; callers get
+        # writable arrays like assign() returns
+        return (
+            np.frombuffer(reply.flat.pod_index, "<i4").copy(),
+            np.frombuffer(reply.flat.counts, "<i4").copy(),
+            np.frombuffer(reply.flat.node_index, "<i4").copy(),
+            np.frombuffer(reply.flat.score, "<i8").copy(),
+        )
+
     def assign(self) -> Tuple[np.ndarray, np.ndarray, float, str]:
         """Returns (assignment, status, cycle_ms, path); ``path`` names the
         device program that ran ("pallas"/"scan"/"shard") so callers can

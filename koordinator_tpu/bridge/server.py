@@ -70,18 +70,34 @@ class ScorerServicer:
             k = int(req.top_k) or snap.nodes.capacity
             k = min(k, snap.nodes.capacity)
             top_scores, top_idx = lax.top_k(masked, k)
-            # one device->host transfer, then numpy-only reply assembly:
-            # per-cell Python int conversion over P x k cells dwarfed
-            # device time at 10k-pod scale (VERDICT r2 weak #5)
+            # one device->host transfer, then numpy-only reply assembly
             top_scores = np.asarray(top_scores)
-            top_idx = np.asarray(top_idx)
+            top_idx = np.asarray(top_idx).astype(np.int32)
             ok = np.take_along_axis(np.asarray(feasible), top_idx, axis=1)
-            valid = np.asarray(snap.pods.valid)
-            for p in np.flatnonzero(valid[:P]):
-                entry = reply.pods.add()
-                m = ok[p]
-                entry.node_index.extend(top_idx[p, m].tolist())
-                entry.score.extend(top_scores[p, m].tolist())
+            valid = np.asarray(snap.pods.valid)[:P].astype(bool)
+            t0 = time.perf_counter()
+            if req.flat:
+                # flat layout (round-3 review #8): O(1) Python calls —
+                # boolean indexing + tobytes, no per-pod message building
+                ok_v = ok[:P][valid]
+                reply.flat.pod_index = (
+                    np.flatnonzero(valid).astype("<i4").tobytes()
+                )
+                reply.flat.counts = ok_v.sum(axis=1).astype("<i4").tobytes()
+                reply.flat.node_index = (
+                    top_idx[:P][valid][ok_v].astype("<i4").tobytes()
+                )
+                reply.flat.score = (
+                    top_scores[:P][valid][ok_v].astype("<i8").tobytes()
+                )
+            else:
+                # legacy per-pod lists: per-valid-pod Python loop
+                for p in np.flatnonzero(valid):
+                    entry = reply.pods.add()
+                    m = ok[p]
+                    entry.node_index.extend(top_idx[p, m].tolist())
+                    entry.score.extend(top_scores[p, m].tolist())
+            reply.build_ms = (time.perf_counter() - t0) * 1000.0
             return reply
 
     def assign(self, req: "pb2.AssignRequest", ctx=None) -> "pb2.AssignReply":

@@ -129,3 +129,32 @@ class TestBridge:
         t = numpy_to_tensor(nxt, prev)
         assert t.data and not t.delta_idx
         np.testing.assert_array_equal(tensor_to_numpy(t, None), nxt)
+
+
+class TestFlatScoreReply:
+    def test_flat_matches_legacy_lists(self):
+        from koordinator_tpu.bridge.server import ScorerServicer
+        from koordinator_tpu.harness.golden import build_sync_request
+        from koordinator_tpu.harness import generators
+        import numpy as np
+
+        n, p, g, q = generators.loadaware_joint(seed=9, pods=64, nodes=16)
+        req, _ = build_sync_request(n, p, [], [], node_bucket=16, pod_bucket=64)
+        sv = ScorerServicer()
+        sv.sync(req)
+        from koordinator_tpu.bridge.codegen import pb2
+
+        legacy = sv.score(pb2.ScoreRequest(snapshot_id="s1", top_k=4))
+        flat = sv.score(pb2.ScoreRequest(snapshot_id="s1", top_k=4, flat=True))
+        pods_idx = np.frombuffer(flat.flat.pod_index, "<i4")
+        counts = np.frombuffer(flat.flat.counts, "<i4")
+        nidx = np.frombuffer(flat.flat.node_index, "<i4")
+        scores = np.frombuffer(flat.flat.score, "<i8")
+        assert counts.sum() == len(nidx) == len(scores)
+        assert len(pods_idx) == len(legacy.pods)
+        off = 0
+        for entry, c in zip(legacy.pods, counts):
+            assert list(entry.node_index) == nidx[off : off + c].tolist()
+            assert list(entry.score) == scores[off : off + c].tolist()
+            off += c
+        assert flat.build_ms >= 0.0 and not flat.pods
