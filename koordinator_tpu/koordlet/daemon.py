@@ -44,6 +44,8 @@ class Daemon:
         metrics: Optional[MetricsRegistry] = None,
         report_interval_seconds: float = 60.0,
         storage_dir: Optional[str] = None,
+        nri_socket: Optional[str] = None,
+        hook_registry=None,
     ):
         self.fs = fs or SysFS()
         if cache is not None:
@@ -67,6 +69,32 @@ class Daemon:
         self.auditor = auditor
         self.metrics = metrics or MetricsRegistry()
         self.pleg = Pleg(self.fs)
+        # NRI delivery mode (reference runtimehooks/nri/server.go): when a
+        # runtime NRI socket is configured, register as a plugin on it —
+        # the runtime then drives the shared HookRegistry through
+        # CreateContainer/UpdateContainer events; proxy and reconciler
+        # modes keep working beside it
+        self.nri = None
+        if nri_socket is not None:
+            import logging
+
+            from koordinator_tpu.koordlet.nri import NriPlugin
+            from koordinator_tpu.koordlet.runtimehooks import default_registry
+
+            try:
+                self.nri = NriPlugin(
+                    nri_socket, hook_registry or default_registry()
+                )
+            except (OSError, RuntimeError):
+                # NRI is one of three delivery modes; an absent/unready
+                # runtime socket must degrade to proxy/reconciler, not
+                # fail the whole daemon (reference runtimehooks.go falls
+                # back the same way when NRI registration fails)
+                logging.getLogger(__name__).exception(
+                    "NRI registration on %s failed; continuing with "
+                    "proxy/reconciler delivery only",
+                    nri_socket,
+                )
         self.report_interval = report_interval_seconds
         self._next_report = 0.0
         self._stop = threading.Event()
@@ -77,6 +105,9 @@ class Daemon:
         """One pass over every subsystem, in the reference's start order."""
         now = time.time() if now is None else now
         events = self.pleg.poll_once()
+        # informer plugin sync (reference states_informer.go:146 Run):
+        # NRT/device producers publish through the informer store each tick
+        informer_reports = self.informer.sync_plugins(now)
         collected = self.advisor.run_once(now)
         reported = None
         if self.reporter is not None and now >= self._next_report:
@@ -92,6 +123,7 @@ class Daemon:
             "collectors": collected,
             "strategies": strategies,
             "node_metric": reported,
+            "informer_reports": informer_reports,
         }
 
     # -- live loop --
@@ -113,6 +145,8 @@ class Daemon:
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self.nri is not None:
+            self.nri.close()
         for t in self._threads:
             t.join(timeout=5)
         if self.predict is not None:

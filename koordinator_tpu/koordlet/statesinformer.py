@@ -45,6 +45,8 @@ class StatesInformer:
         self._pod_specs: Dict[str, Dict] = {}
         self._node_slo: Dict = {}
         self._node_topo: Dict = {}
+        self._devices: List[Dict] = []
+        self._plugins: List = []
         self._callbacks: List[Callable[[str], None]] = []
 
     def register_callback(self, cb: Callable[[str], None]) -> None:
@@ -122,6 +124,41 @@ class StatesInformer:
     def get_node_topo(self) -> Dict:
         with self._lock:
             return dict(self._node_topo)
+
+    def set_devices(self, devices: Sequence[Mapping]) -> None:
+        with self._lock:
+            self._devices = [dict(d) for d in devices]
+        self._notify("devices")
+
+    def get_devices(self) -> List[Dict]:
+        with self._lock:
+            return [dict(d) for d in self._devices]
+
+    # -- plugin registry (reference impl/registry.go: informer plugins
+    # registered by name, set up once, synced by the informer loop) --
+    def register_plugin(self, plugin) -> None:
+        with self._lock:
+            self._plugins.append(plugin)
+
+    def sync_plugins(self, now: float) -> Dict[str, object]:
+        """Run every registered informer plugin once; returns name ->
+        report (None when a plugin had nothing to publish).  A failing
+        plugin is logged and skipped — the reference koordlet continues
+        past informer-plugin errors rather than killing the daemon."""
+        import logging
+
+        with self._lock:
+            plugins = list(self._plugins)
+        out: Dict[str, object] = {}
+        for p in plugins:
+            try:
+                out[p.name] = p.sync(now)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "informer plugin %s sync failed", p.name
+                )
+                out[p.name] = None
+        return out
 
 
 class NodeMetricReporter:
@@ -211,3 +248,124 @@ class NodeMetricReporter:
             },
             "podsMetric": pods_usage,
         }
+
+
+class NodeTopoReporter:
+    """NodeResourceTopology producer (reference
+    ``impl/states_noderesourcetopology.go``): reads the host CPU/NUMA
+    layout from sysfs, builds the NRT report — per-NUMA-zone allocatable
+    resources plus the CPU topology detail the scheduler's cpuset
+    accumulator consumes (``scheduler/topology_options.go``) — and
+    publishes it through the informer store.
+
+    The report dict IS the CR payload: the scheduler side turns a set of
+    them into the NodeNUMAResource plugin's ZoneBatch extras via
+    ``zones_from_node_topos`` + ``model.topology.encode_zones``.
+    """
+
+    name = "nodetopo"
+
+    def __init__(self, fs, informer: StatesInformer, node_name: str = ""):
+        self.fs = fs
+        self.informer = informer
+        self.node_name = node_name
+        self._last: Optional[Dict] = None
+
+    def build(self) -> Optional[Dict]:
+        detail = self.fs.cpu_topology()
+        if not detail:
+            return None
+        zones = []
+        for numa in sorted({node for _, _, node, _ in detail}):
+            cpus = [c for c, _, node, _ in detail if node == numa]
+            zones.append(
+                {
+                    "name": f"node-{numa}",
+                    "type": "Node",
+                    "resources": {
+                        "cpu": f"{len(cpus) * 1000}m",
+                        "memory": self.fs.numa_node_memory_bytes(numa),
+                    },
+                    "cpus": cpus,
+                }
+            )
+        return {
+            "name": self.node_name,
+            "zones": zones,
+            "cpuTopology": {
+                "detail": [
+                    {"cpu": c, "core": core, "node": node, "socket": sock}
+                    for c, core, node, sock in detail
+                ]
+            },
+        }
+
+    def sync(self, now: float) -> Optional[Dict]:
+        report = self.build()
+        # publish (and fire informer callbacks) only on change: the
+        # topology is static, so every tick re-notifying qosmanager /
+        # runtimehooks reactions would be pure churn
+        if report is not None and report != self._last:
+            self.informer.set_node_topo(report)
+            self._last = report
+        return report
+
+
+class DeviceReporter:
+    """Device CR producer (reference ``impl/states_device.go``: the GPU
+    device informer reports the Device CR the DeviceShare plugin
+    consumes; here accelerators come from JAX/libtpu enumeration)."""
+
+    name = "device"
+
+    def __init__(self, informer: StatesInformer, devices_fn=None):
+        self.informer = informer
+        if devices_fn is None:
+            from koordinator_tpu.koordlet.collectors import _jax_devices
+
+            devices_fn = _jax_devices
+        self.devices_fn = devices_fn
+
+    def sync(self, now: float) -> List[Dict]:
+        devices = []
+        for dev in self.devices_fn():
+            # the default enumeration (collectors._jax_devices) yields
+            # {"minor", "platform"}; only accelerators become CR entries —
+            # a CPU-only host must not publish phantom devices
+            dev_type = dev.get("type") or dev.get("platform", "")
+            if dev_type in ("", "cpu"):
+                continue
+            devices.append(
+                {
+                    "type": dev_type,
+                    "minor": int(dev.get("minor", 0)),
+                    "health": bool(dev.get("health", True)),
+                    "resources": dev.get("resources", {}),
+                    "topology": {"numaNode": int(dev.get("numa_node", 0))},
+                }
+            )
+        self.informer.set_devices(devices)
+        return devices
+
+
+def zones_from_node_topos(topos: Sequence[Mapping]) -> List[Dict]:
+    """Adapt published NRT reports into the node-dict shape
+    ``model.topology.encode_zones`` consumes — the producer half feeding
+    the scheduler's NodeNUMAResource zone tensors, replacing hand-built
+    test fixtures (round-3 review #6)."""
+    out: List[Dict] = []
+    for topo in topos:
+        out.append(
+            {
+                "name": topo.get("name", ""),
+                "zones": [
+                    {
+                        "allocatable": z.get("resources", {}),
+                        "requested": z.get("requested", {}),
+                    }
+                    for z in topo.get("zones", ())
+                ],
+                "cpu_amplification": topo.get("cpu_amplification"),
+            }
+        )
+    return out

@@ -139,6 +139,96 @@ class SysFS:
         mi = self.meminfo()
         return max(0, mi.get("MemTotal", 0) - mi.get("MemAvailable", 0))
 
+    # -- CPU / NUMA topology (reference util/system + koordlet nodeinfo
+    # collectors read the same sysfs files to build the
+    # NodeResourceTopology CR, states_noderesourcetopology.go) --
+
+    def sys_path(self, *parts: str) -> str:
+        return os.path.join(self.root, "sys", *parts)
+
+    @staticmethod
+    def _parse_cpulist(text: str) -> List[int]:
+        """"0-3,8,10-11" -> [0, 1, 2, 3, 8, 10, 11]."""
+        cpus: List[int] = []
+        for part in text.strip().split(","):
+            if not part:
+                continue
+            if "-" in part:
+                lo, hi = part.split("-")
+                cpus.extend(range(int(lo), int(hi) + 1))
+            else:
+                cpus.append(int(part))
+        return cpus
+
+    def numa_nodes(self) -> List[int]:
+        """NUMA node ids from /sys/devices/system/node/node*/."""
+        base = self.sys_path("devices", "system", "node")
+        out: List[int] = []
+        try:
+            for name in os.listdir(base):
+                if name.startswith("node") and name[4:].isdigit():
+                    out.append(int(name[4:]))
+        except OSError:
+            return []
+        return sorted(out)
+
+    def numa_node_cpus(self, node: int) -> List[int]:
+        text = self.read(
+            self.sys_path("devices", "system", "node", f"node{node}", "cpulist")
+        )
+        return self._parse_cpulist(text) if text else []
+
+    def numa_node_memory_bytes(self, node: int) -> int:
+        """Node-local MemTotal from node<X>/meminfo ("Node 0 MemTotal: N kB")."""
+        text = (
+            self.read(
+                self.sys_path(
+                    "devices", "system", "node", f"node{node}", "meminfo"
+                )
+            )
+            or ""
+        )
+        for line in text.splitlines():
+            if "MemTotal:" in line:
+                fields = line.split()
+                try:
+                    idx = fields.index("MemTotal:")
+                    value = int(fields[idx + 1])
+                except (ValueError, IndexError):
+                    return 0
+                if len(fields) > idx + 2 and fields[idx + 2] == "kB":
+                    value *= 1024
+                return value
+        return 0
+
+    def cpu_topology(self) -> List[Tuple[int, int, int, int]]:
+        """(cpu, core, numa_node, socket) per online logical CPU, from
+        cpu<N>/topology/{core_id,physical_package_id} + the NUMA cpulists."""
+        cpu_node: Dict[int, int] = {}
+        for n in self.numa_nodes():
+            for c in self.numa_node_cpus(n):
+                cpu_node[c] = n
+        base = self.sys_path("devices", "system", "cpu")
+        out: List[Tuple[int, int, int, int]] = []
+        try:
+            names = os.listdir(base)
+        except OSError:
+            return []
+        for name in sorted(names):
+            if not (name.startswith("cpu") and name[3:].isdigit()):
+                continue
+            cpu = int(name[3:])
+            core = self.read(os.path.join(base, name, "topology", "core_id"))
+            sock = self.read(
+                os.path.join(base, name, "topology", "physical_package_id")
+            )
+            if core is None or sock is None:
+                continue
+            out.append(
+                (cpu, int(core), cpu_node.get(cpu, 0), int(sock))
+            )
+        return out
+
     def proc_stat_cpu(self) -> Tuple[int, int]:
         """(used_ticks, total_ticks) from the aggregate /proc/stat cpu line."""
         text = self.read(self.proc_path("stat")) or ""

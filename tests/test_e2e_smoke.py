@@ -111,6 +111,71 @@ def test_full_stack_smoke(tmp_path, cluster):
     assert all(a >= 0 for a in assignment), "everything must place"
     assert reply.path in ("pallas", "scan")
 
+    # ---- koordlet -> scheduler NRT chain: each node's koordlet publishes
+    # NodeResourceTopology from its (fake) sysfs, and the scheduler's
+    # NodeNUMAResource zone extras are built from the PUBLISHED reports,
+    # not hand-built fixtures (reference states_noderesourcetopology.go
+    # producing what topology_options.go consumes) ----
+    import jax.numpy as jnp
+    import numpy as np
+
+    from koordinator_tpu.koordlet.statesinformer import (
+        NodeTopoReporter,
+        StatesInformer,
+        zones_from_node_topos,
+    )
+    from koordinator_tpu.koordlet.sysfs import CgroupVersion, SysFS
+    from koordinator_tpu.model import encode_snapshot
+    from koordinator_tpu.model.topology import encode_zones
+    from koordinator_tpu.scheduler.framework import (
+        CycleContext,
+        FrameworkExtender,
+    )
+    from koordinator_tpu.ops.numa import POLICY_SINGLE_NUMA_NODE
+    from koordinator_tpu.scheduler.plugins import NodeNUMAResourcePlugin
+    from tests.test_statesinformer_producers import write_sysfs_topology
+
+    published = []
+    for i, nd in enumerate(nodes[:2]):
+        root = str(tmp_path / f"host-{i}")
+        # host 0: 2 NUMA zones x 4 cores; host 1: small 1-core zones
+        write_sysfs_topology(
+            root, numa_nodes=2, cores_per_node=4 if i == 0 else 1, threads=2
+        )
+        informer = StatesInformer()
+        informer.register_plugin(
+            NodeTopoReporter(
+                SysFS(root=root, cgroup_version=CgroupVersion.V1),
+                informer,
+                node_name=nd["name"],
+            )
+        )
+        informer.sync_plugins(time.time())
+        published.append(informer.get_node_topo())
+    assert all(t.get("zones") for t in published)
+
+    numa_snap = encode_snapshot(
+        nodes[:2],
+        [{"name": "numa-pod", "requests": {"cpu": "6000m", "memory": "1024Mi"}}],
+        [],
+        [],
+    )
+    zones = encode_zones(
+        zones_from_node_topos(published), node_bucket=numa_snap.nodes.capacity
+    )
+    policy = jnp.full(
+        (numa_snap.nodes.capacity,), POLICY_SINGLE_NUMA_NODE, jnp.int32
+    )
+    fx = FrameworkExtender([NodeNUMAResourcePlugin()])
+    numa_result = fx.run_cycle(
+        CycleContext(
+            snapshot=numa_snap, extras={"zones": zones, "numa_policy": policy}
+        )
+    )
+    # the 6-core pod fits a published 8-cpu zone on host 0; host 1's
+    # 2-cpu zones cannot hold it under single-numa admission
+    assert int(np.asarray(numa_result.assignment)[0]) == 0
+
     # ---- reservation: Pending -> scheduled -> Available ----
     from koordinator_tpu.scheduler.reservation_controller import (
         AVAILABLE,

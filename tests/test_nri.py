@@ -1,0 +1,240 @@
+"""NRI-mode hook delivery (koordlet/nri.py): the runtime-initiated
+event-subscription path must feed the SAME HookRegistry as the proxy and
+reconciler modes and produce byte-identical cgroup mutations.
+
+Reference: pkg/koordlet/runtimehooks/nri/server.go (CreateContainer at
+:165 returns a ContainerAdjustment the runtime applies).
+"""
+
+import os
+import tempfile
+
+import pytest
+
+from koordinator_tpu.koordlet.nri import (
+    EVENT_CREATE_CONTAINER,
+    EVENT_RUN_POD_SANDBOX,
+    EVENT_STOP_POD_SANDBOX,
+    EVENT_SYNCHRONIZE,
+    EVENT_UPDATE_CONTAINER,
+    NriPlugin,
+    NriRuntime,
+    apply_adjustment,
+)
+from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+from koordinator_tpu.koordlet.runtimehooks import (
+    ContainerContext,
+    Reconciler,
+    default_registry,
+)
+from koordinator_tpu.koordlet.sysfs import CgroupVersion, SysFS
+
+
+BE_POD = {
+    "uid": "u1",
+    "name": "be-pod",
+    "labels": {"koordinator.sh/qosClass": "BE"},
+    "annotations": {},
+    "requests": {
+        "kubernetes.io/batch-cpu": 2000,
+        "kubernetes.io/batch-memory": "1024Mi",
+    },
+    "limits": {},
+}
+
+
+def _fs(tmp_path):
+    return SysFS(root=str(tmp_path), cgroup_version=CgroupVersion.V1)
+
+
+@pytest.fixture
+def session(tmp_path):
+    sock = os.path.join(tempfile.mkdtemp(), "nri.sock")
+    runtime = NriRuntime(sock)
+    registry = default_registry()
+    import threading
+
+    plugin_box = {}
+
+    def connect():
+        plugin_box["plugin"] = NriPlugin(sock, registry)
+
+    t = threading.Thread(target=connect)
+    t.start()
+    reg = runtime.accept_plugin()
+    t.join(timeout=5)
+    assert reg["plugin_name"] == "koordlet"
+    assert EVENT_CREATE_CONTAINER in reg["events"]
+    yield runtime, plugin_box["plugin"], registry
+    plugin_box["plugin"].close()
+    runtime.close()
+
+
+class TestNriDelivery:
+    def test_create_container_matches_reconciler_mutations(
+        self, session, tmp_path
+    ):
+        runtime, plugin, registry = session
+        runtime.event({"event": EVENT_RUN_POD_SANDBOX, "pod": BE_POD})
+        reply = runtime.event(
+            {
+                "event": EVENT_CREATE_CONTAINER,
+                "pod": {"uid": "u1"},
+                "container": {"name": "c1", "cgroup_dir": "kubepods/pod-u1/c1"},
+            }
+        )
+        adj = reply["adjustment"]
+
+        # runtime applies the adjustment to cgroups
+        fs = _fs(tmp_path)
+        ex_nri = ResourceUpdateExecutor(fs)
+        n = apply_adjustment(adj, "kubepods/pod-u1/c1", ex_nri)
+        assert n >= 3
+
+        # the reconciler path on the identical container, separate tree
+        tmp2 = tempfile.mkdtemp()
+        fs2 = SysFS(root=tmp2, cgroup_version=CgroupVersion.V1)
+        ex_rec = ResourceUpdateExecutor(fs2)
+        ctx = ContainerContext(
+            pod_uid="u1",
+            container_name="c1",
+            qos="BE",
+            pod_labels=BE_POD["labels"],
+            pod_annotations={},
+            requests=BE_POD["requests"],
+            limits={},
+            cgroup_dir="kubepods/pod-u1/c1",
+        )
+        Reconciler(registry, ex_rec).reconcile_container(ctx)
+
+        # byte-identical cgroup files across the two delivery modes
+        def tree(root):
+            out = {}
+            for dirpath, _, files in os.walk(root):
+                for f in files:
+                    p = os.path.join(dirpath, f)
+                    out[os.path.relpath(p, root)] = open(p).read()
+            return out
+
+        nri_tree = tree(str(tmp_path))
+        rec_tree = tree(tmp2)
+        assert nri_tree and nri_tree == rec_tree
+
+    def test_cpuset_annotation_flows_through_nri(self, session, tmp_path):
+        runtime, _, _ = session
+        pod = dict(BE_POD)
+        pod["uid"] = "u2"
+        pod["annotations"] = {
+            "scheduling.koordinator.sh/resource-status": {"cpuset": "4-7"}
+        }
+        runtime.event({"event": EVENT_RUN_POD_SANDBOX, "pod": pod})
+        reply = runtime.event(
+            {
+                "event": EVENT_CREATE_CONTAINER,
+                "pod": {"uid": "u2"},
+                "container": {"name": "c1", "cgroup_dir": "kubepods/u2/c1"},
+            }
+        )
+        assert reply["adjustment"]["linux"]["resources"]["cpu"]["cpus"] == "4-7"
+
+    def test_update_and_stop_lifecycle(self, session):
+        runtime, plugin, _ = session
+        runtime.event({"event": EVENT_RUN_POD_SANDBOX, "pod": BE_POD})
+        reply = runtime.event(
+            {
+                "event": EVENT_UPDATE_CONTAINER,
+                "pod": {"uid": "u1"},
+                "container": {"name": "c1", "cgroup_dir": "kubepods/u1/c1"},
+            }
+        )
+        assert "update" in reply and reply["update"]
+        runtime.event({"event": EVENT_STOP_POD_SANDBOX, "pod": {"uid": "u1"}})
+        assert "u1" not in plugin.pods
+
+    def test_synchronize_replays_existing_state(self, session):
+        runtime, plugin, _ = session
+        reply = runtime.event(
+            {
+                "event": EVENT_SYNCHRONIZE,
+                "pods": [BE_POD],
+                "containers": [
+                    {
+                        "name": "c1",
+                        "pod_uid": "u1",
+                        "cgroup_dir": "kubepods/u1/c1",
+                    }
+                ],
+            }
+        )
+        assert len(reply["updates"]) == 1
+        upd = reply["updates"][0]["update"]
+        assert upd["linux"]["resources"]["cpu"]["quota"] == 2000 * 100_000 // 1000
+        assert "u1" in plugin.pods
+
+    def test_unsubscribed_event_is_ignored(self, tmp_path):
+        sock = os.path.join(tempfile.mkdtemp(), "nri2.sock")
+        runtime = NriRuntime(sock)
+        import threading
+
+        box = {}
+        t = threading.Thread(
+            target=lambda: box.update(
+                p=NriPlugin(
+                    sock,
+                    default_registry(),
+                    events=(EVENT_RUN_POD_SANDBOX,),
+                )
+            )
+        )
+        t.start()
+        runtime.accept_plugin()
+        t.join(timeout=5)
+        reply = runtime.event(
+            {
+                "event": EVENT_CREATE_CONTAINER,
+                "pod": {"uid": "x"},
+                "container": {"name": "c"},
+            }
+        )
+        assert reply == {}
+        box["p"].close()
+        runtime.close()
+
+
+class TestDaemonNriWiring:
+    def test_daemon_registers_as_nri_plugin(self, tmp_path):
+        import threading
+
+        from koordinator_tpu.koordlet.daemon import Daemon
+        from koordinator_tpu.koordlet.nri import NriRuntime
+
+        sock = os.path.join(str(tmp_path), "nri.sock")
+        runtime = NriRuntime(sock)
+        box = {}
+        t = threading.Thread(
+            target=lambda: box.update(d=Daemon(fs=_fs(tmp_path), nri_socket=sock))
+        )
+        t.start()
+        reg = runtime.accept_plugin()
+        t.join(timeout=5)
+        assert reg["plugin_name"] == "koordlet"
+        runtime.event({"event": EVENT_RUN_POD_SANDBOX, "pod": BE_POD})
+        reply = runtime.event(
+            {
+                "event": EVENT_CREATE_CONTAINER,
+                "pod": {"uid": "u1"},
+                "container": {"name": "c1", "cgroup_dir": "kubepods/u1/c1"},
+            }
+        )
+        assert reply["adjustment"]["linux"]["resources"]["cpu"]["quota"] > 0
+        box["d"].shutdown()
+        runtime.close()
+
+    def test_daemon_degrades_when_runtime_socket_absent(self, tmp_path):
+        from koordinator_tpu.koordlet.daemon import Daemon
+
+        d = Daemon(
+            fs=_fs(tmp_path), nri_socket=str(tmp_path / "missing.sock")
+        )
+        assert d.nri is None  # degraded to proxy/reconciler, daemon alive
+        d.shutdown()

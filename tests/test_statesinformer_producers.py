@@ -1,0 +1,144 @@
+"""statesinformer producer half (round-3 review #6): the NRT and Device
+reporters publish through the informer plugin registry, and the
+scheduler's zone extras are constructed FROM the published reports
+(reference impl/states_noderesourcetopology.go, impl/registry.go).
+"""
+
+import os
+
+import numpy as np
+
+from koordinator_tpu.koordlet.statesinformer import (
+    DeviceReporter,
+    NodeTopoReporter,
+    StatesInformer,
+    zones_from_node_topos,
+)
+from koordinator_tpu.koordlet.sysfs import CgroupVersion, SysFS
+from koordinator_tpu.model.topology import encode_zones
+
+
+def write_sysfs_topology(root, numa_nodes=2, cores_per_node=2, threads=2,
+                         mem_bytes_per_node=4 << 30):
+    """Fake /sys tree: <numa_nodes> NUMA nodes x <cores_per_node> cores x
+    <threads> SMT threads, contiguous cpu ids per core."""
+    cpu = 0
+    for n in range(numa_nodes):
+        nd = os.path.join(root, "sys", "devices", "system", "node", f"node{n}")
+        os.makedirs(nd, exist_ok=True)
+        first = cpu
+        last = cpu + cores_per_node * threads - 1
+        with open(os.path.join(nd, "cpulist"), "w") as f:
+            f.write(f"{first}-{last}\n")
+        with open(os.path.join(nd, "meminfo"), "w") as f:
+            f.write(f"Node {n} MemTotal: {mem_bytes_per_node // 1024} kB\n")
+        for c in range(cores_per_node):
+            core_id = n * cores_per_node + c
+            for _t in range(threads):
+                cd = os.path.join(
+                    root, "sys", "devices", "system", "cpu", f"cpu{cpu}",
+                    "topology",
+                )
+                os.makedirs(cd, exist_ok=True)
+                with open(os.path.join(cd, "core_id"), "w") as f:
+                    f.write(f"{core_id}\n")
+                with open(os.path.join(cd, "physical_package_id"), "w") as f:
+                    f.write("0\n")
+                cpu += 1
+
+
+class TestSysfsTopology:
+    def test_cpu_topology_and_numa_memory(self, tmp_path):
+        write_sysfs_topology(str(tmp_path))
+        fs = SysFS(root=str(tmp_path), cgroup_version=CgroupVersion.V1)
+        topo = fs.cpu_topology()
+        assert len(topo) == 8  # 2 numa x 2 cores x 2 threads
+        # cpus 0-3 on numa 0, 4-7 on numa 1
+        assert [t[2] for t in topo] == [0, 0, 0, 0, 1, 1, 1, 1]
+        # siblings share a core id
+        assert topo[0][1] == topo[1][1] and topo[2][1] == topo[3][1]
+        assert fs.numa_nodes() == [0, 1]
+        assert fs.numa_node_memory_bytes(0) == 4 << 30
+
+    def test_parse_cpulist_forms(self):
+        assert SysFS._parse_cpulist("0-3,8,10-11") == [0, 1, 2, 3, 8, 10, 11]
+        assert SysFS._parse_cpulist("") == []
+
+
+class TestNodeTopoReporter:
+    def test_publishes_nrt_through_informer(self, tmp_path):
+        write_sysfs_topology(str(tmp_path))
+        fs = SysFS(root=str(tmp_path), cgroup_version=CgroupVersion.V1)
+        informer = StatesInformer()
+        reporter = NodeTopoReporter(fs, informer, node_name="n0")
+        informer.register_plugin(reporter)
+        reports = informer.sync_plugins(now=0.0)
+        nrt = reports["nodetopo"]
+        assert nrt is not None and informer.get_node_topo() == nrt
+        assert [z["name"] for z in nrt["zones"]] == ["node-0", "node-1"]
+        assert nrt["zones"][0]["resources"]["cpu"] == "4000m"
+        assert nrt["zones"][0]["resources"]["memory"] == 4 << 30
+        assert len(nrt["cpuTopology"]["detail"]) == 8
+
+    def test_empty_sysfs_publishes_nothing(self, tmp_path):
+        fs = SysFS(root=str(tmp_path), cgroup_version=CgroupVersion.V1)
+        informer = StatesInformer()
+        reporter = NodeTopoReporter(fs, informer)
+        assert reporter.sync(0.0) is None
+        assert informer.get_node_topo() == {}
+
+
+class TestDeviceReporter:
+    def test_publishes_device_cr(self):
+        informer = StatesInformer()
+        devices = [
+            {"type": "tpu", "minor": 0, "numa_node": 0,
+             "resources": {"koordinator.sh/gpu-core": 100}},
+            {"type": "tpu", "minor": 1, "numa_node": 1, "resources": {}},
+        ]
+        reporter = DeviceReporter(informer, devices_fn=lambda: devices)
+        out = reporter.sync(0.0)
+        assert len(out) == 2
+        got = informer.get_devices()
+        assert got[1]["topology"]["numaNode"] == 1
+        assert got[0]["health"] is True
+
+
+class TestProducerToSchedulerChain:
+    def test_published_nrt_feeds_zone_extras(self, tmp_path):
+        """The consumer half (ops/numa zone kernels) runs on a ZoneBatch
+        built from PUBLISHED reports, not hand-built fixtures."""
+        from koordinator_tpu.ops.numa import zone_fit_mask
+
+        roots = []
+        topos = []
+        for i in range(2):
+            root = os.path.join(str(tmp_path), f"host{i}")
+            write_sysfs_topology(
+                root, numa_nodes=2, cores_per_node=2 + i, threads=2
+            )
+            roots.append(root)
+            fs = SysFS(root=root, cgroup_version=CgroupVersion.V1)
+            informer = StatesInformer()
+            rep = NodeTopoReporter(fs, informer, node_name=f"n{i}")
+            informer.register_plugin(rep)
+            informer.sync_plugins(0.0)
+            topos.append(informer.get_node_topo())
+
+        zb = encode_zones(zones_from_node_topos(topos), node_bucket=2)
+        alloc = np.asarray(zb.allocatable)
+        # node 0: 2 cores x 2 threads = 4000m per zone; node 1: 6000m
+        assert alloc[0, 0, 0] == 4000 and alloc[1, 0, 0] == 6000
+        assert np.asarray(zb.valid).sum() == 4
+
+        # a pod needing 5000m fits only node 1's zones
+        reqs = np.zeros((1, alloc.shape[2]), np.int64)
+        reqs[0, 0] = 5000
+        import jax.numpy as jnp
+
+        fits = np.asarray(
+            zone_fit_mask(
+                jnp.asarray(reqs), zb.allocatable, zb.requested, zb.valid
+            )
+        )
+        assert not fits[0, 0].any() and fits[0, 1].any()
