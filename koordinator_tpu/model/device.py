@@ -59,12 +59,19 @@ def device_resource_vector(rl: Mapping[str, object]) -> np.ndarray:
 
 @dataclasses.dataclass
 class DeviceBatch:
-    """Dense per-node device minors, shapes [N, D, C] / [N, D]."""
+    """Dense per-node device minors, shapes [N, D, C] / [N, D].
+
+    ``numa`` carries each minor's NUMA node id from the Device CR's
+    topology block (reference
+    ``apis/scheduling/v1alpha1/device_types.go DeviceTopology.NodeID``) —
+    the joint allocator's NUMA-affinity tiebreak reads it.
+    """
 
     total: jnp.ndarray  # i64[N, D, C]
     free: jnp.ndarray  # i64[N, D, C]
     dev_type: jnp.ndarray  # i32[N, D] DEVICE_* code
     valid: jnp.ndarray  # bool[N, D] healthy minor exists
+    numa: Optional[jnp.ndarray] = None  # i32[N, D] NUMA node id
 
     @property
     def minors(self) -> int:
@@ -72,7 +79,9 @@ class DeviceBatch:
 
 
 jax.tree_util.register_dataclass(
-    DeviceBatch, data_fields=["total", "free", "dev_type", "valid"], meta_fields=[]
+    DeviceBatch,
+    data_fields=["total", "free", "dev_type", "valid", "numa"],
+    meta_fields=[],
 )
 
 
@@ -99,15 +108,19 @@ def encode_devices(
     free = np.zeros((n_bucket, d_bucket, C), np.int64)
     dtype = np.zeros((n_bucket, d_bucket), np.int32)
     valid = np.zeros((n_bucket, d_bucket), bool)
+    numa = np.zeros((n_bucket, d_bucket), np.int32)
     for i, nd in enumerate(nodes):
         for j, dev in enumerate(nd.get("devices", ())):
             total[i, j] = device_resource_vector(dev.get("total", {}))
             free[i, j] = device_resource_vector(dev.get("free", dev.get("total", {})))
             dtype[i, j] = DEVICE_TYPE_NAMES.get(str(dev.get("type", "gpu")).lower(), 0)
             valid[i, j] = True
+            topo = dev.get("topology") or {}
+            numa[i, j] = int(topo.get("numaNode", 0))
     return DeviceBatch(
         total=jnp.asarray(total),
         free=jnp.asarray(free),
         dev_type=jnp.asarray(dtype),
         valid=jnp.asarray(valid),
+        numa=jnp.asarray(numa),
     )

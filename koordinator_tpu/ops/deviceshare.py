@@ -24,8 +24,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from koordinator_tpu.model.device import (
+    DEVICE_FPGA,
     DEVICE_GPU,
+    DEVICE_RDMA,
     DEVICE_RESOURCE_INDEX,
+    DEVICE_TYPE_NAMES,
     DEVICE_TYPE_RESOURCES,
     DeviceBatch,
     NUM_DEVICE_RESOURCES,
@@ -83,13 +86,26 @@ def normalize_gpu_requests(
     return out
 
 
+# device resource dims that belong to the GPU type (the card-spanning
+# division applies to these ONLY: an RDMA/FPGA quantity must not be
+# divided by the number of GPU cards a co-requesting pod wants)
+_GPU_DIMS = jnp.asarray(
+    [DEVICE_RESOURCE_INDEX[n] for n in DEVICE_TYPE_RESOURCES[DEVICE_GPU]],
+    dtype=jnp.int32,
+)
+
+
 def split_per_card(norm_requests: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(perCard i64[P, N, C], wanted i64[P, N]) — calcDeviceWanted: a ratio
-    that is a positive multiple of 100 spans ratio/100 cards."""
+    that is a positive multiple of 100 spans ratio/100 cards.  Division
+    applies to the GPU dims only; other device types keep their full
+    per-minor quantity (they allocate one minor per request)."""
     ratio = norm_requests[..., _RATIO]
     multi = (ratio >= 100) & (ratio % 100 == 0)
     wanted = jnp.where(multi, ratio // 100, 1)
-    per_card = norm_requests // jnp.maximum(wanted, 1)[..., None]
+    is_gpu_dim = jnp.zeros((norm_requests.shape[-1],), bool).at[_GPU_DIMS].set(True)
+    divided = norm_requests // jnp.maximum(wanted, 1)[..., None]
+    per_card = jnp.where(is_gpu_dim, divided, norm_requests)
     return per_card, wanted
 
 
@@ -118,7 +134,10 @@ def device_fit_mask(
         )  # [P, N, D]
         satisfied &= minors_of_type[None, :, :]
         count = satisfied.sum(axis=-1)  # [P, N]
-        type_ok = count >= wanted
+        # card-spanning applies to the GPU type; other types want one
+        # satisfying minor for their (undivided) request
+        type_wanted = wanted if type_code == DEVICE_GPU else 1
+        type_ok = count >= type_wanted
         ok &= jnp.where(requested_type[:, None], type_ok, True)
         # requesting a type the node doesn't have at all fails
         has_type = jnp.any(minors_of_type, axis=-1)  # [N]
@@ -167,51 +186,29 @@ def allocate_minors(
     preferred: Optional[Set[int]] = None,
     required: Optional[Set[int]] = None,
     most_allocated: bool = False,
+    preferred_numa: Optional[Set[int]] = None,
 ) -> List[int]:
     """Host-side exact minor selection on the chosen node.
 
-    ``minors``: ``[{"minor": int, "total": {dim: qty}, "free": {dim: qty}}]``.
+    ``minors``: ``[{"minor": int, "total": {dim: qty}, "free": {dim: qty},
+    "topology": {"numaNode": int}}]``.
     Ordering parity with scoreDevices + sortDeviceResourcesByMinor
     (device_resources.go:161,177): preferred minors first, then score
-    descending (scoreDevice), then minor ascending; the first ``wanted``
+    descending (scoreDevice), then — when ``preferred_numa`` is given —
+    NUMA-affine minors before others (the joint allocator's cross-type
+    alignment tiebreak), then minor ascending; the first ``wanted``
     satisfying minors win.  Raises ValueError when the node can't satisfy.
     """
     preferred = preferred or set()
     required = required or set()
-
-    def q(dim, value) -> int:
-        return res.parse_quantity(value, dim)
-
-    def free_of(m) -> Dict[str, int]:
-        # an unallocated healthy device is fully free (deviceFree == total)
-        src = m.get("free")
-        if src is None:
-            src = m.get("total") or {}
-        return {dim: q(dim, v) for dim, v in src.items()}
-
-    def score(m) -> int:
-        s = 0
-        n = 0
-        free = free_of(m)
-        for dim, total in (m.get("total") or {}).items():
-            total = q(dim, total)
-            if total == 0:
-                continue
-            f = free.get(dim, 0)
-            req = total - f + int(per_card.get(dim, 0)) if total >= f else total
-            if most_allocated:
-                val = max(0, MAX_NODE_SCORE * req // total) if req <= total else 0
-            else:
-                val = (total - req) * MAX_NODE_SCORE // total if req <= total else 0
-            s += val
-            n += 1
-        return s // n if n else 0
+    preferred_numa = preferred_numa or set()
 
     ranked = sorted(
         minors,
         key=lambda m: (
             m["minor"] not in preferred,
-            -score(m),
+            -_score_device(m, per_card, most_allocated),
+            bool(preferred_numa) and _numa_of(m) not in preferred_numa,
             m["minor"],
         ),
     )
@@ -219,9 +216,289 @@ def allocate_minors(
     for m in ranked:
         if required and m["minor"] not in required:
             continue
-        free = free_of(m)
-        if all(free.get(d, 0) >= q_ for d, q_ in per_card.items()):
+        if _satisfies(m, per_card):
             out.append(m["minor"])
             if len(out) == wanted:
                 return out
     raise ValueError(f"node cannot satisfy {wanted} device minors")
+
+
+def _free_of(m: Mapping) -> Dict[str, int]:
+    # an unallocated healthy device is fully free (deviceFree == total)
+    src = m.get("free")
+    if src is None:
+        src = m.get("total") or {}
+    return {dim: res.parse_quantity(v, dim) for dim, v in src.items()}
+
+
+def _numa_of(m: Mapping) -> int:
+    return int((m.get("topology") or {}).get("numaNode", 0))
+
+
+def _satisfies(m: Mapping, per_card: Mapping[str, int]) -> bool:
+    free = _free_of(m)
+    return all(free.get(d, 0) >= q for d, q in per_card.items())
+
+
+def _score_device(
+    m: Mapping, per_card: Mapping[str, int], most_allocated: bool
+) -> int:
+    """scoreDevice (device_resources.go:161): least/most allocated over
+    the minor's dims as if per_card were placed — the ONE copy both the
+    per-minor and the partition-group orderings use."""
+    s = 0
+    n = 0
+    free = _free_of(m)
+    for dim, total in (m.get("total") or {}).items():
+        total = res.parse_quantity(total, dim)
+        if total == 0:
+            continue
+        f = free.get(dim, 0)
+        req = total - f + int(per_card.get(dim, 0)) if total >= f else total
+        if most_allocated:
+            val = max(0, MAX_NODE_SCORE * req // total) if req <= total else 0
+        else:
+            val = (total - req) * MAX_NODE_SCORE // total if req <= total else 0
+        s += val
+        n += 1
+    return s // n if n else 0
+
+
+def allocate_partitioned(
+    minors: Sequence[Mapping],
+    per_card: Mapping[str, int],
+    wanted: int,
+    partitions: Mapping[int, Sequence[Sequence[int]]],
+    *,
+    preferred: Optional[Set[int]] = None,
+    required: Optional[Set[int]] = None,
+    most_allocated: bool = False,
+) -> List[int]:
+    """Partition-table-constrained multi-card selection.
+
+    ``partitions`` maps allocation size -> the minor groups that may be
+    co-allocated at that size (the GPU partition-table semantics of newer
+    koordinator ``apis/extension`` — e.g. NVLink rings on an 8-GPU host:
+    ``{4: [[0,1,2,3], [4,5,6,7]], 8: [[0..7]]}``).  The chosen set must
+    be exactly one listed group whose every minor satisfies ``per_card``
+    (and covers ``required`` when given); among feasible groups the one
+    containing preferred minors wins, then the emptiest (least-allocated)
+    or fullest (most-allocated) by summed minor score, then the lowest
+    first minor.  Sizes without a table entry fall back to the free
+    per-minor ordering (``allocate_minors``).
+    """
+    groups = partitions.get(wanted) if partitions else None
+    if not groups:
+        return allocate_minors(
+            minors,
+            per_card,
+            wanted,
+            preferred=preferred,
+            required=required,
+            most_allocated=most_allocated,
+        )
+    preferred = preferred or set()
+    required = required or set()
+    by_minor = {m["minor"]: m for m in minors}
+
+    feasible = []
+    for group in groups:
+        if len(group) != wanted:
+            continue
+        members = [by_minor.get(g) for g in group]
+        if any(m is None for m in members):
+            continue
+        if required and not required.issubset(set(group)):
+            continue
+        if not all(_satisfies(m, per_card) for m in members):
+            continue
+        feasible.append((group, members))
+    if not feasible:
+        raise ValueError(
+            f"no partition group of size {wanted} can satisfy the request"
+        )
+    best = min(
+        feasible,
+        key=lambda gm: (
+            not any(g in preferred for g in gm[0]),
+            -sum(_score_device(m, per_card, most_allocated) for m in gm[1]),
+            min(gm[0]),
+        ),
+    )
+    return sorted(best[0])
+
+
+# device-type allocation order of the joint allocator (tryAllocateDevice
+# iterates DeviceResourceNames; a fixed order keeps results deterministic)
+_JOINT_TYPE_ORDER = (DEVICE_GPU, DEVICE_RDMA, DEVICE_FPGA)
+_TYPE_NAMES = {DEVICE_GPU: "gpu", DEVICE_RDMA: "rdma", DEVICE_FPGA: "fpga"}
+
+
+def allocate_joint(
+    minors: Sequence[Mapping],
+    per_card_by_type: Mapping[int, Mapping[str, int]],
+    wanted_by_type: Mapping[int, int],
+    *,
+    partitions: Optional[Mapping[int, Sequence[Sequence[int]]]] = None,
+    preferred: Optional[Mapping[int, Set[int]]] = None,
+    required: Optional[Mapping[int, Set[int]]] = None,
+    most_allocated: bool = False,
+) -> Dict[int, List[int]]:
+    """Joint allocation across device types on one node (reference
+    ``device_cache.go:272 tryAllocateDevice`` loops the requested types;
+    ``allocator.go:91`` drives it from the plugin).
+
+    Types allocate in a fixed order (GPU first); after the first type
+    lands, its minors' NUMA nodes become the NUMA-affinity preference for
+    every later type, so a GPU+RDMA pod gets an RDMA NIC on the same NUMA
+    node as its GPUs whenever one satisfies the request (the alignment
+    newer koordinator drives through device topology hints).  GPU
+    allocations honor the node's partition table when one exists.
+
+    ``minors`` carry a ``"type"`` name; returns {type_code: [minor, ...]}.
+    Raises ValueError when any requested type cannot be satisfied
+    (all-or-nothing, like the reference's tryAllocateDevice).
+    """
+    preferred = preferred or {}
+    required = required or {}
+    out: Dict[int, List[int]] = {}
+    numa_hint: Set[int] = set()
+    by_type: Dict[int, List[Mapping]] = {}
+    for m in minors:
+        code = DEVICE_TYPE_NAMES.get(str(m.get("type", "gpu")).lower(), DEVICE_GPU)
+        by_type.setdefault(code, []).append(m)
+    for code in _JOINT_TYPE_ORDER:
+        per_card = per_card_by_type.get(code)
+        if not per_card:
+            continue
+        wanted = int(wanted_by_type.get(code, 1))
+        pool = by_type.get(code, [])
+        if not pool:
+            raise ValueError(f"node has no {_TYPE_NAMES[code]} devices")
+        if code == DEVICE_GPU and partitions:
+            chosen = allocate_partitioned(
+                pool,
+                per_card,
+                wanted,
+                partitions,
+                preferred=preferred.get(code),
+                required=required.get(code),
+                most_allocated=most_allocated,
+            )
+        else:
+            chosen = allocate_minors(
+                pool,
+                per_card,
+                wanted,
+                preferred=preferred.get(code),
+                required=required.get(code),
+                most_allocated=most_allocated,
+                preferred_numa=numa_hint or None,
+            )
+        out[code] = chosen
+        for m in pool:
+            if m["minor"] in chosen:
+                numa_hint.add(_numa_of(m))
+    return out
+
+
+def minor_dicts_from_batch(
+    devices: DeviceBatch, node_idx: int
+) -> List[Dict]:
+    """Reconstruct host-side minor dicts for one node from the dense
+    DeviceBatch — the Reserve path's input when the caller supplies only
+    the tensor extras (minor id = dense index; topology carried by
+    ``devices.numa``)."""
+    total = np.asarray(devices.total[node_idx])
+    free = np.asarray(devices.free[node_idx])
+    dtyp = np.asarray(devices.dev_type[node_idx])
+    valid = np.asarray(devices.valid[node_idx])
+    numa = (
+        np.asarray(devices.numa[node_idx])
+        if devices.numa is not None
+        else np.zeros_like(dtyp)
+    )
+    code_to_name = {v: k for k, v in DEVICE_TYPE_NAMES.items()}
+    out: List[Dict] = []
+    for d in range(total.shape[0]):
+        if not valid[d]:
+            continue
+        dims = DEVICE_TYPE_RESOURCES[int(dtyp[d])]
+        # tensor cells are axis units (MiB/milli); the minor-dict contract
+        # carries parse_quantity-round-trippable forms
+        out.append(
+            {
+                "minor": d,
+                "type": code_to_name[int(dtyp[d])],
+                "total": {
+                    n: res.format_quantity(
+                        int(total[d, DEVICE_RESOURCE_INDEX[n]]), n
+                    )
+                    for n in dims
+                },
+                "free": {
+                    n: res.format_quantity(
+                        int(free[d, DEVICE_RESOURCE_INDEX[n]]), n
+                    )
+                    for n in dims
+                },
+                "topology": {"numaNode": int(numa[d])},
+            }
+        )
+    return out
+
+
+def partition_fit_mask(
+    pod_requests: jnp.ndarray,  # i64[P, R] (snapshot axis)
+    devices: DeviceBatch,
+    partitions_by_node: Mapping[int, Mapping[int, Sequence[Sequence[int]]]],
+    *,
+    per_card: Optional[np.ndarray] = None,  # precomputed [P, N, C]
+    wanted: Optional[np.ndarray] = None,  # precomputed [P, N]
+) -> np.ndarray:
+    """bool[P, N] host-side refinement of ``device_fit_mask``: on nodes
+    with a GPU partition table, a multi-card request only fits when some
+    listed group of the wanted size has every member free enough — the
+    count-based tensor fit can overcount minors that no single partition
+    group contains.  Callers that already ran the normalization pipeline
+    (plugins.DeviceSharePlugin.filter_mask) pass ``per_card``/``wanted``
+    to avoid recomputing it."""
+    dev_req = np.asarray(pod_device_requests(pod_requests))  # [P, C]
+    if per_card is None or wanted is None:
+        card_mem = gpu_card_total_memory(devices)
+        norm = normalize_gpu_requests(jnp.asarray(dev_req), card_mem)
+        per_card_t, wanted_t = split_per_card(norm)
+        per_card = np.asarray(per_card_t)
+        wanted = np.asarray(wanted_t)
+    free = np.asarray(devices.free)
+    is_gpu = np.asarray((devices.dev_type == DEVICE_GPU) & devices.valid)
+    gpu_dims = [DEVICE_RESOURCE_INDEX[n] for n in DEVICE_TYPE_RESOURCES[DEVICE_GPU]]
+
+    P, N = wanted.shape
+    ok = np.ones((P, N), bool)
+    gpu_requested = dev_req[:, gpu_dims].max(axis=1) > 0  # [P]
+    for n, tables in (partitions_by_node or {}).items():
+        if n >= N or not tables:
+            continue
+        for p in range(P):
+            if not gpu_requested[p]:
+                continue
+            w = int(wanted[p, n])
+            groups = tables.get(w)
+            if not groups:
+                continue  # no table for this size: tensor fit stands
+            need = per_card[p, n][gpu_dims]
+            fit = False
+            for group in groups:
+                if len(group) != w:
+                    continue
+                if all(
+                    d < free.shape[1]
+                    and is_gpu[n, d]
+                    and (free[n, d][gpu_dims] >= need).all()
+                    for d in group
+                ):
+                    fit = True
+                    break
+            ok[p, n] = fit
+    return ok

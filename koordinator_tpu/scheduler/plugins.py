@@ -23,12 +23,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from koordinator_tpu.model import resources as res
+from koordinator_tpu.model.device import (
+    DEVICE_GPU,
+    DEVICE_RESOURCE_AXIS,
+    DEVICE_RESOURCE_INDEX,
+    DEVICE_TYPE_NAMES,
+    DEVICE_TYPE_RESOURCES,
+)
 from koordinator_tpu.ops.deviceshare import (
-    allocate_minors,
+    allocate_joint,
     device_fit_mask,
     deviceshare_scores,
     gpu_card_total_memory,
+    minor_dicts_from_batch,
     normalize_gpu_requests,
+    partition_fit_mask,
     pod_device_requests,
     split_per_card,
 )
@@ -184,7 +193,28 @@ class DeviceSharePlugin(TensorPlugin):
         devices = ctx.extras.get("devices")
         if devices is None:
             return None
-        return device_fit_mask(ctx.snapshot.pods.requests, devices)
+        mask = device_fit_mask(ctx.snapshot.pods.requests, devices)
+        partitions = ctx.extras.get("device_partitions")
+        if partitions:
+            # partition tables constrain which minor GROUPS co-allocate:
+            # the count-based tensor fit overcounts minors no single
+            # group contains, so refine with the host-side group check
+            # (normalization computed once here, not re-derived inside)
+            dev_req = pod_device_requests(ctx.snapshot.pods.requests)
+            norm = normalize_gpu_requests(
+                dev_req, gpu_card_total_memory(devices)
+            )
+            per_card_t, wanted_t = split_per_card(norm)
+            mask = mask & jnp.asarray(
+                partition_fit_mask(
+                    ctx.snapshot.pods.requests,
+                    devices,
+                    partitions,
+                    per_card=np.asarray(per_card_t),
+                    wanted=np.asarray(wanted_t),
+                )
+            )
+        return mask
 
     def score(self, ctx: CycleContext) -> Optional[jnp.ndarray]:
         devices = ctx.extras.get("devices")
@@ -196,37 +226,72 @@ class DeviceSharePlugin(TensorPlugin):
 
     def reserve(self, ctx: CycleContext, pod_idx: int, node_idx: int) -> None:
         devices = ctx.extras.get("devices")
-        minors = (ctx.extras.get("device_minors") or {}).get(node_idx)
-        if devices is None or minors is None:
+        if devices is None:
             return
+        minors = (ctx.extras.get("device_minors") or {}).get(node_idx)
+        if minors is None:
+            # derive the host-side minor view from the tensor extras
+            # (minor id = dense index, topology from devices.numa)
+            minors = minor_dicts_from_batch(devices, node_idx)
+            ctx.extras.setdefault("device_minors", {})[node_idx] = minors
         dev_req = pod_device_requests(ctx.snapshot.pods.requests[pod_idx : pod_idx + 1])
         if not bool(np.asarray(dev_req).any()):
             return
         card_mem = gpu_card_total_memory(devices)
         norm = normalize_gpu_requests(dev_req, card_mem)
         per_card_t, wanted_t = split_per_card(norm)
+        # split_per_card divides the GPU dims by wanted; non-GPU dims keep
+        # their full quantity, so per_card_vec is per-minor for EVERY type
         per_card_vec = np.asarray(per_card_t)[0, node_idx]
         wanted = int(np.asarray(wanted_t)[0, node_idx])
-        from koordinator_tpu.model.device import DEVICE_RESOURCE_AXIS
 
-        per_card = {
-            name: int(per_card_vec[i])
-            for i, name in enumerate(DEVICE_RESOURCE_AXIS)
-            if per_card_vec[i] > 0
-        }
-        chosen = allocate_minors(
-            minors, per_card, wanted, most_allocated=self.most_allocated
+        # split the request per device type and allocate JOINTLY
+        # (tryAllocateDevice loops the requested types; NUMA affinity
+        # aligns later types with the first's minors)
+        per_card_by_type = {}
+        wanted_by_type = {}
+        for code, type_resources in DEVICE_TYPE_RESOURCES.items():
+            pc = {
+                name: int(per_card_vec[DEVICE_RESOURCE_INDEX[name]])
+                for name in type_resources
+                if per_card_vec[DEVICE_RESOURCE_INDEX[name]] > 0
+            }
+            if pc:
+                per_card_by_type[code] = pc
+                # multi-card spanning applies to GPU ratio requests; other
+                # types allocate one minor carrying the full quantity
+                wanted_by_type[code] = wanted if code == DEVICE_GPU else 1
+        partitions = (ctx.extras.get("device_partitions") or {}).get(node_idx)
+        chosen_by_type = allocate_joint(
+            minors,
+            per_card_by_type,
+            wanted_by_type,
+            partitions=partitions,
+            most_allocated=self.most_allocated,
         )
+
+        def code_of(m):
+            return DEVICE_TYPE_NAMES.get(str(m.get("type", "gpu")).lower(), 0)
+
         for m in minors:
-            if m["minor"] in chosen:
+            if m["minor"] in chosen_by_type.get(code_of(m), ()):
+                per_card = per_card_by_type.get(code_of(m), {})
                 free = m.setdefault("free", dict(m.get("total", {})))
                 for dim, q in per_card.items():
                     left = int(res.parse_quantity(free.get(dim, 0), dim)) - q
                     # write back a form parse_quantity round-trips exactly
                     free[dim] = res.format_quantity(left, dim)
         ctx.state.setdefault("device_allocations", {})[pod_idx] = {
-            "minors": chosen,
-            "per_card": per_card,
+            # "minors" stays ACCELERATOR-only: device_env_hook joins it
+            # into NVIDIA_VISIBLE_DEVICES / TPU_VISIBLE_CHIPS, where an
+            # RDMA NIC id would expose the wrong device
+            "minors": sorted(chosen_by_type.get(DEVICE_GPU, [])),
+            "by_type": dict(chosen_by_type),
+            "per_card": {
+                name: int(per_card_vec[i])
+                for i, name in enumerate(DEVICE_RESOURCE_AXIS)
+                if per_card_vec[i] > 0
+            },
         }
 
     def pre_bind(self, ctx, pod_idx, node_idx) -> Optional[Mapping]:

@@ -184,3 +184,266 @@ class TestAllocateMinors:
 
         with pytest.raises(ValueError):
             allocate_minors(self._minors(), {"core": 100}, 3)
+
+
+class TestPartitionTables:
+    """GPU partition tables (newer koordinator apis/extension semantics):
+    multi-card sets must be one listed group, e.g. NVLink rings."""
+
+    def _minors(self, used=()):
+        out = []
+        for i in range(8):
+            free = 20 if i in used else 100
+            out.append(
+                {
+                    "minor": i,
+                    "total": {"koordinator.sh/gpu-core": 100},
+                    "free": {"koordinator.sh/gpu-core": free},
+                    "topology": {"numaNode": i // 4},
+                }
+            )
+        return out
+
+    PART = {4: [[0, 1, 2, 3], [4, 5, 6, 7]], 8: [list(range(8))]}
+
+    def test_partition_group_chosen_whole(self):
+        from koordinator_tpu.ops.deviceshare import allocate_partitioned
+
+        # minor 1 is busy: group [0,1,2,3] infeasible -> the OTHER ring
+        # must be taken whole, even though free minors 0,2,3,4 would win
+        # a per-minor greedy
+        got = allocate_partitioned(
+            self._minors(used=(1,)),
+            {"koordinator.sh/gpu-core": 100},
+            4,
+            self.PART,
+        )
+        assert got == [4, 5, 6, 7]
+
+    def test_no_feasible_group_raises(self):
+        import pytest
+        from koordinator_tpu.ops.deviceshare import allocate_partitioned
+
+        with pytest.raises(ValueError):
+            allocate_partitioned(
+                self._minors(used=(1, 5)),
+                {"koordinator.sh/gpu-core": 100},
+                4,
+                self.PART,
+            )
+
+    def test_size_without_table_falls_back(self):
+        from koordinator_tpu.ops.deviceshare import allocate_partitioned
+
+        got = allocate_partitioned(
+            self._minors(used=(0,)),
+            {"koordinator.sh/gpu-core": 100},
+            2,
+            self.PART,
+        )
+        assert got == [1, 2]  # plain least-allocated ordering
+
+    def test_partition_fit_mask_refines_tensor_fit(self):
+        import numpy as np
+
+        from koordinator_tpu.model.device import encode_devices
+        from koordinator_tpu.ops.deviceshare import (
+            device_fit_mask,
+            partition_fit_mask,
+        )
+
+        # node 0: minors 1 and 5 busy -> 6 free minors, but NO 4-ring free
+        devs = []
+        for i in range(8):
+            free = 20 if i in (1, 5) else 100
+            devs.append(
+                {
+                    "type": "gpu",
+                    "minor": i,
+                    "total": {"koordinator.sh/gpu-core": 100,
+                              "koordinator.sh/gpu-memory": 16 << 30,
+                              "koordinator.sh/gpu-memory-ratio": 100},
+                    "free": {"koordinator.sh/gpu-core": free,
+                             "koordinator.sh/gpu-memory": 16 << 30,
+                             "koordinator.sh/gpu-memory-ratio": free},
+                }
+            )
+        batch = encode_devices([{"devices": devs}], node_bucket=1)
+        reqs = pods({"koordinator.sh/gpu-core": 400,
+                     "koordinator.sh/gpu-memory-ratio": 400})
+        tensor_fit = np.asarray(device_fit_mask(reqs, batch))
+        assert tensor_fit[0, 0]  # count-based fit overcounts
+        refined = partition_fit_mask(reqs, batch, {0: self.PART})
+        assert not refined[0, 0]  # no single ring is free
+
+
+class TestJointAllocation:
+    """allocate_joint: all requested types on one node, NUMA-aligned
+    (reference device_cache.go:272 tryAllocateDevice; allocator.go:91)."""
+
+    def _minors(self):
+        out = []
+        for i in range(4):  # GPUs: 0,1 on numa0; 2,3 on numa1
+            out.append(
+                {
+                    "type": "gpu",
+                    "minor": i,
+                    "total": {"koordinator.sh/gpu-core": 100},
+                    "free": {"koordinator.sh/gpu-core": 100 if i >= 2 else 30},
+                    "topology": {"numaNode": i // 2},
+                }
+            )
+        for i in range(2):  # one RDMA NIC per numa node
+            out.append(
+                {
+                    "type": "rdma",
+                    "minor": 10 + i,
+                    "total": {"koordinator.sh/rdma": 100},
+                    "free": {"koordinator.sh/rdma": 100},
+                    "topology": {"numaNode": i},
+                }
+            )
+        return out
+
+    def test_rdma_follows_gpu_numa(self):
+        from koordinator_tpu.model.device import DEVICE_GPU, DEVICE_RDMA
+        from koordinator_tpu.ops.deviceshare import allocate_joint
+
+        got = allocate_joint(
+            self._minors(),
+            {
+                DEVICE_GPU: {"koordinator.sh/gpu-core": 100},
+                DEVICE_RDMA: {"koordinator.sh/rdma": 50},
+            },
+            {DEVICE_GPU: 1, DEVICE_RDMA: 1},
+        )
+        # only numa1 GPUs have 100 free; the RDMA tiebreak (both NICs
+        # equally free) must follow the GPU onto numa1
+        assert got[DEVICE_GPU] == [2]
+        assert got[DEVICE_RDMA] == [11]
+
+    def test_all_or_nothing(self):
+        import pytest
+
+        from koordinator_tpu.model.device import DEVICE_FPGA, DEVICE_GPU
+        from koordinator_tpu.ops.deviceshare import allocate_joint
+
+        with pytest.raises(ValueError):
+            allocate_joint(
+                self._minors(),
+                {
+                    DEVICE_GPU: {"koordinator.sh/gpu-core": 50},
+                    DEVICE_FPGA: {"koordinator.sh/fpga": 100},
+                },
+                {DEVICE_GPU: 1, DEVICE_FPGA: 1},
+            )
+
+    def test_gpu_partition_table_applies_in_joint(self):
+        from koordinator_tpu.model.device import DEVICE_GPU
+        from koordinator_tpu.ops.deviceshare import allocate_joint
+
+        minors = [
+            {
+                "type": "gpu",
+                "minor": i,
+                "total": {"koordinator.sh/gpu-core": 100},
+                "free": {"koordinator.sh/gpu-core": 100 if i != 0 else 10},
+                "topology": {"numaNode": i // 2},
+            }
+            for i in range(4)
+        ]
+        got = allocate_joint(
+            minors,
+            {DEVICE_GPU: {"koordinator.sh/gpu-core": 100}},
+            {DEVICE_GPU: 2},
+            partitions={2: [[0, 1], [2, 3]]},
+        )
+        # minor 0 busy -> pair [0,1] infeasible; [2,3] taken whole
+        assert got[DEVICE_GPU] == [2, 3]
+
+
+class TestMixedTypeRequests:
+    """A multi-card GPU pod co-requesting RDMA must NOT have its RDMA
+    quantity divided by the GPU card count (round-4 review regression)."""
+
+    def _node(self):
+        devs = [
+            {
+                "type": "gpu",
+                "minor": i,
+                "total": {"koordinator.sh/gpu-core": 100,
+                          "koordinator.sh/gpu-memory": 16 << 30,
+                          "koordinator.sh/gpu-memory-ratio": 100},
+            }
+            for i in range(4)
+        ] + [
+            {"type": "rdma", "minor": 0, "total": {"koordinator.sh/rdma": 100}}
+        ]
+        return {"devices": devs}
+
+    def test_fit_requires_full_rdma_on_one_nic(self):
+        import numpy as np
+
+        from koordinator_tpu.model.device import encode_devices
+        from koordinator_tpu.ops.deviceshare import (
+            device_fit_mask,
+            pod_device_requests,
+            split_per_card,
+            normalize_gpu_requests,
+            gpu_card_total_memory,
+        )
+
+        batch = encode_devices([self._node()], node_bucket=1)
+        reqs = pods({"koordinator.sh/gpu-core": 400,
+                     "koordinator.sh/gpu-memory-ratio": 400,
+                     "koordinator.sh/rdma": 100})
+        # per-card split: GPU dims divided by 4, rdma kept whole
+        norm = normalize_gpu_requests(
+            pod_device_requests(reqs), gpu_card_total_memory(batch)
+        )
+        per_card, wanted = split_per_card(norm)
+        from koordinator_tpu.model.device import DEVICE_RESOURCE_INDEX
+
+        pc = np.asarray(per_card)[0, 0]
+        assert pc[DEVICE_RESOURCE_INDEX["koordinator.sh/gpu-core"]] == 100
+        assert pc[DEVICE_RESOURCE_INDEX["koordinator.sh/rdma"]] == 100
+        assert int(np.asarray(wanted)[0, 0]) == 4
+        assert bool(np.asarray(device_fit_mask(reqs, batch))[0, 0])
+
+    def test_joint_reserve_deducts_full_rdma(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from koordinator_tpu.model import encode_snapshot
+        from koordinator_tpu.model.device import encode_devices
+        from koordinator_tpu.scheduler.framework import CycleContext
+        from koordinator_tpu.scheduler.plugins import DeviceSharePlugin
+
+        batch = encode_devices([self._node()], node_bucket=8)
+        snap = encode_snapshot(
+            [{"name": "n0", "allocatable": {"cpu": "32", "memory": "64Gi"}}],
+            [{"name": "p0", "requests": {
+                "cpu": "1",
+                "koordinator.sh/gpu-core": 400,
+                "koordinator.sh/gpu-memory-ratio": 400,
+                "koordinator.sh/rdma": 100,
+            }}],
+            [],
+            [],
+        )
+        plugin = DeviceSharePlugin()
+        ctx = CycleContext(snapshot=snap, extras={"devices": batch})
+        plugin.reserve(ctx, 0, 0)
+        alloc = ctx.state["device_allocations"][0]
+        from koordinator_tpu.model.device import DEVICE_GPU, DEVICE_RDMA
+
+        assert alloc["minors"] == [0, 1, 2, 3]  # GPU minors only
+        # dense-batch minors are positional: the NIC is index 4
+        assert alloc["by_type"][DEVICE_RDMA] == [4]
+        # the NIC's free rdma went to 0: full quantity deducted
+        minors = ctx.extras["device_minors"][0]
+        nic = next(m for m in minors if m["type"] == "rdma")
+        from koordinator_tpu.model import resources as res
+
+        assert res.parse_quantity(nic["free"]["koordinator.sh/rdma"],
+                                  "koordinator.sh/rdma") == 0

@@ -136,7 +136,13 @@ class TestDaemonWiring:
         metrics = MetricsRegistry(common_labels={"node": "n0"})
         d = Daemon(auditor=auditor, metrics=metrics)
         out = d.run_once(now=10.0)
-        assert set(out) == {"pleg_events", "collectors", "strategies", "node_metric"}
+        assert set(out) == {
+            "pleg_events",
+            "collectors",
+            "strategies",
+            "node_metric",
+            "informer_reports",
+        }
         assert metrics.get("koordlet_ticks_total") == 1.0
         d.run_once(now=11.0)
         assert metrics.get("koordlet_ticks_total") == 2.0
