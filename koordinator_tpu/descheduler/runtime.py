@@ -31,10 +31,14 @@ from koordinator_tpu.descheduler.evictions import PodEvictor
 from koordinator_tpu.descheduler.k8s_plugins import (
     DefaultEvictorArgs,
     default_evictor_filter,
+    pod_life_time,
     remove_duplicates,
+    remove_failed_pods,
     remove_pods_having_too_many_restarts,
     remove_pods_violating_interpod_antiaffinity,
     remove_pods_violating_node_affinity,
+    remove_pods_violating_node_taints,
+    remove_pods_violating_topology_spread,
     TooManyRestartsArgs,
 )
 from koordinator_tpu.descheduler.lownodeload import LowNodeLoadArgs, balance
@@ -186,15 +190,43 @@ class _EvictorAdapter:
 def _deschedule_adaptor(reason: str, select):
     """Wrap the k8s-descheduler adaptor plugins (k8s_plugins.py) as
     Deschedule plugins evicting through the framework.  ``select(pods,
-    nodes, args)`` returns the victims; ``reason`` names the plugin in
-    the eviction audit trail."""
+    nodes, args, now)`` returns the victims per node; ``reason`` names
+    the plugin in the eviction audit trail.  ``now`` is the framework's
+    tick clock so age gates stay fake-clock-testable."""
 
     def factory(fw: Framework, args):
         def run(nodes):
             for nd in nodes:
                 pods = nd.get("pods", [])
-                for pod in select(pods, nodes, args):
+                for pod in select(pods, nodes, args, fw._now):
                     fw.evict(pod, nd["name"], reason=reason)
+
+        return run
+
+    return factory
+
+
+def _cluster_deschedule_adaptor(reason: str, select):
+    """Like _deschedule_adaptor but selection sees the CLUSTER-WIDE pod
+    set in one call — required for plugins whose decision is a global
+    property (topology spread skew is computed across every domain; a
+    per-node view would see counts like (3, 0) in a balanced cluster and
+    evict from every node)."""
+
+    def factory(fw: Framework, args):
+        def run(nodes):
+            node_of = {}
+            all_pods = []
+            for nd in nodes:
+                for pod in nd.get("pods", []):
+                    all_pods.append(pod)
+                    node_of[id(pod)] = nd["name"]
+            for pod in select(all_pods, nodes, args, fw._now):
+                fw.evict(
+                    pod,
+                    node_of.get(id(pod), pod.get("node", "")),
+                    reason=reason,
+                )
 
         return run
 
@@ -205,23 +237,44 @@ DEFAULT_REGISTRY: Dict[str, Callable] = {
     "LowNodeLoad": _low_node_load,
     "RemovePodsHavingTooManyRestarts": _deschedule_adaptor(
         "RemovePodsHavingTooManyRestarts",
-        lambda pods, nodes, args: remove_pods_having_too_many_restarts(
+        lambda pods, nodes, args, now: remove_pods_having_too_many_restarts(
             pods, args or TooManyRestartsArgs()
         ),
     ),
     "RemoveDuplicates": _deschedule_adaptor(
-        "RemoveDuplicates", lambda pods, nodes, args: remove_duplicates(pods)
+        "RemoveDuplicates",
+        lambda pods, nodes, args, now: remove_duplicates(pods),
     ),
     "RemovePodsViolatingNodeAffinity": _deschedule_adaptor(
         "RemovePodsViolatingNodeAffinity",
-        lambda pods, nodes, args: remove_pods_violating_node_affinity(
+        lambda pods, nodes, args, now: remove_pods_violating_node_affinity(
             pods, nodes
         ),
     ),
     "RemovePodsViolatingInterPodAntiAffinity": _deschedule_adaptor(
         "RemovePodsViolatingInterPodAntiAffinity",
-        lambda pods, nodes, args: remove_pods_violating_interpod_antiaffinity(
-            pods
+        lambda pods, nodes, args, now: (
+            remove_pods_violating_interpod_antiaffinity(pods)
+        ),
+    ),
+    "RemovePodsViolatingNodeTaints": _deschedule_adaptor(
+        "RemovePodsViolatingNodeTaints",
+        lambda pods, nodes, args, now: remove_pods_violating_node_taints(
+            pods, nodes, args
+        ),
+    ),
+    "RemoveFailedPods": _deschedule_adaptor(
+        "RemoveFailedPods",
+        lambda pods, nodes, args, now: remove_failed_pods(pods, args, now=now),
+    ),
+    "PodLifeTime": _deschedule_adaptor(
+        "PodLifeTime",
+        lambda pods, nodes, args, now: pod_life_time(pods, args, now=now),
+    ),
+    "RemovePodsViolatingTopologySpreadConstraint": _cluster_deschedule_adaptor(
+        "RemovePodsViolatingTopologySpreadConstraint",
+        lambda pods, nodes, args, now: remove_pods_violating_topology_spread(
+            pods, nodes, args
         ),
     ),
 }

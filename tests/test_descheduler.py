@@ -321,3 +321,325 @@ class TestReviewRegressions:
         for _ in range(5):
             d.mark(False, now=0.0)
         assert d.mark(False, now=100.0) is State.OK  # generation rolled
+
+
+class TestNodeTaints:
+    """RemovePodsViolatingNodeTaints (ref framework/plugins/kubernetes,
+    upstream sigs.k8s.io/descheduler nodetaints semantics)."""
+
+    NODES = [
+        {"name": "n0", "taints": [
+            {"key": "dedicated", "value": "infra", "effect": "NoSchedule"}]},
+        {"name": "n1", "taints": []},
+    ]
+
+    def test_untolerated_pod_selected(self):
+        from koordinator_tpu.descheduler.k8s_plugins import (
+            remove_pods_violating_node_taints,
+        )
+
+        pods = [
+            {"name": "a", "node": "n0", "tolerations": []},
+            {"name": "b", "node": "n0", "tolerations": [
+                {"key": "dedicated", "operator": "Equal", "value": "infra",
+                 "effect": "NoSchedule"}]},
+            {"name": "c", "node": "n1", "tolerations": []},
+        ]
+        got = remove_pods_violating_node_taints(pods, self.NODES)
+        assert [p["name"] for p in got] == ["a"]
+
+    def test_exists_and_empty_key_tolerations(self):
+        from koordinator_tpu.descheduler.k8s_plugins import (
+            remove_pods_violating_node_taints,
+        )
+
+        pods = [
+            {"name": "exists", "node": "n0", "tolerations": [
+                {"key": "dedicated", "operator": "Exists"}]},
+            {"name": "wildcard", "node": "n0", "tolerations": [
+                {"operator": "Exists"}]},
+            {"name": "wrong-value", "node": "n0", "tolerations": [
+                {"key": "dedicated", "value": "web"}]},
+        ]
+        got = remove_pods_violating_node_taints(pods, self.NODES)
+        assert [p["name"] for p in got] == ["wrong-value"]
+
+    def test_excluded_taints_and_prefer_no_schedule(self):
+        from koordinator_tpu.descheduler.k8s_plugins import (
+            NodeTaintsArgs,
+            remove_pods_violating_node_taints,
+        )
+
+        nodes = [
+            {"name": "n0", "taints": [
+                {"key": "soft", "effect": "PreferNoSchedule"}]},
+        ]
+        pods = [{"name": "p", "node": "n0", "tolerations": []}]
+        assert remove_pods_violating_node_taints(pods, nodes) == []
+        got = remove_pods_violating_node_taints(
+            pods, nodes, NodeTaintsArgs(include_prefer_no_schedule=True)
+        )
+        assert len(got) == 1
+        got = remove_pods_violating_node_taints(
+            pods,
+            nodes,
+            NodeTaintsArgs(
+                include_prefer_no_schedule=True, excluded_taints=("soft",)
+            ),
+        )
+        assert got == []
+
+
+class TestRemoveFailedPods:
+    def test_failed_selected_with_reason_and_age_gates(self):
+        from koordinator_tpu.descheduler.k8s_plugins import (
+            FailedPodsArgs,
+            remove_failed_pods,
+        )
+
+        pods = [
+            {"name": "oom", "phase": "Failed", "reason": "OOMKilled",
+             "start_time": 0.0},
+            {"name": "young", "phase": "Failed", "reason": "OOMKilled",
+             "start_time": 95.0},
+            {"name": "other", "phase": "Failed", "reason": "Evicted",
+             "start_time": 0.0},
+            {"name": "running", "phase": "Running"},
+        ]
+        got = remove_failed_pods(
+            pods,
+            FailedPodsArgs(
+                reasons=("OOMKilled",), min_pod_lifetime_seconds=60
+            ),
+            now=100.0,
+        )
+        assert [p["name"] for p in got] == ["oom"]
+
+    def test_owner_kind_exclusion_and_container_reasons(self):
+        from koordinator_tpu.descheduler.k8s_plugins import (
+            FailedPodsArgs,
+            remove_failed_pods,
+        )
+
+        pods = [
+            {"name": "job-pod", "phase": "Failed",
+             "owner_references": [{"kind": "Job"}],
+             "containers": [{"reason": "CrashLoopBackOff"}]},
+        ]
+        assert remove_failed_pods(
+            pods, FailedPodsArgs(exclude_owner_kinds=("Job",))
+        ) == []
+        got = remove_failed_pods(
+            pods, FailedPodsArgs(reasons=("CrashLoopBackOff",))
+        )
+        assert len(got) == 1
+
+
+class TestPodLifeTime:
+    def test_age_state_and_label_gates(self):
+        from koordinator_tpu.descheduler.k8s_plugins import (
+            PodLifeTimeArgs,
+            pod_life_time,
+        )
+
+        pods = [
+            {"name": "old", "start_time": 0.0, "phase": "Running",
+             "labels": {"app": "x"}},
+            {"name": "new", "start_time": 900.0, "phase": "Running",
+             "labels": {"app": "x"}},
+            {"name": "old-pending", "start_time": 0.0, "phase": "Pending",
+             "labels": {"app": "x"}},
+            {"name": "old-other", "start_time": 0.0, "phase": "Running",
+             "labels": {"app": "y"}},
+        ]
+        got = pod_life_time(
+            pods,
+            PodLifeTimeArgs(
+                max_pod_life_time_seconds=600,
+                states=("Running",),
+                label_selector={"app": "x"},
+            ),
+            now=1000.0,
+        )
+        assert [p["name"] for p in got] == ["old"]
+
+
+class TestTopologySpread:
+    def _cluster(self, counts):
+        nodes = [
+            {"name": f"n{i}", "labels": {"zone": f"z{i}"}}
+            for i in range(len(counts))
+        ]
+        pods = []
+        for i, c in enumerate(counts):
+            for j in range(c):
+                pods.append(
+                    {
+                        "name": f"p{i}-{j}",
+                        "node": f"n{i}",
+                        "labels": {"app": "web"},
+                        "topology_spread": [
+                            {
+                                "max_skew": 1,
+                                "topology_key": "zone",
+                                "when_unsatisfiable": "DoNotSchedule",
+                                "label_selector": {"app": "web"},
+                            }
+                        ],
+                    }
+                )
+        return pods, nodes
+
+    def test_balances_skew_to_max(self):
+        from koordinator_tpu.descheduler.k8s_plugins import (
+            remove_pods_violating_topology_spread,
+        )
+
+        pods, nodes = self._cluster([5, 1, 0])
+        got = remove_pods_violating_topology_spread(pods, nodes)
+        # 5/1/0 -> move until max-min <= 1: (4,1,1)->(3,2,1)->(2,2,2) = 3 moves
+        assert len(got) == 3
+        assert all(p["node"] == "n0" for p in got)
+
+    def test_within_skew_selects_nothing(self):
+        from koordinator_tpu.descheduler.k8s_plugins import (
+            remove_pods_violating_topology_spread,
+        )
+
+        pods, nodes = self._cluster([2, 1, 2])
+        assert remove_pods_violating_topology_spread(pods, nodes) == []
+
+    def test_soft_constraints_gated(self):
+        from koordinator_tpu.descheduler.k8s_plugins import (
+            TopologySpreadArgs,
+            remove_pods_violating_topology_spread,
+        )
+
+        pods, nodes = self._cluster([4, 0])
+        for p in pods:
+            p["topology_spread"][0]["when_unsatisfiable"] = "ScheduleAnyway"
+        assert remove_pods_violating_topology_spread(pods, nodes) == []
+        got = remove_pods_violating_topology_spread(
+            pods, nodes, TopologySpreadArgs(include_soft_constraints=True)
+        )
+        assert len(got) > 0
+
+
+class TestNewAdaptorsRegistered:
+    def test_profile_runs_all_new_plugins(self):
+        from koordinator_tpu.descheduler.evictions import PodEvictor
+        from koordinator_tpu.descheduler.runtime import (
+            Descheduler,
+            DeschedulerProfile,
+            PluginSet,
+        )
+
+        nodes = [
+            {
+                "name": "n0",
+                "taints": [{"key": "dedicated", "effect": "NoSchedule"}],
+                "pods": [
+                    {"name": "tainted", "node": "n0", "tolerations": [],
+                     "owner_references": [{"kind": "ReplicaSet"}]},
+                    {"name": "failed", "node": "n0", "phase": "Failed",
+                     "owner_references": [{"kind": "Job"}]},
+                ],
+            },
+            {"name": "n1", "taints": [], "pods": []},
+        ]
+        evictor = PodEvictor(dry_run=True)
+        d = Descheduler(
+            [
+                DeschedulerProfile(
+                    plugins=PluginSet(
+                        deschedule=[
+                            "RemovePodsViolatingNodeTaints",
+                            "RemoveFailedPods",
+                            "PodLifeTime",
+                            "RemovePodsViolatingTopologySpreadConstraint",
+                        ]
+                    )
+                )
+            ],
+            nodes_fn=lambda: nodes,
+            evictor=evictor,
+        )
+        d.descheduler_once()
+        evicted = {e.pod for e in evictor.evicted}
+        assert {"tainted", "failed"} <= evicted
+
+
+class TestReviewRegressionsRound4:
+    def test_topology_spread_cluster_wide_through_registry(self):
+        """A balanced cluster must select nothing when the plugin runs
+        through the registry (a per-node view would see (3,0) skew)."""
+        from koordinator_tpu.descheduler.evictions import PodEvictor
+        from koordinator_tpu.descheduler.runtime import (
+            Descheduler,
+            DeschedulerProfile,
+            PluginSet,
+        )
+
+        spread = [{"max_skew": 1, "topology_key": "zone",
+                   "when_unsatisfiable": "DoNotSchedule",
+                   "label_selector": {"app": "web"}}]
+        nodes = [
+            {"name": f"n{i}", "labels": {"zone": f"z{i}"},
+             "pods": [
+                 {"name": f"p{i}-{j}", "node": f"n{i}",
+                  "labels": {"app": "web"}, "topology_spread": spread}
+                 for j in range(3)
+             ]}
+            for i in range(2)
+        ]
+        evictor = PodEvictor(dry_run=True)
+        d = Descheduler(
+            [DeschedulerProfile(plugins=PluginSet(
+                deschedule=["RemovePodsViolatingTopologySpreadConstraint"]))],
+            nodes_fn=lambda: nodes,
+            evictor=evictor,
+        )
+        d.descheduler_once()
+        assert evictor.evicted == []
+
+    def test_unsatisfiable_zero_skew_selects_nothing(self):
+        from koordinator_tpu.descheduler.k8s_plugins import (
+            remove_pods_violating_topology_spread,
+        )
+
+        spread = [{"max_skew": 0, "topology_key": "zone",
+                   "when_unsatisfiable": "DoNotSchedule",
+                   "label_selector": {"app": "w"}}]
+        nodes = [{"name": "n0", "labels": {"zone": "a"}},
+                 {"name": "n1", "labels": {"zone": "b"}}]
+        pods = [
+            {"name": "p0", "node": "n0", "labels": {"app": "w"},
+             "topology_spread": spread},
+            {"name": "p1", "node": "n0", "labels": {"app": "w"},
+             "topology_spread": spread},
+            {"name": "p2", "node": "n1", "labels": {"app": "w"},
+             "topology_spread": spread},
+        ]
+        assert remove_pods_violating_topology_spread(pods, nodes) == []
+
+    def test_unknown_age_pods_never_selected_by_age_gates(self):
+        from koordinator_tpu.descheduler.k8s_plugins import (
+            FailedPodsArgs,
+            PodLifeTimeArgs,
+            pod_life_time,
+            remove_failed_pods,
+        )
+
+        ageless = [{"name": "p", "phase": "Running", "labels": {}}]
+        assert pod_life_time(
+            ageless, PodLifeTimeArgs(max_pod_life_time_seconds=60),
+            now=1.7e9,
+        ) == []
+        failed_ageless = [{"name": "f", "phase": "Failed"}]
+        assert remove_failed_pods(
+            failed_ageless,
+            FailedPodsArgs(min_pod_lifetime_seconds=60),
+            now=1.7e9,
+        ) == []
+        # without an age gate a Failed pod is still selected
+        assert len(remove_failed_pods(failed_ageless, FailedPodsArgs())) == 1
