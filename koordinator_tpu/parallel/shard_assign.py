@@ -325,7 +325,10 @@ def _assign_waves(
     N = nodes.allocatable.shape[0]
     PCAP = pods.capacity
     W = wave
-    M = min(top_m, N)
+    # the local top-M runs on each shard's node slice, so M is bounded by
+    # the PER-SHARD node count (a 16-node cluster over 8 shards has 2-node
+    # slices; fuzz-found)
+    M = max(1, min(top_m, N // mesh.size))
     axes = tuple(mesh.axis_names)
     ax = axes if len(axes) > 1 else axes[0]
 

@@ -2,11 +2,12 @@
 
 Every feature dimension the cycle supports — quotas, gangs, stale
 metrics, prod/aggregated LoadAware profiles, mixed priority bands — is
-sampled randomly and the Pallas kernel (interpret) must match the
-lax.scan path bit-for-bit on assignments AND post-cycle state.  This is
-the drift alarm for the three-implementation invariant the framework
-maintains (scan / Pallas / shard_map, plus the C++ baseline in
-tests/test_native_bridge.py).
+sampled randomly and every device path — the wide Pallas kernel, the
+dense-layout kernel (both interpret), and the round-based shard_map wave
+path — must match the lax.scan oracle bit-for-bit on assignments AND
+post-cycle state.  This is the drift alarm for the five-implementation
+invariant the framework maintains (scan / wide / dense / waves, plus the
+C++ baseline in tests/test_native_bridge.py).
 """
 
 import numpy as np
@@ -137,8 +138,7 @@ def _random_cfg(rng, with_agg, with_prod):
     )
 
 
-@pytest.mark.parametrize("seed", range(8))
-def test_scan_pallas_parity_fuzz(seed):
+def _fuzz_snapshot(seed):
     rng = np.random.RandomState(seed)
     with_agg = bool(rng.rand() > 0.5)
     with_prod = bool(rng.rand() > 0.5)
@@ -162,9 +162,10 @@ def test_scan_pallas_parity_fuzz(seed):
         qdicts = build_quota_table_inputs(quotas, pod_reqs, qids, total)
     snap = encode_snapshot(nodes, pods, gangs, qdicts)
     cfg = _random_cfg(rng, with_agg, with_prod)
+    return snap, cfg
 
-    want = greedy_assign(snap, cfg)
-    got = greedy_assign_pallas(snap, cfg, interpret=True)
+
+def _assert_matches(want, got, seed):
     np.testing.assert_array_equal(
         np.asarray(got.assignment), np.asarray(want.assignment), err_msg=f"seed={seed}"
     )
@@ -177,3 +178,38 @@ def test_scan_pallas_parity_fuzz(seed):
     np.testing.assert_array_equal(
         np.asarray(got.quota_used), np.asarray(want.quota_used)
     )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_scan_pallas_parity_fuzz(seed):
+    snap, cfg = _fuzz_snapshot(seed)
+    want = greedy_assign(snap, cfg)
+    _assert_matches(want, greedy_assign_pallas(snap, cfg, interpret=True), seed)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_scan_dense_parity_fuzz(seed):
+    """The dense-layout kernel holds the same fuzzed invariant."""
+    from koordinator_tpu.solver.pallas_dense import greedy_assign_dense
+
+    snap, cfg = _fuzz_snapshot(seed)
+    want = greedy_assign(snap, cfg)
+    _assert_matches(want, greedy_assign_dense(snap, cfg, interpret=True), seed)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_scan_waves_parity_fuzz(seed):
+    """The round-based sharded path holds it too (node_requested comes
+    back node-sharded; gang/quota/prod dimensions all sampled)."""
+    import jax
+
+    from koordinator_tpu.parallel import greedy_assign_waves, make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    seed = seed + 100  # distinct cluster family from the kernel fuzz
+    snap, cfg = _fuzz_snapshot(seed)
+    want = greedy_assign(snap, cfg)
+    got, rounds = greedy_assign_waves(snap, make_mesh(), cfg)
+    _assert_matches(want, got, seed)
+    assert rounds >= 1
