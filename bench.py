@@ -274,6 +274,17 @@ def _native_baseline(nodes, pods, gangs, quotas, iters=3, threads=1):
         return _native_run(binary, golden, iters, threads)
 
 
+def _recv_exact(conn, n: int) -> bytes:
+    """Raising wrapper over the bridge transport's frame reader (one
+    framing implementation: bridge/udsserver.py)."""
+    from koordinator_tpu.bridge import udsserver
+
+    out = udsserver._recv_exact(conn, n)
+    if out is None:
+        raise ConnectionError("socket closed mid-frame")
+    return out
+
+
 def _ms(t0: float) -> float:
     return (time.perf_counter() - t0) * 1000.0
 
@@ -595,6 +606,99 @@ def child_config(platform: str, config: str) -> None:
         )
         return
 
+    if config == "bridge":
+        # the production seam end to end: a host scheduler's view — full
+        # Sync then Assign through the REAL raw-UDS framing (the framing
+        # the Go/C++ shims speak) at headline scale, so the number
+        # includes serialization, the socket round trip, the device
+        # cycle, and reply assembly
+        import socket
+        import struct
+        import tempfile
+
+        from koordinator_tpu.bridge.codegen import pb2
+        from koordinator_tpu.bridge.udsserver import (
+            METHOD_ASSIGN,
+            METHOD_SCORE,
+            METHOD_SYNC,
+            RawUdsServer,
+        )
+        from koordinator_tpu.constraints import build_quota_table_inputs
+        from koordinator_tpu.harness.golden import build_sync_request
+
+        _, nodes, pods, gangs, quotas, _ = _quota_snapshot(
+            encode_snapshot, generators, res, build_quota_table_inputs
+        )
+        req, _ = build_sync_request(
+            nodes, pods, gangs, quotas, node_bucket=NODES, pod_bucket=PODS
+        )
+        payload = req.SerializeToString()
+        with tempfile.TemporaryDirectory() as tmp:
+            sock_path = os.path.join(tmp, "scorer.sock")
+            server = RawUdsServer(sock_path)
+            server.start()
+            conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                conn.connect(sock_path)
+
+                def call(method, body):
+                    conn.sendall(
+                        struct.pack(">BI", method, len(body)) + body
+                    )
+                    status, ln = struct.unpack(
+                        ">BI", _recv_exact(conn, 5)
+                    )
+                    out = _recv_exact(conn, ln)
+                    assert status == 0, out
+                    return out
+
+                t0 = time.perf_counter()
+                sync = pb2.SyncReply.FromString(call(METHOD_SYNC, payload))
+                sync_ms = _ms(t0)
+                phase("sync", ms=round(sync_ms, 1), bytes=len(payload))
+
+                areq = pb2.AssignRequest(
+                    snapshot_id=sync.snapshot_id
+                ).SerializeToString()
+                # first assign pays the compile; steady state over 3
+                reply = pb2.AssignReply.FromString(call(METHOD_ASSIGN, areq))
+                phase("first_assign", path=reply.path)
+                times = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    reply = pb2.AssignReply.FromString(
+                        call(METHOD_ASSIGN, areq)
+                    )
+                    times.append(_ms(t0))
+                assigned = sum(1 for a in reply.assignment if a >= 0)
+                sreq = pb2.ScoreRequest(
+                    snapshot_id=sync.snapshot_id, top_k=32, flat=True
+                ).SerializeToString()
+                t0 = time.perf_counter()
+                score = pb2.ScoreReply.FromString(call(METHOD_SCORE, sreq))
+                score_ms = _ms(t0)
+            finally:
+                conn.close()
+                server.stop()
+        print(
+            json.dumps(
+                {
+                    "metric": "bridge_assign_10kpod_2knode_ms",
+                    "value": round(min(times), 2),
+                    "unit": "ms",
+                    "backend": backend,
+                    "path": reply.path,
+                    "assigned": assigned,
+                    "sync_ms": round(sync_ms, 1),
+                    "sync_bytes": len(payload),
+                    "score_top32_ms": round(score_ms, 1),
+                    "score_build_ms": round(score.build_ms, 2),
+                }
+            ),
+            flush=True,
+        )
+        return
+
     if config == "rebalance":
         # BASELINE config #5: LowNodeLoad Balance tick over the same
         # 10k x 2k cluster, pods placed by the scheduling cycle
@@ -794,7 +898,10 @@ def main() -> int:
     ap.add_argument(
         "--config",
         default=None,
-        choices=["spark", "loadaware", "gang", "extras", "rebalance", "smoke"],
+        choices=[
+            "spark", "loadaware", "gang", "extras", "rebalance", "smoke",
+            "bridge",
+        ],
         help="measure a secondary BASELINE config instead of the headline "
         "10k x 2k quota_colocation cycle (driver contract: no args prints "
         "exactly the one headline JSON line)",
