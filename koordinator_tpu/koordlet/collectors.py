@@ -306,7 +306,15 @@ class DeviceCollector(Collector):
                 )
 
 
+_JAX_UNAVAILABLE = False
+
+
 def _jax_devices() -> List[Dict]:
+    global _JAX_UNAVAILABLE
+    if _JAX_UNAVAILABLE:
+        # failed imports are not cached by Python: without this flag a
+        # jax-less host would re-walk the import machinery every tick
+        return []
     try:
         import jax
 
@@ -314,6 +322,9 @@ def _jax_devices() -> List[Dict]:
             {"minor": i, "platform": d.platform}
             for i, d in enumerate(jax.devices())
         ]
+    except ImportError:
+        _JAX_UNAVAILABLE = True
+        return []
     except Exception:
         return []
 

@@ -161,6 +161,8 @@ def build_default_daemon(
     cgroup_root: str = "/",
     storage_dir: Optional[str] = None,
     audit_dir: Optional[str] = None,
+    nri_socket: Optional[str] = None,
+    node_name: str = "",
 ) -> Daemon:
     """Wire the reference's default module set (koordlet.go:126-178):
     metriccache -> statesinformer -> the metricsadvisor collector battery
@@ -182,7 +184,18 @@ def build_default_daemon(
         ResctrlStrategy,
     )
     from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
+    from koordinator_tpu.koordlet.statesinformer import (
+        DeviceReporter,
+        NodeTopoReporter,
+    )
 
+    if not node_name:
+        # reference koordlet resolves the node name from NODE_NAME; an
+        # empty name would publish an NRT no scheduler could match
+        import os
+        import socket as _socket
+
+        node_name = os.environ.get("NODE_NAME") or _socket.gethostname()
     fs = SysFS(root=cgroup_root)
     informer = StatesInformer()
     executor = ResourceUpdateExecutor(fs)
@@ -192,7 +205,7 @@ def build_default_daemon(
         cache = PersistentMetricCache(storage_dir)
     else:
         cache = MetricCache()
-    return Daemon(
+    daemon = Daemon(
         fs=fs,
         cache=cache,
         informer=informer,
@@ -212,7 +225,13 @@ def build_default_daemon(
         ],
         reporter=NodeMetricReporter(cache, informer),
         auditor=Auditor(audit_dir) if audit_dir else None,
+        nri_socket=nri_socket,
     )
+    # informer producer plugins (reference impl/registry.go): publish
+    # NodeResourceTopology and the Device CR each tick
+    informer.register_plugin(NodeTopoReporter(fs, informer, node_name))
+    informer.register_plugin(DeviceReporter(informer))
+    return daemon
 
 
 def main(argv=None) -> int:
@@ -231,6 +250,12 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--audit-dir", default=None)
     ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument(
+        "--nri-socket", default=None,
+        help="runtime NRI socket; when set koordlet registers as an NRI "
+        "plugin (third hook delivery mode beside proxy/reconciler)",
+    )
+    ap.add_argument("--node-name", default="")
     ap.add_argument("--http-host", default="127.0.0.1")
     ap.add_argument("--http-port", type=int, default=9316)
     args = ap.parse_args(argv)
@@ -239,6 +264,8 @@ def main(argv=None) -> int:
         cgroup_root=args.cgroup_root,
         storage_dir=args.storage_dir,
         audit_dir=args.audit_dir,
+        nri_socket=args.nri_socket,
+        node_name=args.node_name,
     )
 
     def app(environ, start_response):

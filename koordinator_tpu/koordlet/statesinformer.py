@@ -325,6 +325,7 @@ class DeviceReporter:
 
             devices_fn = _jax_devices
         self.devices_fn = devices_fn
+        self._last: Optional[List[Dict]] = None
 
     def sync(self, now: float) -> List[Dict]:
         devices = []
@@ -344,7 +345,11 @@ class DeviceReporter:
                     "topology": {"numaNode": int(dev.get("numa_node", 0))},
                 }
             )
-        self.informer.set_devices(devices)
+        # publish (and fire informer callbacks) only on change, like
+        # NodeTopoReporter — device lists are near-static
+        if devices != self._last:
+            self.informer.set_devices(devices)
+            self._last = devices
         return devices
 
 

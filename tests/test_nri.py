@@ -238,3 +238,20 @@ class TestDaemonNriWiring:
         )
         assert d.nri is None  # degraded to proxy/reconciler, daemon alive
         d.shutdown()
+
+
+class TestDefaultDaemonProducers:
+    def test_default_daemon_registers_producers_and_nri_flag(self, tmp_path):
+        from tests.test_statesinformer_producers import write_sysfs_topology
+        from koordinator_tpu.koordlet.daemon import build_default_daemon
+
+        write_sysfs_topology(str(tmp_path))
+        d = build_default_daemon(
+            cgroup_root=str(tmp_path), node_name="n0"
+        )
+        out = d.run_once(now=1.0)
+        reports = out["informer_reports"]
+        assert set(reports) >= {"nodetopo", "device"}
+        nrt = d.informer.get_node_topo()
+        assert nrt["name"] == "n0" and len(nrt["zones"]) == 2
+        d.shutdown()
