@@ -36,6 +36,7 @@ from koordinator_tpu.config import DEFAULT_CYCLE_CONFIG
 from koordinator_tpu.leaderelection import LeaderElector
 from koordinator_tpu.scheduler.config_api import load_config
 from koordinator_tpu.scheduler.services import APIService
+from koordinator_tpu.solver import pallas_demotions
 
 
 class _LeaderGatedServicer(ScorerServicer):
@@ -96,7 +97,20 @@ class SchedulerServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    self._reply(200, {"ok": True, "leader": outer.elector.is_leader})
+                    # demoted kernel shape-buckets ride health (round-3
+                    # review: demotion must be visible beyond a log line)
+                    demoted = {
+                        "/".join(map(str, k)): v
+                        for k, v in pallas_demotions().items()
+                    }
+                    self._reply(
+                        200,
+                        {
+                            "ok": True,
+                            "leader": outer.elector.is_leader,
+                            "kernel_demotions": demoted,
+                        },
+                    )
                     return
                 if self.path == "/debug/stacks":
                     reply_text(self, format_thread_stacks())
@@ -105,7 +119,10 @@ class SchedulerServer:
                     reply_text(
                         self,
                         "# TYPE koord_scheduler_leader gauge\n"
-                        f"koord_scheduler_leader {int(outer.elector.is_leader)}\n",
+                        f"koord_scheduler_leader {int(outer.elector.is_leader)}\n"
+                        "# TYPE koord_scheduler_kernel_demotions gauge\n"
+                        "koord_scheduler_kernel_demotions "
+                        f"{len(pallas_demotions())}\n",
                     )
                     return
                 path, _, query = self.path.partition("?")

@@ -15,7 +15,10 @@ from koordinator_tpu.solver.greedy import (  # noqa: F401
 # a transient backend error (e.g. a tunnel hiccup mid-trace) retries after
 # an exponentially growing number of scan-path cycles, and the demotion
 # state is inspectable via ``pallas_demotions()``.
+import threading as _threading
+
 _PALLAS_FAILURES = {}  # bucket -> [fail_count, cycles_until_retry]
+_PALLAS_LOCK = _threading.Lock()  # HTTP surfacing reads race solver writes
 _RETRY_BASE = 4  # first retry after 4 demoted cycles, then 16, 64, ... 256
 _RETRY_CAP = 256
 
@@ -24,29 +27,33 @@ def pallas_demotions():
     """Snapshot of demoted kernel buckets -> (failures, cycles until the
     next retry).  Surfaced so daemons can export it as a metric instead of
     the demotion being visible only in a log line."""
-    return {k: tuple(v) for k, v in _PALLAS_FAILURES.items()}
+    with _PALLAS_LOCK:
+        return {k: tuple(v) for k, v in _PALLAS_FAILURES.items()}
 
 
 def _demoted(bucket) -> bool:
     """True while the bucket should keep using the scan path; decrements
     the retry counter so the kernel is re-attempted periodically."""
-    state = _PALLAS_FAILURES.get(bucket)
-    if state is None:
-        return False
-    if state[1] <= 0:
-        return False  # retry window open: attempt the kernel again
-    state[1] -= 1
-    return True
+    with _PALLAS_LOCK:
+        state = _PALLAS_FAILURES.get(bucket)
+        if state is None:
+            return False
+        if state[1] <= 0:
+            return False  # retry window open: attempt the kernel again
+        state[1] -= 1
+        return True
 
 
 def _record_failure(bucket) -> None:
-    state = _PALLAS_FAILURES.setdefault(bucket, [0, 0])
-    state[0] += 1
-    state[1] = min(_RETRY_CAP, _RETRY_BASE ** min(state[0], 4))
+    with _PALLAS_LOCK:
+        state = _PALLAS_FAILURES.setdefault(bucket, [0, 0])
+        state[0] += 1
+        state[1] = min(_RETRY_CAP, _RETRY_BASE ** min(state[0], 4))
 
 
 def _record_success(bucket) -> None:
-    _PALLAS_FAILURES.pop(bucket, None)
+    with _PALLAS_LOCK:
+        _PALLAS_FAILURES.pop(bucket, None)
 
 # The kernel's scoring multiplies clamped free capacity by MAX_NODE_SCORE
 # (=100) in i32, so scored tensors need that much headroom below 2^31
@@ -179,6 +186,6 @@ def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None, i32_ok=Non
                     "shape bucket (retry after %d cycles)",
                     variant,
                     bucket,
-                    _PALLAS_FAILURES[bucket][1],
+                    pallas_demotions().get(bucket, (0, 0))[1],
                 )
     return greedy_assign(snapshot, cfg, extra_mask=extra_mask, extra_scores=extra_scores)

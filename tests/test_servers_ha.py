@@ -797,3 +797,33 @@ class TestRemoteHookDispatch:
             proxy.stop()
             remote.close()
             backend.stop()
+
+
+class TestKernelDemotionSurfacing:
+    def test_healthz_and_metrics_expose_demotions(self, tmp_path):
+        import urllib.request
+
+        from koordinator_tpu import solver
+        from koordinator_tpu.scheduler.server import SchedulerServer
+
+        s = SchedulerServer(
+            lease_path=str(tmp_path / "l.lease"),
+            uds_path=str(tmp_path / "scorer.sock"),
+            enable_grpc=False,
+        ).start()
+        bucket = ("dense", "tpu", 2000, 10000, False)
+        try:
+            solver._record_failure(bucket)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{s.http_port}/healthz", timeout=5
+            ) as r:
+                doc = json.loads(r.read())
+            assert "dense/tpu/2000/10000/False" in doc["kernel_demotions"]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{s.http_port}/metrics", timeout=5
+            ) as r:
+                text = r.read().decode()
+            assert "koord_scheduler_kernel_demotions 1" in text
+        finally:
+            solver._record_success(bucket)
+            s.stop()
