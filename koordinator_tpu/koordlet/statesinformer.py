@@ -374,3 +374,34 @@ def zones_from_node_topos(topos: Sequence[Mapping]) -> List[Dict]:
             }
         )
     return out
+
+
+def device_nodes_from_informers(
+    device_lists: Sequence[Sequence[Mapping]],
+) -> List[Dict]:
+    """Adapt published Device CRs (DeviceReporter output, one list per
+    node) into the node-dict shape ``model.device.encode_devices``
+    consumes — the producer half feeding the DeviceShare plugin's
+    tensors, mirroring ``zones_from_node_topos`` for NRT.
+
+    Unhealthy devices stay IN the list (``encode_devices`` keeps their
+    minor slot with ``valid=False``) — dropping one would renumber its
+    neighbors, and slot index is the device identity the Reserve path
+    reports back."""
+    out: List[Dict] = []
+    for devices in device_lists:
+        out.append(
+            {
+                "devices": [
+                    {
+                        "type": d.get("type", "gpu"),
+                        "minor": d.get("minor", 0),
+                        "total": d.get("resources", {}),
+                        "topology": d.get("topology", {}),
+                        "health": bool(d.get("health", True)),
+                    }
+                    for d in devices
+                ]
+            }
+        )
+    return out

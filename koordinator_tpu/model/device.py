@@ -72,6 +72,9 @@ class DeviceBatch:
     dev_type: jnp.ndarray  # i32[N, D] DEVICE_* code
     valid: jnp.ndarray  # bool[N, D] healthy minor exists
     numa: Optional[jnp.ndarray] = None  # i32[N, D] NUMA node id
+    # per-TYPE CR minor (reference device_types.go: each type numbers its
+    # own minors, so slot index != device id on multi-type nodes)
+    minor: Optional[jnp.ndarray] = None  # i32[N, D]
 
     @property
     def minors(self) -> int:
@@ -80,7 +83,7 @@ class DeviceBatch:
 
 jax.tree_util.register_dataclass(
     DeviceBatch,
-    data_fields=["total", "free", "dev_type", "valid", "numa"],
+    data_fields=["total", "free", "dev_type", "valid", "numa", "minor"],
     meta_fields=[],
 )
 
@@ -94,8 +97,15 @@ def encode_devices(
     """Encode per-node device dicts into a DeviceBatch.
 
     Node dict: ``{"devices": [{"type": "gpu", "minor": 0,
-    "total": {res: qty}, "free": {...}}, ...]}``.  ``free`` defaults to
-    ``total`` (an unallocated healthy device).
+    "total": {res: qty}, "free": {...}, "health": bool}, ...]}``.
+    ``free`` defaults to ``total`` (an unallocated healthy device).
+
+    Devices occupy dense slots in list order; the CR minor (which is
+    per-TYPE in the reference, so raw minors collide across types) rides
+    the ``minor`` tensor so the Reserve path reports real device ids.
+    An unhealthy device keeps its slot with ``valid=False`` — dropping
+    it from the list would renumber nothing (ids are carried, not
+    positional) but would lose the health visibility.
     """
     from koordinator_tpu.model.snapshot import pad_bucket
 
@@ -109,18 +119,25 @@ def encode_devices(
     dtype = np.zeros((n_bucket, d_bucket), np.int32)
     valid = np.zeros((n_bucket, d_bucket), bool)
     numa = np.zeros((n_bucket, d_bucket), np.int32)
+    minor = np.zeros((n_bucket, d_bucket), np.int32)
     for i, nd in enumerate(nodes):
         for j, dev in enumerate(nd.get("devices", ())):
             total[i, j] = device_resource_vector(dev.get("total", {}))
-            free[i, j] = device_resource_vector(dev.get("free", dev.get("total", {})))
-            dtype[i, j] = DEVICE_TYPE_NAMES.get(str(dev.get("type", "gpu")).lower(), 0)
-            valid[i, j] = True
+            free[i, j] = device_resource_vector(
+                dev.get("free", dev.get("total", {}))
+            )
+            dtype[i, j] = DEVICE_TYPE_NAMES.get(
+                str(dev.get("type", "gpu")).lower(), 0
+            )
+            valid[i, j] = bool(dev.get("health", True))
             topo = dev.get("topology") or {}
             numa[i, j] = int(topo.get("numaNode", 0))
+            minor[i, j] = int(dev.get("minor", j))
     return DeviceBatch(
         total=jnp.asarray(total),
         free=jnp.asarray(free),
         dev_type=jnp.asarray(dtype),
         valid=jnp.asarray(valid),
         numa=jnp.asarray(numa),
+        minor=jnp.asarray(minor),
     )

@@ -407,8 +407,8 @@ def minor_dicts_from_batch(
 ) -> List[Dict]:
     """Reconstruct host-side minor dicts for one node from the dense
     DeviceBatch — the Reserve path's input when the caller supplies only
-    the tensor extras (minor id = dense index; topology carried by
-    ``devices.numa``)."""
+    the tensor extras (device id from ``devices.minor``, falling back to
+    the dense index; topology carried by ``devices.numa``)."""
     total = np.asarray(devices.total[node_idx])
     free = np.asarray(devices.free[node_idx])
     dtyp = np.asarray(devices.dev_type[node_idx])
@@ -417,6 +417,11 @@ def minor_dicts_from_batch(
         np.asarray(devices.numa[node_idx])
         if devices.numa is not None
         else np.zeros_like(dtyp)
+    )
+    minors_t = (
+        np.asarray(devices.minor[node_idx])
+        if devices.minor is not None
+        else np.arange(total.shape[0], dtype=np.int32)
     )
     code_to_name = {v: k for k, v in DEVICE_TYPE_NAMES.items()}
     out: List[Dict] = []
@@ -428,7 +433,7 @@ def minor_dicts_from_batch(
         # carries parse_quantity-round-trippable forms
         out.append(
             {
-                "minor": d,
+                "minor": int(minors_t[d]),
                 "type": code_to_name[int(dtyp[d])],
                 "total": {
                     n: res.format_quantity(

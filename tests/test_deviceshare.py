@@ -438,8 +438,8 @@ class TestMixedTypeRequests:
         from koordinator_tpu.model.device import DEVICE_GPU, DEVICE_RDMA
 
         assert alloc["minors"] == [0, 1, 2, 3]  # GPU minors only
-        # dense-batch minors are positional: the NIC is index 4
-        assert alloc["by_type"][DEVICE_RDMA] == [4]
+        # the NIC reports its CR minor (per-type numbering), not its slot
+        assert alloc["by_type"][DEVICE_RDMA] == [0]
         # the NIC's free rdma went to 0: full quantity deducted
         minors = ctx.extras["device_minors"][0]
         nic = next(m for m in minors if m["type"] == "rdma")

@@ -142,3 +142,49 @@ class TestProducerToSchedulerChain:
             )
         )
         assert not fits[0, 0].any() and fits[0, 1].any()
+
+
+class TestDeviceProducerToScheduler:
+    def test_published_device_crs_feed_encode_devices(self):
+        from koordinator_tpu.koordlet.statesinformer import (
+            device_nodes_from_informers,
+        )
+        from koordinator_tpu.model.device import encode_devices
+
+        informer = StatesInformer()
+        DeviceReporter(
+            informer,
+            devices_fn=lambda: [
+                {"minor": 0, "platform": "tpu", "numa_node": 1,
+                 "resources": {"koordinator.sh/gpu-core": 100}},
+                {"minor": 1, "platform": "cpu"},  # filtered
+            ],
+        ).sync(0.0)
+        batch = encode_devices(
+            device_nodes_from_informers([informer.get_devices()]),
+            node_bucket=1,
+        )
+        assert int(np.asarray(batch.valid).sum()) == 1
+        assert int(np.asarray(batch.numa)[0, 0]) == 1
+        assert int(np.asarray(batch.total)[0, 0, 0]) == 100
+
+    def test_unhealthy_device_keeps_slot_invalid(self):
+        """An unhealthy minor must NOT renumber its neighbors: slot index
+        is the device identity the Reserve path reports back."""
+        from koordinator_tpu.koordlet.statesinformer import (
+            device_nodes_from_informers,
+        )
+        from koordinator_tpu.model.device import encode_devices
+
+        nodes = device_nodes_from_informers(
+            [[
+                {"type": "gpu", "minor": 0, "health": False,
+                 "resources": {"koordinator.sh/gpu-core": 100}},
+                {"type": "gpu", "minor": 1, "health": True,
+                 "resources": {"koordinator.sh/gpu-core": 100}},
+            ]]
+        )
+        batch = encode_devices(nodes, node_bucket=1)
+        valid = np.asarray(batch.valid)[0]
+        # minor 1 stays at slot 1; slot 0 is present but invalid
+        assert not valid[0] and valid[1]
