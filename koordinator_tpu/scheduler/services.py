@@ -113,12 +113,33 @@ def install_framework_endpoints(api: APIService, extender) -> None:
 
     def debug_scores(_q) -> Tuple[int, Any]:
         table = getattr(extender, "last_debug", None)
-        if table is None:
-            return 200, {"scores": None}
-        return 200, {"scores": table.rows if hasattr(table, "rows") else table}
+        return 200, {
+            "scores": (
+                None
+                if table is None
+                else (table.rows if hasattr(table, "rows") else table)
+            ),
+            "debug_top_n": extender.debug_top_n,
+        }
+
+    def set_debug_scores(q) -> Tuple[int, Any]:
+        # runtime setter on its OWN route (reference debug.go:32-51: the
+        # -debug-scores flag has live setters, not just a startup value);
+        # the reader above stays a pure view so scrapes cannot mutate
+        if "top_n" not in q:
+            return 400, {"error": "missing top_n"}
+        try:
+            extender.debug_top_n = max(0, int(q["top_n"]))
+        except ValueError:
+            return 400, {"error": f"bad top_n {q['top_n']!r}"}
+        if extender.debug_top_n == 0:
+            # disabling must not leave a stale table served as live data
+            extender.last_debug = None
+        return 200, {"debug_top_n": extender.debug_top_n}
 
     def plugins_list(_q) -> Tuple[int, Any]:
         return 200, [p.name for p in extender.plugins]
 
     api.register_plugin("frameworkext", "debug-scores", debug_scores)
+    api.register_plugin("frameworkext", "set-debug-scores", set_debug_scores)
     api.register_plugin("frameworkext", "plugins", plugins_list)

@@ -320,3 +320,66 @@ class TestKoordletCLI:
         with pytest.raises(SystemExit) as exc:
             mod.main(["--help"])
         assert exc.value.code == 0
+
+
+class TestDebugScoresRuntimeSetter:
+    def test_setter_toggles_live_table(self):
+        import jax.numpy as jnp
+
+        from koordinator_tpu.harness import generators
+        from koordinator_tpu.model import encode_snapshot
+        from koordinator_tpu.scheduler.framework import (
+            CycleContext,
+            FrameworkExtender,
+            TensorPlugin,
+        )
+        from koordinator_tpu.scheduler.services import (
+            APIService,
+            install_framework_endpoints,
+        )
+
+        class Scorer(TensorPlugin):
+            name = "toy"
+
+            def score(self, ctx):
+                P = ctx.snapshot.pods.capacity
+                N = ctx.snapshot.nodes.capacity
+                return jnp.ones((P, N), jnp.int64)
+
+        fx = FrameworkExtender([Scorer()])  # debug off at startup (top_n=0)
+        api = APIService()
+        install_framework_endpoints(api, fx)
+
+        n, p, g, q = generators.loadaware_joint(seed=1, pods=8, nodes=4)
+        snap = encode_snapshot(n, p, g, q)
+        fx.run_cycle(CycleContext(snapshot=snap))
+        code, doc = api.dispatch("/apis/v1/plugins/frameworkext/debug-scores", {})
+        assert code == 200 and doc["scores"] is None and doc["debug_top_n"] == 0
+
+        # live enable (debug.go:32 runtime setter analog; its own route —
+        # the reader is a pure view, scrapes cannot mutate)
+        code, doc = api.dispatch(
+            "/apis/v1/plugins/frameworkext/set-debug-scores", {"top_n": "3"}
+        )
+        assert code == 200 and doc["debug_top_n"] == 3
+        fx.run_cycle(CycleContext(snapshot=snap))
+        code, doc = api.dispatch("/apis/v1/plugins/frameworkext/debug-scores", {})
+        assert code == 200 and doc["scores"] and doc["debug_top_n"] == 3
+
+        # bad/missing values rejected
+        code, _ = api.dispatch(
+            "/apis/v1/plugins/frameworkext/set-debug-scores", {"top_n": "zap"}
+        )
+        assert code == 400
+        code, _ = api.dispatch(
+            "/apis/v1/plugins/frameworkext/set-debug-scores", {}
+        )
+        assert code == 400
+        # live disable clears the table: no stale data served as live
+        code, doc = api.dispatch(
+            "/apis/v1/plugins/frameworkext/set-debug-scores", {"top_n": "0"}
+        )
+        assert doc["debug_top_n"] == 0
+        fx.run_cycle(CycleContext(snapshot=snap))
+        code, doc = api.dispatch("/apis/v1/plugins/frameworkext/debug-scores", {})
+        assert doc["scores"] is None and doc["debug_top_n"] == 0
