@@ -836,16 +836,39 @@ def probe(platform: str) -> None:
     )
 
 
+def _env_seconds(name: str, default: float) -> float:
+    """Env override parsed defensively: a malformed value must degrade to
+    the default, never crash parent() before its one JSON line."""
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _probe_until(deadline_seconds: float):
+    """Probe for a LIVE TPU repeatedly until the window closes.
+
+    A tunneled TPU can be down for minutes and flap back (multi-hour
+    outages measured on this platform), and a dead tunnel shows up in
+    TWO ways: the probe child hangs/errors, or jax silently demotes to
+    the CPU backend and the probe "succeeds" reporting cpu.  Both are
+    retryable non-answers here — the bench fights for a TPU artifact
+    across the whole window.  Returns (tpu_alive, errors)."""
+    deadline = time.monotonic() + deadline_seconds
+    errors = []
+    while True:
+        ok, out, err = _spawn("--probe", "default", {}, PROBE_TIMEOUT)
+        if ok and '"probe": "cpu"' not in (out or ""):
+            return True, errors[-2:]
+        errors.append(err if not ok else "probe demoted to cpu backend")
+        if time.monotonic() >= deadline:
+            return False, errors[-2:]
+        time.sleep(30)
+
+
 def parent() -> int:
     """Probe, then measure with retries + hard timeouts; ONE JSON line."""
-    errors = []
-    ok, out, err = _spawn("--probe", "default", {}, PROBE_TIMEOUT)
-    if not ok:
-        errors.append(err)
-        ok, out, err = _spawn("--probe", "default", {}, PROBE_TIMEOUT // 2 or 60)
-        if not ok:
-            errors.append(err)
-    tpu_alive = ok and '"probe": "cpu"' not in (out or "")
+    tpu_alive, errors = _probe_until(_env_seconds("KOORD_BENCH_TPU_WAIT", 900.0))
     if tpu_alive:
         # fight for the TPU across the whole bench window: three attempts
         # with a fresh backend probe between retries, so a transient
@@ -914,12 +937,11 @@ def main() -> int:
         child_config(args.platform, args.config)
         return 0
     if args.config:
-        # same probe/timeout machinery as the headline parent
-        errors = []
-        ok, out, err = _spawn("--probe", "default", {}, PROBE_TIMEOUT)
-        tpu_alive = ok and '"probe": "cpu"' not in (out or "")
-        if not ok:
-            errors.append(err)
+        # same probe machinery as the headline parent (shorter default
+        # window: configs are secondary artifacts)
+        tpu_alive, errors = _probe_until(
+            _env_seconds("KOORD_BENCH_TPU_WAIT_CONFIG", 240.0)
+        )
         if tpu_alive:
             ok, out, err = _spawn(
                 "--child", "default", {}, TPU_TIMEOUT, config=args.config
