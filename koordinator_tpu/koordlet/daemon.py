@@ -163,6 +163,7 @@ def build_default_daemon(
     audit_dir: Optional[str] = None,
     nri_socket: Optional[str] = None,
     node_name: str = "",
+    evict_fn=None,
 ) -> Daemon:
     """Wire the reference's default module set (koordlet.go:126-178):
     metriccache -> statesinformer -> the metricsadvisor collector battery
@@ -180,8 +181,12 @@ def build_default_daemon(
         BlkIOReconcileStrategy,
         CgroupReconcileStrategy,
         CPUBurstStrategy,
+        CPUEvictStrategy,
         CPUSuppressStrategy,
+        Evictor,
+        MemoryEvictStrategy,
         ResctrlStrategy,
+        SystemReconcileStrategy,
     )
     from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
     from koordinator_tpu.koordlet.statesinformer import (
@@ -199,6 +204,10 @@ def build_default_daemon(
     fs = SysFS(root=cgroup_root)
     informer = StatesInformer()
     executor = ResourceUpdateExecutor(fs)
+    # the eviction sink: production passes evict_fn (the reference calls
+    # the apiserver eviction API); it rides on the returned Daemon so
+    # callers can inspect the ledger
+    evictor = Evictor(evict_fn)
     if storage_dir:
         from koordinator_tpu.koordlet.metriccache import PersistentMetricCache
 
@@ -217,11 +226,17 @@ def build_default_daemon(
             DeviceCollector(cache),
         ],
         strategies=[
+            # the reference's full 8-strategy battery
+            # (qosmanager/plugins/register.go); eviction strategies share
+            # one sink (the reference calls the apiserver eviction API)
             CPUSuppressStrategy(informer, cache, executor),
             CPUBurstStrategy(informer, executor),
+            CPUEvictStrategy(informer, cache, evictor),
+            MemoryEvictStrategy(informer, cache, evictor),
             CgroupReconcileStrategy(informer, executor),
             ResctrlStrategy(informer, executor),
             BlkIOReconcileStrategy(informer, executor),
+            SystemReconcileStrategy(informer, executor),
         ],
         reporter=NodeMetricReporter(cache, informer),
         auditor=Auditor(audit_dir) if audit_dir else None,
@@ -231,6 +246,7 @@ def build_default_daemon(
     # NodeResourceTopology and the Device CR each tick
     informer.register_plugin(NodeTopoReporter(fs, informer, node_name))
     informer.register_plugin(DeviceReporter(informer))
+    daemon.evictor = evictor
     return daemon
 
 

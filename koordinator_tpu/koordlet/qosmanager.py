@@ -57,16 +57,26 @@ class Evicted:
 
 class Evictor:
     """Eviction sink (the reference calls the apiserver eviction API;
-    here a callback records/performs it)."""
+    here a callback records/performs it).  The ledger is bounded: a
+    strategy that keeps re-selecting the same victim (its condition only
+    clears once the pod is really gone) must not grow memory without
+    bound in the live loop."""
 
-    def __init__(self, evict_fn: Optional[Callable[[PodMeta, str], bool]] = None):
+    def __init__(
+        self,
+        evict_fn: Optional[Callable[[PodMeta, str], bool]] = None,
+        max_ledger: int = 1024,
+    ):
         self.evict_fn = evict_fn
+        self.max_ledger = max_ledger
         self.evicted: List[Evicted] = []
 
     def evict(self, pod: PodMeta, reason: str) -> bool:
         if self.evict_fn is not None and not self.evict_fn(pod, reason):
             return False
         self.evicted.append(Evicted(pod, reason))
+        if len(self.evicted) > self.max_ledger:
+            del self.evicted[: -self.max_ledger]
         return True
 
 

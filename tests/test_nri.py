@@ -255,3 +255,49 @@ class TestDefaultDaemonProducers:
         nrt = d.informer.get_node_topo()
         assert nrt["name"] == "n0" and len(nrt["zones"]) == 2
         d.shutdown()
+
+
+class TestDefaultDaemonStrategyBattery:
+    def test_full_eight_strategy_battery_wired(self, tmp_path):
+        """build_default_daemon must run the reference's full strategy set
+        (qosmanager/plugins/register.go), not a subset."""
+        from koordinator_tpu.koordlet.daemon import build_default_daemon
+
+        evictions = []
+        d = build_default_daemon(
+            cgroup_root=str(tmp_path),
+            node_name="n0",
+            evict_fn=lambda pod, reason: evictions.append((pod.uid, reason))
+            or True,
+        )
+        names = {s.name for s in d.qos.strategies}
+        assert names == {
+            "cpusuppress", "cpuburst", "cpuevict", "memoryevict",
+            "cgreconcile", "resctrl", "blkio", "sysreconcile",
+        }
+        # the sink is exposed and wired into the evict strategies
+        assert d.evictor.evict_fn is not None
+        for s in d.qos.strategies:
+            if s.name in ("cpuevict", "memoryevict"):
+                assert s.evictor is d.evictor
+        # enable the gated strategies via NodeSLO so the battery really
+        # ticks (a default empty SLO leaves most enabled() False)
+        d.informer.set_node_slo(
+            {
+                "resourceUsedThresholdWithBE": {
+                    "enable": True,
+                    "cpuSuppressThresholdPercent": 65,
+                    "cpuEvictPolicy": "evictByRealLimit",
+                    "memoryEvictThresholdPercent": 70,
+                },
+                "cpuBurstStrategy": {"policy": "auto"},
+            }
+        )
+        d.informer.set_node(
+            {"name": "n0", "capacity_milli_cpu": 8000,
+             "capacity_memory_bytes": 16 << 30}
+        )
+        enabled = {s.name for s in d.qos.strategies if s.enabled()}
+        assert {"cpusuppress", "cpuevict", "memoryevict"} <= enabled
+        d.run_once(now=1.0)  # the enabled battery ticks without error
+        d.shutdown()
