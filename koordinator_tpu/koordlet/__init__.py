@@ -28,6 +28,7 @@ from koordinator_tpu.koordlet.metriccache import MetricCache
 from koordinator_tpu.koordlet.pleg import Pleg
 from koordinator_tpu.koordlet.prediction import FileCheckpointer, PeakPredictServer
 from koordinator_tpu.koordlet.qosmanager import (
+    BlkIOReconcileStrategy,
     CgroupReconcileStrategy,
     CPUBurstStrategy,
     CPUEvictStrategy,
@@ -35,6 +36,8 @@ from koordinator_tpu.koordlet.qosmanager import (
     Evictor,
     MemoryEvictStrategy,
     QOSManager,
+    ResctrlStrategy,
+    SystemReconcileStrategy,
 )
 from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
 from koordinator_tpu.koordlet.runtimehooks import Reconciler, default_registry
@@ -78,11 +81,16 @@ class Daemon:
         self.reporter = NodeMetricReporter(self.cache, self.informer)
         self.qos = QOSManager(
             [
+                # the reference's full battery (plugins/register.go) —
+                # kept in lockstep with daemon.build_default_daemon
                 CPUSuppressStrategy(self.informer, self.cache, self.executor),
                 CPUBurstStrategy(self.informer, self.executor),
                 CPUEvictStrategy(self.informer, self.cache, self.evictor),
                 MemoryEvictStrategy(self.informer, self.cache, self.evictor),
                 CgroupReconcileStrategy(self.informer, self.executor),
+                ResctrlStrategy(self.informer, self.executor),
+                BlkIOReconcileStrategy(self.informer, self.executor),
+                SystemReconcileStrategy(self.informer, self.executor),
             ]
         )
         self.hooks = default_registry()

@@ -46,6 +46,7 @@ class Daemon:
         storage_dir: Optional[str] = None,
         nri_socket: Optional[str] = None,
         hook_registry=None,
+        evictor=None,
     ):
         self.fs = fs or SysFS()
         if cache is not None:
@@ -69,6 +70,7 @@ class Daemon:
         self.auditor = auditor
         self.metrics = metrics or MetricsRegistry()
         self.pleg = Pleg(self.fs)
+        self.evictor = evictor
         # NRI delivery mode (reference runtimehooks/nri/server.go): when a
         # runtime NRI socket is configured, register as a plugin on it —
         # the runtime then drives the shared HookRegistry through
@@ -241,12 +243,12 @@ def build_default_daemon(
         reporter=NodeMetricReporter(cache, informer),
         auditor=Auditor(audit_dir) if audit_dir else None,
         nri_socket=nri_socket,
+        evictor=evictor,
     )
     # informer producer plugins (reference impl/registry.go): publish
     # NodeResourceTopology and the Device CR each tick
     informer.register_plugin(NodeTopoReporter(fs, informer, node_name))
     informer.register_plugin(DeviceReporter(informer))
-    daemon.evictor = evictor
     return daemon
 
 

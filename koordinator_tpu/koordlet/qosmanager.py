@@ -76,7 +76,9 @@ class Evictor:
             return False
         self.evicted.append(Evicted(pod, reason))
         if len(self.evicted) > self.max_ledger:
-            del self.evicted[: -self.max_ledger]
+            # explicit length arithmetic: a [-max_ledger:] slice would be
+            # a no-op at max_ledger=0 (negative zero slicing)
+            del self.evicted[: len(self.evicted) - max(self.max_ledger, 0)]
         return True
 
 
@@ -617,10 +619,24 @@ class QOSManager:
         now = time.time() if now is None else now
         ran = []
         for s in self.strategies:
-            if not s.enabled():
-                continue
-            if now >= self._next_due.get(s.name, 0):
-                s.tick(now)
-                self._next_due[s.name] = now + s.interval_seconds
-                ran.append(s.name)
+            # enabled() reads user-supplied NodeSLO and can throw on
+            # malformed config just like tick() — one failing strategy
+            # must not stop the rest of the battery or kill the daemon
+            # loop (the reference runs each strategy in its own goroutine)
+            try:
+                if not s.enabled():
+                    continue
+                if now >= self._next_due.get(s.name, 0):
+                    self._next_due[s.name] = now + s.interval_seconds
+                    s.tick(now)
+                    ran.append(s.name)
+            except Exception:
+                import logging
+
+                # a throw in enabled() skips the interval update (cheap
+                # recheck next tick); a throw in tick() already consumed
+                # its interval slot, so no hot loop either way
+                logging.getLogger(__name__).exception(
+                    "qos strategy %s failed", s.name
+                )
         return ran

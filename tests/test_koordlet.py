@@ -612,3 +612,29 @@ class TestResctrlFull:
         strategy.tick(2.0)
         with open(f"{root}/sys/fs/resctrl/BE/tasks") as fh:
             assert fh.read().split() == ["100"]
+
+
+class TestQOSStrategyIsolation:
+    def test_failing_strategy_does_not_stop_battery(self):
+        from koordinator_tpu.koordlet.qosmanager import QOSManager, QOSStrategy
+
+        order = []
+
+        class Boom(QOSStrategy):
+            name = "boom"
+
+            def tick(self, now):
+                raise RuntimeError("x")
+
+        class Fine(QOSStrategy):
+            name = "fine"
+
+            def tick(self, now):
+                order.append(now)
+
+        mgr = QOSManager([Boom(), Fine()])
+        ran = mgr.run_once(now=1.0)
+        assert ran == ["fine"] and order == [1.0]
+        # the failing strategy still respects its interval (no hot loop)
+        assert mgr.run_once(now=1.5) == []
+        assert mgr.run_once(now=2.5) == ["fine"]
