@@ -893,9 +893,21 @@ def parent() -> int:
                     break
     # TPU never came up (or exhausted its retry budget): virtual-CPU
     # fallback so an artifact exists either way; "backend" in the line
-    # records the truth
+    # records the truth, and "note" records WHY it is cpu so a reader
+    # does not misread a platform outage as a performance regression
     ok, final, err = _spawn("--child", "cpu", _CPU_ENV, CPU_TIMEOUT)
     if ok:
+        try:
+            doc = json.loads(final)
+            doc["note"] = (
+                "tpu bench attempts failed after a live probe "
+                "(mid-run outage or demotion); "
+                if tpu_alive
+                else "tpu backend unreachable for the whole probe window; "
+            ) + "cpu fallback measures the scan path, not the kernel"
+            final = json.dumps(doc)
+        except ValueError:
+            pass
         print(final)
         return 0
     errors.append(err)

@@ -28,16 +28,9 @@ from koordinator_tpu.koordlet.metriccache import MetricCache
 from koordinator_tpu.koordlet.pleg import Pleg
 from koordinator_tpu.koordlet.prediction import FileCheckpointer, PeakPredictServer
 from koordinator_tpu.koordlet.qosmanager import (
-    BlkIOReconcileStrategy,
-    CgroupReconcileStrategy,
-    CPUBurstStrategy,
-    CPUEvictStrategy,
-    CPUSuppressStrategy,
     Evictor,
-    MemoryEvictStrategy,
     QOSManager,
-    ResctrlStrategy,
-    SystemReconcileStrategy,
+    default_qos_strategies,
 )
 from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
 from koordinator_tpu.koordlet.runtimehooks import Reconciler, default_registry
@@ -80,18 +73,9 @@ class Daemon:
         )
         self.reporter = NodeMetricReporter(self.cache, self.informer)
         self.qos = QOSManager(
-            [
-                # the reference's full battery (plugins/register.go) —
-                # kept in lockstep with daemon.build_default_daemon
-                CPUSuppressStrategy(self.informer, self.cache, self.executor),
-                CPUBurstStrategy(self.informer, self.executor),
-                CPUEvictStrategy(self.informer, self.cache, self.evictor),
-                MemoryEvictStrategy(self.informer, self.cache, self.evictor),
-                CgroupReconcileStrategy(self.informer, self.executor),
-                ResctrlStrategy(self.informer, self.executor),
-                BlkIOReconcileStrategy(self.informer, self.executor),
-                SystemReconcileStrategy(self.informer, self.executor),
-            ]
+            default_qos_strategies(
+                self.informer, self.cache, self.executor, self.evictor
+            )
         )
         self.hooks = default_registry()
         self.reconciler = Reconciler(self.hooks, self.executor)

@@ -180,15 +180,8 @@ def build_default_daemon(
         SysResourceCollector,
     )
     from koordinator_tpu.koordlet.qosmanager import (
-        BlkIOReconcileStrategy,
-        CgroupReconcileStrategy,
-        CPUBurstStrategy,
-        CPUEvictStrategy,
-        CPUSuppressStrategy,
         Evictor,
-        MemoryEvictStrategy,
-        ResctrlStrategy,
-        SystemReconcileStrategy,
+        default_qos_strategies,
     )
     from koordinator_tpu.koordlet.resourceexecutor import ResourceUpdateExecutor
     from koordinator_tpu.koordlet.statesinformer import (
@@ -227,19 +220,7 @@ def build_default_daemon(
             SysResourceCollector(cache),
             DeviceCollector(cache),
         ],
-        strategies=[
-            # the reference's full 8-strategy battery
-            # (qosmanager/plugins/register.go); eviction strategies share
-            # one sink (the reference calls the apiserver eviction API)
-            CPUSuppressStrategy(informer, cache, executor),
-            CPUBurstStrategy(informer, executor),
-            CPUEvictStrategy(informer, cache, evictor),
-            MemoryEvictStrategy(informer, cache, evictor),
-            CgroupReconcileStrategy(informer, executor),
-            ResctrlStrategy(informer, executor),
-            BlkIOReconcileStrategy(informer, executor),
-            SystemReconcileStrategy(informer, executor),
-        ],
+        strategies=default_qos_strategies(informer, cache, executor, evictor),
         reporter=NodeMetricReporter(cache, informer),
         auditor=Auditor(audit_dir) if audit_dir else None,
         nri_socket=nri_socket,

@@ -607,6 +607,26 @@ class SystemReconcileStrategy(QOSStrategy):
             )
 
 
+def default_qos_strategies(
+    informer: StatesInformer,
+    cache: MetricCache,
+    executor: ResourceUpdateExecutor,
+    evictor: Evictor,
+) -> List[QOSStrategy]:
+    """The reference's full battery (plugins/register.go) — the ONE
+    wiring both daemon builders share, so they cannot drift."""
+    return [
+        CPUSuppressStrategy(informer, cache, executor),
+        CPUBurstStrategy(informer, executor),
+        CPUEvictStrategy(informer, cache, evictor),
+        MemoryEvictStrategy(informer, cache, evictor),
+        CgroupReconcileStrategy(informer, executor),
+        ResctrlStrategy(informer, executor),
+        BlkIOReconcileStrategy(informer, executor),
+        SystemReconcileStrategy(informer, executor),
+    ]
+
+
 class QOSManager:
     """Strategy scheduler (qosmanager.go:51): independent per-strategy
     ticks, enable-gated by NodeSLO."""
