@@ -131,6 +131,27 @@ class RawUdsServer:
                 try:
                     req = req_cls.FromString(payload)
                     reply = fn(req, None)
+                    size = reply.ByteSize()
+                    if size > _MAX_FRAME:
+                        # every client enforces the same cap on replies; a
+                        # full-matrix flat Score (top_k=0) at 10k x 2k is
+                        # ~280 MB — fail with a real error instead of
+                        # shipping a frame the peer must reject (and skip
+                        # materializing the wire bytes entirely).
+                        hint = (
+                            "; request a smaller top_k"
+                            if method == METHOD_SCORE
+                            else ""
+                        )
+                        self._reply(
+                            conn,
+                            1,
+                            (
+                                f"reply frame {size} bytes exceeds the "
+                                f"{_MAX_FRAME}-byte transport cap{hint}"
+                            ).encode(),
+                        )
+                        continue
                     self._reply(conn, 0, reply.SerializeToString())
                 except Exception as exc:  # surfaced to the client, not lost
                     self._reply(conn, 1, str(exc).encode())

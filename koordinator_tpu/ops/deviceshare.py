@@ -478,6 +478,18 @@ def partition_fit_mask(
     free = np.asarray(devices.free)
     is_gpu = np.asarray((devices.dev_type == DEVICE_GPU) & devices.valid)
     gpu_dims = [DEVICE_RESOURCE_INDEX[n] for n in DEVICE_TYPE_RESOURCES[DEVICE_GPU]]
+    # partition-table groups carry CR minor ids (the id space
+    # allocate_partitioned matches against m["minor"]), which differ from
+    # dense slot indices on multi-type nodes; map minor -> slot per node,
+    # restricted to GPU minors so an RDMA NIC sharing a minor number with
+    # a GPU cannot shadow it.
+    minors_t = (
+        np.asarray(devices.minor)
+        if devices.minor is not None
+        else np.broadcast_to(
+            np.arange(free.shape[1], dtype=np.int64), is_gpu.shape
+        )
+    )
 
     P, N = wanted.shape
     ok = np.ones((P, N), bool)
@@ -485,6 +497,11 @@ def partition_fit_mask(
     for n, tables in (partitions_by_node or {}).items():
         if n >= N or not tables:
             continue
+        minor_to_slot = {
+            int(minors_t[n, d]): d
+            for d in range(free.shape[1])
+            if is_gpu[n, d]
+        }
         for p in range(P):
             if not gpu_requested[p]:
                 continue
@@ -497,11 +514,10 @@ def partition_fit_mask(
             for group in groups:
                 if len(group) != w:
                     continue
+                slots = [minor_to_slot.get(g) for g in group]
                 if all(
-                    d < free.shape[1]
-                    and is_gpu[n, d]
-                    and (free[n, d][gpu_dims] >= need).all()
-                    for d in group
+                    d is not None and (free[n, d][gpu_dims] >= need).all()
+                    for d in slots
                 ):
                     fit = True
                     break

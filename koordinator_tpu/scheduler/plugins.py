@@ -230,8 +230,11 @@ class DeviceSharePlugin(TensorPlugin):
             return
         minors = (ctx.extras.get("device_minors") or {}).get(node_idx)
         if minors is None:
-            # derive the host-side minor view from the tensor extras
-            # (minor id = dense index, topology from devices.numa)
+            # derive the host-side minor view from the tensor extras.
+            # Minors carry the CR device id from devices.minor (the dense
+            # slot index only as fallback when devices.minor is absent);
+            # device_partitions / preferred / required sets must be
+            # authored in that minor-id space, never in slot space.
             minors = minor_dicts_from_batch(devices, node_idx)
             ctx.extras.setdefault("device_minors", {})[node_idx] = minors
         dev_req = pod_device_requests(ctx.snapshot.pods.requests[pod_idx : pod_idx + 1])

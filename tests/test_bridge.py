@@ -158,3 +158,54 @@ class TestFlatScoreReply:
             assert list(entry.score) == scores[off : off + c].tolist()
             off += c
         assert flat.build_ms >= 0.0 and not flat.pods
+
+
+class TestRawUdsReplyCap:
+    def test_oversized_reply_errors_and_conn_survives(self, tmp_path, monkeypatch):
+        """The server must refuse replies over the transport cap with a
+        real error (every client enforces the same cap and would reject
+        the frame as 'reply frame exceeds cap' otherwise) and keep the
+        connection serving."""
+        import socket
+        import struct
+
+        from koordinator_tpu.bridge import udsserver
+        from koordinator_tpu.bridge.codegen import pb2
+        from koordinator_tpu.harness import generators
+        from koordinator_tpu.harness.golden import build_sync_request
+
+        nodes_l, pods_l, _, _ = generators.loadaware_joint(
+            seed=3, pods=32, nodes=8
+        )
+        req, _ = build_sync_request(nodes_l, pods_l, [], [])
+        sock_path = str(tmp_path / "scorer.sock")
+        server = udsserver.RawUdsServer(sock_path).start()
+
+        def call(conn, method, payload):
+            conn.sendall(struct.pack(">BI", method, len(payload)) + payload)
+            head = conn.recv(5, socket.MSG_WAITALL)
+            status, length = struct.unpack(">BI", head)
+            body = b""
+            while len(body) < length:
+                body += conn.recv(length - len(body))
+            return status, body
+
+        try:
+            c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            c.connect(sock_path)
+            status, _ = call(c, 1, req.SerializeToString())
+            assert status == 0
+            # shrink the cap below any full-matrix Score reply
+            monkeypatch.setattr(udsserver, "_MAX_FRAME", 64)
+            score = pb2.ScoreRequest(snapshot_id="s1", top_k=0, flat=True)
+            status, body = call(c, 2, score.SerializeToString())
+            assert status == 1 and b"exceeds" in body
+            # the connection is still serving after the refusal
+            monkeypatch.setattr(udsserver, "_MAX_FRAME", 64 << 20)
+            status, _ = call(c, 2, pb2.ScoreRequest(
+                snapshot_id="s1", top_k=2, flat=True
+            ).SerializeToString())
+            assert status == 0
+            c.close()
+        finally:
+            server.stop()

@@ -276,6 +276,62 @@ class TestPartitionTables:
         refined = partition_fit_mask(reqs, batch, {0: self.PART})
         assert not refined[0, 0]  # no single ring is free
 
+    def test_partition_fit_mask_minor_id_space_multi_type(self):
+        """Filter and Reserve must read device_partitions in the SAME id
+        space (CR minor ids).  On a multi-type node an RDMA minor 0 sits
+        at slot 0 while GPU minors 0..3 occupy slots 1..4 — indexing the
+        groups as dense slots would test the wrong cells (the advisory's
+        Filter/Reserve divergence)."""
+        import numpy as np
+
+        from koordinator_tpu.model.device import encode_devices
+        from koordinator_tpu.ops.deviceshare import (
+            allocate_partitioned,
+            minor_dicts_from_batch,
+            partition_fit_mask,
+        )
+
+        devs = [
+            {
+                "type": "rdma",
+                "minor": 0,
+                "total": {"koordinator.sh/rdma": 100},
+                "free": {"koordinator.sh/rdma": 100},
+            }
+        ]
+        for i in range(4):
+            devs.append(
+                {
+                    "type": "gpu",
+                    "minor": i,
+                    "total": {"koordinator.sh/gpu-core": 100,
+                              "koordinator.sh/gpu-memory": 16 << 30,
+                              "koordinator.sh/gpu-memory-ratio": 100},
+                    "free": {"koordinator.sh/gpu-core": 100,
+                             "koordinator.sh/gpu-memory": 16 << 30,
+                             "koordinator.sh/gpu-memory-ratio": 100},
+                }
+            )
+        batch = encode_devices([{"devices": devs}], node_bucket=1)
+        part = {2: [[0, 1], [2, 3]]}
+        reqs = pods({"koordinator.sh/gpu-core": 200,
+                     "koordinator.sh/gpu-memory-ratio": 200})
+        refined = partition_fit_mask(reqs, batch, {0: part})
+        assert refined[0, 0]  # all GPU minors free: group [0,1] fits
+
+        # Reserve's view agrees: the same table allocates without raising
+        minors = [
+            m for m in minor_dicts_from_batch(batch, 0) if m["type"] == "gpu"
+        ]
+        got = allocate_partitioned(
+            minors,
+            {"koordinator.sh/gpu-core": 100,
+             "koordinator.sh/gpu-memory-ratio": 100},
+            2,
+            part,
+        )
+        assert got == [0, 1]
+
 
 class TestJointAllocation:
     """allocate_joint: all requested types on one node, NUMA-aligned
