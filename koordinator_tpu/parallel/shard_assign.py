@@ -306,13 +306,29 @@ def _assign_waves(
       that candidate's key recomputed from the gathered state rows plus
       the in-wave delta (commit targets and pod vectors are replicated,
       so every device derives the identical key);
-    * scores only decrease as load is added, and packed keys are unique,
-      so any node outside the pod's top-``top_m`` candidates stays
-      strictly below the frozen ``top_m``-th key k_M — a pod's choice is
-      therefore EXACT (bit-identical with the sequential scan) whenever
-      its best current candidate key is still >= k_M.  The first pod in
-      the wave that cannot be certified ends the commit prefix; it and
-      everything after rerun next round against fresh state.
+    * scores only decrease as load is added (LeastAllocated), and packed
+      keys are unique, so any node outside the pod's top-``top_m``
+      candidates stays strictly below the frozen ``top_m``-th key k_M —
+      a pod's choice is therefore EXACT (bit-identical with the
+      sequential scan) whenever its best current candidate key is still
+      >= k_M.  The first pod in the wave that cannot be certified ends
+      the commit prefix; it and everything after rerun next round
+      against fresh state;
+    * under ``MostAllocated`` (round-4 review #5) scores INCREASE as
+      load is added, so the k_M lower-bound argument inverts.  The exact
+      symmetric certificate rides the CLOSED candidate universe: every
+      in-wave commit lands on some wave pod's gathered candidate, so the
+      union of all wave pods' per-shard top-M candidates (whose full
+      state rows ride the same all_gather) is the ONLY set of nodes
+      whose keys can move within the round.  Each pod re-keys that
+      whole universe exactly (frozen rows + the replicated in-wave
+      commit deltas) and certifies when the universe best >= its own
+      frozen global k_M: any node outside the pod's frozen top-M has
+      frozen key <= k_M and — receiving no in-wave commits — can never
+      rise above it, while packed-key uniqueness turns the boundary
+      case into membership.  Pod 0 of each round has no earlier in-wave
+      commits, so its frozen keys ARE current and it always commits —
+      liveness is unchanged.
 
     Measured on the 10k x 2k benchmark snapshot: wave=32/top_m=4 commits
     ~20 pods per collective (500 rounds vs 10,000 per-pod collectives).
@@ -342,6 +358,10 @@ def _assign_waves(
     rep = P()
 
     SENT_TH = _NEG * N // 2  # keys below this decode as infeasible
+    # MostAllocated needs the upper-bound certificate (docstring bullet 4)
+    most_alloc = cfg.enable_fit_score and (
+        cfg.fit_scoring_strategy == MOST_ALLOCATED
+    )
 
     def body(
         alloc, req0, usage, uprod, node_ok_def, node_ok_pr, fresh,
@@ -380,41 +400,82 @@ def _assign_waves(
             ptr, nreq, nest, quse, chosen_buf, nwaves = carry
             ps = lax.dynamic_slice(order_pad, (ptr,), (W,))
             wvalid = (ptr + iota_w) < PCAP
+            preq_wave = preq[ps]  # [W, R]
+            pest_wave = pest[ps]
 
             keys_loc = jax.vmap(lambda p: one_pod_keys(nreq, nest, p))(ps)
             lvals, lidx = lax.top_k(keys_loc, M)  # [W, M]
             gid = offset + lidx.astype(jnp.int64)
 
-            if prod_sensitive:
-                usage_rows = jnp.where(
-                    pprod[ps][:, None, None], uprod[lidx], usage[lidx]
+            if most_alloc:
+                # the closed candidate universe (see docstring): this
+                # shard's contribution is the union of its W pods' local
+                # top-M rows, keyed by NODE (duplicates are harmless —
+                # identical rows produce identical keys)
+                uni_idx = lidx.reshape(-1)  # [W*M] local slots
+                uni_gid = offset + uni_idx.astype(jnp.int64)
+
+            if most_alloc:
+                # universe payload: node-keyed rows for the closed
+                # candidate set + the frozen per-pod keys (k_M only)
+                payload = dict(
+                    key=lvals,  # [W, M]
+                    u_gid=uni_gid,  # [W*M]
+                    u_alloc=alloc[uni_idx],
+                    u_nreq=nreq[uni_idx],
+                    u_nest=nest[uni_idx],
+                    u_usage=usage[uni_idx],
+                    u_okd=node_ok_def[uni_idx],
+                    u_fresh=fresh[uni_idx],
+                    u_xval=(
+                        xscores[ps[:, None], uni_idx[None, :]]
+                        if xscores is not None
+                        else jnp.zeros((W, W * M), jnp.int64)
+                    ),
+                    u_xfeas=(
+                        xmask[ps[:, None], uni_idx[None, :]]
+                        if xmask is not None
+                        else jnp.ones((W, W * M), bool)
+                    ),
                 )
-                ok_rows = jnp.where(
-                    pprod[ps][:, None], node_ok_pr[lidx], node_ok_def[lidx]
-                )
+                if prod_sensitive:
+                    # the prod-usage variants ride only when some pod can
+                    # actually select them (trace-time flag) — otherwise
+                    # they would double the universe rows in the ONE
+                    # collective this design exists to minimize
+                    payload["u_uprod"] = uprod[uni_idx]
+                    payload["u_okp"] = node_ok_pr[uni_idx]
             else:
-                usage_rows = usage[lidx]
-                ok_rows = node_ok_def[lidx]
-            payload = dict(
-                key=lvals,
-                gid=gid,
-                alloc=alloc[lidx],
-                nreq=nreq[lidx],
-                nest=nest[lidx],
-                usage=usage_rows,
-                ok=ok_rows,
-                fresh=fresh[lidx],
-                xval=(
-                    xscores[ps[:, None], lidx]
-                    if xscores is not None
-                    else jnp.zeros((W, M), jnp.int64)
-                ),
-                xfeas=(
-                    xmask[ps[:, None], lidx]
-                    if xmask is not None
-                    else jnp.ones((W, M), bool)
-                ),
-            )
+                if prod_sensitive:
+                    usage_rows = jnp.where(
+                        pprod[ps][:, None, None], uprod[lidx], usage[lidx]
+                    )
+                    ok_rows = jnp.where(
+                        pprod[ps][:, None], node_ok_pr[lidx], node_ok_def[lidx]
+                    )
+                else:
+                    usage_rows = usage[lidx]
+                    ok_rows = node_ok_def[lidx]
+                payload = dict(
+                    key=lvals,
+                    gid=gid,
+                    alloc=alloc[lidx],
+                    nreq=nreq[lidx],
+                    nest=nest[lidx],
+                    usage=usage_rows,
+                    ok=ok_rows,
+                    fresh=fresh[lidx],
+                    xval=(
+                        xscores[ps[:, None], lidx]
+                        if xscores is not None
+                        else jnp.zeros((W, M), jnp.int64)
+                    ),
+                    xfeas=(
+                        xmask[ps[:, None], lidx]
+                        if xmask is not None
+                        else jnp.ones((W, M), bool)
+                    ),
+                )
             # the ONE collective of the round
             gathered = lax.all_gather(payload, ax)  # leading [S, ...]
 
@@ -422,23 +483,41 @@ def _assign_waves(
                 a = jnp.moveaxis(a, 0, 1)
                 return a.reshape((W, -1) + a.shape[3:])
 
-            g = {k: _flat(v) for k, v in gathered.items()}
-            gkeys, gsel = lax.top_k(g["key"], M)  # [W, M] global candidates
+            if most_alloc:
+                # frozen per-pod global top-M keys (k_M certification bar)
+                cand_key, _ = lax.top_k(_flat(gathered["key"]), M)
+                R_ = alloc.shape[1]
+                u_gid = gathered["u_gid"].reshape(-1)  # [U = S*W*M]
+                U = u_gid.shape[0]
+                u_alloc = gathered["u_alloc"].reshape(U, R_)
+                u_nreq = gathered["u_nreq"].reshape(U, R_)
+                u_nest = gathered["u_nest"].reshape(U, R_)
+                u_usage = gathered["u_usage"].reshape(U, R_)
+                u_okd = gathered["u_okd"].reshape(U)
+                u_fresh = gathered["u_fresh"].reshape(U)
+                if prod_sensitive:
+                    u_uprod = gathered["u_uprod"].reshape(U, R_)
+                    u_okp = gathered["u_okp"].reshape(U)
+                # [S, W, W*M] -> [W, U] aligned with u_gid's (s, k) order
+                u_xval = jnp.moveaxis(gathered["u_xval"], 0, 1).reshape(W, U)
+                u_xfeas = jnp.moveaxis(gathered["u_xfeas"], 0, 1).reshape(W, U)
+            else:
+                g = {k: _flat(v) for k, v in gathered.items()}
+                gkeys, gsel = lax.top_k(g["key"], M)  # [W, M] global candidates
 
-            def take(a):
-                sel = gsel
-                while sel.ndim < a.ndim:
-                    sel = sel[..., None]
-                return jnp.take_along_axis(a, sel, axis=1)
+                def take(a):
+                    sel = gsel
+                    while sel.ndim < a.ndim:
+                        sel = sel[..., None]
+                    return jnp.take_along_axis(a, sel, axis=1)
 
-            cand = {k: take(v) for k, v in g.items() if k != "key"}
-            cand_key = gkeys
+                cand = {k: take(v) for k, v in g.items() if k != "key"}
+                cand_key = gkeys
 
-            preq_wave = preq[ps]  # [W, R]
-            pest_wave = pest[ps]
             psreq_wave = psreq[ps]
             pqid_wave = pqid[ps]
             pvalid_wave = pvalid[ps]
+            pprod_wave = pprod[ps]
 
             def resolve(i, st):
                 choices, committed, active, done, quse_w, ncommit = st
@@ -449,56 +528,117 @@ def _assign_waves(
                 qi = jnp.maximum(qid, 0)
                 earlier = committed & (iota_w < i)
 
-                # candidate current keys (recomputed when dirtied in-wave)
-                c_nodes = cand["gid"][i]  # [M]
-                hit = earlier[:, None] & (
-                    choices[:, None] == c_nodes[None, :]
-                )  # [W, M]
-                dreq = jnp.einsum(
-                    "wm,wr->mr", hit.astype(jnp.int64), preq_wave
-                )
-                dest = jnp.einsum(
-                    "wm,wr->mr", hit.astype(jnp.int64), pest_wave
-                )
-                dirty = jnp.any(hit, axis=0)  # [M]
-                # re-key dirtied candidates with the SAME step semantics
-                # the scan path and the frozen wave scoring use — the
-                # candidate rows stand in as an M-node block, quota
-                # disabled (qid=-1; admission is the replicated recheck
-                # below).  No third copy of Filter+Score exists here.
-                re_feas, re_total = step_feasible_scores(
-                    cand["nreq"][i] + dreq,
-                    cand["nest"][i] + dest,
-                    quse_w,
-                    cand["alloc"][i],
-                    cand["usage"][i],
-                    cand["fresh"][i],
-                    cand["ok"][i],
-                    req,
-                    sreq,
-                    est,
-                    jnp.int32(-1),
-                    jnp.bool_(True),
-                    qrt,
-                    qlim,
-                    cfg,
-                )
-                re_total = re_total + jnp.where(
-                    cand["xfeas"][i], cand["xval"][i], 0
-                )
-                re_feas = re_feas & cand["xfeas"][i]
-                rekeys = jnp.where(
-                    re_feas,
-                    re_total * N + (N - 1 - c_nodes),
-                    _NEG * N + (N - 1 - c_nodes),
-                )
-                cur = jnp.where(dirty, rekeys, cand_key[i])  # [M]
-                best_key = jnp.max(cur)
-                best_node = c_nodes[jnp.argmax(cur)]
-
                 k_m = cand_key[i, M - 1]
+                # k_M at sentinel: fewer than M nodes were feasible at
+                # frozen state, so ALL feasible nodes are candidates —
+                # and committed load never turns an infeasible node
+                # feasible under either strategy
                 sentinel_m = k_m <= SENT_TH
-                certified = (best_key >= k_m) | sentinel_m
+
+                if most_alloc:
+                    # universe certificate (docstring bullet 4): re-key
+                    # the WHOLE closed candidate universe exactly for
+                    # this pod — frozen rows + replicated in-wave commit
+                    # deltas — then certify against the frozen k_M
+                    hit_u = earlier[:, None] & (
+                        choices[:, None] == u_gid[None, :]
+                    )  # [W, U]
+                    dreq_u = jnp.einsum(
+                        "wu,wr->ur", hit_u.astype(jnp.int64), preq_wave
+                    )
+                    dest_u = jnp.einsum(
+                        "wu,wr->ur", hit_u.astype(jnp.int64), pest_wave
+                    )
+                    if prod_sensitive:
+                        usage_u = jnp.where(
+                            pprod_wave[i], u_uprod, u_usage
+                        )
+                        ok_u = jnp.where(pprod_wave[i], u_okp, u_okd)
+                    else:
+                        usage_u = u_usage
+                        ok_u = u_okd
+                    re_feas, re_total = step_feasible_scores(
+                        u_nreq + dreq_u,
+                        u_nest + dest_u,
+                        quse_w,
+                        u_alloc,
+                        usage_u,
+                        u_fresh,
+                        ok_u,
+                        req,
+                        sreq,
+                        est,
+                        jnp.int32(-1),
+                        jnp.bool_(True),
+                        qrt,
+                        qlim,
+                        cfg,
+                    )
+                    re_total = re_total + jnp.where(
+                        u_xfeas[i], u_xval[i], 0
+                    )
+                    re_feas = re_feas & u_xfeas[i]
+                    cur = jnp.where(
+                        re_feas,
+                        re_total * N + (N - 1 - u_gid),
+                        _NEG * N + (N - 1 - u_gid),
+                    )  # [U]
+                    best_key = jnp.max(cur)
+                    best_node = u_gid[jnp.argmax(cur)]
+                    # pod 0 has no earlier in-wave commits: frozen keys
+                    # are current, its frozen top-1 is in the universe
+                    # (liveness: every round commits at least one pod)
+                    certified = (best_key >= k_m) | sentinel_m | (i == 0)
+                else:
+                    # candidate current keys (recomputed when dirtied
+                    # in-wave)
+                    c_nodes = cand["gid"][i]  # [M]
+                    hit = earlier[:, None] & (
+                        choices[:, None] == c_nodes[None, :]
+                    )  # [W, M]
+                    dreq = jnp.einsum(
+                        "wm,wr->mr", hit.astype(jnp.int64), preq_wave
+                    )
+                    dest = jnp.einsum(
+                        "wm,wr->mr", hit.astype(jnp.int64), pest_wave
+                    )
+                    dirty = jnp.any(hit, axis=0)  # [M]
+                    # re-key dirtied candidates with the SAME step
+                    # semantics the scan path and the frozen wave scoring
+                    # use — the candidate rows stand in as an M-node
+                    # block, quota disabled (qid=-1; admission is the
+                    # replicated recheck below).  No third copy of
+                    # Filter+Score exists here.
+                    re_feas, re_total = step_feasible_scores(
+                        cand["nreq"][i] + dreq,
+                        cand["nest"][i] + dest,
+                        quse_w,
+                        cand["alloc"][i],
+                        cand["usage"][i],
+                        cand["fresh"][i],
+                        cand["ok"][i],
+                        req,
+                        sreq,
+                        est,
+                        jnp.int32(-1),
+                        jnp.bool_(True),
+                        qrt,
+                        qlim,
+                        cfg,
+                    )
+                    re_total = re_total + jnp.where(
+                        cand["xfeas"][i], cand["xval"][i], 0
+                    )
+                    re_feas = re_feas & cand["xfeas"][i]
+                    rekeys = jnp.where(
+                        re_feas,
+                        re_total * N + (N - 1 - c_nodes),
+                        _NEG * N + (N - 1 - c_nodes),
+                    )
+                    cur = jnp.where(dirty, rekeys, cand_key[i])  # [M]
+                    best_key = jnp.max(cur)
+                    best_node = c_nodes[jnp.argmax(cur)]
+                    certified = (best_key >= k_m) | sentinel_m
                 feas = best_key > SENT_TH
 
                 qblocked = (qid >= 0) & jnp.any(
@@ -506,10 +646,18 @@ def _assign_waves(
                 )
                 usable = pvalid_wave[i] & ~qblocked & wvalid[i]
                 choice = jnp.where(feas & usable, best_node, -1)
-                # -1 outcomes are exact regardless of candidate state
-                # (monotonicity: infeasible/blocked/invalid stays so), so
-                # they never need certification; padding lanes auto-commit
-                certified = certified | ~(feas & usable)
+                # a -1 outcome is exact only when it is node-INDEPENDENT
+                # (quota-blocked / invalid pod / padding lane) or when
+                # sentinel_m says every frozen-feasible node is already a
+                # candidate (infeasible stays infeasible under commits).
+                # With k_M > sentinel, "no candidate feasible" proves
+                # nothing about nodes OUTSIDE the gathered set — feasible
+                # frozen nodes below k_M may remain, so the pod must end
+                # the commit prefix and rerun next round against fresh
+                # state (certification via sentinel_m is already in
+                # `certified`; adding ~feas here would wrongly commit
+                # schedulable pods as unschedulable).
+                certified = certified | ~usable
 
                 commit = active & certified
                 take_node = commit & (choice >= 0)
@@ -618,19 +766,13 @@ def greedy_assign_waves(
     with greedy_assign, one all_gather per round instead of one pmax per
     pod.  Returns (CycleResult, collective_round_count).
 
-    The wave certification proof requires scores to be NON-INCREASING in
-    committed load (least-requested is; see _assign_waves docstring).
-    ``MostAllocated`` scoring is monotonically increasing — an in-wave
-    commit could raise an outside node above the frozen k_M and the wave
-    path would silently mis-place — so that strategy is routed to the
-    per-pod collective path (exact for any monotonicity), reported as one
-    collective per pod."""
-    if cfg.enable_fit_score and cfg.fit_scoring_strategy == MOST_ALLOCATED:
-        result = greedy_assign_sharded(
-            snapshot, mesh, cfg, extra_mask=extra_mask,
-            extra_scores=extra_scores,
-        )
-        return result, int(snapshot.pods.capacity)
+    Both fit strategies certify exactly: LeastAllocated through the
+    frozen k_M lower bound (scores non-increasing in committed load),
+    MostAllocated through the symmetric frozen upper bound on
+    non-candidate nodes (round-4 review #5; see the _assign_waves
+    docstring).  The reference parallelizes Score identically for both
+    (``frameworkext/framework_extender.go:216``,
+    ``plugins/nodenumaresource/most_allocated.go``)."""
     if extra_scores is not None:
         hi = int(jnp.max(jnp.abs(extra_scores)))
         if hi >= 2**31:

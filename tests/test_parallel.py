@@ -184,10 +184,13 @@ class TestWaveRounds:
         )
         assert rounds < 2048 // 4, rounds
 
-    def test_wave_mostallocated_routes_to_perpod(self):
+    def test_wave_mostallocated_parity(self):
         """MostAllocated scoring is monotonically INCREASING in committed
-        load, which breaks the wave certification proof — the wrapper must
-        route it to the per-pod collective path and stay bit-exact."""
+        load; the wave path certifies it through the frozen per-round
+        upper bound on non-candidate nodes (round-4 review #5) and must
+        stay bit-exact with FEWER collectives than pods — symmetric with
+        the reference's strategy-agnostic Score fan-out
+        (framework_extender.go:216, most_allocated.go)."""
         from koordinator_tpu.config import CycleConfig
         from koordinator_tpu.parallel import greedy_assign_waves
 
@@ -198,5 +201,138 @@ class TestWaveRounds:
         np.testing.assert_array_equal(
             np.asarray(got.assignment), np.asarray(want.assignment)
         )
-        # per-pod path: one collective per pod slot
-        assert rounds == snap.pods.capacity
+        np.testing.assert_array_equal(
+            np.asarray(got.status), np.asarray(want.status)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.node_requested), np.asarray(want.node_requested)
+        )
+        assert rounds < snap.pods.capacity, rounds
+
+    def test_wave_mostallocated_parity_quota(self):
+        from koordinator_tpu.config import CycleConfig
+        from koordinator_tpu.parallel import greedy_assign_waves
+
+        snap = generators.quota_colocation_snapshot(pods=512, nodes=128)[0]
+        cfg = CycleConfig(fit_scoring_strategy="MostAllocated")
+        want = greedy_assign(snap, cfg)
+        got, rounds = greedy_assign_waves(snap, make_mesh(), cfg)
+        np.testing.assert_array_equal(
+            np.asarray(got.assignment), np.asarray(want.assignment)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.quota_used), np.asarray(want.quota_used)
+        )
+        assert rounds < 512, rounds
+
+
+class TestWaveAwkwardShapes:
+    """Round-4 review #8: the wave path at non-power-of-2 meshes, 1-node
+    shards, and wave sizes larger than the remaining pods must keep exact
+    parity (the robustness bar of the reference's -race CI, Makefile:94)."""
+
+    @pytest.mark.parametrize("mesh_size", [3, 5, 6, 7])
+    @pytest.mark.parametrize("wave", [1, 7, 33])
+    def test_parity_mesh_x_wave(self, mesh_size, wave):
+        from koordinator_tpu.parallel import greedy_assign_waves
+
+        n, p, g, q = generators.loadaware_joint(seed=11, pods=24, nodes=10)
+        snap = encode_snapshot(n, p, g, q)
+        mesh = make_mesh(jax.devices()[:mesh_size])
+        want = greedy_assign(snap)
+        got, rounds = greedy_assign_waves(snap, mesh, wave=wave, top_m=4)
+        np.testing.assert_array_equal(
+            np.asarray(got.assignment), np.asarray(want.assignment)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.node_requested), np.asarray(want.node_requested)
+        )
+        assert rounds >= 1
+
+    @pytest.mark.parametrize("mesh_size", [3, 7])
+    def test_parity_one_node_shards_mostallocated(self, mesh_size):
+        """Node count == mesh size: every shard holds ONE node, so the
+        local top-M clamps to 1 and the MostAllocated candidate universe
+        shrinks to one row per (shard, wave pod) — parity must survive
+        both strategies."""
+        from koordinator_tpu.config import CycleConfig
+        from koordinator_tpu.parallel import greedy_assign_waves
+
+        n, p, g, q = generators.loadaware_joint(
+            seed=5, pods=16, nodes=mesh_size
+        )
+        snap = encode_snapshot(n, p, g, q)
+        mesh = make_mesh(jax.devices()[:mesh_size])
+        for cfg in (None, CycleConfig(fit_scoring_strategy="MostAllocated")):
+            args = (snap, mesh) if cfg is None else (snap, mesh, cfg)
+            want = greedy_assign(snap) if cfg is None else greedy_assign(snap, cfg)
+            got, _ = greedy_assign_waves(*args, wave=7, top_m=4)
+            np.testing.assert_array_equal(
+                np.asarray(got.assignment), np.asarray(want.assignment)
+            )
+
+    def test_wave_larger_than_pods(self):
+        from koordinator_tpu.parallel import greedy_assign_waves
+
+        n, p, g, q = generators.loadaware_joint(seed=2, pods=5, nodes=6)
+        snap = encode_snapshot(n, p, g, q)
+        mesh = make_mesh(jax.devices()[:3])
+        want = greedy_assign(snap)
+        got, rounds = greedy_assign_waves(snap, mesh, wave=33, top_m=4)
+        np.testing.assert_array_equal(
+            np.asarray(got.assignment), np.asarray(want.assignment)
+        )
+
+
+class TestWaveTightCapacity:
+    """Regression for the round-5 review's exactness hole: identical pods
+    racing for one-pod-each nodes exhaust every gathered candidate within
+    a wave.  A pod whose candidates all filled in-wave must END the
+    commit prefix (feasible nodes below the frozen k_M remain), not
+    commit -1 — the old `certified |= ~feas` wrongly marked schedulable
+    pods unschedulable."""
+
+    def _tight_snap(self, pods=12, nodes=16):
+        node_l = [
+            {
+                "name": f"tight-{i}",
+                "allocatable": {"cpu": "1000m", "memory": 1 << 30, "pods": 110},
+            }
+            for i in range(nodes)
+        ]
+        pod_l = [
+            {
+                "name": f"pod-{p}",
+                "requests": {"cpu": "900m", "memory": 512 << 20, "pods": 1},
+            }
+            for p in range(pods)
+        ]
+        return encode_snapshot(node_l, pod_l, [], [])
+
+    @pytest.mark.parametrize("mesh_size", [2, 8])
+    def test_all_pods_place_least_allocated(self, mesh_size):
+        from koordinator_tpu.parallel import greedy_assign_waves
+
+        snap = self._tight_snap()
+        mesh = make_mesh(jax.devices()[:mesh_size])
+        want = greedy_assign(snap)
+        got, _ = greedy_assign_waves(snap, mesh, wave=8, top_m=2)
+        np.testing.assert_array_equal(
+            np.asarray(got.assignment), np.asarray(want.assignment)
+        )
+        assert int((np.asarray(got.assignment) >= 0).sum()) == 12
+
+    @pytest.mark.parametrize("mesh_size", [2, 8])
+    def test_all_pods_place_most_allocated(self, mesh_size):
+        from koordinator_tpu.config import CycleConfig
+        from koordinator_tpu.parallel import greedy_assign_waves
+
+        snap = self._tight_snap()
+        cfg = CycleConfig(fit_scoring_strategy="MostAllocated")
+        mesh = make_mesh(jax.devices()[:mesh_size])
+        want = greedy_assign(snap, cfg)
+        got, _ = greedy_assign_waves(snap, mesh, cfg, wave=8, top_m=2)
+        np.testing.assert_array_equal(
+            np.asarray(got.assignment), np.asarray(want.assignment)
+        )
+        assert int((np.asarray(got.assignment) >= 0).sum()) == 12
