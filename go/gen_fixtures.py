@@ -26,6 +26,14 @@ import sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import jax  # noqa: E402
+
+# the env var is not enough where a platform site-hook pins jax_platforms
+# (the axon TPU tunnel image); the config update wins either way — without
+# it this script hangs trying to initialize a dead tunnel (tests/conftest.py
+# documents the same trap)
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 
 import koordinator_tpu  # noqa: F401,E402
@@ -43,6 +51,101 @@ def tensor_json(t: "pb2.Tensor") -> dict:
         "shape": list(t.shape),
         "data": np.frombuffer(t.data, "<i8").tolist(),
     }
+
+
+def plugin_flow_fixtures(blobs: dict, expected: dict) -> None:
+    """Fixtures for the Go plugin's warm-cycle delta sync
+    (go/plugin/batchedtpuscorer.go buildSync + scorerclient DeltaTensor):
+    a full single-pod sync, then a delta sync against it, then a flat
+    Score — generated through bridge/plugin_sim.py (the executable spec)
+    and replayed through the REAL servicer.  golden_test.go rebuilds the
+    requests with DeltaTensor and must match byte-for-byte."""
+    from koordinator_tpu.bridge.plugin_sim import (
+        NUM_AXES,
+        ResidentMirror,
+        build_sync,
+        node_vectors,
+    )
+
+    def vec(cpu=0, mem=0, pods=0):
+        v = [0] * NUM_AXES
+        v[0], v[1], v[3] = cpu, mem, pods
+        return v
+
+    alloc = vec(cpu=8000, mem=16384, pods=110)
+    req1 = vec(cpu=1000, mem=1024, pods=5)
+    nodes1 = [(f"plugin-node-{i}", alloc, req1) for i in range(4)]
+    metrics = {"plugin-node-0": vec(cpu=500, mem=512)}
+    pod_vec = vec(cpu=500, mem=512, pods=1)
+
+    names, a1, r1, u1, f1 = node_vectors(nodes1, metrics)
+    mirror = ResidentMirror()
+    sync1 = build_sync(
+        mirror, False, names, a1, r1, u1, f1, "plugin-pod-1", pod_vec, 0
+    )
+
+    sv = ScorerServicer()
+    reply1 = sv.sync(pb2.SyncRequest.FromString(sync1))
+    mirror.names, mirror.alloc, mirror.requested, mirror.usage = (
+        names, a1, r1, u1,
+    )
+    mirror.gen, mirror.valid = 1, True
+
+    # warm cycle: one node's committed load moves
+    nodes2 = list(nodes1)
+    nodes2[2] = ("plugin-node-2", alloc, vec(cpu=1500, mem=1536, pods=6))
+    names2, a2, r2, u2, f2 = node_vectors(nodes2, metrics)
+    sync2 = build_sync(
+        mirror, True, names2, a2, r2, u2, f2, "plugin-pod-2", pod_vec, 0
+    )
+    reply2 = sv.sync(pb2.SyncRequest.FromString(sync2))
+    score_req = pb2.ScoreRequest(
+        snapshot_id=reply2.snapshot_id, top_k=0, flat=True
+    )
+    score_reply = sv.score(score_req)
+
+    # both encoders must agree byte-for-byte before the bytes become truth
+    for raw in (sync1, sync2):
+        assert pb2.SyncRequest.FromString(raw).SerializeToString() == raw
+
+    blobs.update(
+        {
+            "plugin_sync1_request.bin": sync1,
+            "plugin_sync1_reply.bin": reply1.SerializeToString(),
+            "plugin_sync2_request.bin": sync2,
+            "plugin_sync2_reply.bin": reply2.SerializeToString(),
+            "plugin_score2_request.bin": score_req.SerializeToString(),
+            "plugin_score2_reply.bin": score_reply.SerializeToString(),
+        }
+    )
+    expected["plugin_flow"] = {
+        "names": names,
+        "alloc1": a1, "req1": r1, "usage1": u1, "fresh1": f1,
+        "alloc2": a2, "req2": r2, "usage2": u2, "fresh2": f2,
+        "pod1": "plugin-pod-1", "pod2": "plugin-pod-2",
+        "pod_vec": pod_vec,
+        "sync1_reply": {
+            "snapshot_id": reply1.snapshot_id,
+            "nodes": reply1.nodes, "pods": reply1.pods,
+        },
+        "sync2_reply": {
+            "snapshot_id": reply2.snapshot_id,
+            "nodes": reply2.nodes, "pods": reply2.pods,
+        },
+        "score2_reply": {
+            "pod_index": np.frombuffer(score_reply.flat.pod_index, "<i4").tolist(),
+            "counts": np.frombuffer(score_reply.flat.counts, "<i4").tolist(),
+            "node_index": np.frombuffer(score_reply.flat.node_index, "<i4").tolist(),
+            "score": np.frombuffer(score_reply.flat.score, "<i8").tolist(),
+        },
+    }
+
+    # the round-4 advisory regression: empty repeated-string elements
+    # must survive (dropping one misaligns names with tensor rows)
+    empty = pb2.SyncRequest()
+    empty.pods.names.extend(["", "pod-b"])
+    blobs["empty_name_request.bin"] = empty.SerializeToString()
+    expected["empty_name"] = {"pod_names": ["", "pod-b"]}
 
 
 def main() -> None:
@@ -72,9 +175,6 @@ def main() -> None:
         "assign_request.bin": assign_req.SerializeToString(),
         "assign_reply.bin": assign_reply.SerializeToString(),
     }
-    for name, data in blobs.items():
-        with open(os.path.join(OUT, name), "wb") as f:
-            f.write(data)
 
     expected = {
         "top_k": TOP_K,
@@ -125,6 +225,11 @@ def main() -> None:
             "path": assign_reply.path,
         },
     }
+    plugin_flow_fixtures(blobs, expected)
+
+    for name, data in blobs.items():
+        with open(os.path.join(OUT, name), "wb") as f:
+            f.write(data)
     with open(os.path.join(OUT, "expected.json"), "w") as f:
         json.dump(expected, f, indent=1, sort_keys=True)
     print(f"wrote {len(blobs)} fixtures + expected.json to {OUT}")

@@ -31,7 +31,9 @@ import (
 	"context"
 	"fmt"
 	"os"
+	"slices"
 	"sync"
+	"time"
 
 	v1 "k8s.io/api/core/v1"
 	"k8s.io/apimachinery/pkg/runtime"
@@ -65,12 +67,102 @@ type podScores struct {
 
 func (p *podScores) Clone() framework.StateData { return p }
 
+// NodeMetricsProvider feeds real node utilization into the sidecar's
+// LoadAware term (the NodeMetric CR consumption of
+// reference pkg/scheduler/plugins/loadaware/load_aware.go:269-337).
+// Usage returns the node's dense usage vector (RESOURCE_AXIS order, cpu
+// milli / MiB) and whether the metric is fresh; (nil, false) means no
+// usable metric, in which case the sidecar zeroes the LoadAware term
+// for that node (MetricFresh=false) rather than guessing.
+type NodeMetricsProvider interface {
+	Usage(nodeName string) ([]int64, bool)
+}
+
+// NodeMetricCache is the default NodeMetricsProvider: an informer-fed
+// map of node -> usage vector with the reference's staleness window
+// (load_aware.go DefaultNodeMetricExpirationSeconds).  Wire the
+// NodeMetric CR informer's add/update handler to Set; the koordlet side
+// produces the payload (koordinator_tpu/koordlet/statesinformer.py
+// NodeMetricReporter: nodeMetric.nodeUsage {cpu: "1500m", memory: "<bytes>"}).
+type NodeMetricCache struct {
+	mu      sync.RWMutex
+	entries map[string]metricEntry
+	// MaxAge bounds metric staleness; zero means the 180s reference default.
+	MaxAge time.Duration
+}
+
+type metricEntry struct {
+	vec []int64
+	at  time.Time
+}
+
+const defaultMetricMaxAge = 180 * time.Second
+
+// NewNodeMetricCache builds an empty cache with the reference staleness
+// window.
+func NewNodeMetricCache() *NodeMetricCache {
+	return &NodeMetricCache{entries: map[string]metricEntry{}}
+}
+
+// Set records a node's usage vector as of reportTime (the NodeMetric
+// status updateTime, not the local clock — a stale CR must read stale).
+func (c *NodeMetricCache) Set(node string, vec []int64, reportTime time.Time) {
+	c.mu.Lock()
+	defer c.mu.Unlock()
+	c.entries[node] = metricEntry{vec: vec, at: reportTime}
+}
+
+// SetQuantities is the common-case Set: cpu milli + memory bytes from
+// the NodeMetric nodeUsage payload.
+func (c *NodeMetricCache) SetQuantities(node string, cpuMilli, memBytes int64, reportTime time.Time) {
+	vec := make([]int64, numAxes)
+	vec[axisCPU] = cpuMilli
+	vec[axisMemory] = memBytes / mib
+	c.Set(node, vec, reportTime)
+}
+
+// Usage implements NodeMetricsProvider.
+func (c *NodeMetricCache) Usage(node string) ([]int64, bool) {
+	c.mu.RLock()
+	e, ok := c.entries[node]
+	c.mu.RUnlock()
+	if !ok {
+		return nil, false
+	}
+	maxAge := c.MaxAge
+	if maxAge == 0 {
+		maxAge = defaultMetricMaxAge
+	}
+	if time.Since(e.at) > maxAge {
+		return nil, false
+	}
+	return e.vec, true
+}
+
+// residentMirror is the last ACKED node table: the delta baseline.  Like
+// the Python client (bridge/client.py), new values are promoted only
+// after the server confirms the Sync, and a generation jump (another
+// client synced, or the sidecar restarted and lost its resident
+// tensors) invalidates the baseline so the next sync ships full state.
+type residentMirror struct {
+	names                  []string
+	alloc, requested, usage []int64
+	gen                    int64
+	valid                  bool
+}
+
+func (m *residentMirror) invalidate() { *m = residentMirror{} }
+
 // Scorer is the BatchedTPUScorer plugin.
 type Scorer struct {
 	handle framework.Handle
 	mu     sync.Mutex
 	client *scorerclient.Client
 	socket string
+	mirror residentMirror
+	// Metrics feeds real utilization; nil degrades MetricFresh to
+	// all-false (Fit-only scoring, never a silent guess).
+	Metrics NodeMetricsProvider
 }
 
 var (
@@ -81,12 +173,16 @@ var (
 
 // New builds the plugin; the sidecar socket comes from
 // KOORD_TPU_SCORER_SOCKET (default /var/run/koordinator-tpu/scorer.sock).
+// The returned Scorer carries an empty NodeMetricCache as its Metrics
+// provider — wire a NodeMetric CR informer to its Set/SetQuantities to
+// feed real utilization (until then every node reads MetricFresh=false
+// and scoring is Fit-only, matching a cluster with no koordlet reports).
 func New(_ runtime.Object, handle framework.Handle) (framework.Plugin, error) {
 	socket := os.Getenv("KOORD_TPU_SCORER_SOCKET")
 	if socket == "" {
 		socket = "/var/run/koordinator-tpu/scorer.sock"
 	}
-	return &Scorer{handle: handle, socket: socket}, nil
+	return &Scorer{handle: handle, socket: socket, Metrics: NewNodeMetricCache()}, nil
 }
 
 func (s *Scorer) Name() string { return Name }
@@ -134,9 +230,10 @@ func resourceVector(rl v1.ResourceList) []int64 {
 	return vec
 }
 
-func nodeInfoVectors(infos []*framework.NodeInfo) (names []string, alloc, requested, usage []int64) {
+func nodeInfoVectors(infos []*framework.NodeInfo, metrics NodeMetricsProvider) (names []string, alloc, requested, usage []int64, fresh []bool) {
 	for _, ni := range infos {
-		names = append(names, ni.Node().Name)
+		name := ni.Node().Name
+		names = append(names, name)
 		alloc = append(alloc, resourceVector(ni.Node().Status.Allocatable)...)
 		req := make([]int64, numAxes)
 		req[axisCPU] = ni.Requested.MilliCPU
@@ -144,9 +241,20 @@ func nodeInfoVectors(infos []*framework.NodeInfo) (names []string, alloc, reques
 		req[axisEphem] = ni.Requested.EphemeralStorage / mib
 		req[axisPods] = int64(len(ni.Pods))
 		requested = append(requested, req...)
-		// without a NodeMetric feed usage mirrors requested (the sidecar
-		// zeroes LoadAware terms for nodes it has no fresh metric for)
+		// real utilization when the NodeMetric feed has a fresh sample
+		// (load_aware.go:269-337 semantics: a hot-but-underrequested node
+		// must score below a cold one); otherwise usage mirrors requested
+		// and MetricFresh=false makes the sidecar zero the LoadAware term
+		// for this node instead of trusting the guess
+		if metrics != nil {
+			if vec, ok := metrics.Usage(name); ok && len(vec) == numAxes {
+				usage = append(usage, vec...)
+				fresh = append(fresh, true)
+				continue
+			}
+		}
 		usage = append(usage, req...)
+		fresh = append(fresh, false)
 	}
 	return
 }
@@ -162,8 +270,49 @@ func podVector(pod *v1.Pod) []int64 {
 	return vec
 }
 
+// buildSync assembles the cycle's SyncRequest.  When delta is true the
+// node tensors are encoded against the mirror's acked baseline (only
+// changed cells ride the wire) and Names are omitted (the server keeps
+// its resident copy); the tiny single-pod table always ships full.
+func buildSync(m *residentMirror, delta bool, names []string, alloc, requested, usage []int64, fresh []bool, pod *v1.Pod) *scorerclient.SyncRequest {
+	n := int64(len(names))
+	shape := []int64{n, numAxes}
+	var prevAlloc, prevReq, prevUsage []int64
+	wireNames := names
+	if delta {
+		prevAlloc, prevReq, prevUsage = m.alloc, m.requested, m.usage
+		wireNames = nil
+	}
+	podVec := podVector(pod)
+	return &scorerclient.SyncRequest{
+		Nodes: scorerclient.NodeTable{
+			Names:       wireNames,
+			Allocatable: scorerclient.DeltaTensor(shape, prevAlloc, alloc, scorerclient.DefaultMaxDeltaRatio),
+			Requested:   scorerclient.DeltaTensor(shape, prevReq, requested, scorerclient.DefaultMaxDeltaRatio),
+			Usage:       scorerclient.DeltaTensor(shape, prevUsage, usage, scorerclient.DefaultMaxDeltaRatio),
+			MetricFresh: fresh,
+		},
+		Pods: scorerclient.PodTable{
+			Names: []string{pod.Name},
+			Requests: scorerclient.Tensor{
+				Shape: []int64{1, numAxes},
+				Data:  scorerclient.LEInt64Bytes(podVec),
+			},
+			Estimated: scorerclient.Tensor{
+				Shape: []int64{1, numAxes},
+				Data:  scorerclient.LEInt64Bytes(podVec),
+			},
+			Priority: []int64{podPriority(pod)},
+			GangID:   []int32{-1},
+			QuotaID:  []int32{-1},
+		},
+	}
+}
+
 // PreScore ships the cycle's cluster view + the pod to the sidecar and
-// caches the pod's node-score row in CycleState.
+// caches the pod's node-score row in CycleState.  Warm cycles against an
+// unchanged node set sync sparse deltas onto the sidecar's resident
+// state (bridge/state.py) instead of re-shipping the full table.
 func (s *Scorer) PreScore(
 	ctx context.Context,
 	state *framework.CycleState,
@@ -189,49 +338,43 @@ func (s *Scorer) PreScore(
 			selected = append(selected, ni)
 		}
 	}
-	names, alloc, requested, usage := nodeInfoVectors(selected)
-	n := int64(len(names))
-	fresh := make([]bool, n)
-	podVec := podVector(pod)
+	names, alloc, requested, usage, fresh := nodeInfoVectors(selected, s.Metrics)
 
-	req := &scorerclient.SyncRequest{
-		Nodes: scorerclient.NodeTable{
-			Names: names,
-			Allocatable: scorerclient.Tensor{
-				Shape: []int64{n, numAxes},
-				Data:  scorerclient.LEInt64Bytes(alloc),
-			},
-			Requested: scorerclient.Tensor{
-				Shape: []int64{n, numAxes},
-				Data:  scorerclient.LEInt64Bytes(requested),
-			},
-			Usage: scorerclient.Tensor{
-				Shape: []int64{n, numAxes},
-				Data:  scorerclient.LEInt64Bytes(usage),
-			},
-			MetricFresh: fresh,
-		},
-		Pods: scorerclient.PodTable{
-			Names: []string{pod.Name},
-			Requests: scorerclient.Tensor{
-				Shape: []int64{1, numAxes},
-				Data:  scorerclient.LEInt64Bytes(podVec),
-			},
-			Estimated: scorerclient.Tensor{
-				Shape: []int64{1, numAxes},
-				Data:  scorerclient.LEInt64Bytes(podVec),
-			},
-			Priority: []int64{podPriority(pod)},
-			GangID:   []int32{-1},
-			QuotaID:  []int32{-1},
-		},
-	}
-	if _, err := client.Sync(req); err != nil {
+	// the scheduling cycle is serial (one PreScore at a time), so the
+	// mirror needs no extra lock beyond the client mutex already held
+	// around dial/drop
+	delta := s.mirror.valid && slices.Equal(s.mirror.names, names)
+	syncReply, err := client.Sync(buildSync(&s.mirror, delta, names, alloc, requested, usage, fresh, pod))
+	if err != nil {
+		// the sidecar may not have applied the deltas (a restart loses
+		// its resident tensors): next cycle must ship full state
+		s.mirror.invalidate()
 		s.dropClient(client)
 		return framework.AsStatus(fmt.Errorf("sync: %w", err))
 	}
+	gen := scorerclient.Generation(syncReply.SnapshotID)
+	if delta && gen != s.mirror.gen+1 {
+		// another client synced in between (or the sidecar restarted and
+		// rebuilt): our deltas landed on a base we never saw — re-sync
+		// the full table before trusting any scores
+		syncReply, err = client.Sync(buildSync(&s.mirror, false, names, alloc, requested, usage, fresh, pod))
+		if err != nil {
+			s.mirror.invalidate()
+			s.dropClient(client)
+			return framework.AsStatus(fmt.Errorf("full re-sync: %w", err))
+		}
+		gen = scorerclient.Generation(syncReply.SnapshotID)
+	}
+	s.mirror = residentMirror{
+		names: names, alloc: alloc, requested: requested, usage: usage,
+		gen: gen, valid: true,
+	}
 	reply, err := client.ScoreFlat(0)
 	if err != nil {
+		// FAILED_PRECONDITION (another client displaced our snapshot
+		// between Sync and Score) or transport failure: either way the
+		// baseline is unknown
+		s.mirror.invalidate()
 		s.dropClient(client)
 		return framework.AsStatus(fmt.Errorf("score: %w", err))
 	}

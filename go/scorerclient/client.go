@@ -5,6 +5,8 @@ import (
 	"fmt"
 	"io"
 	"net"
+	"strconv"
+	"strings"
 )
 
 // Method bytes of the raw framing (bridge/udsserver.py).
@@ -66,6 +68,19 @@ func (c *Client) call(method byte, payload []byte) ([]byte, error) {
 		return nil, fmt.Errorf("scorer error: %s", string(body))
 	}
 	return body, nil
+}
+
+// Generation parses a server snapshot id ("s<generation>",
+// bridge/server.py); -1 when absent or malformed.  Delta-syncing callers
+// compare successive generations to detect a displaced resident state
+// (another client synced in between, or the sidecar restarted) and fall
+// back to a full sync.
+func Generation(snapshotID string) int64 {
+	n, err := strconv.ParseInt(strings.TrimPrefix(snapshotID, "s"), 10, 64)
+	if err != nil {
+		return -1
+	}
+	return n
 }
 
 // Sync ships the cluster snapshot and records the acknowledged id.

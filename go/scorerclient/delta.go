@@ -1,0 +1,47 @@
+package scorerclient
+
+// Delta encoding for warm Sync cycles — the Go mirror of the sidecar's
+// resident-state codec (koordinator_tpu/bridge/state.py numpy_to_tensor
+// + native/koordnative.cpp delta_encode): when at most maxRatio of a
+// tensor changed since the last ACKED sync, ship sparse flat
+// (index, value) pairs instead of the full payload.  The server applies
+// them onto its resident mirror (state.py tensor_to_numpy), so a warm
+// cycle's node-table cost is proportional to what changed, not to the
+// cluster size — the delta-driven informer-bus economics of
+// reference pkg/client/informers +
+// reference pkg/scheduler/frameworkext/helper/forcesync_eventhandler.go.
+
+// DefaultMaxDeltaRatio mirrors bridge/state.py numpy_to_tensor's 0.25:
+// past a quarter changed, a full payload is cheaper than the index list.
+const DefaultMaxDeltaRatio = 0.25
+
+// DeltaTensor encodes next against prev (both flat C-order, len =
+// product(shape)).  prev == nil, a length mismatch, or too many changed
+// cells all fall back to a full Data payload.  A zero-change delta
+// encodes as empty DeltaIdx/DeltaVal — the server treats the tensor as
+// unchanged, costing nothing on the wire.
+func DeltaTensor(shape []int64, prev, next []int64, maxRatio float64) Tensor {
+	t := Tensor{Shape: shape}
+	if prev == nil || len(prev) != len(next) {
+		t.Data = LEInt64Bytes(next)
+		return t
+	}
+	maxChanges := int(float64(len(next)) * maxRatio)
+	if maxChanges < 1 {
+		maxChanges = 1
+	}
+	var idx, val []int64
+	for i := range next {
+		if next[i] != prev[i] {
+			idx = append(idx, int64(i))
+			val = append(val, next[i])
+			if len(idx) > maxChanges {
+				t.Data = LEInt64Bytes(next)
+				return t
+			}
+		}
+	}
+	t.DeltaIdx = LEInt64Bytes(idx)
+	t.DeltaVal = LEInt64Bytes(val)
+	return t
+}

@@ -1,0 +1,291 @@
+"""Executable spec of ``go/plugin/batchedtpuscorer.go``.
+
+The image has no Go toolchain, so the Go plugin cannot compile or run
+here.  This module re-states its PreScore protocol — vector building,
+delta-vs-full sync decision, generation-continuity check with full
+re-sync, mirror promotion/invalidation, flat-score row extraction —
+step for step in Python, using the independent wire codec
+(``bridge/wirecheck.py``), and drives it against the REAL raw-UDS server
+in ``tests/test_plugin_seam.py``.  Any behavior change in the Go file
+must land here too; the tests are the executable contract the Go code
+is reviewed against (the release gate in go/README.md additionally
+requires ``go test ./...`` where a toolchain exists).
+
+Go references (line-level mirrors):
+  * nodeInfoVectors       -> node_vectors
+  * DeltaTensor           -> delta_tensor (go/scorerclient/delta.go)
+  * buildSync             -> build_sync
+  * Scorer.PreScore       -> GoPluginSim.pre_score
+  * scorerclient.Generation -> generation
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from koordinator_tpu.bridge import wirecheck
+
+NUM_AXES = 13
+AXIS_CPU = 0
+AXIS_MEMORY = 1
+DEFAULT_MAX_DELTA_RATIO = 0.25
+
+METHOD_SYNC = 1
+METHOD_SCORE = 2
+METHOD_ASSIGN = 3
+
+
+def generation(snapshot_id: str) -> int:
+    """scorerclient.Generation: parse "s<generation>", -1 when malformed."""
+    try:
+        return int(snapshot_id.removeprefix("s"))
+    except ValueError:
+        return -1
+
+
+def delta_tensor(
+    shape: Sequence[int],
+    prev: Optional[Sequence[int]],
+    next_: Sequence[int],
+    max_ratio: float = DEFAULT_MAX_DELTA_RATIO,
+) -> Dict:
+    """go/scorerclient/delta.go DeltaTensor, exactly: full Data when prev
+    is absent/mismatched or more than max(1, int(size*ratio)) cells
+    changed; sparse flat (idx, val) otherwise (empty = unchanged)."""
+    next_ = list(next_)
+    t = {"shape": list(shape)}
+    if prev is None or len(prev) != len(next_):
+        t["data"] = np.asarray(next_, "<i8").tobytes()
+        return t
+    max_changes = max(1, int(len(next_) * max_ratio))
+    idx = [i for i, (a, b) in enumerate(zip(prev, next_)) if a != b]
+    if len(idx) > max_changes:
+        t["data"] = np.asarray(next_, "<i8").tobytes()
+        return t
+    t["delta_idx"] = np.asarray(idx, "<i8").tobytes()
+    t["delta_val"] = np.asarray([next_[i] for i in idx], "<i8").tobytes()
+    return t
+
+
+def node_vectors(
+    nodes: Sequence[Tuple[str, Sequence[int], Sequence[int]]],
+    metrics: Optional[Dict[str, Sequence[int]]],
+):
+    """nodeInfoVectors: (names, alloc, requested, usage, fresh) with
+    usage from the metrics provider when a fresh sample exists, else
+    requested with fresh=False (Fit-only for that node)."""
+    names: List[str] = []
+    alloc: List[int] = []
+    requested: List[int] = []
+    usage: List[int] = []
+    fresh: List[bool] = []
+    for name, a, r in nodes:
+        names.append(name)
+        alloc.extend(a)
+        requested.extend(r)
+        vec = (metrics or {}).get(name)
+        if vec is not None and len(vec) == NUM_AXES:
+            usage.extend(vec)
+            fresh.append(True)
+        else:
+            usage.extend(r)
+            fresh.append(False)
+    return names, alloc, requested, usage, fresh
+
+
+def build_sync(
+    mirror: "ResidentMirror",
+    delta: bool,
+    names: List[str],
+    alloc: List[int],
+    requested: List[int],
+    usage: List[int],
+    fresh: List[bool],
+    pod_name: str,
+    pod_vec: List[int],
+    priority: int,
+) -> bytes:
+    """buildSync: node tensors delta-encoded against the acked baseline
+    (names omitted) on warm cycles; the single-pod table always full."""
+    n = len(names)
+    shape = [n, NUM_AXES]
+    prev_alloc = prev_req = prev_usage = None
+    wire_names = names
+    if delta:
+        prev_alloc, prev_req, prev_usage = (
+            mirror.alloc,
+            mirror.requested,
+            mirror.usage,
+        )
+        wire_names = []
+    req = {
+        "nodes": {
+            "names": wire_names,
+            "allocatable": delta_tensor(shape, prev_alloc, alloc),
+            "requested": delta_tensor(shape, prev_req, requested),
+            "usage": delta_tensor(shape, prev_usage, usage),
+            "metric_fresh": fresh,
+        },
+        "pods": {
+            "names": [pod_name],
+            "requests": {
+                "shape": [1, NUM_AXES],
+                "data": np.asarray(pod_vec, "<i8").tobytes(),
+            },
+            "estimated": {
+                "shape": [1, NUM_AXES],
+                "data": np.asarray(pod_vec, "<i8").tobytes(),
+            },
+            "priority": [priority],
+            "gang_id": [-1],
+            "quota_id": [-1],
+        },
+    }
+    return wirecheck.encode_sync_request(req)
+
+
+class ResidentMirror:
+    """residentMirror: the last ACKED node table (delta baseline)."""
+
+    def __init__(self):
+        self.invalidate()
+
+    def invalidate(self) -> None:
+        self.names: List[str] = []
+        self.alloc: List[int] = []
+        self.requested: List[int] = []
+        self.usage: List[int] = []
+        self.gen = 0
+        self.valid = False
+
+
+class GoPluginSim:
+    """Scorer (the plugin struct) over a raw-UDS connection."""
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self.mirror = ResidentMirror()
+        # NodeMetricsProvider: node -> usage vector (fresh by presence;
+        # staleness windows are the cache's concern, not the plugin's)
+        self.metrics: Dict[str, Sequence[int]] = {}
+        self._conn: Optional[socket.socket] = None
+        # wire observability for tests: (method, payload_len) per frame
+        self.sent_frames: List[Tuple[int, int]] = []
+
+    # ensureClient / dropClient
+    def _client(self) -> socket.socket:
+        if self._conn is None:
+            self._conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._conn.connect(self.socket_path)
+        return self._conn
+
+    def _drop_client(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    def _call(self, method: int, payload: bytes) -> bytes:
+        conn = self._client()
+        self.sent_frames.append((method, len(payload)))
+        conn.sendall(struct.pack(">BI", method, len(payload)) + payload)
+        head = conn.recv(5, socket.MSG_WAITALL)
+        status, length = struct.unpack(">BI", head)
+        body = b""
+        while len(body) < length:
+            chunk = conn.recv(length - len(body))
+            if not chunk:
+                raise ConnectionError("connection closed mid-reply")
+            body += chunk
+        if status != 0:
+            raise RuntimeError(f"scorer error: {body.decode()}")
+        return body
+
+    def pre_score(
+        self,
+        nodes: Sequence[Tuple[str, Sequence[int], Sequence[int]]],
+        pod_name: str,
+        pod_vec: Sequence[int],
+        priority: int = 0,
+    ) -> Dict[str, int]:
+        """Scorer.PreScore: returns {node name: combined score} (the
+        CycleState row); raises on any seam failure, with the mirror
+        invalidated exactly where the Go code invalidates it."""
+        names, alloc, requested, usage, fresh = node_vectors(
+            nodes, self.metrics
+        )
+        pod_vec = list(pod_vec)
+        delta = self.mirror.valid and self.mirror.names == names
+        try:
+            reply = wirecheck.decode_sync_reply(
+                self._call(
+                    METHOD_SYNC,
+                    build_sync(
+                        self.mirror, delta, names, alloc, requested,
+                        usage, fresh, pod_name, pod_vec, priority,
+                    ),
+                )
+            )
+        except Exception:
+            self.mirror.invalidate()
+            self._drop_client()
+            raise
+        gen = generation(reply["snapshot_id"])
+        if delta and gen != self.mirror.gen + 1:
+            # resident state displaced: full re-sync before trusting scores
+            try:
+                reply = wirecheck.decode_sync_reply(
+                    self._call(
+                        METHOD_SYNC,
+                        build_sync(
+                            self.mirror, False, names, alloc, requested,
+                            usage, fresh, pod_name, pod_vec, priority,
+                        ),
+                    )
+                )
+            except Exception:
+                self.mirror.invalidate()
+                self._drop_client()
+                raise
+            gen = generation(reply["snapshot_id"])
+        self.mirror.names = names
+        self.mirror.alloc = alloc
+        self.mirror.requested = requested
+        self.mirror.usage = usage
+        self.mirror.gen = gen
+        self.mirror.valid = True
+        try:
+            score = wirecheck.decode_score_reply(
+                self._call(
+                    METHOD_SCORE,
+                    wirecheck.encode_score_request(
+                        {"snapshot_id": reply["snapshot_id"], "top_k": 0,
+                         "flat": True}
+                    ),
+                )
+            )
+        except Exception:
+            self.mirror.invalidate()
+            self._drop_client()
+            raise
+        flat = score["flat"]
+        if flat is None:
+            raise RuntimeError("scorer did not return the flat layout")
+        pod_index = np.frombuffer(flat["pod_index"], "<i4")
+        counts = np.frombuffer(flat["counts"], "<i4")
+        node_index = np.frombuffer(flat["node_index"], "<i4")
+        scores_arr = np.frombuffer(flat["score"], "<i8")
+        scores: Dict[str, int] = {}
+        off = 0
+        for g, p in enumerate(pod_index):
+            c = int(counts[g])
+            if p == 0:  # single-pod table: group 0 is our pod
+                for i in range(off, off + c):
+                    ni = int(node_index[i])
+                    if ni < len(names):
+                        scores[names[ni]] = int(scores_arr[i])
+            off += c
+        return scores

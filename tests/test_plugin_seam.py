@@ -1,0 +1,172 @@
+"""The Go plugin's PreScore protocol, executed against the real server.
+
+go/plugin/batchedtpuscorer.go cannot run here (no Go toolchain), so
+bridge/plugin_sim.py re-states its PreScore flow step for step and these
+tests drive that executable spec against the REAL raw-UDS server
+(bridge/udsserver.py + ScorerServicer): the usage feed (VERDICT round-4
+#3), warm-cycle delta sync (#2), generation displacement, and sidecar
+restart all execute end to end.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.bridge.plugin_sim import GoPluginSim, NUM_AXES
+from koordinator_tpu.bridge.udsserver import RawUdsServer
+
+
+def vec(cpu=0, mem=0, pods=0):
+    v = [0] * NUM_AXES
+    v[0], v[1], v[3] = cpu, mem, pods
+    return v
+
+
+ALLOC = vec(cpu=8000, mem=16384, pods=110)
+REQ = vec(cpu=1000, mem=1024, pods=5)
+POD = vec(cpu=500, mem=512, pods=1)
+
+
+@pytest.fixture()
+def server():
+    path = os.path.join(tempfile.mkdtemp(), "scorer.sock")
+    srv = RawUdsServer(path).start()
+    yield path, srv
+    srv.stop()
+
+
+NODES = [("node-cold", ALLOC, REQ), ("node-hot", ALLOC, REQ)]
+
+
+class TestUsageFeed:
+    def test_hot_underrequested_node_scores_below_cold(self, server):
+        """load_aware.go:269-337 semantics: with identical requests, the
+        node whose MEASURED utilization is high must score below the
+        cold one — the behavior usage:=requested erased (round-4 #3)."""
+        path, _ = server
+        sim = GoPluginSim(path)
+        # hot node sits just under the 65% cpu Filter threshold so the
+        # comparison exercises Score, not Filter
+        sim.metrics = {
+            "node-cold": vec(cpu=500, mem=512),
+            "node-hot": vec(cpu=4800, mem=8192),
+        }
+        scores = sim.pre_score(NODES, "pod-x", POD)
+        assert set(scores) == {"node-cold", "node-hot"}
+        assert scores["node-cold"] > scores["node-hot"]
+
+    def test_overloaded_node_is_filtered_entirely(self, server):
+        """Usage over the 65% cpu threshold (DEFAULT_USAGE_THRESHOLDS,
+        load_aware.go:185-222) removes the node from the score row —
+        visible only because real usage now reaches the sidecar."""
+        path, _ = server
+        sim = GoPluginSim(path)
+        sim.metrics = {
+            "node-cold": vec(cpu=500, mem=512),
+            "node-hot": vec(cpu=7000, mem=14336),
+        }
+        scores = sim.pre_score(NODES, "pod-x", POD)
+        assert set(scores) == {"node-cold"}
+
+    def test_without_metrics_loadaware_is_neutral(self, server):
+        """No NodeMetric feed -> MetricFresh=false -> the sidecar zeroes
+        the LoadAware term instead of trusting usage==requested: both
+        identical nodes score the same (Fit-only)."""
+        path, _ = server
+        sim = GoPluginSim(path)
+        scores = sim.pre_score(NODES, "pod-x", POD)
+        assert scores["node-cold"] == scores["node-hot"]
+
+
+class TestDeltaSync:
+    def test_warm_cycle_ships_sparse_delta(self, server):
+        """Cycle 2 against an unchanged node set must sync a sparse
+        delta whose size tracks what CHANGED, not the cluster size
+        (round-4 #2: the plugin used to re-ship the full table every
+        pod cycle) — and produce scores identical to a cold full sync."""
+        path, _ = server
+        many = [(f"node-{i}", ALLOC, REQ) for i in range(64)]
+        sim = GoPluginSim(path)
+        sim.pre_score(many, "pod-x", POD)
+        full_frame = sim.sent_frames[0][1]
+        assert full_frame > 64 * 13 * 8 * 3  # three full [64,13] tensors
+
+        # one node's committed load moves; everything else is unchanged
+        nodes2 = list(many)
+        nodes2[5] = ("node-5", ALLOC, vec(cpu=1500, mem=1536, pods=6))
+        scores_delta = sim.pre_score(nodes2, "pod-y", POD)
+        assert len(sim.sent_frames) == 4  # sync, score, sync, score
+        delta_frame = sim.sent_frames[2][1]
+        # 3 changed cells ride as (idx, val) pairs + the constant-size
+        # single-pod table; the 20 KB node table stays home
+        assert delta_frame < full_frame / 10, (
+            f"warm sync {delta_frame}B should be far below full {full_frame}B"
+        )
+
+        # a cold client syncing the same view must agree exactly
+        cold = GoPluginSim(path)
+        assert cold.pre_score(nodes2, "pod-y", POD) == scores_delta
+
+    def test_all_changed_falls_back_to_full(self, server):
+        """More than a quarter of the table changed -> DeltaTensor ships
+        the full payload (the 0.25 ratio of bridge/state.py)."""
+        path, _ = server
+        sim = GoPluginSim(path)
+        sim.pre_score(NODES, "pod-x", POD)
+        # every axis of both nodes moves: 26/26 cells changed per tensor,
+        # far past the max(1, int(26*0.25)) = 6 change cap
+        a2 = [9000 + i for i in range(13)]
+        r2 = [3000 + i for i in range(13)]
+        a3 = [7000 + i for i in range(13)]
+        r3 = [2000 + i for i in range(13)]
+        nodes2 = [("node-cold", a2, r2), ("node-hot", a3, r3)]
+        sim.pre_score(nodes2, "pod-y", POD)
+        # the warm sync is still smaller than the cold one (names are
+        # omitted) but carries full tensors: much bigger than a delta
+        warm = sim.sent_frames[2][1]
+        assert warm > 3 * 26 * 8  # three full [2,13] i64 tensors at least
+
+
+class TestGenerationDisplacement:
+    def test_foreign_sync_triggers_full_resync(self, server):
+        """Another client syncs between our cycles: the generation jump
+        must trigger a full re-sync (our deltas landed on a base we
+        never saw), and the scores must match a cold client's."""
+        path, _ = server
+        sim = GoPluginSim(path)
+        sim.pre_score(NODES, "pod-x", POD)
+
+        other = GoPluginSim(path)
+        other.pre_score(
+            [("node-other", ALLOC, REQ), ("node-other2", ALLOC, REQ)],
+            "pod-foreign",
+            POD,
+        )
+
+        sim.sent_frames.clear()
+        scores = sim.pre_score(NODES, "pod-y", POD)
+        # delta sync + full re-sync + score = 3 frames
+        methods = [m for m, _ in sim.sent_frames]
+        assert methods == [1, 1, 2]
+        cold = GoPluginSim(path)
+        assert cold.pre_score(NODES, "pod-y", POD) == scores
+
+    def test_sidecar_restart_recovers_with_full_sync(self, server):
+        """A restarted sidecar loses its resident tensors AND the
+        connection: the first warm cycle fails, invalidates the mirror,
+        and the next cycle re-dials and ships full state."""
+        path, srv = server
+        sim = GoPluginSim(path)
+        sim.pre_score(NODES, "pod-x", POD)
+        srv.stop()
+        srv2 = RawUdsServer(path).start()
+        try:
+            with pytest.raises(Exception):
+                sim.pre_score(NODES, "pod-y", POD)
+            assert not sim.mirror.valid
+            scores = sim.pre_score(NODES, "pod-y", POD)
+            assert set(scores) == {"node-cold", "node-hot"}
+        finally:
+            srv2.stop()
