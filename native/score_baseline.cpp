@@ -30,7 +30,20 @@
 // reference), but each pod's Filter+Score scan over nodes is chunked
 // across threads with a first-index tie-break-preserving reduction.
 //
-// Usage: score_baseline <sync_request_file> [iters] [threads]
+// The optional 4th argument is an extras file (harness/extras_scenario.py
+// write_extras_file): the RAW NUMA-zone / device / reservation tables,
+// from which this binary independently re-derives the extended-plugin
+// mask and scores — zone admission + zone scoring
+// (reference pkg/scheduler/plugins/nodenumaresource/scoring.go:55),
+// device count-fit + scoreNode
+// (reference pkg/scheduler/plugins/deviceshare/device_cache.go:329-352,
+// scoring.go:179), and reservation nomination/preferred-node scoring
+// (reference pkg/scheduler/plugins/reservation/scoring.go:42,105,177) —
+// then composes them exactly like FrameworkExtender (masks AND, weighted
+// scores SUM) into the cycle.  Parity with the JAX extras path is
+// asserted by tests/test_native_extras.py and bench --config extras.
+//
+// Usage: score_baseline <sync_request_file> [iters] [threads] [extras_file]
 // Output line 1: {"metric": "cpu_baseline_cycle_ms", ...}
 // Output line 2: assign <i0> <i1> ...
 
@@ -40,6 +53,7 @@
 #include <cstdio>
 #include <cstring>
 #include <fstream>
+#include <map>
 #include <numeric>
 #include <sstream>
 #include <string>
@@ -102,6 +116,294 @@ int64_t least_requested(int64_t req, int64_t cap) {
   return (cap - req) * kMaxNodeScore / cap;
 }
 
+// ---- extras file (harness/extras_scenario.py write_extras_file) ----
+
+struct Arr {
+  std::vector<int64_t> dims;
+  std::vector<int64_t> data;
+  int64_t dim(size_t i) const { return i < dims.size() ? dims[i] : 1; }
+  int64_t at(int64_t a) const { return data[a]; }
+  int64_t at(int64_t a, int64_t b) const { return data[a * dim(1) + b]; }
+  int64_t at(int64_t a, int64_t b, int64_t c) const {
+    return data[(a * dim(1) + b) * dim(2) + c];
+  }
+  bool empty() const { return data.empty(); }
+};
+
+struct Extras {
+  std::map<std::string, Arr> sections;
+  bool loaded = false;
+  const Arr& get(const char* name) const {
+    static const Arr kEmpty;
+    auto it = sections.find(name);
+    return it == sections.end() ? kEmpty : it->second;
+  }
+};
+
+Extras load_extras(const char* path) {
+  Extras e;
+  std::ifstream in(path, std::ios::binary);
+  if (!in) {
+    std::fprintf(stderr, "cannot open extras file %s\n", path);
+    std::exit(2);
+  }
+  char magic[6];
+  in.read(magic, 6);
+  if (std::memcmp(magic, "KEXT1\n", 6) != 0) {
+    std::fprintf(stderr, "bad extras magic\n");
+    std::exit(2);
+  }
+  while (true) {
+    uint32_t name_len = 0;
+    in.read(reinterpret_cast<char*>(&name_len), 4);
+    if (!in) break;
+    std::string name(name_len, '\0');
+    in.read(name.data(), name_len);
+    uint32_t ndim = 0;
+    in.read(reinterpret_cast<char*>(&ndim), 4);
+    Arr a;
+    a.dims.resize(ndim);
+    in.read(reinterpret_cast<char*>(a.dims.data()), 8 * ndim);
+    int64_t count = 1;
+    for (int64_t d : a.dims) count *= d;
+    a.data.resize(count);
+    in.read(reinterpret_cast<char*>(a.data.data()), 8 * count);
+    if (!in) {
+      std::fprintf(stderr, "truncated extras section %s\n", name.c_str());
+      std::exit(2);
+    }
+    e.sections[name] = std::move(a);
+  }
+  e.loaded = true;
+  return e;
+}
+
+// FrameworkExtender composition: masks AND, weight-1 scores SUM.
+// mask/score are row-major [P, N].
+struct ExtraTensors {
+  std::vector<uint8_t> mask;
+  std::vector<int64_t> score;
+  bool present = false;
+};
+
+// Weighted mean over the R axis with integer division
+// (ops/scoring.py weighted_resource_score).
+int64_t weighted_mean(const int64_t* per_res, const int64_t* w, int64_t R) {
+  int64_t wsum = 0, total = 0;
+  for (int64_t r = 0; r < R; ++r) {
+    wsum += w[r];
+    total += per_res[r] * w[r];
+  }
+  if (wsum == 0) return 0;
+  return total / wsum;
+}
+
+ExtraTensors compute_extras(const Extras& e, const Mat& preq) {
+  ExtraTensors out;
+  const int64_t P = preq.rows, R = preq.cols;
+  const Arr& zalloc = e.get("zone_alloc");
+  const Arr& zreq = e.get("zone_req");
+  const Arr& zvalid = e.get("zone_valid");
+  const Arr& policy = e.get("numa_policy");
+  const Arr& weights = e.get("fit_weights");
+  const int64_t N = zalloc.dim(0), Z = zalloc.dim(1);
+  out.mask.assign(P * N, 1);
+  out.score.assign(P * N, 0);
+  out.present = true;
+
+  // --- NodeNUMAResource: admit mask + zone scores (ops/numa.py) ---
+  std::vector<int64_t> union_free(N * R, 0);
+  std::vector<uint8_t> has_zones(N, 0);
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t z = 0; z < Z; ++z)
+      if (zvalid.at(n, z)) {
+        has_zones[n] = 1;
+        for (int64_t r = 0; r < R; ++r)
+          union_free[n * R + r] += zalloc.at(n, z, r) - zreq.at(n, z, r);
+      }
+  std::vector<int64_t> per_res(R);
+  for (int64_t p = 0; p < P; ++p) {
+    const int64_t* pr = &preq.data[p * R];
+    for (int64_t n = 0; n < N; ++n) {
+      bool single = false;
+      int64_t best_zone = -1;  // max over fitting zones of weighted score
+      for (int64_t z = 0; z < Z; ++z) {
+        if (!zvalid.at(n, z)) continue;
+        bool fits = true;
+        for (int64_t r = 0; r < R; ++r)
+          if (pr[r] > zalloc.at(n, z, r) - zreq.at(n, z, r)) {
+            fits = false;
+            break;
+          }
+        if (!fits) continue;
+        single = true;
+        for (int64_t r = 0; r < R; ++r)
+          per_res[r] =
+              least_requested(zreq.at(n, z, r) + pr[r], zalloc.at(n, z, r));
+        best_zone =
+            std::max(best_zone, weighted_mean(per_res.data(), weights.data.data(), R));
+      }
+      bool union_fit = true;
+      for (int64_t r = 0; r < R; ++r)
+        if (pr[r] > union_free[n * R + r]) {
+          union_fit = false;
+          break;
+        }
+      const int64_t pol = policy.at(n);
+      bool admitted = pol == 3 ? single : (pol == 2 ? union_fit : true);
+      if (!(admitted || !has_zones[n])) out.mask[p * N + n] = 0;
+      out.score[p * N + n] += std::max<int64_t>(best_zone, 0);
+    }
+  }
+
+  // --- Reservation: nomination scores + preferred node (ops/reservation.py) ---
+  const Arr& rnode = e.get("rsv_node");
+  const int64_t V = rnode.dims.empty() ? 0 : rnode.dim(0);
+  if (V > 0) {
+    const Arr& ralloc = e.get("rsv_allocatable");
+    const Arr& ralloced = e.get("rsv_allocated");
+    const Arr& rdecl = e.get("rsv_declared");
+    const Arr& rpol = e.get("rsv_policy");
+    const Arr& rorder = e.get("rsv_order");
+    const Arr& runsched = e.get("rsv_unschedulable");
+    const Arr& rvalid = e.get("rsv_valid");
+    const Arr& rmatch = e.get("rsv_matched");
+    constexpr int64_t kLongMax = int64_t{1} << 62;
+    for (int64_t p = 0; p < P; ++p) {
+      const int64_t* pr = &preq.data[p * R];
+      std::vector<int64_t> vfit(V, 0), vscore(V, 0);
+      int64_t best_order = kLongMax, best_v = 0;
+      for (int64_t v = 0; v < V; ++v) {
+        bool fits_declared = true;
+        for (int64_t r = 0; r < R; ++r)
+          if (rdecl.at(v, r) &&
+              pr[r] > ralloc.at(v, r) - ralloced.at(v, r)) {
+            fits_declared = false;
+            break;
+          }
+        const bool constrained = rpol.at(v) == 1 || rpol.at(v) == 2;
+        const bool ok = (constrained ? fits_declared : true) &&
+                        rmatch.at(p, v) && rvalid.at(v) && !runsched.at(v);
+        vfit[v] = ok;
+        int64_t ndecl = 0, sum = 0;
+        for (int64_t r = 0; r < R; ++r) {
+          ndecl += rdecl.at(v, r) != 0;
+          const int64_t cap = ralloc.at(v, r);
+          const int64_t requested = pr[r] + ralloced.at(v, r);
+          if (rdecl.at(v, r) && requested <= cap)
+            sum += kMaxNodeScore * requested / std::max<int64_t>(cap, 1);
+        }
+        vscore[v] = rvalid.at(v) ? sum / std::max<int64_t>(ndecl, 1) : 0;
+        // preferred: smallest nonzero order among fitting matches
+        // (first index wins ties, like jnp.argmin)
+        const int64_t ord =
+            (rorder.at(v) != 0 && ok) ? rorder.at(v) : kLongMax;
+        if (ord < best_order) {
+          best_order = ord;
+          best_v = v;
+        }
+      }
+      std::vector<int64_t> node_best(N, -1);
+      for (int64_t v = 0; v < V; ++v) {
+        const int64_t n = rnode.at(v);
+        if (vfit[v] && rvalid.at(v) && n >= 0 && n < N)
+          node_best[n] = std::max(node_best[n], vscore[v]);
+      }
+      const int64_t preferred =
+          best_order < kLongMax ? rnode.at(best_v) : -1;
+      for (int64_t n = 0; n < N; ++n) {
+        int64_t s = std::max<int64_t>(node_best[n], 0);
+        if (n == preferred) s = kMaxNodeScore;
+        out.score[p * N + n] += s;
+      }
+    }
+  }
+
+  // --- DeviceShare: count-fit + scoreNode (ops/deviceshare.py) ---
+  const Arr& dtotal = e.get("dev_total");
+  if (!dtotal.empty()) {
+    const Arr& dfree = e.get("dev_free");
+    const Arr& dtype = e.get("dev_type");
+    const Arr& dvalid = e.get("dev_valid");
+    const Arr& daxis = e.get("dev_axis");
+    const int64_t D = dtotal.dim(1), C = dtotal.dim(2);
+    constexpr int64_t kMem = 1, kRatio = 2;  // canonical device axis
+    // per-type dims: gpu = {0,1,2}, rdma = {3}, fpga = {4}
+    const std::vector<std::vector<int64_t>> type_dims = {{0, 1, 2}, {3}, {4}};
+    std::vector<int64_t> card_mem(N, 0);
+    std::vector<int64_t> sum_total(N * C, 0), sum_free(N * C, 0);
+    for (int64_t n = 0; n < N; ++n)
+      for (int64_t d = 0; d < D; ++d) {
+        if (!dvalid.at(n, d)) continue;
+        if (dtype.at(n, d) == 0)
+          card_mem[n] = std::max(card_mem[n], dtotal.at(n, d, kMem));
+        for (int64_t c = 0; c < C; ++c) {
+          sum_total[n * C + c] += dtotal.at(n, d, c);
+          sum_free[n * C + c] += dfree.at(n, d, c);
+        }
+      }
+    std::vector<int64_t> dev_req(C), norm(C), per_card(C);
+    for (int64_t p = 0; p < P; ++p) {
+      const int64_t* pr = &preq.data[p * R];
+      for (int64_t c = 0; c < C; ++c) dev_req[c] = pr[daxis.at(c)];
+      bool any_dev = false;
+      for (int64_t c = 0; c < C; ++c) any_dev |= dev_req[c] > 0;
+      for (int64_t n = 0; n < N; ++n) {
+        // normalize_gpu_requests: fill memory <-> ratio from card memory
+        const int64_t card = std::max<int64_t>(card_mem[n], 1);
+        for (int64_t c = 0; c < C; ++c) norm[c] = dev_req[c];
+        if (dev_req[kMem] > 0)
+          norm[kRatio] = dev_req[kMem] * 100 / card;
+        else
+          norm[kMem] = dev_req[kRatio] * card_mem[n] / 100;
+        // split_per_card: ratio multiples of 100 span ratio/100 cards
+        const int64_t ratio = norm[kRatio];
+        const int64_t wanted =
+            (ratio >= 100 && ratio % 100 == 0) ? ratio / 100 : 1;
+        for (int64_t c = 0; c < C; ++c)
+          per_card[c] = c <= 2 ? norm[c] / std::max<int64_t>(wanted, 1)
+                               : norm[c];
+        if (any_dev) {
+          // device_cache.go:329-352 count fit per requested type
+          for (size_t t = 0; t < type_dims.size() && out.mask[p * N + n];
+               ++t) {
+            bool requested_type = false;
+            for (int64_t c : type_dims[t]) requested_type |= dev_req[c] > 0;
+            if (!requested_type) continue;
+            int64_t count = 0;
+            for (int64_t d = 0; d < D; ++d) {
+              if (!dvalid.at(n, d) ||
+                  dtype.at(n, d) != static_cast<int64_t>(t))
+                continue;
+              bool sat = true;
+              for (int64_t c : type_dims[t])
+                if (per_card[c] > dfree.at(n, d, c)) {
+                  sat = false;
+                  break;
+                }
+              count += sat;
+            }
+            const int64_t type_wanted = t == 0 ? wanted : 1;
+            if (count < type_wanted) out.mask[p * N + n] = 0;
+          }
+        }
+        // scoreNode: least-allocated over summed minors, dims the pod
+        // requests weighted 1 (scoring.go:179)
+        int64_t wsum = 0, total = 0;
+        for (int64_t c = 0; c < C; ++c) {
+          if (norm[c] <= 0) continue;
+          wsum += 1;
+          const int64_t cap = sum_total[n * C + c];
+          const int64_t used = cap - sum_free[n * C + c] + norm[c];
+          total += least_requested(used, cap);
+        }
+        if (wsum > 0) out.score[p * N + n] += total / wsum;
+      }
+    }
+  }
+  return out;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -136,6 +438,18 @@ int main(int argc, char** argv) {
   const Mat qlim = decode(req.quotas().limited());
   const int64_t N = alloc.rows, R = alloc.cols, P = preq.rows;
   const int64_t Q = qrt.rows;
+
+  ExtraTensors xt;
+  if (argc > 4) {
+    const Extras extras = load_extras(argv[4]);
+    if (extras.get("zone_alloc").dim(0) != N) {
+      std::fprintf(stderr, "extras node bucket %lld != snapshot N %lld\n",
+                   static_cast<long long>(extras.get("zone_alloc").dim(0)),
+                   static_cast<long long>(N));
+      return 2;
+    }
+    xt = compute_extras(extras, preq);
+  }
 
   std::vector<bool> fresh(N, true);
   for (int i = 0; i < req.nodes().metric_fresh_size() && i < N; ++i)
@@ -182,11 +496,12 @@ int main(int argc, char** argv) {
     // thread chunk under OpenMP.
     const auto scan_range = [&](int64_t p, const int64_t* pr,
                                 const int64_t* pe, int64_t n0, int64_t n1) {
-      (void)p;
       int64_t best_score = INT64_MIN;
       int64_t chosen = -1;
       for (int64_t n = n0; n < n1; ++n) {
         if (!node_ok[n]) continue;
+        // extended-plugin admission (FrameworkExtender: masks AND)
+        if (xt.present && !xt.mask[p * N + n]) continue;
         const int64_t* nr = &nreq[n * R];
         bool fits = true;
         for (int64_t r = 0; r < R; ++r) {
@@ -217,7 +532,9 @@ int main(int argc, char** argv) {
                             alloc.at(n, kMem))) /
                kWSum;
         }
-        const int64_t total = fit + la;
+        int64_t total = fit + la;
+        // extended-plugin scores (FrameworkExtender: weight-1 SUM)
+        if (xt.present) total += xt.score[p * N + n];
         if (total > best_score) {  // strict >: first-index tie-break
           best_score = total;
           chosen = n;
