@@ -525,14 +525,28 @@ def child_config(platform: str, config: str) -> None:
         snap, nodes, pods, gangs, quotas, qdicts = _quota_snapshot(
             encode_snapshot, generators, res, build_quota_table_inputs
         )
+        N = snap.nodes.allocatable.shape[0]
+        P = snap.pods.capacity
+        t0 = time.perf_counter()
+        # the scenario mutates nodes/pods (device resources on both) so
+        # every plugin leg is load-bearing — re-encode the snapshot and
+        # quota tables from the mutated lists
+        zones, policy, devices, rsv, nodes, pods = extras_scenario(
+            nodes, pods, seed=0, node_bucket=N, pod_bucket=P,
+        )
+        pod_reqs = [res.resource_vector(p["requests"]) for p in pods]
+        qidx = {q["name"]: i for i, q in enumerate(quotas)}
+        qids = [qidx.get(p.get("quota"), -1) for p in pods]
+        total = [0] * res.NUM_RESOURCES
+        for n in nodes:
+            v = res.resource_vector(n["allocatable"])
+            total = [a + b for a, b in zip(total, v)]
+        qdicts = build_quota_table_inputs(quotas, pod_reqs, qids, total)
+        snap = encode_snapshot(
+            nodes, pods, gangs, qdicts, node_bucket=N, pod_bucket=P
+        )
         if backend != "cpu":
             assert pallas_inputs_fit_i32(snap), "snapshot out of i32 range"
-        N = snap.nodes.allocatable.shape[0]
-        t0 = time.perf_counter()
-        zones, policy, devices, rsv = extras_scenario(
-            nodes, pods, seed=0,
-            node_bucket=N, pod_bucket=snap.pods.capacity,
-        )
         xmask, xscore = plugin_extra_tensors(snap, zones, policy, devices, rsv)
         phase("extras_tensors", ms=_ms(t0))
         run = (

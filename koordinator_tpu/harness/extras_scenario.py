@@ -54,15 +54,23 @@ def extras_scenario(
     seed: int = 0,
     node_bucket: int = 0,
     pod_bucket: int = 0,
-) -> Tuple[ZoneBatch, np.ndarray, DeviceBatch, ReservationTable]:
-    """Deterministic extras tables aligned to an existing node/pod list.
+) -> Tuple[ZoneBatch, np.ndarray, DeviceBatch, ReservationTable, List[Dict], List[Dict]]:
+    """Deterministic extras tables for an existing node/pod list, plus
+    the MUTATED node/pod lists that make every plugin leg load-bearing
+    (callers must encode the snapshot from the returned lists):
 
-    * every node gets 2 NUMA zones splitting its allocatable, with a
-      policy mix over the node index (none / best-effort / restricted /
-      single-numa-node);
+    * every node gets 2 NUMA zones splitting its FULL allocatable vector
+      (cpu, memory, pods, device axes — ``zone_fit_mask`` checks every
+      requested axis), with a policy mix over the node index
+      (none / best-effort / restricted / single-numa-node);
     * every 4th node carries 4 GPU minors (some partially used) and one
-      RDMA NIC;
+      RDMA NIC, and advertises the device resources in its allocatable;
+    * every 8th pod requests one GPU card (every 32nd two cards, every
+      64th also an RDMA share), so device count-fit and scoreNode have
+      real work on both implementations;
     * one reservation per 16th node, matched to every 8th pod.
+
+    Returns ``(zones, policy, devices, rsv, nodes_out, pods_out)``.
     """
     from koordinator_tpu.model.snapshot import pad_bucket
 
@@ -72,21 +80,58 @@ def extras_scenario(
     node_bucket = node_bucket or pad_bucket(N)
     pod_bucket = pod_bucket or pad_bucket(P)
 
-    zone_specs = []
+    # device-carrying nodes advertise the resources node-level (the
+    # reference's device webhook patches Node status the same way)
+    nodes_out: List[Dict] = []
     for i, nd in enumerate(nodes):
-        alloc = nd["allocatable"]
-        cpu = res.parse_quantity(alloc.get("cpu", 0), "cpu")
-        mem = res.parse_quantity(alloc.get("memory", 0), "memory")
-        used_cpu = int(rng.randint(0, max(cpu // 4, 1)))
+        nd = dict(nd)
+        if i % 4 == 0:
+            alloc = dict(nd["allocatable"])
+            alloc["koordinator.sh/gpu-core"] = 400
+            alloc["koordinator.sh/gpu-memory"] = 4 * 16 * Gi
+            alloc["koordinator.sh/gpu-memory-ratio"] = 400
+            alloc["koordinator.sh/rdma"] = 100
+            nd["allocatable"] = alloc
+        nodes_out.append(nd)
+
+    # every 8th pod requests a GPU card; the koordlet-side webhook fills
+    # memory from ratio, so ratio+core is the canonical request shape
+    pods_out: List[Dict] = []
+    for p, pod in enumerate(pods):
+        pod = dict(pod)
+        if p % 8 == 0:
+            reqs = dict(pod.get("requests", {}))
+            cards = 2 if p % 32 == 0 else 1
+            reqs["koordinator.sh/gpu-core"] = 100 * cards
+            reqs["koordinator.sh/gpu-memory-ratio"] = 100 * cards
+            if p % 64 == 0:
+                reqs["koordinator.sh/rdma"] = 50
+            pod["requests"] = reqs
+        pods_out.append(pod)
+
+    zone_specs = []
+    for i, nd in enumerate(nodes_out):
+        full = res.resource_vector(nd["allocatable"])
+        used_cpu = int(rng.randint(0, max(full[res.RESOURCE_INDEX[res.CPU]] // 4, 1)))
+        # axis units (cpu milli, MiB) back through format_quantity so
+        # encode_zones' resource_vector round-trips them exactly
+        half0 = {
+            name: res.format_quantity(int(full[res.RESOURCE_INDEX[name]]) // 2, name)
+            for name in res.RESOURCE_AXIS
+            if full[res.RESOURCE_INDEX[name]]
+        }
+        half1 = {
+            name: res.format_quantity(
+                int(full[res.RESOURCE_INDEX[name]])
+                - int(full[res.RESOURCE_INDEX[name]]) // 2,
+                name,
+            )
+            for name in res.RESOURCE_AXIS
+            if full[res.RESOURCE_INDEX[name]]
+        }
         zones = [
-            {
-                "allocatable": {"cpu": f"{cpu // 2}m", "memory": mem // 2},
-                "requested": {"cpu": f"{used_cpu}m", "memory": 0},
-            },
-            {
-                "allocatable": {"cpu": f"{cpu - cpu // 2}m", "memory": mem - mem // 2},
-                "requested": {"cpu": 0, "memory": 0},
-            },
+            {"allocatable": half0, "requested": {"cpu": f"{used_cpu}m"}},
+            {"allocatable": half1, "requested": {}},
         ]
         zone_specs.append({"zones": zones})
     zbatch = encode_zones(zone_specs, node_bucket=node_bucket)
@@ -132,7 +177,7 @@ def extras_scenario(
     # reservations match pods by owner label selector (the reference's
     # MatchReservationOwners label path); tag every 8th pod round-robin
     rsv_specs = []
-    node_names = [nd["name"] for nd in nodes]
+    node_names = [nd["name"] for nd in nodes_out]
     n_rsv = max(1, len(range(0, N, 16)))
     for k, i in enumerate(range(0, N, 16)):
         rsv_specs.append(
@@ -146,18 +191,15 @@ def extras_scenario(
                 "owners": [{"label_selector": {"rsv-owner": f"rsv-{k}"}}],
             }
         )
-    pods_tagged = []
-    for p, pod in enumerate(pods):
-        pod = dict(pod)
+    for p, pod in enumerate(pods_out):
         if p % 8 == 0:
             labels = dict(pod.get("labels", {}))
             labels["rsv-owner"] = f"rsv-{(p // 8) % n_rsv}"
             pod["labels"] = labels
-        pods_tagged.append(pod)
     rsv = encode_reservations(
-        rsv_specs, pods_tagged, node_names, pod_bucket=pod_bucket
+        rsv_specs, pods_out, node_names, pod_bucket=pod_bucket
     )
-    return zbatch, policy, dbatch, rsv
+    return zbatch, policy, dbatch, rsv, nodes_out, pods_out
 
 
 def plugin_extra_tensors(snapshot, zones, policy, devices, rsv, cfg=None):

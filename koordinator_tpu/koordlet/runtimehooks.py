@@ -129,12 +129,22 @@ DEVICE_ALLOCATED_ANNOTATION = "scheduling.koordinator.sh/device-allocated"
 
 def device_env_hook(ctx: ContainerContext) -> None:
     """Expose allocated accelerator minors to the container (reference
-    hooks/gpu: sets NVIDIA_VISIBLE_DEVICES; TPU_VISIBLE_CHIPS here)."""
+    hooks/gpu/gpu.go InjectContainerGPUEnv: parses the DeviceAllocations
+    annotation — apis/extension/device_share.go:56-66, type name ->
+    [{"minor", "resources"}] — and sets NVIDIA_VISIBLE_DEVICES;
+    TPU_VISIBLE_CHIPS here).  Only accelerator (gpu) minors are joined —
+    an RDMA NIC id in the visible-devices list would expose the wrong
+    device."""
     raw = ctx.pod_annotations.get(DEVICE_ALLOCATED_ANNOTATION)
     if not raw:
         return
     alloc = raw if isinstance(raw, dict) else json.loads(raw)
-    minors = alloc.get("minors")
+    entries = alloc.get("gpu")
+    if entries is not None:
+        minors = [e["minor"] for e in entries]
+    else:
+        # pre-round-5 rebuild payloads carried a flat accelerator list
+        minors = alloc.get("minors")
     if minors:
         visible = ",".join(str(m) for m in minors)
         ctx.env["TPU_VISIBLE_CHIPS"] = visible

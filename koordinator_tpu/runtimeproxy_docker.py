@@ -160,7 +160,14 @@ class DockerProxyServer:
             container_name=labels.get("io.kubernetes.container.name", ""),
             qos=labels.get("koordinator.sh/qosClass", ""),
             pod_labels=dict(labels),
-            pod_annotations={},
+            # dockershim stores pod annotations as "annotation."-prefixed
+            # labels; annotation-reading hooks (cpuset, device env) need
+            # them back under their bare keys
+            pod_annotations={
+                k[len("annotation."):]: v
+                for k, v in labels.items()
+                if k.startswith("annotation.")
+            },
             cgroup_dir=host_config.get("CgroupParent", ""),
             cfs_quota_us=host_config.get("CpuQuota"),
             cpu_shares=host_config.get("CpuShares"),

@@ -284,18 +284,27 @@ class DeviceSharePlugin(TensorPlugin):
                     left = int(res.parse_quantity(free.get(dim, 0), dim)) - q
                     # write back a form parse_quantity round-trips exactly
                     free[dim] = res.format_quantity(left, dim)
-        ctx.state.setdefault("device_allocations", {})[pod_idx] = {
-            # "minors" stays ACCELERATOR-only: device_env_hook joins it
-            # into NVIDIA_VISIBLE_DEVICES / TPU_VISIBLE_CHIPS, where an
-            # RDMA NIC id would expose the wrong device
-            "minors": sorted(chosen_by_type.get(DEVICE_GPU, [])),
-            "by_type": dict(chosen_by_type),
-            "per_card": {
-                name: int(per_card_vec[i])
-                for i, name in enumerate(DEVICE_RESOURCE_AXIS)
-                if per_card_vec[i] > 0
-            },
-        }
+        # the reference's DeviceAllocations annotation payload
+        # (apis/extension/device_share.go:56-66: type name -> entries of
+        # {"minor", "resources"}), written at PreBind and consumed by the
+        # koordlet gpu hook (runtimehooks/hooks/gpu) — exact keys so a
+        # reference koordlet could read a rebuild scheduler's allocations
+        # and vice versa
+        type_names = {v: k for k, v in DEVICE_TYPE_NAMES.items()}
+        allocations = {}
+        for code, chosen in chosen_by_type.items():
+            per_card = per_card_by_type.get(code, {})
+            allocations[type_names[code]] = [
+                {
+                    "minor": int(m),
+                    "resources": {
+                        dim: res.format_quantity(int(q), dim)
+                        for dim, q in per_card.items()
+                    },
+                }
+                for m in sorted(chosen)
+            ]
+        ctx.state.setdefault("device_allocations", {})[pod_idx] = allocations
 
     def pre_bind(self, ctx, pod_idx, node_idx) -> Optional[Mapping]:
         alloc = ctx.state.get("device_allocations", {}).get(pod_idx)

@@ -448,6 +448,16 @@ int main(int argc, char** argv) {
                    static_cast<long long>(N));
       return 2;
     }
+    // the pod-indexed section must match the snapshot's padded P too:
+    // Arr::at has no bounds checks, so a bucket mismatch would read out
+    // of rsv_matched instead of failing cleanly
+    const Arr& rmatch = extras.get("rsv_matched");
+    if (!rmatch.empty() && rmatch.dim(0) != P) {
+      std::fprintf(stderr, "extras pod bucket %lld != snapshot P %lld\n",
+                   static_cast<long long>(rmatch.dim(0)),
+                   static_cast<long long>(P));
+      return 2;
+    }
     xt = compute_extras(extras, preq);
   }
 

@@ -491,11 +491,11 @@ class TestMixedTypeRequests:
         ctx = CycleContext(snapshot=snap, extras={"devices": batch})
         plugin.reserve(ctx, 0, 0)
         alloc = ctx.state["device_allocations"][0]
-        from koordinator_tpu.model.device import DEVICE_GPU, DEVICE_RDMA
 
-        assert alloc["minors"] == [0, 1, 2, 3]  # GPU minors only
+        # the reference DeviceAllocations shape (device_share.go:56-66)
+        assert [e["minor"] for e in alloc["gpu"]] == [0, 1, 2, 3]
         # the NIC reports its CR minor (per-type numbering), not its slot
-        assert alloc["by_type"][DEVICE_RDMA] == [0]
+        assert [e["minor"] for e in alloc["rdma"]] == [0]
         # the NIC's free rdma went to 0: full quantity deducted
         minors = ctx.extras["device_minors"][0]
         nic = next(m for m in minors if m["type"] == "rdma")

@@ -252,7 +252,7 @@ class TestDevicePluginIntegration:
         a = np.asarray(result.assignment)
         assert a[0] == 0  # only node-0 has the GPU
         alloc = ctx.state["device_allocations"][0]
-        assert alloc["minors"] == [0]
+        assert [e["minor"] for e in alloc["gpu"]] == [0]
         # free deducted on the minor
         assert minors[0][0]["free"][res.GPU_CORE] == 0
 
