@@ -189,6 +189,9 @@ def extras_scenario(
                 "allocate_policy": "Aligned" if i % 32 else "Default",
                 "order": (k + 1) if i % 48 == 0 else 0,
                 "owners": [{"label_selector": {"rsv-owner": f"rsv-{k}"}}],
+                "labels": {
+                    "reservation-type": "gold" if k % 2 == 0 else "general"
+                },
             }
         )
     for p, pod in enumerate(pods_out):
@@ -196,6 +199,15 @@ def extras_scenario(
             labels = dict(pod.get("labels", {}))
             labels["rsv-owner"] = f"rsv-{(p // 8) % n_rsv}"
             pod["labels"] = labels
+        if p % 16 == 0 and n_rsv > 1:
+            # required reservation affinity (reference exact key): these
+            # pods may only land on nodes holding a matched gold-labeled
+            # reservation — the affinity filter leg is load-bearing
+            anns = dict(pod.get("annotations", {}))
+            anns["scheduling.koordinator.sh/reservation-affinity"] = {
+                "reservationSelector": {"reservation-type": "gold"}
+            }
+            pod["annotations"] = anns
     rsv = encode_reservations(
         rsv_specs, pods_out, node_names, pod_bucket=pod_bucket
     )
@@ -284,6 +296,11 @@ def write_extras_file(
         "rsv_unschedulable": np.asarray(rsv.unschedulable),
         "rsv_valid": np.asarray(rsv.valid),
         "rsv_matched": np.asarray(rsv.matched),
+        "rsv_affinity_required": (
+            np.asarray(rsv.affinity_required)
+            if rsv.affinity_required is not None
+            else np.zeros(np.asarray(rsv.matched).shape[0], bool)
+        ),
     }
     with open(path, "wb") as f:
         f.write(b"KEXT1\n")

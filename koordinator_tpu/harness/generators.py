@@ -182,12 +182,31 @@ def quota_colocation_snapshot(
 
     Returns (snapshot, node_list, pod_list, gangs, quotas, quota_dicts).
     """
-    from koordinator_tpu.constraints import build_quota_table_inputs
-    from koordinator_tpu.model import encode_snapshot, resources as res
-
     node_list, pod_list, gangs, quotas = quota_colocation(
         seed=seed, pods=pods, nodes=nodes, tenants=tenants
     )
+    snap, qdicts = encode_quota_lists(
+        node_list,
+        pod_list,
+        gangs,
+        quotas,
+        node_bucket=node_bucket or nodes,
+        pod_bucket=pod_bucket or pods,
+    )
+    return snap, node_list, pod_list, gangs, quotas, qdicts
+
+
+def encode_quota_lists(
+    node_list, pod_list, gangs, quotas, node_bucket=None, pod_bucket=None
+):
+    """Encode explicit node/pod/quota lists with the ONE quota-table
+    recipe (quota-id mapping by pod "quota" name, cluster totals from
+    node allocatables) — shared by quota_colocation_snapshot and callers
+    that mutate the lists first (bench --config extras), so the recipe
+    cannot desync across call sites.  Returns (snapshot, quota_dicts)."""
+    from koordinator_tpu.constraints import build_quota_table_inputs
+    from koordinator_tpu.model import encode_snapshot, resources as res
+
     pod_reqs = [res.resource_vector(p["requests"]) for p in pod_list]
     qidx = {q["name"]: i for i, q in enumerate(quotas)}
     qids = [qidx.get(p.get("quota"), -1) for p in pod_list]
@@ -201,7 +220,7 @@ def quota_colocation_snapshot(
         pod_list,
         gangs,
         qdicts,
-        node_bucket=node_bucket or nodes,
-        pod_bucket=pod_bucket or pods,
+        node_bucket=node_bucket,
+        pod_bucket=pod_bucket,
     )
-    return snap, node_list, pod_list, gangs, quotas, qdicts
+    return snap, qdicts

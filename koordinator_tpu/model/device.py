@@ -29,6 +29,7 @@ DEVICE_GPU = 0
 DEVICE_RDMA = 1
 DEVICE_FPGA = 2
 DEVICE_TYPE_NAMES = {"gpu": DEVICE_GPU, "rdma": DEVICE_RDMA, "fpga": DEVICE_FPGA}
+DEVICE_TYPE_CODE_TO_NAME = {v: k for k, v in DEVICE_TYPE_NAMES.items()}
 
 # Device resource dims (the C axis).  Order is part of the device ABI.
 DEVICE_RESOURCE_AXIS = (

@@ -46,7 +46,16 @@ class ReservationTable:
     unschedulable: jnp.ndarray  # bool[V]
     valid: jnp.ndarray  # bool[V]
     matched: jnp.ndarray  # bool[P, V] owner match per pending pod
+    # pods carrying a REQUIRED reservation affinity
+    # (AnnotationReservationAffinity): such a pod may only land on nodes
+    # holding a matched reservation (reference plugin.go:238 Filter
+    # "node(s) no reservations match reservation affinity")
+    affinity_required: Optional[jnp.ndarray] = None  # bool[P]
     names: Tuple[str, ...] = ()
+    # CR UIDs, parallel to names ("" when unknown): the
+    # reservation-allocated annotation carries both (reference
+    # SetReservationAllocated, apis/extension/reservation.go:86-97)
+    uids: Tuple[str, ...] = ()
 
     @property
     def capacity(self) -> int:
@@ -69,8 +78,9 @@ jax.tree_util.register_dataclass(
         "unschedulable",
         "valid",
         "matched",
+        "affinity_required",
     ],
-    meta_fields=["names"],
+    meta_fields=["names", "uids"],
 )
 
 
@@ -109,6 +119,90 @@ _POLICY_NAMES = {
     "Restricted": ALLOCATE_POLICY_RESTRICTED,
 }
 
+# reference apis/extension/reservation.go:40
+RESERVATION_AFFINITY_ANNOTATION = (
+    "scheduling.koordinator.sh/reservation-affinity"
+)
+
+
+#: sentinel for a present-but-unparseable affinity annotation: the pod
+#: REQUIRES reservation affinity but can match nothing — it schedules
+#: nowhere through reservations, mirroring the reference's per-pod
+#: rejection (GetReservationAffinity error -> PreFilter Unschedulable)
+#: without aborting the whole table encode.
+INVALID_AFFINITY = object()
+
+
+def required_reservation_affinity(pod: Mapping):
+    """Parse the pod's ReservationAffinity annotation (the reference's
+    exact key and JSON shape, apis/extension/reservation.go:48-68):
+    ``{"reservationSelector": {k: v}, "requiredDuringScheduling...":
+    {"reservationSelectorTerms": [{"matchExpressions": [...]}]}}``.
+    Returns the parsed dict, None when the pod has no affinity, or
+    ``INVALID_AFFINITY`` when the annotation is present but malformed
+    (one bad pod must not abort encoding every other pod's table)."""
+    import json
+
+    raw = (pod.get("annotations") or {}).get(RESERVATION_AFFINITY_ANNOTATION)
+    if not raw:
+        return None
+    if isinstance(raw, Mapping):
+        return raw
+    try:
+        parsed = json.loads(raw)
+    except ValueError:
+        return INVALID_AFFINITY
+    return parsed if isinstance(parsed, Mapping) else INVALID_AFFINITY
+
+
+def _match_expressions(labels: Mapping, exprs: Sequence[Mapping]) -> bool:
+    """corev1.NodeSelectorTerm matchExpressions over reservation labels
+    (terms reuse the node-selector operators; GetReservationAffinity
+    validates the same set)."""
+    for e in exprs or ():
+        key = e.get("key")
+        op = e.get("operator")
+        values = e.get("values") or []
+        have = key in labels
+        val = labels.get(key)
+        if op == "In":
+            if not (have and val in values):
+                return False
+        elif op == "NotIn":
+            if have and val in values:
+                return False
+        elif op == "Exists":
+            if not have:
+                return False
+        elif op == "DoesNotExist":
+            if have:
+                return False
+        else:
+            return False  # unknown operator: fail closed, like validation
+    return True
+
+
+def matches_reservation_affinity(
+    affinity: Mapping, reservation_labels: Mapping
+) -> bool:
+    """reference pkg/util/reservation GetRequiredReservationAffinity +
+    ReservationAffinity.Match: the flat ``reservationSelector`` map must
+    all match; selector TERMS are ORed."""
+    selector = affinity.get("reservationSelector")
+    if selector:
+        if not all(reservation_labels.get(k) == v for k, v in selector.items()):
+            return False
+    required = affinity.get(
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    )
+    terms = (required or {}).get("reservationSelectorTerms")
+    if terms:
+        return any(
+            _match_expressions(reservation_labels, t.get("matchExpressions"))
+            for t in terms
+        )
+    return True
+
 
 def encode_reservations(
     reservations: Sequence[Mapping],
@@ -121,13 +215,19 @@ def encode_reservations(
     """Encode reservation dicts + pending pods into a ReservationTable.
 
     Reservation dict: ``{"name", "node": node-name, "allocatable": {...},
-    "allocated": {...}, "owners": [...], "allocate_policy":
-    "Default"|"Aligned"|"Restricted", "order": int, "allocate_once": bool,
-    "assigned_pods": int, "unschedulable": bool}``.
+    "allocated": {...}, "owners": [...], "labels": {...},
+    "allocate_policy": "Default"|"Aligned"|"Restricted", "order": int,
+    "allocate_once": bool, "assigned_pods": int, "unschedulable": bool}``.
 
     AllocateOnce reservations that already have assigned pods are dropped
     from the table entirely (the reference skips them during restore,
     transformer.go:95).
+
+    A pod carrying the ReservationAffinity annotation (the reference's
+    exact key ``scheduling.koordinator.sh/reservation-affinity``) matches
+    only reservations whose LABELS satisfy its selector, and is flagged
+    in ``affinity_required`` — the ReservationPlugin's filter then admits
+    it only onto nodes holding a matched reservation (plugin.go:238).
     """
     from koordinator_tpu.model.snapshot import pad_bucket
 
@@ -151,6 +251,11 @@ def encode_reservations(
     valid = np.zeros((v_bucket,), bool)
     matched = np.zeros((p_bucket, v_bucket), bool)
 
+    affinity_required = np.zeros((p_bucket,), bool)
+    pod_affinity = [required_reservation_affinity(pod) for pod in pods]
+    for p, aff in enumerate(pod_affinity):
+        affinity_required[p] = aff is not None
+
     for i, r in enumerate(active):
         node_index[i] = node_idx.get(r.get("node"), -1)
         alloc[i] = res.resource_vector(r.get("allocatable", {}))
@@ -160,8 +265,16 @@ def encode_reservations(
         order[i] = int(r.get("order", 0))
         unsched[i] = bool(r.get("unschedulable"))
         valid[i] = node_index[i] >= 0
+        rlabels = r.get("labels", {})
         for p, pod in enumerate(pods):
-            matched[p, i] = valid[i] and match_owners(pod, r.get("owners", ()))
+            ok = valid[i] and match_owners(pod, r.get("owners", ()))
+            if ok and pod_affinity[p] is not None:
+                # malformed affinity: required with zero matches (the
+                # pod alone becomes unschedulable via reservations)
+                ok = pod_affinity[p] is not INVALID_AFFINITY and (
+                    matches_reservation_affinity(pod_affinity[p], rlabels)
+                )
+            matched[p, i] = ok
 
     return ReservationTable(
         node_index=jnp.asarray(node_index),
@@ -173,5 +286,7 @@ def encode_reservations(
         unschedulable=jnp.asarray(unsched),
         valid=jnp.asarray(valid),
         matched=jnp.asarray(matched),
+        affinity_required=jnp.asarray(affinity_required),
         names=tuple(r.get("name", f"rsv-{i}") for i, r in enumerate(active)),
+        uids=tuple(str(r.get("uid", "")) for r in active),
     )

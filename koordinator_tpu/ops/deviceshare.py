@@ -28,6 +28,7 @@ from koordinator_tpu.model.device import (
     DEVICE_GPU,
     DEVICE_RDMA,
     DEVICE_RESOURCE_INDEX,
+    DEVICE_TYPE_CODE_TO_NAME,
     DEVICE_TYPE_NAMES,
     DEVICE_TYPE_RESOURCES,
     DeviceBatch,
@@ -423,7 +424,6 @@ def minor_dicts_from_batch(
         if devices.minor is not None
         else np.arange(total.shape[0], dtype=np.int32)
     )
-    code_to_name = {v: k for k, v in DEVICE_TYPE_NAMES.items()}
     out: List[Dict] = []
     for d in range(total.shape[0]):
         if not valid[d]:
@@ -434,7 +434,7 @@ def minor_dicts_from_batch(
         out.append(
             {
                 "minor": int(minors_t[d]),
-                "type": code_to_name[int(dtyp[d])],
+                "type": DEVICE_TYPE_CODE_TO_NAME[int(dtyp[d])],
                 "total": {
                     n: res.format_quantity(
                         int(total[d, DEVICE_RESOURCE_INDEX[n]]), n

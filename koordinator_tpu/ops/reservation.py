@@ -107,6 +107,31 @@ def reservation_scores(
     return jnp.where(rsv.valid[None, :], scores, 0)
 
 
+def reservation_affinity_mask(
+    rsv: ReservationTable,
+    num_nodes: int,
+) -> Optional[jnp.ndarray]:
+    """bool[P, N] Filter for required reservation affinity (reference
+    ``plugin.go:238``: "node(s) no reservations match reservation
+    affinity").  A pod flagged ``affinity_required`` may only land on
+    nodes holding a matched, schedulable reservation; other pods pass
+    everywhere.  None when no pod requires affinity (no mask cost)."""
+    # trace-safe: only the None (field absent) case skips; an all-False
+    # column just yields an all-True mask inside the fused program
+    if rsv.affinity_required is None:
+        return None
+    usable = rsv.matched & rsv.valid[None, :] & ~rsv.unschedulable[None, :]
+    safe_idx = jnp.where(rsv.valid, rsv.node_index, 0)
+    onehot = (
+        (safe_idx[None, :] == jnp.arange(num_nodes)[:, None])
+        & rsv.valid[None, :]
+    )  # [N, V]
+    has_match = jnp.einsum(
+        "pv,nv->pn", usable.astype(jnp.int32), onehot.astype(jnp.int32)
+    ) > 0
+    return has_match | ~rsv.affinity_required[:, None]
+
+
 def nominate_reservations(
     pod_requests: jnp.ndarray,  # i64[P, R]
     rsv: ReservationTable,

@@ -27,6 +27,7 @@ from koordinator_tpu.model.device import (
     DEVICE_GPU,
     DEVICE_RESOURCE_AXIS,
     DEVICE_RESOURCE_INDEX,
+    DEVICE_TYPE_CODE_TO_NAME,
     DEVICE_TYPE_NAMES,
     DEVICE_TYPE_RESOURCES,
 )
@@ -42,7 +43,10 @@ from koordinator_tpu.ops.deviceshare import (
     split_per_card,
 )
 from koordinator_tpu.ops.numa import numa_admit_mask, numa_zone_scores
-from koordinator_tpu.ops.reservation import nominate_reservations
+from koordinator_tpu.ops.reservation import (
+    nominate_reservations,
+    reservation_affinity_mask,
+)
 from koordinator_tpu.scheduler.cpu_accumulator import (
     CPUBindPolicy,
     NUMAAllocateStrategy,
@@ -152,6 +156,15 @@ class ReservationPlugin(TensorPlugin):
 
     name = "Reservation"
 
+    def filter_mask(self, ctx: CycleContext) -> Optional[jnp.ndarray]:
+        """Required reservation affinity: a pod carrying the
+        reservation-affinity annotation is admitted only onto nodes with
+        a matched reservation (reference plugin.go:238)."""
+        rsv = ctx.extras.get("reservations")
+        if rsv is None:
+            return None
+        return reservation_affinity_mask(rsv, ctx.snapshot.nodes.capacity)
+
     def score(self, ctx: CycleContext) -> Optional[jnp.ndarray]:
         rsv = ctx.extras.get("reservations")
         if rsv is None:
@@ -171,11 +184,16 @@ class ReservationPlugin(TensorPlugin):
             return None
         rsv = ctx.extras["reservations"]
         name = rsv.names[v] if v < len(rsv.names) else str(v)
+        # reference SetReservationAllocated writes {"name", "uid"}
+        # (apis/extension/reservation.go:86-97); uid omitted when the CR
+        # uid is unknown to the table
+        allocated = {"name": name}
+        uid = rsv.uids[v] if v < len(rsv.uids) else ""
+        if uid:
+            allocated["uid"] = uid
         return {
             "annotations": {
-                "scheduling.koordinator.sh/reservation-allocated": {
-                    "name": name
-                }
+                "scheduling.koordinator.sh/reservation-allocated": allocated
             }
         }
 
@@ -290,11 +308,10 @@ class DeviceSharePlugin(TensorPlugin):
         # koordlet gpu hook (runtimehooks/hooks/gpu) — exact keys so a
         # reference koordlet could read a rebuild scheduler's allocations
         # and vice versa
-        type_names = {v: k for k, v in DEVICE_TYPE_NAMES.items()}
         allocations = {}
         for code, chosen in chosen_by_type.items():
             per_card = per_card_by_type.get(code, {})
-            allocations[type_names[code]] = [
+            allocations[DEVICE_TYPE_CODE_TO_NAME[code]] = [
                 {
                     "minor": int(m),
                     "resources": {

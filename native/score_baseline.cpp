@@ -268,6 +268,7 @@ ExtraTensors compute_extras(const Extras& e, const Mat& preq) {
     const Arr& runsched = e.get("rsv_unschedulable");
     const Arr& rvalid = e.get("rsv_valid");
     const Arr& rmatch = e.get("rsv_matched");
+    const Arr& raffinity = e.get("rsv_affinity_required");
     constexpr int64_t kLongMax = int64_t{1} << 62;
     for (int64_t p = 0; p < P; ++p) {
       const int64_t* pr = &preq.data[p * R];
@@ -311,10 +312,25 @@ ExtraTensors compute_extras(const Extras& e, const Mat& preq) {
       }
       const int64_t preferred =
           best_order < kLongMax ? rnode.at(best_v) : -1;
+      // required reservation affinity (ops/reservation.py
+      // reservation_affinity_mask; reference plugin.go:238): the pod
+      // may only land on nodes holding a matched usable reservation
+      const bool affinity_req = !raffinity.empty() && raffinity.at(p);
+      std::vector<uint8_t> node_has_match;
+      if (affinity_req) {
+        node_has_match.assign(N, 0);
+        for (int64_t v = 0; v < V; ++v) {
+          const int64_t n = rnode.at(v);
+          if (rmatch.at(p, v) && rvalid.at(v) && !runsched.at(v) &&
+              n >= 0 && n < N)
+            node_has_match[n] = 1;
+        }
+      }
       for (int64_t n = 0; n < N; ++n) {
         int64_t s = std::max<int64_t>(node_best[n], 0);
         if (n == preferred) s = kMaxNodeScore;
         out.score[p * N + n] += s;
+        if (affinity_req && !node_has_match[n]) out.mask[p * N + n] = 0;
       }
     }
   }

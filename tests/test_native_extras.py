@@ -73,6 +73,12 @@ class TestNativeExtrasParity:
         m = np.asarray(mask)
         assert not m[0, 1]  # pod 0 wants 2 GPUs; node 1 has none
         assert m[0, 0]  # node 0 carries 4 free-enough GPU minors
+        # the reservation-affinity leg is load-bearing too: pod 0 carries
+        # a required gold-reservation affinity, so ONLY its reservation's
+        # node admits it
+        assert rsv.affinity_required is not None
+        assert bool(np.asarray(rsv.affinity_required)[0])
+        assert m[0].sum() == 1
         # the NUMA leg too: some zone actually fits and scores
         from koordinator_tpu.config import DEFAULT_CYCLE_CONFIG
         from koordinator_tpu.ops.numa import numa_zone_scores

@@ -180,3 +180,112 @@ class TestNominate:
         # unmatched pod: no nominations, zero scores
         assert (nominated[1] == -1).all()
         assert (node_scores[1] == 0).all()
+
+
+class TestReservationAffinity:
+    """The reference's exact affinity protocol
+    (apis/extension/reservation.go:40-68 AnnotationReservationAffinity;
+    Filter rejection at plugin.go:238)."""
+
+    AFF = "scheduling.koordinator.sh/reservation-affinity"
+
+    def _pods(self):
+        return [
+            # selector-map form, matches rsv labels {"reservation-type": "gpu"}
+            {
+                "name": "wants-gpu-rsv",
+                "labels": {"app": "web"},
+                "annotations": {
+                    self.AFF: {"reservationSelector": {"reservation-type": "gpu"}}
+                },
+            },
+            # terms form with an In expression
+            {
+                "name": "wants-any-tier",
+                "labels": {"app": "web"},
+                "annotations": {
+                    self.AFF: json_str(
+                        {
+                            "requiredDuringSchedulingIgnoredDuringExecution": {
+                                "reservationSelectorTerms": [
+                                    {
+                                        "matchExpressions": [
+                                            {
+                                                "key": "tier",
+                                                "operator": "In",
+                                                "values": ["gold", "silver"],
+                                            }
+                                        ]
+                                    }
+                                ]
+                            }
+                        }
+                    )
+                },
+            },
+            {"name": "no-affinity", "labels": {"app": "web"}},
+        ]
+
+    def _rsv(self):
+        reservations = [
+            {
+                "name": "rsv-gpu",
+                "node": "node-0",
+                "allocatable": {"cpu": "4"},
+                "labels": {"reservation-type": "gpu", "tier": "gold"},
+                "owners": [{"label_selector": {"app": "web"}}],
+            },
+            {
+                "name": "rsv-plain",
+                "node": "node-1",
+                "allocatable": {"cpu": "4"},
+                "labels": {"reservation-type": "general"},
+                "owners": [{"label_selector": {"app": "web"}}],
+            },
+        ]
+        return encode_reservations(
+            reservations, self._pods(), ["node-0", "node-1", "node-2"],
+            pod_bucket=3,
+        )
+
+    def test_selector_restricts_matched(self):
+        rsv = self._rsv()
+        m = np.asarray(rsv.matched)[:, :2]  # trim the padded V axis
+        assert list(m[0]) == [True, False]  # selector map: only rsv-gpu
+        assert list(m[1]) == [True, False]  # In-term: tier gold matches
+        assert list(m[2]) == [True, True]  # no affinity: owner match only
+        assert list(np.asarray(rsv.affinity_required)) == [True, True, False]
+
+    def test_filter_mask_rejects_nodes_without_match(self):
+        from koordinator_tpu.ops.reservation import reservation_affinity_mask
+
+        mask = np.asarray(reservation_affinity_mask(self._rsv(), 3))
+        # affinity pods: only node-0 (rsv-gpu) admits
+        assert list(mask[0]) == [True, False, False]
+        assert list(mask[1]) == [True, False, False]
+        # no affinity: everywhere
+        assert list(mask[2]) == [True, True, True]
+
+    def test_plugin_filter_wires_the_mask(self):
+        from koordinator_tpu.model import encode_snapshot
+        from koordinator_tpu.scheduler.framework import CycleContext
+        from koordinator_tpu.scheduler.plugins import ReservationPlugin
+
+        nodes = [
+            {"name": f"node-{i}", "allocatable": {"cpu": "8", "memory": "16Gi"}}
+            for i in range(3)
+        ]
+        pods = [
+            {**p, "requests": {"cpu": "1"}} for p in self._pods()
+        ]
+        snap = encode_snapshot(nodes, pods, [], [], node_bucket=3, pod_bucket=3)
+        ctx = CycleContext(snapshot=snap, extras={"reservations": self._rsv()})
+        mask = np.asarray(ReservationPlugin().filter_mask(ctx))
+        assert not mask[0, 1] and not mask[0, 2] and mask[0, 0]
+        assert mask[2].all()
+
+
+def json_str(obj):
+    import json
+
+    return json.dumps(obj)
