@@ -43,8 +43,29 @@ def tensor_to_numpy(
     if t.delta_idx:
         if base is None:
             raise ValueError("delta sync without a resident tensor")
+        # the delta must target the RESIDENT shape: a client with a stale
+        # differently-shaped mirror emits indices that may all land
+        # inside the resident cell count yet write the wrong cells —
+        # shape equality rejects every mismatch, not just the
+        # out-of-range subset
+        if t.shape and tuple(t.shape) != base.shape:
+            raise ValueError(
+                f"delta shape {tuple(t.shape)} != resident {base.shape}"
+            )
         idx = np.frombuffer(t.delta_idx, dtype="<i8")
         val = np.frombuffer(t.delta_val, dtype="<i8")
+        if len(idx) != len(val):
+            raise ValueError(
+                f"delta index/value length mismatch: {len(idx)} vs {len(val)}"
+            )
+        # bounds-check BEFORE the native path: delta_apply writes through
+        # raw pointers, so an out-of-range index from a hostile frame
+        # would corrupt server memory instead of raising
+        if len(idx) and (int(idx.min()) < 0 or int(idx.max()) >= base.size):
+            raise ValueError(
+                f"delta index out of range for resident tensor of "
+                f"{base.size} cells"
+            )
         out = base.copy()
         native.delta_apply(out, idx, val)
         return out
@@ -123,6 +144,11 @@ class ResidentState:
         self._i32_ok: Optional[bool] = None
 
     def apply_sync(self, reqmsg: "pb2.SyncRequest") -> None:
+        """Decode EVERYTHING first, commit only if every tensor decoded:
+        a rejected frame (bad delta shape/index, missing first-sync
+        tensors) must leave the resident state untouched — a torn
+        half-applied sync would hand every OTHER client a corrupted
+        delta baseline behind an unbumped generation."""
         n = reqmsg.nodes
         p = reqmsg.pods
 
@@ -130,43 +156,50 @@ class ResidentState:
             new = tensor_to_numpy(tensor, current)
             return current if new is None else new
 
-        self.node_alloc = upd(self.node_alloc, n.allocatable)
-        self.node_requested = upd(self.node_requested, n.requested)
-        self.node_usage = upd(self.node_usage, n.usage)
+        staged = {
+            "node_alloc": upd(self.node_alloc, n.allocatable),
+            "node_requested": upd(self.node_requested, n.requested),
+            "node_usage": upd(self.node_usage, n.usage),
+            "node_agg": upd(self.node_agg, n.agg_usage),
+            "node_agg_fresh": upd(self.node_agg_fresh, n.agg_fresh),
+            "node_prod": upd(self.node_prod, n.prod_usage),
+            "pod_requests": upd(self.pod_requests, p.requests),
+            "pod_estimated": upd(self.pod_estimated, p.estimated),
+            "quota_runtime": upd(self.quota_runtime, reqmsg.quotas.runtime),
+            "quota_used": upd(self.quota_used, reqmsg.quotas.used),
+            "quota_limited": upd(self.quota_limited, reqmsg.quotas.limited),
+        }
+        if staged["node_alloc"] is None or staged["pod_requests"] is None:
+            raise ValueError("first Sync must carry full node and pod tensors")
         if n.metric_fresh:
-            self.node_fresh = np.asarray(list(n.metric_fresh), dtype=bool)
-        self.node_agg = upd(self.node_agg, n.agg_usage)
-        self.node_agg_fresh = upd(self.node_agg_fresh, n.agg_fresh)
-        self.node_prod = upd(self.node_prod, n.prod_usage)
+            staged["node_fresh"] = np.asarray(list(n.metric_fresh), dtype=bool)
         if n.names:
-            self.node_names = tuple(n.names)
-        self.pod_requests = upd(self.pod_requests, p.requests)
-        self.pod_estimated = upd(self.pod_estimated, p.estimated)
+            staged["node_names"] = tuple(n.names)
         if p.priority:
-            self.pod_priority = np.asarray(list(p.priority), dtype=np.int64)
+            staged["pod_priority"] = np.asarray(list(p.priority), dtype=np.int64)
         if p.priority_class:
-            self.pod_priority_class = np.asarray(
+            staged["pod_priority_class"] = np.asarray(
                 list(p.priority_class), dtype=np.int32
             )
         if p.gang_id:
-            self.pod_gang = np.asarray(list(p.gang_id), dtype=np.int32)
+            staged["pod_gang"] = np.asarray(list(p.gang_id), dtype=np.int32)
         if p.quota_id:
-            self.pod_quota = np.asarray(list(p.quota_id), dtype=np.int32)
+            staged["pod_quota"] = np.asarray(list(p.quota_id), dtype=np.int32)
         if p.names:
-            self.pod_names = tuple(p.names)
+            staged["pod_names"] = tuple(p.names)
         if reqmsg.gangs.min_member:
-            self.gang_min = np.asarray(list(reqmsg.gangs.min_member), np.int32)
-        self.quota_runtime = upd(self.quota_runtime, reqmsg.quotas.runtime)
-        self.quota_used = upd(self.quota_used, reqmsg.quotas.used)
-        self.quota_limited = upd(self.quota_limited, reqmsg.quotas.limited)
-        if self.node_alloc is None or self.pod_requests is None:
-            raise ValueError("first Sync must carry full node and pod tensors")
-        self.node_bucket = int(reqmsg.node_bucket) or pad_bucket(
-            self.node_alloc.shape[0]
+            staged["gang_min"] = np.asarray(
+                list(reqmsg.gangs.min_member), np.int32
+            )
+        staged["node_bucket"] = int(reqmsg.node_bucket) or pad_bucket(
+            staged["node_alloc"].shape[0]
         )
-        self.pod_bucket = int(reqmsg.pod_bucket) or pad_bucket(
-            self.pod_requests.shape[0]
+        staged["pod_bucket"] = int(reqmsg.pod_bucket) or pad_bucket(
+            staged["pod_requests"].shape[0]
         )
+        # atomic commit point: nothing above mutated self
+        for key, value in staged.items():
+            setattr(self, key, value)
         self._snapshot = None  # rebuilt lazily
         self._i32_ok = None
 

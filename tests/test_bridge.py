@@ -123,6 +123,73 @@ class TestBridge:
         back = tensor_to_numpy(t, prev)
         np.testing.assert_array_equal(back, nxt)
 
+    def test_delta_out_of_range_raises_not_corrupts(self):
+        """Client deltas cross a trust boundary: an index past the
+        resident tensor must raise (the native delta_apply writes through
+        raw pointers — unchecked it would corrupt server memory, not
+        error)."""
+        import pytest
+
+        from koordinator_tpu.bridge.codegen import pb2
+
+        base = np.zeros((2, 4), np.int64)
+        t = pb2.Tensor(shape=[2, 4])
+        t.delta_idx = np.asarray([99], "<i8").tobytes()
+        t.delta_val = np.asarray([7], "<i8").tobytes()
+        with pytest.raises(ValueError, match="out of range"):
+            tensor_to_numpy(t, base)
+        t.delta_idx = np.asarray([1, 2], "<i8").tobytes()
+        with pytest.raises(ValueError, match="length mismatch"):
+            tensor_to_numpy(t, base)
+
+    def test_delta_shape_mismatch_rejected(self):
+        """A stale differently-shaped mirror's indices may all land
+        inside the resident cell count but write the wrong cells — shape
+        equality must reject the frame outright."""
+        import pytest
+
+        from koordinator_tpu.bridge.codegen import pb2
+
+        base = np.zeros((12, 13), np.int64)
+        t = pb2.Tensor(shape=[8, 13])
+        t.delta_idx = np.asarray([5], "<i8").tobytes()
+        t.delta_val = np.asarray([7], "<i8").tobytes()
+        with pytest.raises(ValueError, match="delta shape"):
+            tensor_to_numpy(t, base)
+
+    def test_rejected_sync_leaves_resident_state_untouched(self):
+        """Half-applied syncs must not happen: a frame whose first
+        tensor is valid but whose later tensor is rejected leaves the
+        OTHER clients' delta baseline corrupted behind an unbumped
+        generation.  apply_sync stages everything and commits only when
+        the whole frame decodes."""
+        import pytest
+
+        from koordinator_tpu.bridge.codegen import pb2
+        from koordinator_tpu.bridge.server import ScorerServicer
+        from koordinator_tpu.harness import generators
+        from koordinator_tpu.harness.golden import build_sync_request
+
+        nodes_l, pods_l, _, _ = generators.loadaware_joint(
+            seed=5, pods=16, nodes=4
+        )
+        req, _ = build_sync_request(nodes_l, pods_l, [], [])
+        sv = ScorerServicer()
+        sv.sync(req)
+        before = sv.state.node_alloc.copy()
+
+        bad = pb2.SyncRequest()
+        # valid full allocatable replacement...
+        bad.nodes.allocatable.shape.extend(before.shape)
+        bad.nodes.allocatable.data = (before * 2).astype("<i8").tobytes()
+        # ...but an out-of-range usage delta: the whole frame must bounce
+        bad.nodes.usage.shape.extend(before.shape)
+        bad.nodes.usage.delta_idx = np.asarray([10**6], "<i8").tobytes()
+        bad.nodes.usage.delta_val = np.asarray([1], "<i8").tobytes()
+        with pytest.raises(ValueError):
+            sv.state.apply_sync(bad)
+        np.testing.assert_array_equal(sv.state.node_alloc, before)
+
     def test_tensor_full_when_mostly_changed(self):
         prev = np.zeros((8, 8), np.int64)
         nxt = np.arange(64, dtype=np.int64).reshape(8, 8)

@@ -129,6 +129,66 @@ class TestDeltaSync:
         assert warm > 3 * 26 * 8  # three full [2,13] i64 tensors at least
 
 
+class TestDeltaProtocolFuzz:
+    def test_random_mutation_displacement_restart_sequences(self, server):
+        """Fuzz the warm-cycle protocol: random node-table mutations,
+        foreign syncs (generation displacement), and sidecar restarts in
+        one long session.  After every cycle the plugin's scores must
+        equal a cold client syncing the same view — the delta baseline
+        can never drift."""
+        import random
+
+        path, srv = server
+        rng = random.Random(17)
+        n_nodes = 12
+        reqs = {f"node-{i}": list(REQ) for i in range(n_nodes)}
+
+        def view():
+            return [(name, ALLOC, list(r)) for name, r in sorted(reqs.items())]
+
+        sim = GoPluginSim(path)
+        other = GoPluginSim(path)
+        servers = [srv]
+        for cycle in range(20):
+            action = rng.random()
+            if action < 0.5:
+                # mutate a few nodes' committed load
+                for _ in range(rng.randrange(1, 4)):
+                    r = reqs[f"node-{rng.randrange(n_nodes)}"]
+                    r[0] = rng.randrange(500, 4000)
+                    r[3] = rng.randrange(1, 50)
+            elif action < 0.7:
+                # foreign client displaces the resident generation
+                other.metrics = {}
+                try:
+                    other.pre_score(
+                        [(f"other-{i}", ALLOC, REQ) for i in range(3)],
+                        f"foreign-{cycle}",
+                        POD,
+                    )
+                except Exception:
+                    other._drop_client()
+            elif action < 0.8 and cycle > 0:
+                # sidecar restart: resident state + connections lost
+                servers[-1].stop()
+                servers.append(RawUdsServer(path).start())
+                other._drop_client()
+                other.mirror.invalidate()
+
+            try:
+                got = sim.pre_score(view(), f"pod-{cycle}", POD)
+            except Exception:
+                # first cycle after a restart fails and invalidates;
+                # the retry must ship full state and succeed
+                assert not sim.mirror.valid
+                got = sim.pre_score(view(), f"pod-{cycle}", POD)
+            cold = GoPluginSim(path)
+            assert cold.pre_score(view(), f"pod-{cycle}", POD) == got, (
+                f"delta baseline drifted at cycle {cycle}"
+            )
+        servers[-1].stop()
+
+
 class TestGenerationDisplacement:
     def test_foreign_sync_triggers_full_resync(self, server):
         """Another client syncs between our cycles: the generation jump
