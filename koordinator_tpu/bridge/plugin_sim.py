@@ -17,6 +17,8 @@ Go references (line-level mirrors):
   * buildSync             -> build_sync
   * Scorer.PreScore       -> GoPluginSim.pre_score
   * scorerclient.Generation -> generation
+  * NodeMetricCache.SetQuantities (the NodeMetric informer parse)
+                          -> usage_vector_from_node_metric
 """
 
 from __future__ import annotations
@@ -45,6 +47,29 @@ def generation(snapshot_id: str) -> int:
         return int(snapshot_id.removeprefix("s"))
     except ValueError:
         return -1
+
+
+def usage_vector_from_node_metric(payload: Dict) -> Optional[List[int]]:
+    """NodeMetricCache.SetQuantities' parse path, in Python: convert the
+    koordlet NodeMetricReporter payload
+    (statesinformer.py: ``{"nodeMetric": {"nodeUsage": {"cpu": "1500m",
+    "memory": "<bytes>"}}}``) into the dense usage vector the shim syncs
+    (cpu milli at axis 0, memory MiB at axis 1).  None when the payload
+    carries no node usage (the cache keeps its previous sample).
+    Quantities go through the one parser (model/resources.parse_quantity)
+    so every Kubernetes serialization form ("2Gi", "1500000000n") lands
+    in the exact axis units."""
+    from koordinator_tpu.model import resources as res
+
+    usage = ((payload or {}).get("nodeMetric") or {}).get("nodeUsage")
+    if not usage:
+        return None
+    vec = [0] * NUM_AXES
+    vec[AXIS_CPU] = int(res.parse_quantity(usage.get("cpu", 0), res.CPU))
+    vec[AXIS_MEMORY] = int(
+        res.parse_quantity(usage.get("memory", 0), res.MEMORY)
+    )
+    return vec
 
 
 def delta_tensor(
@@ -175,6 +200,15 @@ class GoPluginSim:
         self._conn: Optional[socket.socket] = None
         # wire observability for tests: (method, payload_len) per frame
         self.sent_frames: List[Tuple[int, int]] = []
+
+    def update_node_metric(self, node: str, payload: Dict) -> None:
+        """The NodeMetric informer callback (the Go plugin wires the CR
+        informer's add/update handler to NodeMetricCache.Set the same
+        way): parse the koordlet report and refresh the node's usage
+        sample; a payload without node usage keeps the previous one."""
+        vec = usage_vector_from_node_metric(payload)
+        if vec is not None:
+            self.metrics[node] = vec
 
     # ensureClient / dropClient
     def _client(self) -> socket.socket:

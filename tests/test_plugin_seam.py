@@ -80,6 +80,53 @@ class TestUsageFeed:
         assert scores["node-cold"] == scores["node-hot"]
 
 
+class TestNodeMetricFeed:
+    def test_koordlet_payload_drives_loadaware(self, server):
+        """The FULL usage pipeline: a real koordlet NodeMetricReporter
+        payload (metriccache -> collect) parses into the shim's usage
+        vector and drives LoadAware scoring — the end-to-end wiring of
+        round-4 review #3 ('populate Usage/MetricFresh from the
+        NodeMetric payloads the koordlet side already produces')."""
+        from koordinator_tpu.bridge.plugin_sim import (
+            usage_vector_from_node_metric,
+        )
+        from koordinator_tpu.koordlet import metriccache as mc
+        from koordinator_tpu.koordlet.metriccache import MetricCache
+        from koordinator_tpu.koordlet.statesinformer import (
+            NodeMetricReporter,
+            StatesInformer,
+        )
+
+        def payload_for(cores_used: float):
+            cache = MetricCache()
+            for i in range(10):
+                cache.append(mc.NODE_CPU_USAGE, cores_used, ts=float(i))
+                cache.append(mc.NODE_MEMORY_USAGE, 2 * (1 << 30), ts=float(i))
+            return NodeMetricReporter(cache, StatesInformer()).collect(10.0)
+
+        hot = usage_vector_from_node_metric(payload_for(5.0))
+        cold = usage_vector_from_node_metric(payload_for(0.5))
+        assert hot is not None and hot[0] == 5000 and hot[1] == 2048
+        assert cold is not None and cold[0] == 500
+        assert usage_vector_from_node_metric({"nodeMetric": {}}) is None
+        # every Kubernetes quantity serialization parses (the Go cache
+        # accepts resource.Quantity forms too)
+        gi = usage_vector_from_node_metric(
+            {"nodeMetric": {"nodeUsage": {"cpu": "1500000000n", "memory": "2Gi"}}}
+        )
+        assert gi == [1500, 2048] + [0] * 11
+
+        path, _ = server
+        sim = GoPluginSim(path)
+        # the informer-callback path, exactly like the Go plugin wires
+        # NodeMetricCache.Set into the CR informer
+        sim.update_node_metric("node-hot", payload_for(5.0))
+        sim.update_node_metric("node-cold", payload_for(0.5))
+        sim.update_node_metric("node-cold", {"nodeMetric": {}})  # kept
+        scores = sim.pre_score(NODES, "pod-x", POD)
+        assert scores["node-cold"] > scores["node-hot"]
+
+
 class TestDeltaSync:
     def test_warm_cycle_ships_sparse_delta(self, server):
         """Cycle 2 against an unchanged node set must sync a sparse
