@@ -209,6 +209,34 @@ class TestWaveRounds:
         )
         assert rounds < snap.pods.capacity, rounds
 
+    def test_wave_mostallocated_parity_extras(self):
+        """Extended-plugin mask/score tensors ride the MostAllocated
+        universe path too (the gathered u_xval/u_xfeas rows): parity must
+        hold with per-(pod, node) extras in play."""
+        from koordinator_tpu.config import CycleConfig
+        from koordinator_tpu.parallel import greedy_assign_waves
+
+        snap = _snap()
+        P = snap.pods.capacity
+        N = snap.nodes.allocatable.shape[0]
+        rng = np.random.default_rng(23)
+        xm = jax.numpy.asarray(rng.random((P, N)) > 0.3)
+        xs = jax.numpy.asarray(
+            rng.integers(0, 50, size=(P, N)), dtype=jax.numpy.int64
+        )
+        cfg = CycleConfig(fit_scoring_strategy="MostAllocated")
+        want = greedy_assign(snap, cfg, extra_mask=xm, extra_scores=xs)
+        got, rounds = greedy_assign_waves(
+            snap, make_mesh(), cfg, extra_mask=xm, extra_scores=xs
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.assignment), np.asarray(want.assignment)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.node_requested), np.asarray(want.node_requested)
+        )
+        assert rounds < snap.pods.capacity, rounds
+
     def test_wave_mostallocated_parity_quota(self):
         from koordinator_tpu.config import CycleConfig
         from koordinator_tpu.parallel import greedy_assign_waves
