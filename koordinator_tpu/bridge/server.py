@@ -27,8 +27,18 @@ from koordinator_tpu.solver import run_cycle, score_cycle
 
 
 class ScorerServicer:
-    def __init__(self, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG):
+    def __init__(self, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG, mesh=None):
+        """``mesh``: a ``jax.sharding.Mesh`` turns the ASSIGN RPC into
+        the round-based multi-chip cycle (parallel/shard_assign.py
+        greedy_assign_waves, bit-identical with the single-chip path);
+        clients see ``path="shard"``.  Scope: Assign only — Sync and
+        Score still materialize the snapshot on the default device, so
+        the resident tensors must fit one device's memory; the mesh buys
+        cycle wall-clock, not snapshot capacity.  A shard-path failure
+        falls back to the single-chip cycle for that RPC (placements are
+        bit-identical either way)."""
         self.cfg = cfg
+        self.mesh = mesh
         self.state = ResidentState()
         self._generation = 0
         # one lock over state-mutating Sync and state-reading Score/Assign:
@@ -105,7 +115,31 @@ class ScorerServicer:
             self._check_generation(req, ctx)
             snap = self.state.snapshot()
             t0 = time.perf_counter()
-            result = run_cycle(snap, self.cfg, i32_ok=self.state.i32_fits())
+            result = None
+            if self.mesh is not None:
+                from koordinator_tpu.parallel import greedy_assign_waves
+
+                try:
+                    result, _rounds = greedy_assign_waves(
+                        snap, self.mesh, self.cfg
+                    )
+                except Exception:
+                    # same degraded-mode philosophy as the Pallas kernel
+                    # demotion inside run_cycle: a wedged device or a
+                    # shard_map compile fault must not hard-fail every
+                    # Assign until restart — the single-chip cycle is
+                    # bit-identical, and path in the reply makes the
+                    # degradation visible to callers
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "sharded assign failed; serving this RPC "
+                        "single-chip"
+                    )
+            if result is None:
+                result = run_cycle(
+                    snap, self.cfg, i32_ok=self.state.i32_fits()
+                )
             assignment = np.asarray(result.assignment)
             status = np.asarray(result.status)
             ms = (time.perf_counter() - t0) * 1000.0
@@ -128,8 +162,9 @@ def make_server(
     servicer: Optional[ScorerServicer] = None,
     cfg: CycleConfig = DEFAULT_CYCLE_CONFIG,
     max_workers: int = 4,
+    mesh=None,
 ) -> grpc.Server:
-    servicer = servicer or ScorerServicer(cfg)
+    servicer = servicer or ScorerServicer(cfg, mesh=mesh)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     handlers = {
         "Sync": _handler(servicer.sync, pb2.SyncRequest),
@@ -143,10 +178,13 @@ def make_server(
     return server
 
 
-def serve_uds(path: str, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG) -> grpc.Server:
+def serve_uds(
+    path: str, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG, mesh=None
+) -> grpc.Server:
     """Bind the scorer on a unix-domain socket (the reference's CRI proxy
-    transport, criserver.go:93) and start it."""
-    server = make_server(cfg=cfg)
+    transport, criserver.go:93) and start it.  Pass a multi-device
+    ``mesh`` to serve the round-based sharded cycle (path="shard")."""
+    server = make_server(cfg=cfg, mesh=mesh)
     server.add_insecure_port(f"unix://{path}")
     server.start()
     return server

@@ -60,9 +60,10 @@ class RawUdsServer:
         path: str,
         servicer: Optional[ScorerServicer] = None,
         cfg: CycleConfig = DEFAULT_CYCLE_CONFIG,
+        mesh=None,
     ):
         self.path = path
-        self.servicer = servicer or ScorerServicer(cfg)
+        self.servicer = servicer or ScorerServicer(cfg, mesh=mesh)
         if os.path.exists(path):
             os.unlink(path)
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -165,6 +166,8 @@ class RawUdsServer:
 
 
 def serve_raw_uds(
-    path: str, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG
+    path: str, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG, mesh=None
 ) -> RawUdsServer:
-    return RawUdsServer(path, cfg=cfg).start()
+    """Pass a ``mesh`` to serve the round-based sharded Assign
+    (path="shard"), same as the gRPC serve_uds."""
+    return RawUdsServer(path, cfg=cfg, mesh=mesh).start()
