@@ -118,24 +118,50 @@ class ScorerServicer:
             result = None
             if self.mesh is not None:
                 from koordinator_tpu.parallel import greedy_assign_waves
+                from koordinator_tpu.solver import (
+                    _demoted,
+                    _record_failure,
+                    _record_success,
+                )
 
-                try:
-                    result, _rounds = greedy_assign_waves(
-                        snap, self.mesh, self.cfg
-                    )
-                except Exception:
-                    # same degraded-mode philosophy as the Pallas kernel
-                    # demotion inside run_cycle: a wedged device or a
-                    # shard_map compile fault must not hard-fail every
-                    # Assign until restart — the single-chip cycle is
-                    # bit-identical, and path in the reply makes the
-                    # degradation visible to callers
-                    import logging
+                bucket = (
+                    "shard",
+                    int(snap.nodes.allocatable.shape[0]),
+                    int(snap.pods.capacity),
+                    self.mesh.size,
+                )
+                if not _demoted(bucket):
+                    try:
+                        result, _rounds = greedy_assign_waves(
+                            snap, self.mesh, self.cfg
+                        )
+                        # materialize INSIDE the guard: with async
+                        # dispatch a late device fault would otherwise
+                        # surface at the reply assembly, outside this
+                        # fallback (the same hazard run_cycle documents)
+                        import dataclasses
 
-                    logging.getLogger(__name__).exception(
-                        "sharded assign failed; serving this RPC "
-                        "single-chip"
-                    )
+                        result = dataclasses.replace(
+                            result,
+                            assignment=np.asarray(result.assignment),
+                            status=np.asarray(result.status),
+                        )
+                        _record_success(bucket)
+                    except Exception:
+                        # the run_cycle demotion philosophy, shared
+                        # machinery: back off this shape bucket instead
+                        # of re-paying a failed shard compile on every
+                        # RPC; the single-chip cycle is bit-identical
+                        # and path in the reply shows the degradation
+                        _record_failure(bucket)
+                        result = None
+                        import logging
+
+                        logging.getLogger(__name__).exception(
+                            "sharded assign failed; serving single-chip "
+                            "and backing off bucket %r",
+                            bucket,
+                        )
             if result is None:
                 result = run_cycle(
                     snap, self.cfg, i32_ok=self.state.i32_fits()

@@ -43,8 +43,8 @@ class _LeaderGatedServicer(ScorerServicer):
     """Assign requires leadership; Score/Sync serve on any replica (they
     are read-only against the resident snapshot)."""
 
-    def __init__(self, cfg, is_leader):
-        super().__init__(cfg)
+    def __init__(self, cfg, is_leader, mesh=None):
+        super().__init__(cfg, mesh=mesh)
         self._is_leader = is_leader
 
     def assign(self, req, ctx=None):
@@ -66,6 +66,7 @@ class SchedulerServer:
         http_host: str = "127.0.0.1",
         http_port: int = 0,
         enable_grpc: bool = True,
+        shard: bool = False,
     ):
         cfg = DEFAULT_CYCLE_CONFIG
         self.profiles = []
@@ -79,8 +80,18 @@ class SchedulerServer:
             lease_path,
             identity or f"{socket.gethostname()}-{os.getpid()}",
         )
+        mesh = None
+        if shard:
+            # serve the round-based sharded cycle over every visible
+            # device (parallel/shard_assign.py; Assign replies
+            # path="shard", bit-identical with single-chip)
+            import jax
+
+            from koordinator_tpu.parallel import make_mesh
+
+            mesh = make_mesh(jax.devices())
         self.servicer = _LeaderGatedServicer(
-            cfg, lambda: self.elector.is_leader
+            cfg, lambda: self.elector.is_leader, mesh=mesh
         )
         self.api = APIService()
         self.uds_path = uds_path
@@ -188,6 +199,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     )
     ap.add_argument("--http-host", default="127.0.0.1")
     ap.add_argument("--http-port", type=int, default=10251)
+    ap.add_argument(
+        "--shard", action="store_true",
+        help="serve the round-based multi-chip Assign over every visible "
+        "device (jax.sharding.Mesh; placements stay bit-identical)",
+    )
     return ap
 
 
@@ -200,6 +216,7 @@ def main(argv=None) -> int:
         uds_path=args.uds,
         http_host=args.http_host,
         http_port=args.http_port,
+        shard=args.shard,
     ).start()
     try:
         threading.Event().wait()

@@ -129,6 +129,36 @@ class TestSchedulerServer:
         finally:
             s.stop()
 
+    def test_daemon_shard_flag_serves_multichip(self, tmp_path):
+        """--shard builds a mesh over every visible device and Assign
+        serves the round-based sharded cycle (path='shard'), leadership
+        still gating it."""
+        from koordinator_tpu.bridge.codegen import pb2
+        from koordinator_tpu.harness.golden import build_sync_request
+        from koordinator_tpu.harness import generators
+        from koordinator_tpu.scheduler.server import SchedulerServer
+
+        s = SchedulerServer(
+            lease_path=str(tmp_path / "leader.lease"),
+            uds_path=str(tmp_path / "scorer.sock"),
+            enable_grpc=False,
+            shard=True,
+        ).start()
+        try:
+            deadline = time.time() + 10
+            while not s.elector.is_leader and time.time() < deadline:
+                time.sleep(0.05)
+            nodes_l, pods_l, _, _ = generators.loadaware_joint(
+                seed=3, pods=16, nodes=8
+            )
+            req, _ = build_sync_request(nodes_l, pods_l, [], [])
+            s.servicer.sync(req)
+            reply = s.servicer.assign(pb2.AssignRequest(snapshot_id="s1"))
+            assert reply.path == "shard"
+            assert len(reply.assignment) == 16
+        finally:
+            s.stop()
+
 
 class TestDeschedulerServer:
     def test_leader_ticks_follower_idles(self, tmp_path):
