@@ -708,6 +708,33 @@ def child_config(platform: str, config: str) -> None:
                 sync_ms = _ms(t0)
                 phase("sync", ms=round(sync_ms, 1), bytes=len(payload))
 
+                # warm-cycle delta sync (round-4 review #2): a few node
+                # rows change; the frame carries sparse (idx, val) pairs
+                # against the resident state instead of the full table
+                from koordinator_tpu.bridge.state import numpy_to_tensor
+
+                prev_req = np.frombuffer(
+                    req.nodes.requested.data, "<i8"
+                ).reshape(tuple(req.nodes.requested.shape)).copy()
+                warm_req_arr = prev_req.copy()
+                warm_req_arr[:3, 0] += 500  # three nodes' cpu moves
+                warm = pb2.SyncRequest()
+                warm.nodes.requested.CopyFrom(
+                    numpy_to_tensor(warm_req_arr, prev_req)
+                )
+                warm_payload = warm.SerializeToString()
+                t0 = time.perf_counter()
+                sync = pb2.SyncReply.FromString(call(METHOD_SYNC, warm_payload))
+                delta_sync_ms = _ms(t0)
+                phase(
+                    "delta_sync",
+                    ms=round(delta_sync_ms, 2),
+                    bytes=len(warm_payload),
+                )
+                assert len(warm_payload) < len(payload) // 100, (
+                    "delta frame should be ~100x below the full sync"
+                )
+
                 areq = pb2.AssignRequest(
                     snapshot_id=sync.snapshot_id
                 ).SerializeToString()
@@ -742,6 +769,8 @@ def child_config(platform: str, config: str) -> None:
                     "assigned": assigned,
                     "sync_ms": round(sync_ms, 1),
                     "sync_bytes": len(payload),
+                    "delta_sync_ms": round(delta_sync_ms, 2),
+                    "delta_sync_bytes": len(warm_payload),
                     "score_top32_ms": round(score_ms, 1),
                     "score_build_ms": round(score.build_ms, 2),
                 }

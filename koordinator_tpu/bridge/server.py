@@ -62,11 +62,14 @@ class ScorerServicer:
         with self._lock:
             self.state.apply_sync(req)
             self._generation += 1
-            snap = self.state.snapshot()
+            # counts come from the host mirrors — building the padded
+            # device snapshot here would make every warm delta sync pay
+            # the full re-encode that Score/Assign (which actually need
+            # it) will build lazily anyway
             return pb2.SyncReply(
                 snapshot_id=f"s{self._generation}",
-                nodes=snap.num_nodes,
-                pods=snap.num_pods,
+                nodes=self.state.node_alloc.shape[0],
+                pods=self.state.pod_requests.shape[0],
             )
 
     def score(self, req: "pb2.ScoreRequest", ctx=None) -> "pb2.ScoreReply":
