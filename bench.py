@@ -31,6 +31,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import Optional
 
 TARGET_MS = 500.0
 PODS, NODES = 10_000, 2_000
@@ -45,6 +46,12 @@ _CPU_ENV = {"XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
 PROBE_TIMEOUT = int(os.environ.get("KOORD_BENCH_PROBE_TIMEOUT", "120"))
 TPU_TIMEOUT = int(os.environ.get("KOORD_BENCH_TPU_TIMEOUT", "600"))
 CPU_TIMEOUT = int(os.environ.get("KOORD_BENCH_CPU_TIMEOUT", "900"))
+# Artifact-first wall-clock budget (BENCH_r05 was rc=124 with NO artifact:
+# the 2400s TPU probe window plus the CPU fallback overran the driver's
+# timeout).  Every stage's window is derived from what remains of this
+# budget, and the CPU fallback is always reserved a slot — an artifact
+# line exists under every failure mode before the driver's axe falls.
+TOTAL_BUDGET = 2400.0  # default for KOORD_BENCH_TOTAL_BUDGET, seconds
 
 
 def _quota_snapshot(encode_snapshot, generators, res, build_quota_table_inputs):
@@ -708,46 +715,82 @@ def child_config(platform: str, config: str) -> None:
                 sync_ms = _ms(t0)
                 phase("sync", ms=round(sync_ms, 1), bytes=len(payload))
 
-                # warm-cycle delta sync (round-4 review #2): a few node
-                # rows change; the frame carries sparse (idx, val) pairs
-                # against the resident state instead of the full table
+                def assign(snapshot_id):
+                    areq = pb2.AssignRequest(
+                        snapshot_id=snapshot_id
+                    ).SerializeToString()
+                    t0 = time.perf_counter()
+                    reply = pb2.AssignReply.FromString(
+                        call(METHOD_ASSIGN, areq)
+                    )
+                    return reply, _ms(t0)
+
+                # first assign pays the compile (and the cold snapshot
+                # build); everything after reuses the jit cache
+                reply, _first_ms = assign(sync.snapshot_id)
+                phase("first_assign", path=reply.path)
+
+                # WARM cycles (the tentpole path): each rep ships a
+                # sparse delta (a few node rows move, round-4 review #2)
+                # that lands as an on-device scatter into the resident
+                # tensors, then Assign runs straight off them — no host
+                # re-encode, no full re-upload
                 from koordinator_tpu.bridge.state import numpy_to_tensor
 
                 prev_req = np.frombuffer(
                     req.nodes.requested.data, "<i8"
                 ).reshape(tuple(req.nodes.requested.shape)).copy()
-                warm_req_arr = prev_req.copy()
-                warm_req_arr[:3, 0] += 500  # three nodes' cpu moves
-                warm = pb2.SyncRequest()
-                warm.nodes.requested.CopyFrom(
-                    numpy_to_tensor(warm_req_arr, prev_req)
-                )
-                warm_payload = warm.SerializeToString()
-                t0 = time.perf_counter()
-                sync = pb2.SyncReply.FromString(call(METHOD_SYNC, warm_payload))
-                delta_sync_ms = _ms(t0)
+                delta_sync_ms = None
+                warm_payload = b""
+                warm_times = []
+                for rep in range(3):
+                    warm_req_arr = prev_req.copy()
+                    warm_req_arr[:3, 0] += 500 + rep  # three nodes' cpu move
+                    warm = pb2.SyncRequest()
+                    warm.nodes.requested.CopyFrom(
+                        numpy_to_tensor(warm_req_arr, prev_req)
+                    )
+                    warm_payload = warm.SerializeToString()
+                    t0 = time.perf_counter()
+                    sync = pb2.SyncReply.FromString(
+                        call(METHOD_SYNC, warm_payload)
+                    )
+                    delta_ms = _ms(t0)
+                    delta_sync_ms = (
+                        delta_ms if delta_sync_ms is None
+                        else min(delta_sync_ms, delta_ms)
+                    )
+                    prev_req = warm_req_arr
+                    assert server.servicer.state.last_sync_path == "warm", (
+                        "delta sync must land on the resident device tensors"
+                    )
+                    reply, ms = assign(sync.snapshot_id)
+                    warm_times.append(ms)
                 phase(
-                    "delta_sync",
-                    ms=round(delta_sync_ms, 2),
+                    "warm_assign",
+                    ms=round(min(warm_times), 2),
+                    delta_sync_ms=round(delta_sync_ms, 2),
                     bytes=len(warm_payload),
                 )
                 assert len(warm_payload) < len(payload) // 100, (
                     "delta frame should be ~100x below the full sync"
                 )
 
-                areq = pb2.AssignRequest(
-                    snapshot_id=sync.snapshot_id
-                ).SerializeToString()
-                # first assign pays the compile; steady state over 3
-                reply = pb2.AssignReply.FromString(call(METHOD_ASSIGN, areq))
-                phase("first_assign", path=reply.path)
-                times = []
+                # COLD cycles (the pre-PR price of EVERY Assign): drop
+                # the resident state so the next full Sync re-decodes
+                # everything and Assign pays the host re-encode + full
+                # upload before the device cycle
+                from koordinator_tpu.bridge.state import ResidentState
+
+                cold_times = []
                 for _ in range(3):
-                    t0 = time.perf_counter()
-                    reply = pb2.AssignReply.FromString(
-                        call(METHOD_ASSIGN, areq)
-                    )
-                    times.append(_ms(t0))
+                    server.servicer.state = ResidentState()
+                    sync = pb2.SyncReply.FromString(call(METHOD_SYNC, payload))
+                    assert server.servicer.state.last_sync_path == "cold"
+                    reply, ms = assign(sync.snapshot_id)
+                    cold_times.append(ms)
+                phase("cold_assign", ms=round(min(cold_times), 2))
+
                 assigned = sum(1 for a in reply.assignment if a >= 0)
                 sreq = pb2.ScoreRequest(
                     snapshot_id=sync.snapshot_id, top_k=32, flat=True
@@ -758,15 +801,25 @@ def child_config(platform: str, config: str) -> None:
             finally:
                 conn.close()
                 server.stop()
+        cold_ms = min(cold_times)
+        warm_ms = min(warm_times)
         print(
             json.dumps(
                 {
                     "metric": "bridge_assign_10kpod_2knode_ms",
-                    "value": round(min(times), 2),
+                    # the cold steady-state price: Assign after a full
+                    # Sync dropped residency (host re-encode + full
+                    # upload + device cycle) — what every warm cycle
+                    # paid before the resident fast path
+                    "value": round(cold_ms, 2),
                     "unit": "ms",
                     "backend": backend,
                     "path": reply.path,
                     "assigned": assigned,
+                    # warm cycle: the delta sync scattered on device and
+                    # Assign ran straight off the resident tensors
+                    "warm_assign_ms": round(warm_ms, 2),
+                    "warm_speedup": round(cold_ms / warm_ms, 3),
                     "sync_ms": round(sync_ms, 1),
                     "sync_bytes": len(payload),
                     "delta_sync_ms": round(delta_sync_ms, 2),
@@ -925,7 +978,27 @@ def _env_seconds(name: str, default: float) -> float:
         return default
 
 
-def _probe_until(deadline_seconds: float):
+class _Budget:
+    """Total-wall-clock accountant: stage windows are derived from what
+    remains, and a CPU-fallback slot is always held back so the last
+    stage can still print an artifact line inside the driver's timeout."""
+
+    def __init__(self, total: float, reserve: float):
+        self.start = time.monotonic()
+        self.total = total
+        self.reserve = reserve
+
+    def remaining(self) -> float:
+        return max(0.0, self.total - (time.monotonic() - self.start))
+
+    def window(self, want: float, reserve: Optional[float] = None) -> float:
+        """Clamp a desired stage window to the budget, holding back the
+        CPU-fallback reserve (pass reserve=0 for the fallback itself)."""
+        keep = self.reserve if reserve is None else reserve
+        return max(0.0, min(want, self.remaining() - keep))
+
+
+def _probe_until(budget: "_Budget", window_seconds: float):
     """Probe for a LIVE TPU repeatedly until the window closes.
 
     A tunneled TPU can be down for minutes and flap back (multi-hour
@@ -934,10 +1007,15 @@ def _probe_until(deadline_seconds: float):
     the CPU backend and the probe "succeeds" reporting cpu.  Both are
     retryable non-answers here — the bench fights for a TPU artifact
     across the whole window.  Returns (tpu_alive, errors)."""
-    deadline = time.monotonic() + deadline_seconds
+    deadline = time.monotonic() + budget.window(window_seconds)
     errors = []
     while True:
-        ok, out, err = _spawn("--probe", "default", {}, PROBE_TIMEOUT)
+        left = deadline - time.monotonic()
+        if left <= 0 or budget.window(PROBE_TIMEOUT) <= 0:
+            return False, errors[-2:]
+        ok, out, err = _spawn(
+            "--probe", "default", {}, max(1.0, min(PROBE_TIMEOUT, left))
+        )
         if ok and '"probe": "cpu"' not in (out or ""):
             return True, errors[-2:]
         errors.append(err if not ok else "probe demoted to cpu backend")
@@ -947,27 +1025,41 @@ def _probe_until(deadline_seconds: float):
 
 
 def parent() -> int:
-    """Probe, then measure with retries + hard timeouts; ONE JSON line."""
-    # default probe window 40 min (round-4 review: the round-4 artifact
-    # fell back to CPU inside a multi-hour tunnel outage; a TPU-backed
-    # artifact is worth waiting well past one flap cycle for).  Tune down
-    # with KOORD_BENCH_TPU_WAIT for interactive runs.
-    tpu_alive, errors = _probe_until(_env_seconds("KOORD_BENCH_TPU_WAIT", 2400.0))
+    """Probe, then measure with retries + hard timeouts; ONE JSON line,
+    inside KOORD_BENCH_TOTAL_BUDGET seconds under every failure mode."""
+    # The CPU fallback's slot is reserved from the start; the TPU probe
+    # window (default 40 min, round-4 review: a TPU artifact is worth
+    # waiting a flap cycle for) shrinks to whatever the total budget
+    # leaves after that reservation — artifact first, probing second.
+    budget = _Budget(
+        _env_seconds("KOORD_BENCH_TOTAL_BUDGET", TOTAL_BUDGET),
+        reserve=CPU_TIMEOUT + 30.0,
+    )
+    tpu_alive, errors = _probe_until(
+        budget, _env_seconds("KOORD_BENCH_TPU_WAIT", 2400.0)
+    )
     if tpu_alive:
-        # fight for the TPU across the whole bench window: three attempts
-        # with a fresh backend probe between retries, so a transient
-        # tunnel hiccup mid-run doesn't demote the artifact to CPU
+        # fight for the TPU across the remaining window: up to three
+        # attempts with a fresh backend probe between retries, so a
+        # transient tunnel hiccup mid-run doesn't demote the artifact
         for attempt, timeout in enumerate(
             (TPU_TIMEOUT, TPU_TIMEOUT, TPU_TIMEOUT * 3 // 4)
         ):
+            timeout = budget.window(timeout)
+            if timeout <= 60:
+                errors.append("tpu attempt skipped: budget exhausted")
+                break
             ok, final, err = _spawn("--child", "default", {}, timeout)
             if ok:
                 print(final)
                 return 0
             errors.append(err)
             if attempt < 2:
+                if budget.window(PROBE_TIMEOUT) <= 0:
+                    errors.append("reprobe skipped: budget exhausted")
+                    break
                 ok, pout, perr = _spawn(
-                    "--probe", "default", {}, PROBE_TIMEOUT
+                    "--probe", "default", {}, budget.window(PROBE_TIMEOUT)
                 )
                 # same demotion check as the initial gate: a dead tunnel
                 # makes jax fall back to CPU, so a "successful" probe that
@@ -979,7 +1071,10 @@ def parent() -> int:
     # fallback so an artifact exists either way; "backend" in the line
     # records the truth, and "note" records WHY it is cpu so a reader
     # does not misread a platform outage as a performance regression
-    ok, final, err = _spawn("--child", "cpu", _CPU_ENV, CPU_TIMEOUT)
+    ok, final, err = _spawn(
+        "--child", "cpu", _CPU_ENV,
+        max(60.0, budget.window(CPU_TIMEOUT, reserve=0.0)),
+    )
     if ok:
         try:
             doc = json.loads(final)
@@ -1033,21 +1128,31 @@ def main() -> int:
         child_config(args.platform, args.config)
         return 0
     if args.config:
-        # same probe machinery as the headline parent (shorter default
-        # window: configs are secondary artifacts)
+        # same probe + budget machinery as the headline parent (shorter
+        # default probe window: configs are secondary artifacts)
+        budget = _Budget(
+            _env_seconds("KOORD_BENCH_TOTAL_BUDGET", TOTAL_BUDGET),
+            reserve=CPU_TIMEOUT + 30.0,
+        )
         tpu_alive, errors = _probe_until(
-            _env_seconds("KOORD_BENCH_TPU_WAIT_CONFIG", 240.0)
+            budget, _env_seconds("KOORD_BENCH_TPU_WAIT_CONFIG", 240.0)
         )
         if tpu_alive:
-            ok, out, err = _spawn(
-                "--child", "default", {}, TPU_TIMEOUT, config=args.config
-            )
-            if ok:
-                print(out)
-                return 0
-            errors.append(err)
+            window = budget.window(TPU_TIMEOUT)
+            if window > 60:
+                ok, out, err = _spawn(
+                    "--child", "default", {}, window, config=args.config
+                )
+                if ok:
+                    print(out)
+                    return 0
+                errors.append(err)
+            else:
+                errors.append("tpu attempt skipped: budget exhausted")
         ok, out, err = _spawn(
-            "--child", "cpu", _CPU_ENV, CPU_TIMEOUT, config=args.config
+            "--child", "cpu", _CPU_ENV,
+            max(60.0, budget.window(CPU_TIMEOUT, reserve=0.0)),
+            config=args.config,
         )
         if ok:
             print(out)

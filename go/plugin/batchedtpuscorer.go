@@ -141,13 +141,18 @@ func (c *NodeMetricCache) Usage(node string) ([]int64, bool) {
 
 // residentMirror is the last ACKED node table: the delta baseline.  Like
 // the Python client (bridge/client.py), new values are promoted only
-// after the server confirms the Sync, and a generation jump (another
+// after the server confirms the Sync, and a continuity break (another
 // client synced, or the sidecar restarted and lost its resident
 // tensors) invalidates the baseline so the next sync ships full state.
+// Continuity is epoch+generation: snapshot ids are "s<epoch>-<gen>"
+// where epoch is the sidecar's per-boot nonce — after a restart the
+// generation counter resets, so a bare gen == mirror.gen+1 check can
+// coincidentally pass and silently land deltas on a foreign baseline.
 type residentMirror struct {
 	names                  []string
 	alloc, requested, usage []int64
 	gen                    int64
+	epoch                  string
 	valid                  bool
 }
 
@@ -203,8 +208,13 @@ func (s *Scorer) ensureClient() (*scorerclient.Client, error) {
 
 // dropClient discards a client whose connection errored so the next
 // cycle re-dials (the sidecar may have restarted); without this one
-// broken fd would disable scoring until the scheduler restarts.
+// broken fd would disable scoring until the scheduler restarts.  Nil is
+// a no-op: the recovery path can reach here with client == nil when the
+// re-dial itself failed.
 func (s *Scorer) dropClient(c *scorerclient.Client) {
+	if c == nil {
+		return
+	}
 	s.mu.Lock()
 	defer s.mu.Unlock()
 	if s.client == c {
@@ -345,29 +355,42 @@ func (s *Scorer) PreScore(
 	// around dial/drop
 	delta := s.mirror.valid && slices.Equal(s.mirror.names, names)
 	syncReply, err := client.Sync(buildSync(&s.mirror, delta, names, alloc, requested, usage, fresh, pod))
+	resyncedFull := false
+	if err != nil && delta {
+		// a restarted sidecar lost its resident tensors (and usually the
+		// connection too): the delta frame is unservable but the condition
+		// is recoverable within this same cycle — re-dial and ship full
+		// state once before surfacing an error
+		s.dropClient(client)
+		if client, err = s.ensureClient(); err == nil {
+			syncReply, err = client.Sync(buildSync(&s.mirror, false, names, alloc, requested, usage, fresh, pod))
+			resyncedFull = err == nil
+		}
+	}
 	if err != nil {
-		// the sidecar may not have applied the deltas (a restart loses
-		// its resident tensors): next cycle must ship full state
+		// the sidecar may not have applied the deltas: next cycle must
+		// ship full state
 		s.mirror.invalidate()
 		s.dropClient(client)
 		return framework.AsStatus(fmt.Errorf("sync: %w", err))
 	}
-	gen := scorerclient.Generation(syncReply.SnapshotID)
-	if delta && gen != s.mirror.gen+1 {
-		// another client synced in between (or the sidecar restarted and
-		// rebuilt): our deltas landed on a base we never saw — re-sync
-		// the full table before trusting any scores
+	epoch, gen := scorerclient.ParseSnapshotID(syncReply.SnapshotID)
+	if delta && !resyncedFull && (epoch != s.mirror.epoch || gen != s.mirror.gen+1) {
+		// another client synced in between, or the sidecar restarted
+		// under a fresh epoch (caught even when the new generation
+		// coincidentally continues ours): our deltas landed on a base we
+		// never saw — re-sync the full table before trusting any scores
 		syncReply, err = client.Sync(buildSync(&s.mirror, false, names, alloc, requested, usage, fresh, pod))
 		if err != nil {
 			s.mirror.invalidate()
 			s.dropClient(client)
 			return framework.AsStatus(fmt.Errorf("full re-sync: %w", err))
 		}
-		gen = scorerclient.Generation(syncReply.SnapshotID)
+		epoch, gen = scorerclient.ParseSnapshotID(syncReply.SnapshotID)
 	}
 	s.mirror = residentMirror{
 		names: names, alloc: alloc, requested: requested, usage: usage,
-		gen: gen, valid: true,
+		gen: gen, epoch: epoch, valid: true,
 	}
 	reply, err := client.ScoreFlat(0)
 	if err != nil {

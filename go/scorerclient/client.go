@@ -70,17 +70,41 @@ func (c *Client) call(method byte, payload []byte) ([]byte, error) {
 	return body, nil
 }
 
-// Generation parses a server snapshot id ("s<generation>",
-// bridge/server.py); -1 when absent or malformed.  Delta-syncing callers
-// compare successive generations to detect a displaced resident state
-// (another client synced in between, or the sidecar restarted) and fall
-// back to a full sync.
-func Generation(snapshotID string) int64 {
-	n, err := strconv.ParseInt(strings.TrimPrefix(snapshotID, "s"), 10, 64)
-	if err != nil {
-		return -1
+// ParseSnapshotID splits a server snapshot id ("s<epoch>-<generation>",
+// bridge/server.py; the epoch is a per-boot nonce) into its halves.
+// Legacy epoch-less "s<generation>" ids parse with an empty epoch; a
+// malformed generation parses as -1, which never satisfies a continuity
+// check.  Delta-syncing callers must require the SAME epoch AND
+// gen == previous+1 before trusting a delta baseline: after a sidecar
+// restart the generation counter resets, so the bare arithmetic check
+// can coincidentally pass on a foreign baseline.
+//
+// Deploy order: upgrade plugin binaries together with (or before) an
+// epoch-emitting sidecar.  A pre-epoch plugin parses the new id format
+// as -1, fails continuity every cycle, and silently degrades to a full
+// re-sync per cycle — correct placements, but the sparse-delta saving
+// is gone.
+func ParseSnapshotID(snapshotID string) (string, int64) {
+	body := strings.TrimPrefix(snapshotID, "s")
+	if i := strings.LastIndexByte(body, '-'); i >= 0 {
+		gen, err := strconv.ParseInt(body[i+1:], 10, 64)
+		if err != nil {
+			return body[:i], -1
+		}
+		return body[:i], gen
 	}
-	return n
+	gen, err := strconv.ParseInt(body, 10, 64)
+	if err != nil {
+		return "", -1
+	}
+	return "", gen
+}
+
+// Generation is the generation half of ParseSnapshotID; -1 when absent
+// or malformed.
+func Generation(snapshotID string) int64 {
+	_, gen := ParseSnapshotID(snapshotID)
+	return gen
 }
 
 // Sync ships the cluster snapshot and records the acknowledged id.

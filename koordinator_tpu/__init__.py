@@ -33,19 +33,44 @@ import jax
 jax.config.update("jax_enable_x64", True)
 
 # Persistent XLA compilation cache: the cycle kernels take 10-20s to compile
-# per shape bucket, but a scheduler must be ready at informer-sync speed
-# (reference analog: cmd/koord-scheduler/app/server.go:206-220).  With the
-# cache a fresh process reuses the traced executable and the first cycle
-# runs in well under a second.  Opt out with KOORD_XLA_CACHE=0 or point
-# KOORD_XLA_CACHE at a different directory.
+# per shape bucket (16.5s measured for the dense TPU kernel, BENCH_r03), but
+# a scheduler must be ready at informer-sync speed (reference analog:
+# cmd/koord-scheduler/app/server.go:206-220).  With the cache a restarted
+# sidecar reuses the compiled executable and the first cycle runs in well
+# under a second.  Opt out with KOORD_XLA_CACHE=0 or point KOORD_XLA_CACHE
+# at a different directory; daemons re-point it under their --state-dir via
+# configure_compilation_cache (scheduler/server.py).
+
+
+def configure_compilation_cache(path, min_compile_seconds: float = 1.0) -> None:
+    """Point JAX's persistent compilation cache at ``path``.
+
+    Must run before the first compile — the cache is initialized lazily on
+    first use and later re-pointing does not move already-initialized
+    state.  ``path=None`` or ``""`` disables the cache.  The
+    ``KOORD_XLA_CACHE`` env var takes precedence over programmatic calls
+    (an operator override must win over a daemon default).
+    """
+    env = os.environ.get("KOORD_XLA_CACHE", "")
+    if env:
+        return  # import-time wiring below already honored the override
+    if not path:
+        jax.config.update("jax_compilation_cache_dir", None)
+        return
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache every compile that costs more than min_compile_seconds; keep
+    # tiny jits out (caching them would churn small files for no win)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", float(min_compile_seconds)
+    )
+
+
 _cache = os.environ.get("KOORD_XLA_CACHE", "")
 if _cache != "0":
     jax.config.update(
         "jax_compilation_cache_dir",
         _cache or os.path.expanduser("~/.cache/koordinator_tpu/xla"),
     )
-    # cache every compile that costs more than a second; keep the default
-    # for tiny jits (caching them would churn small files for no win)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 __version__ = "0.1.0"

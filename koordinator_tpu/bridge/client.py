@@ -18,12 +18,19 @@ from koordinator_tpu.bridge.codegen import method_path, pb2
 from koordinator_tpu.bridge.state import numpy_to_tensor
 
 
-def _parse_generation(snapshot_id: str) -> int:
-    """Server snapshot ids are "s<generation>" (bridge/server.py)."""
+def parse_snapshot_id(snapshot_id: str) -> Tuple[str, int]:
+    """Server snapshot ids are "s<epoch>-<generation>" (bridge/server.py;
+    the epoch is a per-boot nonce).  Legacy epoch-less "s<generation>" ids
+    parse with an empty epoch; malformed ids yield generation -1, which
+    never satisfies a continuity check."""
+    body = snapshot_id[1:] if snapshot_id.startswith("s") else snapshot_id
+    epoch, sep, gen = body.rpartition("-")
+    if not sep:
+        epoch, gen = "", body
     try:
-        return int(snapshot_id.lstrip("s"))
+        return epoch, int(gen)
     except ValueError:
-        return -1
+        return epoch, -1
 
 
 class ScorerClient:
@@ -52,6 +59,7 @@ class ScorerClient:
         self._prev: Dict[str, np.ndarray] = {}
         self._prev_scalars: Dict[str, tuple] = {}
         self._generation: Optional[int] = None
+        self._epoch: Optional[str] = None
         self.snapshot_id: Optional[str] = None
 
     def close(self) -> None:
@@ -61,6 +69,7 @@ class ScorerClient:
         self._prev.clear()
         self._prev_scalars.clear()
         self._generation = None
+        self._epoch = None
         self.snapshot_id = None
 
     def sync(
@@ -163,19 +172,33 @@ class ScorerClient:
             return req
 
         baseline = self._prev
+        sent_full = False
         try:
             reply = self._sync(build(baseline, full=False))
         except grpc.RpcError:
-            # the server may not have applied the deltas (restart loses its
-            # resident tensors): invalidate the baseline so the next sync
-            # ships full tensors
-            self._invalidate()
-            raise
-        gen = _parse_generation(reply.snapshot_id)
-        if self._generation is not None and gen != self._generation + 1:
-            # another client synced in between (or the server restarted and
-            # rebuilt): our deltas were applied onto a base we never saw.
-            # Re-sync full tensors — from the pre-clear baseline, so fields
+            if not baseline:
+                # nothing was delta-encoded; the failure is not recoverable
+                # by resending full state
+                self._invalidate()
+                raise
+            # a restarted sidecar lost its resident tensors and refused the
+            # delta frame — recoverable within the same cycle with one full
+            # re-sync (ADVICE r5); a second failure is surfaced
+            try:
+                reply = self._sync(build(baseline, full=True))
+                sent_full = True
+            except grpc.RpcError:
+                self._invalidate()
+                raise
+        epoch, gen = parse_snapshot_id(reply.snapshot_id)
+        if self._generation is not None and not sent_full and (
+            epoch != self._epoch or gen != self._generation + 1
+        ):
+            # another client synced in between, or the server restarted
+            # (fresh epoch — the bare generation can coincidentally line
+            # up after a restart, so the epoch check is load-bearing):
+            # our deltas were applied onto a base we never saw.  Re-sync
+            # full tensors — from the pre-clear baseline, so fields
             # omitted this cycle still resend their last acked state.
             try:
                 reply = self._sync(build(baseline, full=True))
@@ -184,10 +207,11 @@ class ScorerClient:
                 # treat the baseline as unknown
                 self._invalidate()
                 raise
-            gen = _parse_generation(reply.snapshot_id)
+            epoch, gen = parse_snapshot_id(reply.snapshot_id)
         self._prev = dict(baseline, **staged)
         self._prev_scalars.update(staged_scalars)
         self._generation = gen
+        self._epoch = epoch
         self.snapshot_id = reply.snapshot_id
         return reply
 

@@ -15,7 +15,9 @@ Go references (line-level mirrors):
   * nodeInfoVectors       -> node_vectors
   * DeltaTensor           -> delta_tensor (go/scorerclient/delta.go)
   * buildSync             -> build_sync
-  * Scorer.PreScore       -> GoPluginSim.pre_score
+  * Scorer.PreScore       -> GoPluginSim.pre_score (including the
+    delta-failure full-retry and the epoch+generation continuity check)
+  * parseSnapshotID       -> parse_snapshot_id
   * scorerclient.Generation -> generation
   * NodeMetricCache.SetQuantities (the NodeMetric informer parse)
                           -> usage_vector_from_node_metric
@@ -41,12 +43,26 @@ METHOD_SCORE = 2
 METHOD_ASSIGN = 3
 
 
-def generation(snapshot_id: str) -> int:
-    """scorerclient.Generation: parse "s<generation>", -1 when malformed."""
+def parse_snapshot_id(snapshot_id: str) -> Tuple[str, int]:
+    """scorerclient.ParseSnapshotID: "s<epoch>-<generation>" -> (epoch,
+    generation); legacy "s<generation>" -> ("", generation); malformed
+    generations parse as -1 (never satisfies a continuity check).  The
+    epoch is the sidecar's per-boot nonce: delta continuity requires the
+    SAME epoch — after a restart the generation counter resets and bare
+    ``gen == mirror.gen+1`` can coincidentally pass (ADVICE r5)."""
+    body = snapshot_id.removeprefix("s")
+    epoch, sep, gen = body.rpartition("-")
+    if not sep:
+        epoch, gen = "", body
     try:
-        return int(snapshot_id.removeprefix("s"))
+        return epoch, int(gen)
     except ValueError:
-        return -1
+        return epoch, -1
+
+
+def generation(snapshot_id: str) -> int:
+    """scorerclient.Generation: the generation half of the snapshot id."""
+    return parse_snapshot_id(snapshot_id)[1]
 
 
 def usage_vector_from_node_metric(payload: Dict) -> Optional[List[int]]:
@@ -185,6 +201,7 @@ class ResidentMirror:
         self.requested: List[int] = []
         self.usage: List[int] = []
         self.gen = 0
+        self.epoch = ""
         self.valid = False
 
 
@@ -253,43 +270,60 @@ class GoPluginSim:
         )
         pod_vec = list(pod_vec)
         delta = self.mirror.valid and self.mirror.names == names
-        try:
-            reply = wirecheck.decode_sync_reply(
+
+        def sync_once(as_delta: bool) -> Dict:
+            return wirecheck.decode_sync_reply(
                 self._call(
                     METHOD_SYNC,
                     build_sync(
-                        self.mirror, delta, names, alloc, requested,
+                        self.mirror, as_delta, names, alloc, requested,
                         usage, fresh, pod_name, pod_vec, priority,
                     ),
                 )
             )
+
+        resynced_full = False
+        try:
+            reply = sync_once(delta)
         except Exception:
-            self.mirror.invalidate()
+            if not delta:
+                self.mirror.invalidate()
+                self._drop_client()
+                raise
+            # delta-Sync failure is recoverable within the same cycle: a
+            # restarted sidecar lost its resident tensors (and possibly
+            # the connection) — re-dial and ship full state once before
+            # surfacing an error (ADVICE r5)
             self._drop_client()
-            raise
-        gen = generation(reply["snapshot_id"])
-        if delta and gen != self.mirror.gen + 1:
-            # resident state displaced: full re-sync before trusting scores
             try:
-                reply = wirecheck.decode_sync_reply(
-                    self._call(
-                        METHOD_SYNC,
-                        build_sync(
-                            self.mirror, False, names, alloc, requested,
-                            usage, fresh, pod_name, pod_vec, priority,
-                        ),
-                    )
-                )
+                reply = sync_once(False)
+                resynced_full = True
             except Exception:
                 self.mirror.invalidate()
                 self._drop_client()
                 raise
-            gen = generation(reply["snapshot_id"])
+        epoch, gen = parse_snapshot_id(reply["snapshot_id"])
+        if delta and not resynced_full and (
+            epoch != self.mirror.epoch or gen != self.mirror.gen + 1
+        ):
+            # resident state displaced (foreign sync bumped the
+            # generation, or a restart reset it under a fresh epoch —
+            # the epoch comparison catches the restart even when the new
+            # generation coincidentally continues ours): full re-sync
+            # before trusting scores
+            try:
+                reply = sync_once(False)
+            except Exception:
+                self.mirror.invalidate()
+                self._drop_client()
+                raise
+            epoch, gen = parse_snapshot_id(reply["snapshot_id"])
         self.mirror.names = names
         self.mirror.alloc = alloc
         self.mirror.requested = requested
         self.mirror.usage = usage
         self.mirror.gen = gen
+        self.mirror.epoch = epoch
         self.mirror.valid = True
         try:
             score = wirecheck.decode_score_reply(

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 from concurrent import futures
 from typing import Optional
 
@@ -41,17 +42,30 @@ class ScorerServicer:
         self.mesh = mesh
         self.state = ResidentState()
         self._generation = 0
+        # per-boot epoch in every snapshot id ("s<epoch>-<gen>"): a client
+        # checking bare generation continuity (gen == mirror.gen+1) can
+        # coincidentally pass after a sidecar restart reset the counter,
+        # and would then delta-sync onto a foreign baseline; the epoch
+        # makes the restart unmistakable (ADVICE r5)
+        self._epoch = uuid.uuid4().hex[:8]
         # one lock over state-mutating Sync and state-reading Score/Assign:
         # the server runs on a thread pool, and a Sync racing a Score would
         # otherwise let one cycle mix tensors from two generations
         self._lock = threading.Lock()
 
+    def snapshot_id(self) -> str:
+        return f"s{self._epoch}-{self._generation}"
+
     def _check_generation(self, req, ctx) -> None:
         want = getattr(req, "snapshot_id", "")
-        if want and want != f"s{self._generation}":
+        # the FULL id must match, epoch included: accepting a bare
+        # legacy "s<gen>" here would re-open for Score/Assign the very
+        # restart-coincidence the epoch closes (clients echo the Sync
+        # reply's id verbatim, so nothing legitimate constructs one)
+        if want and want != self.snapshot_id():
             msg = (
                 f"snapshot {want!r} is not resident "
-                f"(current s{self._generation})"
+                f"(current {self.snapshot_id()})"
             )
             if ctx is not None:
                 ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, msg)
@@ -62,12 +76,12 @@ class ScorerServicer:
         with self._lock:
             self.state.apply_sync(req)
             self._generation += 1
-            # counts come from the host mirrors — building the padded
-            # device snapshot here would make every warm delta sync pay
-            # the full re-encode that Score/Assign (which actually need
-            # it) will build lazily anyway
+            # counts come from the host mirrors.  A warm frame lands its
+            # deltas straight on the resident device tensors inside
+            # apply_sync (state.last_sync_path == "warm"); only a cold
+            # frame defers the full padded build to the next Score/Assign
             return pb2.SyncReply(
-                snapshot_id=f"s{self._generation}",
+                snapshot_id=self.snapshot_id(),
                 nodes=self.state.node_alloc.shape[0],
                 pods=self.state.pod_requests.shape[0],
             )

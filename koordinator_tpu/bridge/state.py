@@ -1,14 +1,42 @@
 """Resident snapshot state for the scorer sidecar.
 
-The host->device transfer is the boundary to engineer (SURVEY §5/§7): the
-server keeps numpy mirrors of every snapshot tensor; a warm Sync ships
-only sparse (index, value) deltas (native/koordnative.cpp codec) against
-them, and only the tensors that changed are re-uploaded to the device.
+The host->device transfer is the boundary to engineer (SURVEY §5/§7), in
+two layers:
+
+* **host mirrors** — the server keeps numpy mirrors of every snapshot
+  tensor; a warm Sync ships only sparse (index, value) deltas
+  (native/koordnative.cpp codec) against them.  The mirrors are the
+  source of truth: validation, i32-bounds checks and cold rebuilds all
+  read them.
+* **device residency** (the warm-cycle fast path) — the committed
+  ``ClusterSnapshot``'s ``jax.Array`` tensors stay alive across Syncs.
+  A delta frame is applied ON DEVICE as a jitted scatter
+  (solver/resident.py, donating the dead pre-delta buffer); a full
+  tensor of unchanged geometry re-uploads just that tensor; and derived
+  columns (padded priority/gang/quota vectors, freshness masks) are
+  rebuilt only when their wire columns actually changed.  Assign/Score
+  then run straight off the resident arrays — a warm cycle pays
+  O(changed), skipping the host re-encode and the full host->device
+  re-upload entirely.
+
+Any geometry change (table size, pad bucket, a tensor appearing or
+disappearing) drops device residency and the next snapshot() is a cold
+rebuild from the mirrors.  The two paths are bit-exact by construction
+(the warm path edits the same padded cells the cold encode would write);
+tests/test_resident_warm.py fuzzes random delta sequences against cold
+re-encodes on both the scan and interpret-mode Pallas paths.
+
+The resident snapshot carries NO name tuples: names are static pytree
+metadata, so routing them through the jitted cycle would retrace it
+whenever a pod name changes (every warm cycle on the Go seam).  Names
+stay host-side on this object; replies are index-based.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+import dataclasses
+import logging
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -28,18 +56,22 @@ from koordinator_tpu.model.snapshot import (
 
 R = res.NUM_RESOURCES
 
+logger = logging.getLogger(__name__)
 
-def tensor_to_numpy(
+
+def decode_tensor(
     t: "pb2.Tensor", base: Optional[np.ndarray]
-) -> Optional[np.ndarray]:
-    """Decode a proto Tensor: full payload, or sparse delta onto ``base``.
+) -> Tuple[Optional[np.ndarray], str, Optional[np.ndarray], Optional[np.ndarray]]:
+    """Decode a proto Tensor against the resident ``base`` mirror.
 
-    Returns the new mirror array, or None when the message carries nothing
-    (tensor unchanged since the last sync).
+    Returns ``(mirror, kind, idx, val)`` where kind is "none" (message
+    carries nothing; tensor unchanged), "full" (full payload) or "delta"
+    (sparse update; idx/val are the validated flat indices and values so
+    the device path can scatter them without re-diffing).
     """
     if t.data:
         arr = np.frombuffer(t.data, dtype="<i8").copy()
-        return arr.reshape(tuple(t.shape))
+        return arr.reshape(tuple(t.shape)), "full", None, None
     if t.delta_idx:
         if base is None:
             raise ValueError("delta sync without a resident tensor")
@@ -58,6 +90,13 @@ def tensor_to_numpy(
             raise ValueError(
                 f"delta index/value length mismatch: {len(idx)} vs {len(val)}"
             )
+        # duplicate indices are rejected, not tolerated: the host path
+        # (native.delta_apply) is sequential last-wins but the device
+        # scatter's duplicate semantics are implementation-defined, so a
+        # frame with repeats could silently split the mirror from the
+        # resident tensors — and no honest delta encoder emits them
+        if len(idx) != len(np.unique(idx)):
+            raise ValueError("delta carries duplicate indices")
         # bounds-check BEFORE the native path: delta_apply writes through
         # raw pointers, so an out-of-range index from a hostile frame
         # would corrupt server memory instead of raising
@@ -68,8 +107,20 @@ def tensor_to_numpy(
             )
         out = base.copy()
         native.delta_apply(out, idx, val)
-        return out
-    return None
+        return out, "delta", idx, val
+    return None, "none", None, None
+
+
+def tensor_to_numpy(
+    t: "pb2.Tensor", base: Optional[np.ndarray]
+) -> Optional[np.ndarray]:
+    """Decode a proto Tensor: full payload, or sparse delta onto ``base``.
+
+    Returns the new mirror array, or None when the message carries nothing
+    (tensor unchanged since the last sync).
+    """
+    arr, kind, _, _ = decode_tensor(t, base)
+    return arr if kind != "none" else None
 
 
 def numpy_to_tensor(
@@ -115,8 +166,45 @@ def _pc_column(explicit, priority, P, pb):
     return col
 
 
+def _present(a: Optional[np.ndarray]) -> bool:
+    return a is not None and a.size > 0
+
+
+# wire tensors that ride the sparse-delta path, keyed by mirror attribute
+_DELTA_TENSORS = (
+    "node_alloc",
+    "node_requested",
+    "node_usage",
+    "node_agg",
+    "node_agg_fresh",
+    "node_prod",
+    "pod_requests",
+    "pod_estimated",
+    "quota_runtime",
+    "quota_used",
+    "quota_limited",
+)
+
+# companions reset to defaults when a full tensor changes the node table
+# size (ADVICE r5: a stale differently-shaped column must not linger to
+# fail later at snapshot build).  node_requested/node_usage are included:
+# a resize frame may legally omit them, and an old-shaped mirror would
+# otherwise be padded/truncated onto the NEW nodes' rows at snapshot
+# build — silently wrong data, or a broadcast error under a smaller
+# explicit bucket
+_NODE_COMPANIONS = ("node_fresh", "node_names", "node_agg", "node_agg_fresh",
+                    "node_prod", "node_requested", "node_usage")
+# NOTE: gang_min is deliberately NOT a pod companion — the gang table's
+# shape is per-gang, not per-pod (like the quota tables), so resetting it
+# on a pod resize would wipe gang gating while the new pod table's
+# gang_id column still references the gangs
+_POD_COMPANIONS = ("pod_priority", "pod_priority_class", "pod_gang",
+                   "pod_quota", "pod_names", "pod_estimated")
+_COMPANION_DEFAULTS = {"node_names": (), "pod_names": ()}
+
+
 class ResidentState:
-    """Numpy mirrors + the device ClusterSnapshot built from them."""
+    """Numpy mirrors + the device-resident ClusterSnapshot built from them."""
 
     def __init__(self):
         self.node_alloc: Optional[np.ndarray] = None
@@ -142,6 +230,10 @@ class ResidentState:
         self.pod_bucket = 0
         self._snapshot: Optional[ClusterSnapshot] = None
         self._i32_ok: Optional[bool] = None
+        # observability: how the last Sync landed on the device
+        # ("cold" = residency dropped, rebuild at next snapshot();
+        #  "warm" = resident tensors updated in place)
+        self.last_sync_path = "cold"
 
     def apply_sync(self, reqmsg: "pb2.SyncRequest") -> None:
         """Decode EVERYTHING first, commit only if every tensor decoded:
@@ -151,24 +243,26 @@ class ResidentState:
         delta baseline behind an unbumped generation."""
         n = reqmsg.nodes
         p = reqmsg.pods
-
-        def upd(current, tensor):
-            new = tensor_to_numpy(tensor, current)
-            return current if new is None else new
-
-        staged = {
-            "node_alloc": upd(self.node_alloc, n.allocatable),
-            "node_requested": upd(self.node_requested, n.requested),
-            "node_usage": upd(self.node_usage, n.usage),
-            "node_agg": upd(self.node_agg, n.agg_usage),
-            "node_agg_fresh": upd(self.node_agg_fresh, n.agg_fresh),
-            "node_prod": upd(self.node_prod, n.prod_usage),
-            "pod_requests": upd(self.pod_requests, p.requests),
-            "pod_estimated": upd(self.pod_estimated, p.estimated),
-            "quota_runtime": upd(self.quota_runtime, reqmsg.quotas.runtime),
-            "quota_used": upd(self.quota_used, reqmsg.quotas.used),
-            "quota_limited": upd(self.quota_limited, reqmsg.quotas.limited),
+        wire = {
+            "node_alloc": n.allocatable,
+            "node_requested": n.requested,
+            "node_usage": n.usage,
+            "node_agg": n.agg_usage,
+            "node_agg_fresh": n.agg_fresh,
+            "node_prod": n.prod_usage,
+            "pod_requests": p.requests,
+            "pod_estimated": p.estimated,
+            "quota_runtime": reqmsg.quotas.runtime,
+            "quota_used": reqmsg.quotas.used,
+            "quota_limited": reqmsg.quotas.limited,
         }
+        staged: Dict[str, object] = {}
+        tinfo: Dict[str, tuple] = {}
+        for key, tensor in wire.items():
+            current = getattr(self, key)
+            arr, kind, idx, val = decode_tensor(tensor, current)
+            staged[key] = current if kind == "none" else arr
+            tinfo[key] = (kind, idx, val)
         if staged["node_alloc"] is None or staged["pod_requests"] is None:
             raise ValueError("first Sync must carry full node and pod tensors")
         if n.metric_fresh:
@@ -191,17 +285,248 @@ class ResidentState:
             staged["gang_min"] = np.asarray(
                 list(reqmsg.gangs.min_member), np.int32
             )
-        staged["node_bucket"] = int(reqmsg.node_bucket) or pad_bucket(
-            staged["node_alloc"].shape[0]
+        # explicit wire buckets win; otherwise a warm frame that omits
+        # them INHERITS the resident bucket (sticky-grow) instead of
+        # recomputing pad_bucket and silently reshaping — and recompiling
+        # — the resident snapshot mid-stream
+        def bucket(wire_value, current, rows):
+            if wire_value:
+                return int(wire_value)
+            if current and current >= rows:
+                return current
+            return pad_bucket(rows)
+
+        staged["node_bucket"] = bucket(
+            reqmsg.node_bucket, self.node_bucket,
+            staged["node_alloc"].shape[0],
         )
-        staged["pod_bucket"] = int(reqmsg.pod_bucket) or pad_bucket(
-            staged["pod_requests"].shape[0]
+        staged["pod_bucket"] = bucket(
+            reqmsg.pod_bucket, self.pod_bucket,
+            staged["pod_requests"].shape[0],
         )
+        self._reset_companions(staged, tinfo)
+        # device-update plan, computed against the PRE-commit mirrors
+        plan = self._warm_plan(staged, tinfo)
         # atomic commit point: nothing above mutated self
         for key, value in staged.items():
             setattr(self, key, value)
-        self._snapshot = None  # rebuilt lazily
+        if plan is None:
+            self._snapshot = None  # cold: rebuilt lazily at snapshot()
+            self.last_sync_path = "cold"
+        else:
+            try:
+                self._snapshot = self._apply_warm(plan)
+                self.last_sync_path = "warm"
+            except Exception:
+                # a torn device update may have donated buffers out of the
+                # old snapshot: drop residency, the mirrors stay truthful
+                # and the next snapshot() cold-rebuilds from them
+                logger.exception(
+                    "warm device update failed; falling back to cold rebuild"
+                )
+                self._snapshot = None
+                self.last_sync_path = "cold"
         self._i32_ok = None
+
+    # -- companion resets (ADVICE r5) --
+    def _reset_companions(self, staged: Dict[str, object], tinfo) -> None:
+        """When a full tensor changes a table's row count, omitted
+        companion columns reset to defaults of the new shape instead of
+        lingering at the stale shape to fail later at snapshot build.
+        (None means "use the default of the current shape" everywhere in
+        this class: all-fresh, zero priority, no gang/quota membership,
+        estimated = requests.)"""
+        def rows(a):
+            return -1 if a is None else a.shape[0]
+
+        def reset(companions, new_rows):
+            for key in companions:
+                if key in _DELTA_TENSORS:
+                    # carried-over tensor mirror (nothing in this frame):
+                    # its rows no longer match the new table
+                    if tinfo[key][0] == "none":
+                        staged[key] = None
+                    elif rows(staged[key]) != new_rows:
+                        # a delta (validated against the PRE-resize
+                        # shape) or an old-shaped full tensor riding the
+                        # same frame as the resize: committing it would
+                        # silently pad stale rows onto the new table
+                        raise ValueError(
+                            f"{key} targets the pre-resize table "
+                            f"({rows(staged[key])} rows != {new_rows})"
+                        )
+                elif key not in staged:
+                    staged[key] = _COMPANION_DEFAULTS.get(key)
+
+        if rows(staged["node_alloc"]) != rows(self.node_alloc):
+            reset(_NODE_COMPANIONS, rows(staged["node_alloc"]))
+        if rows(staged["pod_requests"]) != rows(self.pod_requests):
+            reset(_POD_COMPANIONS, rows(staged["pod_requests"]))
+
+    # -- warm-path planning / application --
+    def _warm_plan(self, staged, tinfo):
+        """Decide how this Sync lands on the resident device snapshot.
+
+        Returns None when residency must drop (no resident snapshot, or
+        any geometry change: table rows, pad buckets, a tensor or table
+        appearing/disappearing).  Otherwise returns
+        ``(tensor_updates, derived)`` where tensor_updates maps mirror
+        keys to ("delta", idx, val) / ("full",) and derived is the set of
+        scalar-derived device columns to rebuild.  Runs BEFORE the mirror
+        commit so it can compare staged against current values."""
+        if self._snapshot is None:
+            return None
+        if (
+            staged["node_bucket"] != self.node_bucket
+            or staged["pod_bucket"] != self.pod_bucket
+        ):
+            return None
+
+        def shape(a):
+            return None if a is None else a.shape
+
+        # geometry must be identical for every resident tensor, and
+        # presence flips (None <-> array, empty <-> non-empty) change the
+        # snapshot structure -> cold
+        for key in _DELTA_TENSORS:
+            old, new = getattr(self, key), staged[key]
+            if _present(old) != _present(new):
+                return None
+            if _present(old) and shape(old) != shape(new):
+                return None
+        old_gang = self.gang_min if self.gang_min is not None else ()
+        new_gang = staged.get("gang_min", self.gang_min)
+        new_gang = new_gang if new_gang is not None else ()
+        if len(old_gang) != len(new_gang):
+            return None
+        # a freshness column of the wrong length would fail the cold
+        # build too; surface it there instead of a device-shape error
+        new_fresh = staged.get("node_fresh", self.node_fresh)
+        if new_fresh is not None and len(new_fresh) != staged["node_alloc"].shape[0]:
+            return None
+
+        tensor_updates = {}
+        for key in _DELTA_TENSORS:
+            kind, idx, val = tinfo[key]
+            if kind == "delta":
+                tensor_updates[key] = ("delta", idx, val)
+            elif kind == "full":
+                if not np.array_equal(staged[key], getattr(self, key)):
+                    tensor_updates[key] = ("full",)
+        # estimated falls back to requests while never synced: a requests
+        # update must land on the estimated device tensor too
+        if staged["pod_estimated"] is None and "pod_requests" in tensor_updates:
+            tensor_updates["pod_estimated_from_requests"] = tensor_updates[
+                "pod_requests"
+            ]
+
+        derived = set()
+        for key in ("node_fresh", "pod_priority", "pod_priority_class",
+                    "pod_gang", "pod_quota", "gang_min"):
+            if key not in staged:
+                continue
+            old = getattr(self, key)
+            if old is None or not np.array_equal(
+                np.asarray(staged[key]), np.asarray(old)
+            ):
+                derived.add(key)
+        if "pod_priority" in derived and staged.get(
+            "pod_priority_class", self.pod_priority_class
+        ) is None:
+            # priority_class is derived from priority bands when the wire
+            # never sent explicit classes
+            derived.add("pod_priority_class")
+        return tensor_updates, derived
+
+    def _apply_warm(self, plan) -> ClusterSnapshot:
+        """Apply a warm plan to the resident snapshot (mirrors are already
+        committed).  Delta tensors scatter on device (donating the dead
+        buffer); full tensors re-upload just themselves; derived columns
+        rebuild through the same builders the cold path uses."""
+        from koordinator_tpu.solver.resident import apply_flat_delta
+
+        tensor_updates, derived = plan
+        snap = self._snapshot
+        nodes, pods, quotas = snap.nodes, snap.pods, snap.quotas
+
+        def updated(dev_arr, key, update):
+            if update[0] == "delta":
+                return apply_flat_delta(dev_arr, update[1], update[2])
+            return None  # full: rebuilt below from the committed mirror
+
+        node_patch = {}
+        for key, field in (
+            ("node_alloc", "allocatable"),
+            ("node_requested", "requested"),
+            ("node_usage", "usage"),
+        ):
+            if key in tensor_updates:
+                new = updated(getattr(nodes, field), key, tensor_updates[key])
+                node_patch[field] = (
+                    new if new is not None
+                    else self._dev_padded2(key, self.node_bucket)
+                )
+        for key, field, builder in (
+            ("node_agg", "agg_usage", self._dev_agg_usage),
+            ("node_agg_fresh", "agg_fresh", self._dev_agg_fresh),
+            ("node_prod", "prod_usage", self._dev_prod_usage),
+        ):
+            if key in tensor_updates:
+                new = updated(getattr(nodes, field), key, tensor_updates[key])
+                node_patch[field] = new if new is not None else builder()
+        if "node_fresh" in derived:
+            node_patch["metric_fresh"] = self._dev_metric_fresh()
+
+        pod_patch = {}
+        if "pod_requests" in tensor_updates:
+            new = updated(pods.requests, "pod_requests",
+                          tensor_updates["pod_requests"])
+            pod_patch["requests"] = (
+                new if new is not None
+                else self._dev_padded2("pod_requests", self.pod_bucket)
+            )
+        est_update = tensor_updates.get(
+            "pod_estimated", tensor_updates.get("pod_estimated_from_requests")
+        )
+        if est_update is not None:
+            new = updated(pods.estimated, "pod_estimated", est_update)
+            pod_patch["estimated"] = (
+                new if new is not None else self._dev_estimated()
+            )
+        if "pod_priority" in derived:
+            pod_patch["priority"] = self._dev_priority()
+        if "pod_priority_class" in derived:
+            pod_patch["priority_class"] = self._dev_priority_class()
+        if "pod_gang" in derived:
+            pod_patch["gang_id"] = self._dev_gang_id()
+        if "pod_quota" in derived:
+            pod_patch["quota_id"] = self._dev_quota_id()
+
+        quota_patch = {}
+        for key, field in (
+            ("quota_runtime", "runtime"),
+            ("quota_used", "used"),
+            ("quota_limited", "limited"),
+        ):
+            if key in tensor_updates:
+                new = updated(getattr(quotas, field), key, tensor_updates[key])
+                if new is None:
+                    arr = getattr(self, key)
+                    new = jnp.asarray(
+                        arr.astype(bool) if field == "limited" else arr
+                    )
+                quota_patch[field] = new
+
+        if node_patch:
+            nodes = dataclasses.replace(nodes, **node_patch)
+        if pod_patch:
+            pods = dataclasses.replace(pods, **pod_patch)
+        if quota_patch:
+            quotas = dataclasses.replace(quotas, **quota_patch)
+        gangs = self._dev_gangs() if "gang_min" in derived else snap.gangs
+        return ClusterSnapshot(
+            nodes=nodes, pods=pods, gangs=gangs, quotas=quotas
+        )
 
     def i32_fits(self) -> bool:
         """Whether the resident tensors fit the Pallas kernel's i32
@@ -241,6 +566,103 @@ class ResidentState:
         out[: a.shape[0]] = a
         return out
 
+    # -- per-field device builders (shared by cold rebuild + warm patch;
+    #    one implementation keeps the two paths bit-exact) --
+    def _dev_padded2(self, key: str, rows: int) -> jnp.ndarray:
+        return jnp.asarray(
+            self._pad2(np.asarray(getattr(self, key), np.int64), rows)
+        )
+
+    def _dev_metric_fresh(self) -> jnp.ndarray:
+        N = self.node_alloc.shape[0]
+        fresh = np.zeros(self.node_bucket, bool)
+        fresh[:N] = (
+            self.node_fresh if self.node_fresh is not None else np.ones(N, bool)
+        )
+        return jnp.asarray(fresh)
+
+    def _dev_agg_usage(self):
+        if not _present(self.node_agg):
+            return None
+        return jnp.asarray(_pad_rows_to(self.node_agg, self.node_bucket))
+
+    def _dev_agg_fresh(self):
+        if not _present(self.node_agg_fresh):
+            return None
+        return jnp.asarray(
+            _pad_rows_to(self.node_agg_fresh, self.node_bucket).astype(bool)
+        )
+
+    def _dev_prod_usage(self):
+        if not _present(self.node_prod):
+            return None
+        return jnp.asarray(
+            _pad_rows_to(np.asarray(self.node_prod, np.int64), self.node_bucket)
+        )
+
+    def _dev_estimated(self) -> jnp.ndarray:
+        est = (
+            self.pod_estimated
+            if self.pod_estimated is not None
+            else self.pod_requests
+        )
+        return jnp.asarray(self._pad2(np.asarray(est, np.int64), self.pod_bucket))
+
+    def _dev_priority(self) -> jnp.ndarray:
+        P = self.pod_requests.shape[0]
+        prio = (
+            self.pod_priority
+            if self.pod_priority is not None
+            else np.zeros(P, np.int64)
+        )
+        pprio = np.zeros(self.pod_bucket, np.int64)
+        pprio[:P] = prio
+        return jnp.asarray(pprio)
+
+    def _dev_priority_class(self) -> jnp.ndarray:
+        P = self.pod_requests.shape[0]
+        prio = (
+            self.pod_priority
+            if self.pod_priority is not None
+            else np.zeros(P, np.int64)
+        )
+        # explicit classes from the wire, else derived from the priority
+        # value bands (apis/extension/priority.go:84); padding is NONE —
+        # zeros would mean PROD and wrongly put padded pods on the prod
+        # filter/score path
+        return jnp.asarray(
+            _pc_column(self.pod_priority_class, prio, P, self.pod_bucket)
+        )
+
+    def _dev_gang_id(self) -> jnp.ndarray:
+        P = self.pod_requests.shape[0]
+        gang = (
+            self.pod_gang if self.pod_gang is not None else np.full(P, -1, np.int32)
+        )
+        pgang = np.full(self.pod_bucket, -1, np.int32)
+        pgang[:P] = gang
+        return jnp.asarray(pgang)
+
+    def _dev_quota_id(self) -> jnp.ndarray:
+        P = self.pod_requests.shape[0]
+        quota = (
+            self.pod_quota if self.pod_quota is not None else np.full(P, -1, np.int32)
+        )
+        pquota = np.full(self.pod_bucket, -1, np.int32)
+        pquota[:P] = quota
+        return jnp.asarray(pquota)
+
+    def _dev_gangs(self) -> GangTable:
+        gmin = self.gang_min if self.gang_min is not None else np.zeros(0, np.int32)
+        G = max(1, len(gmin))
+        gvalid = np.zeros(G, bool)
+        gvalid[: len(gmin)] = True
+        gm = np.zeros(G, np.int32)
+        gm[: len(gmin)] = gmin
+        return GangTable(
+            min_member=jnp.asarray(gm), valid=jnp.asarray(gvalid), names=()
+        )
+
     def snapshot(self) -> ClusterSnapshot:
         if self._snapshot is not None:
             return self._snapshot
@@ -251,114 +673,49 @@ class ResidentState:
         nvalid[:N] = True
         pvalid = np.zeros(pb, bool)
         pvalid[:P] = True
-        fresh = np.zeros(nb, bool)
-        fresh[:N] = (
-            self.node_fresh if self.node_fresh is not None else np.ones(N, bool)
-        )
-        est = (
-            self.pod_estimated
-            if self.pod_estimated is not None
-            else self.pod_requests
-        )
-        prio = (
-            self.pod_priority
-            if self.pod_priority is not None
-            else np.zeros(P, np.int64)
-        )
-        gang = (
-            self.pod_gang if self.pod_gang is not None else np.full(P, -1, np.int32)
-        )
-        quota = (
-            self.pod_quota if self.pod_quota is not None else np.full(P, -1, np.int32)
-        )
-        gmin = self.gang_min if self.gang_min is not None else np.zeros(0, np.int32)
-        G = max(1, len(gmin))
-        gvalid = np.zeros(G, bool)
-        gvalid[: len(gmin)] = True
-        gm = np.zeros(G, np.int32)
-        gm[: len(gmin)] = gmin
-        if self.quota_runtime is not None and self.quota_runtime.size:
+        if _present(self.quota_runtime):
             Q = self.quota_runtime.shape[0]
             qrt, quse = self.quota_runtime, self.quota_used
             qlim = self.quota_limited.astype(bool)
             qvalid = np.ones(Q, bool)
         else:
-            Q = 1
             qrt = np.zeros((1, R), np.int64)
             quse = np.zeros((1, R), np.int64)
             qlim = np.zeros((1, R), bool)
             qvalid = np.zeros(1, bool)
 
-        def padded(a, rows):
-            return jnp.asarray(self._pad2(np.asarray(a, np.int64), rows))
-
-        pprio = np.zeros(pb, np.int64)
-        pprio[:P] = prio
-        pgang = np.full(pb, -1, np.int32)
-        pgang[:P] = gang
-        pquota = np.full(pb, -1, np.int32)
-        pquota[:P] = quota
         self._snapshot = ClusterSnapshot(
             nodes=NodeBatch(
-                allocatable=padded(self.node_alloc, nb),
-                requested=padded(
-                    self.node_requested
+                allocatable=self._dev_padded2("node_alloc", nb),
+                requested=(
+                    self._dev_padded2("node_requested", nb)
                     if self.node_requested is not None
-                    else np.zeros_like(self.node_alloc),
-                    nb,
+                    else jnp.zeros((nb, R), jnp.int64)
                 ),
-                usage=padded(
-                    self.node_usage
+                usage=(
+                    self._dev_padded2("node_usage", nb)
                     if self.node_usage is not None
-                    else np.zeros_like(self.node_alloc),
-                    nb,
+                    else jnp.zeros((nb, R), jnp.int64)
                 ),
-                metric_fresh=jnp.asarray(fresh),
+                metric_fresh=self._dev_metric_fresh(),
                 valid=jnp.asarray(nvalid),
-                agg_usage=(
-                    jnp.asarray(_pad_rows_to(self.node_agg, nb))
-                    if self.node_agg is not None and self.node_agg.size
-                    else None
-                ),
-                agg_fresh=(
-                    jnp.asarray(
-                        _pad_rows_to(self.node_agg_fresh, nb).astype(bool)
-                    )
-                    if self.node_agg_fresh is not None
-                    and self.node_agg_fresh.size
-                    else None
-                ),
-                prod_usage=(
-                    jnp.asarray(
-                        _pad_rows_to(
-                            np.asarray(self.node_prod, np.int64), nb
-                        )
-                    )
-                    if self.node_prod is not None and self.node_prod.size
-                    else None
-                ),
-                names=self.node_names,
+                agg_usage=self._dev_agg_usage(),
+                agg_fresh=self._dev_agg_fresh(),
+                prod_usage=self._dev_prod_usage(),
+                names=(),
             ),
             pods=PodBatch(
-                requests=padded(self.pod_requests, pb),
-                estimated=padded(est, pb),
-                # explicit classes from the wire, else derived from the
-                # priority value bands (apis/extension/priority.go:84);
-                # padding is NONE — zeros would mean PROD and wrongly put
-                # padded pods on the prod filter/score path
-                priority_class=jnp.asarray(_pc_column(
-                    self.pod_priority_class, prio, P, pb
-                )),
+                requests=self._dev_padded2("pod_requests", pb),
+                estimated=self._dev_estimated(),
+                priority_class=self._dev_priority_class(),
                 qos=jnp.zeros(pb, jnp.int32),
-                priority=jnp.asarray(pprio),
-                gang_id=jnp.asarray(pgang),
-                quota_id=jnp.asarray(pquota),
+                priority=self._dev_priority(),
+                gang_id=self._dev_gang_id(),
+                quota_id=self._dev_quota_id(),
                 valid=jnp.asarray(pvalid),
-                names=self.pod_names,
+                names=(),
             ),
-            gangs=GangTable(
-                min_member=jnp.asarray(gm), valid=jnp.asarray(gvalid), names=()
-            ),
+            gangs=self._dev_gangs(),
             quotas=QuotaTable(
                 runtime=jnp.asarray(qrt),
                 used=jnp.asarray(quse),

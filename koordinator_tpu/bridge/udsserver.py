@@ -71,6 +71,13 @@ class RawUdsServer:
         self._sock.listen(8)
         self._stop = threading.Event()
         self._conn_slots = threading.BoundedSemaphore(_MAX_CONNS)
+        # live connections, closed on stop(): a stopped server must not
+        # keep draining requests on established sockets — a client would
+        # get one more successful RPC against dying resident state and
+        # only see the restart on the call after (the warm-path recovery
+        # protocol depends on the failure surfacing at the Sync)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._methods = {
             METHOD_SYNC: (pb2.SyncRequest, self.servicer.sync),
@@ -87,6 +94,17 @@ class RawUdsServer:
         try:
             self._sock.close()
         finally:
+            with self._conns_lock:
+                conns = list(self._conns)
+            for conn in conns:
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
             if os.path.exists(self.path):
                 os.unlink(self.path)
 
@@ -100,6 +118,18 @@ class RawUdsServer:
             if not self._conn_slots.acquire(timeout=1.0):
                 conn.close()  # saturated: shed instead of queueing unbounded
                 continue
+            with self._conns_lock:
+                self._conns.add(conn)
+            # close the race with stop(): a connection accepted just
+            # before the listener closed but registered after stop()
+            # snapshotted _conns would otherwise keep serving the dying
+            # resident state
+            if self._stop.is_set():
+                with self._conns_lock:
+                    self._conns.discard(conn)
+                conn.close()
+                self._conn_slots.release()
+                return
             t = threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             )
@@ -109,6 +139,8 @@ class RawUdsServer:
         try:
             self._serve_conn_inner(conn)
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             self._conn_slots.release()
 
     def _serve_conn_inner(self, conn: socket.socket) -> None:

@@ -93,17 +93,118 @@ class CertManager:
             return base64.b64encode(fh.read()).decode()
 
     def _near_expiry(self) -> bool:
-        from cryptography import x509
-
-        try:
-            with open(self.cert_path, "rb") as fh:
-                cert = x509.load_pem_x509_certificate(fh.read())
-        except (OSError, ValueError):
+        expires = self._cert_expiry()
+        if expires is None:
             return True
-        expires = cert.not_valid_after_utc.timestamp()
         return self.clock() >= expires - self.rotate_before
 
+    def _cert_expiry(self) -> Optional[float]:
+        """The server cert's notAfter as a unix timestamp, or None when
+        unreadable (treated as expired)."""
+        try:
+            from cryptography import x509
+
+            with open(self.cert_path, "rb") as fh:
+                cert = x509.load_pem_x509_certificate(fh.read())
+            return cert.not_valid_after_utc.timestamp()
+        except ImportError:
+            pass
+        except (OSError, ValueError):
+            return None
+        # no ``cryptography`` in this environment: the openssl CLI reads
+        # the same field ("notAfter=<C-locale date> GMT")
+        import subprocess
+
+        proc = subprocess.run(
+            ["openssl", "x509", "-enddate", "-noout", "-in", self.cert_path],
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            return None
+        # openssl prints C-locale dates ("notAfter=Aug  3 05:00:00 2027
+        # GMT"); parse by hand — strptime's %b is LC_TIME-dependent and
+        # would misread every cert under a non-English locale, churning
+        # rotations forever
+        months = {
+            m: i + 1
+            for i, m in enumerate(
+                "Jan Feb Mar Apr May Jun Jul Aug Sep Oct Nov Dec".split()
+            )
+        }
+        try:
+            mon, day, clock, year = proc.stdout.strip().split(
+                "=", 1
+            )[1].split()[:4]
+            hh, mm, ss = (int(v) for v in clock.split(":"))
+            dt = datetime.datetime(
+                int(year), months[mon], int(day), hh, mm, ss,
+                tzinfo=datetime.timezone.utc,
+            )
+            return dt.timestamp()
+        except (IndexError, KeyError, ValueError):
+            return None
+
     def _generate(self) -> None:
+        """Self-signed CA + SAN server cert, via the ``cryptography``
+        package when importable, else the openssl CLI (same artifacts:
+        ca.crt / tls.crt / tls.key; the CLI path exists because minimal
+        images carry the openssl binary but not the Python bindings)."""
+        try:
+            import cryptography  # noqa: F401
+        except ImportError:
+            self._generate_openssl()
+        else:
+            self._generate_cryptography()
+        self.rotations += 1
+
+    def _generate_openssl(self) -> None:
+        import subprocess
+
+        def run(*argv):
+            subprocess.run(argv, capture_output=True, check=True)
+
+        ca_key = os.path.join(self.cert_dir, "ca.key")
+        csr = os.path.join(self.cert_dir, "server.csr")
+        cnf = os.path.join(self.cert_dir, "openssl.cnf")
+        sans = ",".join(
+            f"DNS:{n}" for n in tuple(self.dns_names) + ("localhost",)
+        )
+        # explicit config: relying on the system default config risks
+        # duplicate x509v3 extensions (-addext on top of the distro's
+        # v3_ca section), which poisons chain validation
+        with open(cnf, "w") as fh:
+            fh.write(
+                "[req]\n"
+                "distinguished_name = dn\n"
+                "prompt = no\n"
+                "[dn]\n"
+                "CN = placeholder\n"
+                "[v3_ca]\n"
+                "basicConstraints = critical,CA:TRUE\n"
+                "subjectKeyIdentifier = hash\n"
+                "[v3_server]\n"
+                f"subjectAltName = {sans}\n"
+            )
+        days = str(self.validity_days)
+        run(
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", ca_key, "-out", self.ca_path, "-days", days,
+            "-subj", "/CN=koordinator-webhook-ca",
+            "-config", cnf, "-extensions", "v3_ca",
+        )
+        run(
+            "openssl", "req", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", self.key_path, "-out", csr,
+            "-subj", f"/CN={self.dns_names[0]}", "-config", cnf,
+        )
+        run(
+            "openssl", "x509", "-req", "-in", csr, "-CA", self.ca_path,
+            "-CAkey", ca_key, "-CAcreateserial", "-out", self.cert_path,
+            "-days", days, "-extfile", cnf, "-extensions", "v3_server",
+        )
+
+    def _generate_cryptography(self) -> None:
         from cryptography import x509
         from cryptography.hazmat.primitives import hashes, serialization
         from cryptography.hazmat.primitives.asymmetric import rsa
@@ -165,7 +266,6 @@ class CertManager:
                     serialization.NoEncryption(),
                 )
             )
-        self.rotations += 1
 
 
 # ---------------------------------------------------------------------------

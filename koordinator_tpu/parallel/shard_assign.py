@@ -65,6 +65,23 @@ from koordinator_tpu.solver.greedy import (
 _NEG = jnp.int64(-(2**40))
 
 
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """Version-compat shard_map: ``jax.shard_map`` (with its ``check_vma``
+    kwarg) graduated from ``jax.experimental.shard_map.shard_map`` (whose
+    equivalent kwarg is ``check_rep``); the installed jax may carry either."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 def _pad_nodes_to(snap: ClusterSnapshot, multiple: int) -> ClusterSnapshot:
     """Pad the node axis to a multiple of the device count with invalid
     rows (valid=False keeps them unchoosable)."""
@@ -250,7 +267,7 @@ def _assign_sharded(
         (nreq, nest, quse), chosen_in_order = lax.scan(step, init, order)
         return chosen_in_order, nreq, nest, quse
 
-    chosen_in_order, node_requested, node_estimated, quota_used = jax.shard_map(
+    chosen_in_order, node_requested, node_estimated, quota_used = _shard_map(
         body,
         mesh=mesh,
         in_specs=tuple(in_specs),
@@ -723,7 +740,7 @@ def _assign_waves(
         return chosen_buf[:PCAP], nreq, nest, quse, nwaves
 
     (chosen_in_order, node_requested, node_estimated, quota_used, nwaves) = (
-        jax.shard_map(
+        _shard_map(
             body,
             mesh=mesh,
             in_specs=tuple(in_specs),

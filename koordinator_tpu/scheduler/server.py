@@ -39,6 +39,18 @@ from koordinator_tpu.scheduler.services import APIService
 from koordinator_tpu.solver import pallas_demotions
 
 
+def default_state_dir() -> str:
+    """Per-user daemon state dir (XDG state home).  NOT a fixed /tmp
+    path: the state dir holds the persistent XLA compile cache, whose
+    entries are deserialized executables — a world-writable shared
+    location would let another local user pre-plant cache entries the
+    scheduler then loads."""
+    base = os.environ.get("XDG_STATE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".local", "state"
+    )
+    return os.path.join(base, "koord-scheduler")
+
+
 class _LeaderGatedServicer(ScorerServicer):
     """Assign requires leadership; Score/Sync serve on any replica (they
     are read-only against the resident snapshot)."""
@@ -67,7 +79,23 @@ class SchedulerServer:
         http_port: int = 0,
         enable_grpc: bool = True,
         shard: bool = False,
+        state_dir: Optional[str] = None,
     ):
+        # persistent compile cache under the daemon's state dir: a
+        # restarted sidecar skips the multi-second (16.5s on TPU,
+        # BENCH_r03) cycle-kernel compile and is serving warm cycles at
+        # informer-sync speed.  Must happen before the first compile;
+        # KOORD_XLA_CACHE (operator override) wins if set.
+        if state_dir is None:
+            state_dir = default_state_dir()
+        self.state_dir = state_dir
+        if state_dir:
+            import koordinator_tpu
+
+            os.makedirs(state_dir, exist_ok=True)
+            koordinator_tpu.configure_compilation_cache(
+                os.path.join(state_dir, "xla-cache")
+            )
         cfg = DEFAULT_CYCLE_CONFIG
         self.profiles = []
         if config_path:
@@ -120,6 +148,10 @@ class SchedulerServer:
                             "ok": True,
                             "leader": outer.elector.is_leader,
                             "kernel_demotions": demoted,
+                            # warm-cycle visibility: whether the last Sync
+                            # landed on the resident device tensors
+                            # ("warm") or dropped residency ("cold")
+                            "last_sync_path": outer.servicer.state.last_sync_path,
                         },
                     )
                     return
@@ -204,6 +236,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="serve the round-based multi-chip Assign over every visible "
         "device (jax.sharding.Mesh; placements stay bit-identical)",
     )
+    ap.add_argument(
+        "--state-dir", default=None,
+        help="daemon state directory (default: $XDG_STATE_HOME/"
+        "koord-scheduler, per-user); the persistent XLA compile cache "
+        "lives at <state-dir>/xla-cache so a restarted sidecar skips the "
+        "multi-second cycle-kernel compile (KOORD_XLA_CACHE overrides)",
+    )
     return ap
 
 
@@ -217,6 +256,7 @@ def main(argv=None) -> int:
         http_host=args.http_host,
         http_port=args.http_port,
         shard=args.shard,
+        state_dir=args.state_dir,
     ).start()
     try:
         threading.Event().wait()

@@ -1,0 +1,63 @@
+"""On-device sparse updates for the resident ClusterSnapshot.
+
+The warm-cycle fast path (bridge/state.py) keeps the committed snapshot's
+``jax.Array`` tensors alive across Syncs.  A warm Sync's sparse delta
+frame is applied here as a jitted scatter straight into the resident
+device buffer — the old buffer is DONATED (it is dead the moment the new
+generation commits), so the update is in-place on backends that support
+aliasing and the warm path never re-uploads the full table.
+
+Exactness contract: a scatter of (idx, val) onto the resident array is
+bit-identical to re-encoding the updated host mirror, because the flat
+index space of the unpadded [N, ...] mirror embeds prefix-wise into the
+row-padded [N_bucket, ...] device array (same trailing dims, row-major);
+tests/test_resident_warm.py fuzzes this against cold re-encodes.
+
+Compile economics: delta sizes vary per cycle, so (idx, val) are padded
+to power-of-two buckets (pad slots carry an out-of-range index dropped
+by ``mode="drop"``) — one compiled scatter per (shape, dtype, bucket)
+instead of one per delta length.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from koordinator_tpu.model.snapshot import pad_bucket
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_flat(arr, idx, val):
+    """arr.flat[idx] = val (OOB indices dropped), preserving arr's dtype.
+
+    ``arr`` is donated: the pre-delta buffer backs the post-delta array
+    where the backend supports input/output aliasing, so a warm update
+    costs one small (idx, val) upload instead of a full-table transfer.
+    """
+    flat = arr.reshape(-1)
+    flat = flat.at[idx].set(val.astype(arr.dtype), mode="drop")
+    return flat.reshape(arr.shape)
+
+
+def apply_flat_delta(arr: "jax.Array", idx, val) -> "jax.Array":
+    """Apply a sparse (flat-index, value) delta to a resident device array.
+
+    ``idx``/``val`` are host arrays in the UNPADDED mirror's flat index
+    space; because padding only appends rows, the same flat indices address
+    the same cells in the row-padded resident array.  Returns the updated
+    array; the input array is donated (dead) afterwards.
+    """
+    idx = np.asarray(idx, np.int64)
+    val = np.asarray(val, np.int64)
+    bucket = pad_bucket(max(len(idx), 1))
+    if len(idx) < bucket:
+        # pad slots target arr.size, which mode="drop" discards
+        pad = bucket - len(idx)
+        idx = np.concatenate([idx, np.full(pad, arr.size, np.int64)])
+        val = np.concatenate([val, np.zeros(pad, np.int64)])
+    return _scatter_flat(arr, jnp.asarray(idx), jnp.asarray(val))

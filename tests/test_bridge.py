@@ -208,11 +208,11 @@ class TestFlatScoreReply:
         n, p, g, q = generators.loadaware_joint(seed=9, pods=64, nodes=16)
         req, _ = build_sync_request(n, p, [], [], node_bucket=16, pod_bucket=64)
         sv = ScorerServicer()
-        sv.sync(req)
+        sid = sv.sync(req).snapshot_id
         from koordinator_tpu.bridge.codegen import pb2
 
-        legacy = sv.score(pb2.ScoreRequest(snapshot_id="s1", top_k=4))
-        flat = sv.score(pb2.ScoreRequest(snapshot_id="s1", top_k=4, flat=True))
+        legacy = sv.score(pb2.ScoreRequest(snapshot_id=sid, top_k=4))
+        flat = sv.score(pb2.ScoreRequest(snapshot_id=sid, top_k=4, flat=True))
         pods_idx = np.frombuffer(flat.flat.pod_index, "<i4")
         counts = np.frombuffer(flat.flat.counts, "<i4")
         nidx = np.frombuffer(flat.flat.node_index, "<i4")
@@ -249,21 +249,21 @@ class TestMultiChipServing:
         req, _ = build_sync_request(nodes_l, pods_l, [], [])
 
         sharded = ScorerServicer(mesh=make_mesh(jax.devices()[:8]))
-        sharded.sync(req)
-        shard_reply = sharded.assign(pb2.AssignRequest(snapshot_id="s1"))
+        sid = sharded.sync(req).snapshot_id
+        shard_reply = sharded.assign(pb2.AssignRequest(snapshot_id=sid))
         assert shard_reply.path == "shard"
 
         single = ScorerServicer()
-        single.sync(req)
-        single_reply = single.assign(pb2.AssignRequest(snapshot_id="s1"))
+        sid = single.sync(req).snapshot_id
+        single_reply = single.assign(pb2.AssignRequest(snapshot_id=sid))
         assert list(shard_reply.assignment) == list(single_reply.assignment)
         assert list(shard_reply.status) == list(single_reply.status)
 
         # a 1-device mesh is honored too (path="shard", not silently
         # dropped): a dev box or degraded slice keeps the contract
         one = ScorerServicer(mesh=make_mesh(jax.devices()[:1]))
-        one.sync(req)
-        one_reply = one.assign(pb2.AssignRequest(snapshot_id="s1"))
+        sid = one.sync(req).snapshot_id
+        one_reply = one.assign(pb2.AssignRequest(snapshot_id=sid))
         assert one_reply.path == "shard"
         assert list(one_reply.assignment) == list(single_reply.assignment)
 
@@ -289,7 +289,7 @@ class TestMultiChipServing:
         )
         req, _ = build_sync_request(nodes_l, pods_l, [], [])
         sv = ScorerServicer(mesh=make_mesh(jax.devices()[:8]))
-        sv.sync(req)
+        sid = sv.sync(req).snapshot_id
 
         calls = {"n": 0}
 
@@ -299,11 +299,11 @@ class TestMultiChipServing:
 
         monkeypatch.setattr(parallel, "greedy_assign_waves", boom)
         try:
-            r1 = sv.assign(pb2.AssignRequest(snapshot_id="s1"))
+            r1 = sv.assign(pb2.AssignRequest(snapshot_id=sid))
             assert r1.path in ("scan", "pallas", "dense")  # single-chip
             assert calls["n"] == 1
             # demoted: the next RPC skips the failing shard path
-            r2 = sv.assign(pb2.AssignRequest(snapshot_id="s1"))
+            r2 = sv.assign(pb2.AssignRequest(snapshot_id=sid))
             assert calls["n"] == 1
             assert list(r2.assignment) == list(r1.assignment)
         finally:
@@ -352,17 +352,18 @@ class TestRawUdsReplyCap:
         try:
             c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             c.connect(sock_path)
-            status, _ = call(c, 1, req.SerializeToString())
+            status, body = call(c, 1, req.SerializeToString())
             assert status == 0
+            sid = pb2.SyncReply.FromString(body).snapshot_id
             # shrink the cap below any full-matrix Score reply
             monkeypatch.setattr(udsserver, "_MAX_FRAME", 64)
-            score = pb2.ScoreRequest(snapshot_id="s1", top_k=0, flat=True)
+            score = pb2.ScoreRequest(snapshot_id=sid, top_k=0, flat=True)
             status, body = call(c, 2, score.SerializeToString())
             assert status == 1 and b"exceeds" in body
             # the connection is still serving after the refusal
             monkeypatch.setattr(udsserver, "_MAX_FRAME", 64 << 20)
             status, _ = call(c, 2, pb2.ScoreRequest(
-                snapshot_id="s1", top_k=2, flat=True
+                snapshot_id=sid, top_k=2, flat=True
             ).SerializeToString())
             assert status == 0
             c.close()

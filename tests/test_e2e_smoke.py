@@ -104,8 +104,8 @@ def test_full_stack_smoke(tmp_path, cluster):
 
     pending = [dict(p, node=None) for p in prod_pods[:4]] + [mutated]
     req, _ = build_sync_request(nodes, pending, [], [])
-    sched.servicer.sync(req)
-    reply = sched.servicer.assign(pb2.AssignRequest(snapshot_id="s1"))
+    sid = sched.servicer.sync(req).snapshot_id
+    reply = sched.servicer.assign(pb2.AssignRequest(snapshot_id=sid))
     assignment = list(reply.assignment)
     assert len(assignment) == len(pending)
     assert all(a >= 0 for a in assignment), "everything must place"
@@ -195,8 +195,8 @@ def test_full_stack_smoke(tmp_path, cluster):
     reserve_pods = rc.pending_reserve_pods()
     req2, _ = build_sync_request(nodes, reserve_pods, [], [])
     sv2 = sched.servicer
-    sv2.sync(req2)
-    r2 = sv2.assign(pb2.AssignRequest(snapshot_id="s2"))
+    sid2 = sv2.sync(req2).snapshot_id
+    r2 = sv2.assign(pb2.AssignRequest(snapshot_id=sid2))
     chosen = list(r2.assignment)[0]
     assert chosen >= 0
     rc.on_reserve_pod_assigned("web-reserve", nodes[chosen]["name"])
@@ -307,10 +307,8 @@ def test_reservation_first_migration(cluster):
         if not reserve_pods:
             return
         req, _ = build_sync_request(candidates, reserve_pods, [], [])
-        servicer.sync(req)
-        reply = servicer.assign(
-            pb2.AssignRequest(snapshot_id=f"s{servicer._generation}")
-        )
+        sid = servicer.sync(req).snapshot_id
+        reply = servicer.assign(pb2.AssignRequest(snapshot_id=sid))
         for pod, chosen in zip(reserve_pods, reply.assignment):
             if chosen >= 0:
                 rc.on_reserve_pod_assigned(

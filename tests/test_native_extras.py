@@ -34,10 +34,18 @@ NATIVE = os.path.join(REPO, "native")
 
 
 def _build(target: str) -> str:
+    import shutil
+
     path = os.path.join(NATIVE, target)
+    # the binary compiles protoc-generated message code; a container
+    # without protoc (and no prebuilt binary) cannot run the native seam
+    if not os.path.exists(path) and shutil.which("protoc") is None:
+        pytest.skip("protoc unavailable and no prebuilt native binary")
     proc = subprocess.run(
         ["make", "-C", NATIVE, target], capture_output=True, text=True
     )
+    if proc.returncode != 0 and shutil.which("protoc") is None:
+        pytest.skip("native build needs protoc, which this image lacks")
     assert proc.returncode == 0, f"native build failed:\n{proc.stderr}"
     return path
 

@@ -260,20 +260,28 @@ class TestGenerationDisplacement:
         cold = GoPluginSim(path)
         assert cold.pre_score(NODES, "pod-y", POD) == scores
 
-    def test_sidecar_restart_recovers_with_full_sync(self, server):
+    def test_sidecar_restart_recovers_within_one_cycle(self, server):
         """A restarted sidecar loses its resident tensors AND the
-        connection: the first warm cycle fails, invalidates the mirror,
-        and the next cycle re-dials and ships full state."""
+        connection: the warm cycle's delta Sync fails, and PreScore
+        recovers IN THE SAME CYCLE by re-dialing and shipping full state
+        once (ADVICE r5) — the pod's scheduling cycle never errors."""
         path, srv = server
         sim = GoPluginSim(path)
         sim.pre_score(NODES, "pod-x", POD)
         srv.stop()
         srv2 = RawUdsServer(path).start()
         try:
-            with pytest.raises(Exception):
-                sim.pre_score(NODES, "pod-y", POD)
-            assert not sim.mirror.valid
+            sim.sent_frames.clear()
             scores = sim.pre_score(NODES, "pod-y", POD)
             assert set(scores) == {"node-cold", "node-hot"}
+            # failed delta sync, full retry, score — one cycle
+            methods = [m for m, _ in sim.sent_frames]
+            assert methods == [1, 1, 2]
+            # the retry carried full tensors (bigger than the delta frame)
+            assert sim.sent_frames[1][1] > sim.sent_frames[0][1]
+            assert sim.mirror.valid
+            # the fresh boot's epoch was adopted as the new baseline
+            cold = GoPluginSim(path)
+            assert cold.pre_score(NODES, "pod-y", POD) == scores
         finally:
             srv2.stop()

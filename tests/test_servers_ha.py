@@ -118,14 +118,14 @@ class TestSchedulerServer:
                 seed=3, pods=8, nodes=4
             )
             req, _ = build_sync_request(nodes_l, pods_l, [], [])
-            s.servicer.sync(req)
-            reply = s.servicer.assign(pb2.AssignRequest(snapshot_id="s1"))
+            sid = s.servicer.sync(req).snapshot_id
+            reply = s.servicer.assign(pb2.AssignRequest(snapshot_id=sid))
             assert len(reply.assignment) == 8
 
             # a follower must refuse Assign
             s.elector.is_leader = False
             with pytest.raises(PermissionError):
-                s.servicer.assign(pb2.AssignRequest(snapshot_id="s1"))
+                s.servicer.assign(pb2.AssignRequest(snapshot_id=sid))
         finally:
             s.stop()
 
@@ -152,8 +152,8 @@ class TestSchedulerServer:
                 seed=3, pods=16, nodes=8
             )
             req, _ = build_sync_request(nodes_l, pods_l, [], [])
-            s.servicer.sync(req)
-            reply = s.servicer.assign(pb2.AssignRequest(snapshot_id="s1"))
+            sid = s.servicer.sync(req).snapshot_id
+            reply = s.servicer.assign(pb2.AssignRequest(snapshot_id=sid))
             assert reply.path == "shard"
             assert len(reply.assignment) == 16
         finally:
@@ -604,7 +604,9 @@ class TestRawUdsConcurrency:
         try:
             c0 = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             c0.connect(sock_path)
-            call(c0, 1, req.SerializeToString())
+            sid = pb2.SyncReply.FromString(
+                call(c0, 1, req.SerializeToString())
+            ).snapshot_id
 
             results = []
             errors = []
@@ -618,7 +620,7 @@ class TestRawUdsConcurrency:
                             c,
                             3,
                             pb2.AssignRequest(
-                                snapshot_id="s1"
+                                snapshot_id=sid
                             ).SerializeToString(),
                         )
                         reply = pb2.AssignReply.FromString(body)
