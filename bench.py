@@ -22,7 +22,10 @@ backend is unhealthy):
   which code path executed (``path``: "pallas" single-kernel cycle vs
   "scan" lax.scan) — on TPU the Pallas kernel is asserted, NO silent
   fallback;
-* any failure prints a JSON error line (never a bare stack trace).
+* any failure prints a JSON error line (never a bare stack trace);
+* every artifact line is schema-validated before printing
+  (``_validate_artifact``): a crashed stage exits non-zero instead of
+  publishing a partial BENCH_*.json line.
 """
 
 import argparse
@@ -52,6 +55,58 @@ CPU_TIMEOUT = int(os.environ.get("KOORD_BENCH_CPU_TIMEOUT", "900"))
 # budget, and the CPU fallback is always reserved a slot — an artifact
 # line exists under every failure mode before the driver's axe falls.
 TOTAL_BUDGET = 2400.0  # default for KOORD_BENCH_TOTAL_BUDGET, seconds
+
+
+def _validate_artifact(line: Optional[str]) -> list:
+    """Small schema over the one BENCH_*.json line: a crashed or
+    half-finished stage must not publish a partial artifact the driver
+    would archive as a measurement.  Returns problems (empty = valid)."""
+    try:
+        doc = json.loads(line or "")
+    except ValueError:
+        return ["artifact is not valid JSON"]
+    if not isinstance(doc, dict):
+        return ["artifact is not a JSON object"]
+    problems = []
+    metric = doc.get("metric")
+    if not isinstance(metric, str) or not metric:
+        problems.append("'metric' must be a non-empty string")
+    value = doc.get("value")
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        problems.append("'value' must be a number")
+    elif value != value or value in (float("inf"), float("-inf")):
+        problems.append("'value' must be finite")
+    if "error" in doc and not isinstance(doc["error"], str):
+        problems.append("'error' must be a string")
+    if "error" not in doc:
+        # a real measurement also names its unit; error artifacts may not
+        unit = doc.get("unit")
+        if not isinstance(unit, str) or not unit:
+            problems.append("'unit' must be a non-empty string")
+    vsb = doc.get("vs_baseline")
+    if vsb is not None and (
+        isinstance(vsb, bool)
+        or not isinstance(vsb, (int, float))
+        or vsb != vsb
+        or vsb in (float("inf"), float("-inf"))
+    ):
+        problems.append("'vs_baseline' must be a finite number")
+    return problems
+
+
+def _emit_artifact(line: Optional[str]) -> bool:
+    """Validate-then-print gate for every artifact line; schema failures
+    go to stderr and the caller exits non-zero instead of publishing."""
+    problems = _validate_artifact(line)
+    if problems:
+        print(
+            f"malformed bench artifact suppressed: {'; '.join(problems)}; "
+            f"line was: {line!r:.300}",
+            file=sys.stderr,
+        )
+        return False
+    print(line)
+    return True
 
 
 def _quota_snapshot(encode_snapshot, generators, res, build_quota_table_inputs):
@@ -1051,8 +1106,9 @@ def parent() -> int:
                 break
             ok, final, err = _spawn("--child", "default", {}, timeout)
             if ok:
-                print(final)
-                return 0
+                if _emit_artifact(final):
+                    return 0
+                err = "tpu artifact failed schema validation"
             errors.append(err)
             if attempt < 2:
                 if budget.window(PROBE_TIMEOUT) <= 0:
@@ -1087,10 +1143,12 @@ def parent() -> int:
             final = json.dumps(doc)
         except ValueError:
             pass
-        print(final)
-        return 0
-    errors.append(err)
-    print(
+        if _emit_artifact(final):
+            return 0
+        errors.append("cpu artifact failed schema validation")
+    else:
+        errors.append(err)
+    _emit_artifact(
         json.dumps(
             {
                 "metric": METRIC,
@@ -1144,8 +1202,9 @@ def main() -> int:
                     "--child", "default", {}, window, config=args.config
                 )
                 if ok:
-                    print(out)
-                    return 0
+                    if _emit_artifact(out):
+                        return 0
+                    err = "tpu config artifact failed schema validation"
                 errors.append(err)
             else:
                 errors.append("tpu attempt skipped: budget exhausted")
@@ -1155,10 +1214,12 @@ def main() -> int:
             config=args.config,
         )
         if ok:
-            print(out)
-            return 0
-        errors.append(err)
-        print(
+            if _emit_artifact(out):
+                return 0
+            errors.append("cpu config artifact failed schema validation")
+        else:
+            errors.append(err)
+        _emit_artifact(
             json.dumps(
                 {"metric": args.config, "value": -1, "error": "; ".join(errors)}
             )

@@ -1,0 +1,53 @@
+"""koordlint: JAX-invariant static analysis + wire-contract cross-check.
+
+PR 1's hardest bugs were all statically detectable classes: pod names
+riding as static pytree metadata (silent per-cycle retrace), donated
+buffers read after donation in the resident-snapshot scatter path, and
+host syncs hiding inside hot jitted cycles.  This package makes those
+bug classes un-landable instead of re-debugged per PR: an AST pass over
+the repo plus a cross-language diff of the BatchedScorer wire contract,
+wired into tier-1 via ``tests/test_koordlint.py`` and runnable as
+``python -m koordinator_tpu.analysis``.
+
+Rules (each suppressible per line with ``# koordlint: disable=<rule>``):
+
+* ``donation-safety``   — a name passed to a ``donate_argnums`` /
+  ``donate_argnames`` jitted call must not be read again in the same
+  scope after the call (solver/resident.py scatter-path bug class).
+* ``retrace-hazard``    — Python ``if``/``while``/``assert`` on
+  tracer-typed values inside jitted functions, unhashable or
+  tuple-of-str static args at call sites, and name/str payloads inside
+  pytree registrations (the PR-1 name-tuple retrace).
+* ``host-sync-in-jit``  — ``np.asarray``, ``.item()``, ``float()``/
+  ``int()`` on jnp values, and ``print()`` inside jitted functions.
+* ``broad-except``      — ``except Exception:`` handlers must re-raise,
+  log, or surface the bound error; silent swallowers need a reasoned
+  ``# koordlint: disable=broad-except(<reason>)`` tag.
+* ``wire-contract``     — statically diffs scorer.proto (the layout
+  bridge/codegen.py's emitted ``scorer_pb2`` is generated from) against
+  the hand-rolled Go codec in go/scorerclient/wire.go + delta.go:
+  field names, numbers, emit order, integer widths, endianness helpers
+  and the shared delta-ratio constant.
+
+The runtime companion ``analysis.retrace_guard`` locks the warm path's
+compile economics in at test time (tests/test_resident_warm.py).
+"""
+
+from koordinator_tpu.analysis.core import (  # noqa: F401
+    Violation,
+    iter_python_files,
+    run_repo,
+    run_rules_on_source,
+)
+from koordinator_tpu.analysis.retrace_guard import (  # noqa: F401
+    RetraceBudgetExceeded,
+    retrace_guard,
+)
+
+RULES = (
+    "donation-safety",
+    "retrace-hazard",
+    "host-sync-in-jit",
+    "broad-except",
+    "wire-contract",
+)

@@ -1,0 +1,235 @@
+"""Shared koordlint infrastructure: violations, suppressions, the runner.
+
+Suppression syntax (line-scoped, on the offending line or the line just
+above it):
+
+    risky_thing()  # koordlint: disable=retrace-hazard
+    # koordlint: disable=broad-except(reason: probe must never raise)
+    except Exception:
+
+Multiple rules separate with commas; an optional parenthesised reason is
+encouraged (and REQUIRED by review convention for broad-except).  Tags
+never suppress whole files — a blanket-suppressed file would hide new
+regressions behind an old annotation.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+# '#' for Python, '//' for Go sources (wire-contract tags live in wire.go)
+_DISABLE_RE = re.compile(r"(?:#|//)\s*koordlint:\s*disable=(.*)$")
+# one rule token: name, optional (reason), flexible whitespace
+_RULE_TOKEN_RE = re.compile(r"\s*([a-z0-9\-]+)\s*(\([^)]*\))?\s*")
+
+
+def _parse_rule_list(tail: str) -> Set[str]:
+    """Strict sequential tokenizer: rule[,rule...] with optional
+    parenthesised reasons.  Scanning STOPS at the first non-token text,
+    so words inside a reason (or trailing prose) can never leak into
+    the suppressed-rule set."""
+    rules: Set[str] = set()
+    i = 0
+    while i < len(tail):
+        m = _RULE_TOKEN_RE.match(tail, i)
+        if not m or not m.group(1):
+            break
+        rules.add(m.group(1))
+        i = m.end()
+        if i < len(tail) and tail[i] == ",":
+            i += 1
+        else:
+            break
+    return rules
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def parse_suppressions(text: str, lang: str = "python") -> Dict[int, Set[str]]:
+    """Map line number -> set of rule names disabled on that line.
+
+    For Python sources the tags are extracted from REAL comment tokens
+    (via tokenize), so a string literal or docstring that merely
+    mentions ``koordlint: disable=`` — the rule messages themselves do —
+    can never register a phantom suppression.  Non-Python sources (Go,
+    for wire-contract tags) fall back to a per-line regex."""
+    out: Dict[int, Set[str]] = {}
+
+    def record(lineno: int, comment: str) -> None:
+        m = _DISABLE_RE.search(comment)
+        if m:
+            rules = _parse_rule_list(m.group(1))
+            if rules:
+                out[lineno] = rules
+
+    if lang == "python":
+        import io
+        import tokenize
+
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    record(tok.start[0], tok.string)
+            return out
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            out.clear()  # unparseable: per-line fallback below
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        record(lineno, line)
+    return out
+
+
+class SourceFile:
+    """One parsed Python file handed to every AST rule."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        self.suppressions = parse_suppressions(text)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        # the offending line, or a dedicated comment line just above it
+        for at in (line, line - 1):
+            if rule in self.suppressions.get(at, ()):
+                return True
+        return False
+
+
+def _filter(source: SourceFile, violations: Iterable[Violation]) -> List[Violation]:
+    return [
+        v for v in violations if not source.suppressed(v.rule, v.line)
+    ]
+
+
+def run_rules_on_source(
+    path: str, text: str, rules: Optional[Sequence[str]] = None
+) -> List[Violation]:
+    """Run the AST rules over one file's source text (the unit-test seam:
+    seeded-regression fixtures feed synthetic sources through here)."""
+    from koordinator_tpu.analysis import donation, excepts, hostsync, retrace
+
+    try:
+        source = SourceFile(path, text)
+    except SyntaxError as exc:
+        return [
+            Violation(
+                rule="parse-error",
+                path=path,
+                line=exc.lineno or 0,
+                message=f"cannot parse: {exc.msg}",
+            )
+        ]
+    out: List[Violation] = []
+    table = {
+        "donation-safety": donation.check,
+        "retrace-hazard": retrace.check,
+        "host-sync-in-jit": hostsync.check,
+        "broad-except": excepts.check,
+    }
+    for rule, fn in table.items():
+        if rules is not None and rule not in rules:
+            continue
+        out.extend(_filter(source, fn(source)))
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out
+
+
+def iter_python_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d for d in dirnames
+            if d != "__pycache__" and not d.startswith(".")
+        ]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def find_repo_root(start: Optional[str] = None) -> str:
+    """Nearest ancestor containing the koordinator_tpu package."""
+    here = os.path.abspath(start or os.getcwd())
+    probe = here
+    while True:
+        if os.path.isdir(os.path.join(probe, "koordinator_tpu")):
+            return probe
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            return here
+        probe = parent
+
+
+def run_repo(
+    root: Optional[str] = None,
+    rules: Optional[Sequence[str]] = None,
+    wire: bool = True,
+) -> List[Violation]:
+    """The full pass: AST rules over every repo Python file plus the
+    cross-language wire-contract diff.  Returns sorted violations."""
+    from koordinator_tpu.analysis import wire_contract
+
+    root = root or find_repo_root(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    scan_roots = [os.path.join(root, "koordinator_tpu")]
+    extra_files = [os.path.join(root, "bench.py")]
+    out: List[Violation] = []
+    for scan_root in scan_roots:
+        if not os.path.isdir(scan_root):
+            continue
+        for path in iter_python_files(scan_root):
+            out.extend(_run_file(path, root, rules))
+    for path in extra_files:
+        if os.path.exists(path):
+            out.extend(_run_file(path, root, rules))
+    if wire and (rules is None or "wire-contract" in rules):
+        out.extend(_filter_file_comments(root, wire_contract.check_repo(root)))
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out
+
+
+def _filter_file_comments(
+    root: str, violations: Iterable[Violation]
+) -> List[Violation]:
+    """Line-suppression for non-AST rules (wire-contract points at Go
+    sources): honor ``// koordlint: disable=<rule>`` on the flagged line
+    or the line above.  Line-0 violations (message-level drift like a
+    never-emitted field or a stale pb2 regen) are deliberately NOT
+    suppressible — the fix there is the wire edit or a regen, and the
+    ``_ALLOWED_UNDECODED`` allowlist covers legitimate one-sided reads."""
+    cache: Dict[str, Dict[int, Set[str]]] = {}
+    out: List[Violation] = []
+    for v in violations:
+        if v.line > 0:
+            if v.path not in cache:
+                path = os.path.join(root, v.path)
+                try:
+                    with open(path, "r", encoding="utf-8") as f:
+                        cache[v.path] = parse_suppressions(f.read(), lang="go")
+                except OSError:
+                    cache[v.path] = {}
+            sups = cache[v.path]
+            if any(
+                v.rule in sups.get(at, ()) for at in (v.line, v.line - 1)
+            ):
+                continue
+        out.append(v)
+    return out
+
+
+def _run_file(path: str, root: str, rules: Optional[Sequence[str]]) -> List[Violation]:
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    rel = os.path.relpath(path, root)
+    return run_rules_on_source(rel, text, rules)

@@ -1,0 +1,115 @@
+"""donation-safety: no reads of a buffer after it was donated.
+
+The solver/resident.py bug class: ``_scatter_flat`` donates its first
+argument (the pre-delta resident buffer is dead once the new generation
+commits), so any later read of that name in the calling scope touches a
+buffer XLA may already have aliased over — silently wrong values on
+backends with aliasing, a use-after-donate error on others.
+
+Flow-insensitive by line number within one function scope: a read of the
+donated name strictly after the donating call is a violation unless the
+name was re-bound first (the ``arr = scatter(arr, ...)`` idiom re-binds
+on the call line itself, which counts)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from koordinator_tpu.analysis import jitscope
+from koordinator_tpu.analysis.core import SourceFile, Violation
+
+RULE = "donation-safety"
+
+
+def _scopes(tree: ast.AST) -> Iterator[ast.AST]:
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def check(source: SourceFile) -> List[Violation]:
+    donors = jitscope.donating_callables(source.tree)
+    if not donors:
+        return []
+    out: List[Violation] = []
+    for scope in _scopes(source.tree):
+        # gather loads / stores of every name in this scope, by line.
+        # An AugAssign target (`buf += 1`) READS the old value even
+        # though its ctx is Store: count it as a load and NOT as a
+        # forgiving rebind — `buf += 1` after donating buf is itself a
+        # read-after-donate, and must not silence later reads either.
+        loads: List[ast.Name] = []
+        stores: List[ast.Name] = []
+        calls: List[ast.Call] = []
+        aug_target_ids = set()
+        for node in jitscope.scope_walk(scope):
+            if isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                aug_target_ids.add(id(node.target))
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load) or id(node) in aug_target_ids:
+                    loads.append(node)
+                else:
+                    stores.append(node)
+            elif isinstance(node, ast.Call):
+                calls.append(node)
+        for call in calls:
+            if not isinstance(call.func, ast.Name):
+                continue
+            spec = donors.get(call.func.id)
+            if spec is None or spec.func is None:
+                continue
+            pos = spec.positional_params()
+            donated_idx = sorted(
+                i for i, p in enumerate(pos) if p in spec.donated_params()
+            )
+            donated_args: List[ast.Name] = []
+            for i in donated_idx:
+                if i < len(call.args) and isinstance(call.args[i], ast.Name):
+                    donated_args.append(call.args[i])
+            for kw in call.keywords:
+                if kw.arg in spec.donated_params() and isinstance(
+                    kw.value, ast.Name
+                ):
+                    donated_args.append(kw.value)
+            end = call.end_lineno or call.lineno
+            end_col = call.end_col_offset or 0
+            own = {id(n) for n in ast.walk(call)}
+            for arg in donated_args:
+                # first re-bind after the call forgives later reads;
+                # a store ON the call line is the x = f(x) idiom
+                rebinds = [
+                    s.lineno for s in stores
+                    if s.id == arg.id and s.lineno >= call.lineno
+                ]
+                horizon = min(rebinds) if rebinds else None
+                for load in loads:
+                    if load.id != arg.id or id(load) in own:
+                        continue
+                    # lexicographically after the call: later line, or
+                    # the call's end line past its closing paren (the
+                    # `return scatter(buf, ...), buf.sum()` form)
+                    after = load.lineno > end or (
+                        load.lineno == end and load.col_offset > end_col
+                    )
+                    if not after:
+                        continue
+                    if horizon is not None and load.lineno >= horizon:
+                        continue
+                    out.append(
+                        Violation(
+                            rule=RULE,
+                            path=source.path,
+                            line=load.lineno,
+                            message=(
+                                f"'{arg.id}' is read after being donated to "
+                                f"{call.func.id}() on line {call.lineno}; the "
+                                "buffer may already be aliased over "
+                                "(re-bind the name or copy before the call)"
+                            ),
+                        )
+                    )
+    return out

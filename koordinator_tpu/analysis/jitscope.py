@@ -1,0 +1,222 @@
+"""Recognize jitted functions and their static/donated arguments.
+
+Handles the three spellings the repo uses::
+
+    @jax.jit                                   # plain decorator
+    @partial(jax.jit, static_argnames=("cfg",))
+    @partial(jax.jit, donate_argnums=(0,))
+    scatter = jax.jit(_scatter, donate_argnums=(0,))   # call form
+
+Flow-insensitive and module-local by design: a jit wrapper imported from
+another module is invisible here (the donation contract of an exported
+helper belongs in its own module's call sites and docstring — see
+solver/resident.py's ``apply_flat_delta``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+
+def is_jitted_def(node: ast.AST) -> bool:
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    return any(
+        _is_jit_ref(deco) or _jit_call_spec(deco) is not None
+        for deco in node.decorator_list
+    )
+
+
+def scope_walk(scope: ast.AST, into_closures: bool = False):
+    """Walk a function/module scope without descending into nested
+    function DEFINITIONS — nested defs are their own scopes, and nested
+    jitted defs get their own pass, so walking into them double-reports
+    and mis-attributes violations.
+
+    ``into_closures=True`` descends into nested NON-jitted defs: a
+    closure inside a jitted function (the ``step`` of a ``lax.scan``)
+    executes under the enclosing trace, so trace-context rules must see
+    its body; only nested JITTED defs stay excluded."""
+    stack = list(scope.body) if hasattr(scope, "body") else []
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if into_closures and not is_jitted_def(node):
+                stack.extend(node.body)
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    """jax.jit / jit / pjit-style attribute reference."""
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("jit", "pjit")
+    if isinstance(node, ast.Name):
+        return node.id in ("jit", "pjit")
+    return False
+
+
+def _literal_strs(node: Optional[ast.AST]) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        elts = node.elts
+    elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    else:
+        return out
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            out.add(e.value)
+    return out
+
+
+def _literal_ints(node: Optional[ast.AST]) -> Set[int]:
+    out: Set[int] = set()
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        elts = node.elts
+    elif isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    else:
+        return out
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, int):
+            out.add(e.value)
+    return out
+
+
+@dataclasses.dataclass
+class JitSpec:
+    """One jit application: the wrapped function (when visible) plus the
+    static/donate argument declarations."""
+
+    name: str
+    func: Optional[ast.FunctionDef]
+    static_names: Set[str]
+    static_nums: Set[int]
+    donate_names: Set[str]
+    donate_nums: Set[int]
+    line: int
+
+    def params(self) -> List[str]:
+        if self.func is None:
+            return []
+        a = self.func.args
+        return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+
+    def positional_params(self) -> List[str]:
+        if self.func is None:
+            return []
+        a = self.func.args
+        return [p.arg for p in a.posonlyargs + a.args]
+
+    def static_params(self) -> Set[str]:
+        out = set(self.static_names)
+        pos = self.positional_params()
+        for i in self.static_nums:
+            if 0 <= i < len(pos):
+                out.add(pos[i])
+        return out
+
+    def donated_params(self) -> Set[str]:
+        out = set(self.donate_names)
+        pos = self.positional_params()
+        for i in self.donate_nums:
+            if 0 <= i < len(pos):
+                out.add(pos[i])
+        return out
+
+
+def _spec_from_call_kwargs(call: ast.Call) -> Tuple[Set[str], Set[int], Set[str], Set[int]]:
+    static_names: Set[str] = set()
+    static_nums: Set[int] = set()
+    donate_names: Set[str] = set()
+    donate_nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            static_names |= _literal_strs(kw.value)
+        elif kw.arg == "static_argnums":
+            static_nums |= _literal_ints(kw.value)
+        elif kw.arg == "donate_argnames":
+            donate_names |= _literal_strs(kw.value)
+        elif kw.arg == "donate_argnums":
+            donate_nums |= _literal_ints(kw.value)
+    return static_names, static_nums, donate_names, donate_nums
+
+
+def _jit_call_spec(node: ast.AST) -> Optional[Tuple[Set[str], Set[int], Set[str], Set[int]]]:
+    """Match ``jax.jit(...)`` or ``partial(jax.jit, ...)`` call nodes."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jit_ref(node.func):
+        return _spec_from_call_kwargs(node)
+    # partial(jax.jit, static_argnames=...)
+    f = node.func
+    is_partial = (isinstance(f, ast.Name) and f.id == "partial") or (
+        isinstance(f, ast.Attribute) and f.attr == "partial"
+    )
+    if is_partial and node.args and _is_jit_ref(node.args[0]):
+        return _spec_from_call_kwargs(node)
+    return None
+
+
+def jitted_defs(tree: ast.AST) -> List[JitSpec]:
+    """Every function DEFINITION wrapped by jit (decorator spellings)."""
+    out: List[JitSpec] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for deco in node.decorator_list:
+            if _is_jit_ref(deco):
+                out.append(
+                    JitSpec(node.name, node, set(), set(), set(), set(),
+                            node.lineno)
+                )
+                break
+            spec = _jit_call_spec(deco)
+            if spec is not None:
+                sn, si, dn, di = spec
+                out.append(JitSpec(node.name, node, sn, si, dn, di, node.lineno))
+                break
+    return out
+
+
+def jit_assignments(tree: ast.AST) -> Dict[str, JitSpec]:
+    """``name = jax.jit(fn, ...)`` module/function-level assignments.
+    The wrapped fn's def is attached when it is a plain module-level name."""
+    defs = {
+        n.name: n
+        for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef)
+    }
+    out: Dict[str, JitSpec] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        if not _is_jit_ref(call.func):
+            continue
+        sn, si, dn, di = _spec_from_call_kwargs(call)
+        wrapped = None
+        if call.args and isinstance(call.args[0], ast.Name):
+            wrapped = defs.get(call.args[0].id)
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = JitSpec(
+                    tgt.id, wrapped, sn, si, dn, di, node.lineno
+                )
+    return out
+
+
+def donating_callables(tree: ast.AST) -> Dict[str, JitSpec]:
+    """Module-local names that, when CALLED, donate some arguments."""
+    out: Dict[str, JitSpec] = {}
+    for spec in jitted_defs(tree):
+        if spec.donate_nums or spec.donate_names:
+            out[spec.name] = spec
+    for name, spec in jit_assignments(tree).items():
+        if spec.donate_nums or spec.donate_names:
+            out[name] = spec
+    return out

@@ -1,0 +1,217 @@
+"""retrace-hazard: things that silently recompile the hot cycle.
+
+Three statically detectable shapes of the PR-1 name-tuple retrace:
+
+1. Python control flow (``if``/``while``/``assert``) on a TRACED
+   parameter inside a jitted function.  Branching on a tracer either
+   raises (abstract truthiness) or — worse, for weak types — forces a
+   concretization; branching on values that vary per cycle retraces.
+   ``x is None`` / ``x is not None`` checks are exempt: pytree presence
+   is part of the trace signature, branching on it is the idiomatic way
+   to specialize a jitted function.
+2. Unhashable or string-tuple STATIC arguments at call sites of a
+   module-local jitted function: a list/dict/set static arg raises at
+   call time, and a tuple-of-str static arg (names!) keys the jit cache
+   on payload data — one retrace per distinct name set.
+3. Name/str payloads registered as pytree METADATA: a field called
+   ``name``/``names`` (or ``*_name``/``*_names``) in ``meta_fields`` of
+   ``register_dataclass`` (or an aux_data tuple of
+   ``register_pytree_node``) keys every downstream jit cache on object
+   names — the exact PR-1 bug.  Intentional embedded-API registrations
+   carry a reasoned disable tag instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from koordinator_tpu.analysis import jitscope
+from koordinator_tpu.analysis.core import SourceFile, Violation
+
+RULE = "retrace-hazard"
+
+_NAMEY = ("name", "names")
+
+
+def _is_namey(field: str) -> bool:
+    return field in _NAMEY or any(
+        field.endswith("_" + suffix) for suffix in _NAMEY
+    )
+
+
+# attribute reads that are concrete at trace time: branching on them
+# specializes per shape bucket, it does not retrace per cycle
+_TRACE_CONST_ATTRS = ("shape", "ndim", "dtype", "size")
+
+
+def _exempt_names(test: ast.AST) -> Set[int]:
+    """ids of Name nodes used only in trace-time-constant positions:
+    ``x is (not) None`` compares, ``x.shape``/``.ndim``/``.dtype``/
+    ``.size`` reads, and ``len(x)`` calls."""
+    exempt: Set[int] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare):
+            operands = [node.left] + list(node.comparators)
+            if all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            ) and any(
+                isinstance(o, ast.Constant) and o.value is None
+                for o in operands
+            ):
+                for o in operands:
+                    if isinstance(o, ast.Name):
+                        exempt.add(id(o))
+        elif isinstance(node, ast.Attribute):
+            if node.attr in _TRACE_CONST_ATTRS and isinstance(
+                node.value, ast.Name
+            ):
+                exempt.add(id(node.value))
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id == "len":
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        exempt.add(id(arg))
+    return exempt
+
+
+def _tracer_branches(source: SourceFile, spec: jitscope.JitSpec) -> List[Violation]:
+    out: List[Violation] = []
+    traced = set(spec.params()) - spec.static_params()
+    # closures run under this trace, so branches on the enclosing
+    # traced params inside them count; nested JITTED defs get their own
+    # pass with their own parameter namespace
+    for node in jitscope.scope_walk(spec.func, into_closures=True):
+        if isinstance(node, (ast.If, ast.While)):
+            test, kind = node.test, type(node).__name__.lower()
+        elif isinstance(node, ast.Assert):
+            test, kind = node.test, "assert"
+        else:
+            continue
+        exempt = _exempt_names(test)
+        for name in ast.walk(test):
+            if (
+                isinstance(name, ast.Name)
+                and isinstance(name.ctx, ast.Load)
+                and name.id in traced
+                and id(name) not in exempt
+            ):
+                out.append(
+                    Violation(
+                        rule=RULE,
+                        path=source.path,
+                        line=node.lineno,
+                        message=(
+                            f"Python {kind} on traced argument "
+                            f"'{name.id}' inside jitted "
+                            f"{spec.name}(); use lax.cond/jnp.where, or "
+                            "declare it static if it is configuration"
+                        ),
+                    )
+                )
+                break
+    return out
+
+
+def _static_call_args(source: SourceFile) -> List[Violation]:
+    """Unhashable / tuple-of-str values passed to static params of
+    module-local jitted functions."""
+    specs = {
+        s.name: s for s in jitscope.jitted_defs(source.tree)
+    }
+    specs.update(jitscope.jit_assignments(source.tree))
+    out: List[Violation] = []
+    for node in ast.walk(source.tree):
+        if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Name):
+            continue
+        spec = specs.get(node.func.id)
+        if spec is None:
+            continue
+        static = spec.static_params()
+        if not static and not spec.static_nums:
+            continue
+        pos = spec.positional_params()
+        candidates = []
+        for i, arg in enumerate(node.args):
+            pname = pos[i] if i < len(pos) else None
+            if i in spec.static_nums or (pname and pname in static):
+                candidates.append((pname or f"#{i}", arg))
+        for kw in node.keywords:
+            if kw.arg in static:
+                candidates.append((kw.arg, kw.value))
+        for pname, val in candidates:
+            if isinstance(val, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                                ast.DictComp, ast.SetComp)):
+                out.append(
+                    Violation(
+                        rule=RULE,
+                        path=source.path,
+                        line=val.lineno,
+                        message=(
+                            f"unhashable {type(val).__name__.lower()} passed "
+                            f"as static arg '{pname}' of {spec.name}(); jit "
+                            "static args must be hashable"
+                        ),
+                    )
+                )
+            elif isinstance(val, ast.Tuple) and val.elts and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in val.elts
+            ):
+                out.append(
+                    Violation(
+                        rule=RULE,
+                        path=source.path,
+                        line=val.lineno,
+                        message=(
+                            f"tuple-of-str passed as static arg '{pname}' of "
+                            f"{spec.name}(): the jit cache keys on the string "
+                            "payload — one retrace per distinct value (the "
+                            "PR-1 name-tuple bug); keep names host-side"
+                        ),
+                    )
+                )
+    return out
+
+
+def _pytree_metadata(source: SourceFile) -> List[Violation]:
+    out: List[Violation] = []
+    for node in ast.walk(source.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        attr = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else ""
+        )
+        if attr != "register_dataclass":
+            continue
+        for kw in node.keywords:
+            if kw.arg != "meta_fields":
+                continue
+            for field in jitscope._literal_strs(kw.value):
+                if _is_namey(field):
+                    out.append(
+                        Violation(
+                            rule=RULE,
+                            path=source.path,
+                            line=kw.value.lineno,
+                            message=(
+                                f"pytree meta field '{field}' looks like an "
+                                "object-name payload: static metadata keys "
+                                "every jit cache on it, so a changed name "
+                                "retraces the cycle (the PR-1 bug); carry "
+                                "names host-side or tag with a reason"
+                            ),
+                        )
+                    )
+    return out
+
+
+def check(source: SourceFile) -> List[Violation]:
+    out: List[Violation] = []
+    for spec in jitscope.jitted_defs(source.tree):
+        out.extend(_tracer_branches(source, spec))
+    out.extend(_static_call_args(source))
+    out.extend(_pytree_metadata(source))
+    return out

@@ -1,0 +1,106 @@
+"""Runtime companion: assert warm cycles stay inside a retrace budget.
+
+The static pass catches the *sources* of retraces; this guard locks the
+*outcome* in at test time: wrap a warm delta-Sync/Assign sequence in
+``retrace_guard(budget=0)`` and any jit cache miss inside the block —
+a retrace from leaked static metadata, a bucket that failed to stick, a
+geometry wobble — fails the test with the observed counts.
+
+Counting: jax's monitoring bus records a
+``/jax/core/compile/jaxpr_trace_duration`` event for every trace and a
+``.../backend_compile_duration`` event for every XLA compile.  A single
+logical cache miss can record more than one trace event (nested
+jaxprs), so budgets are exact only at 0 — which is precisely the warm
+path's contract.  Trace events are the primary signal: a retrace that
+hits the persistent compile cache skips the backend compile but still
+re-traces (and still pays trace time on the hot path).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class RetraceBudgetExceeded(AssertionError):
+    pass
+
+
+class RetraceCounter:
+    """Counts jit traces/compiles between ``start()`` and ``stop()``."""
+
+    def __init__(self):
+        self.traces = 0
+        self.compiles = 0
+        self._active = False
+
+    # registered once per guard; the _active flag makes the callback a
+    # no-op outside the with-block even if unregistration is unavailable
+    def _on_event(self, name: str, *args, **kw) -> None:
+        if not self._active:
+            return
+        if name == _TRACE_EVENT:
+            self.traces += 1
+        elif name == _COMPILE_EVENT:
+            self.compiles += 1
+
+    def start(self) -> None:
+        from jax._src import monitoring
+
+        monitoring.register_event_duration_secs_listener(self._on_event)
+        self._active = True
+
+    def stop(self) -> None:
+        from jax._src import monitoring
+
+        self._active = False
+        unregister = getattr(
+            monitoring, "_unregister_event_duration_listener_by_callback", None
+        )
+        if unregister is not None:
+            unregister(self._on_event)
+            return
+        # private-API drift fallback: unhook by hand, or at least warn —
+        # a long-lived process must not silently accumulate one no-op
+        # listener per guard use
+        listeners = getattr(monitoring, "_event_duration_secs_listeners", None)
+        if isinstance(listeners, list) and self._on_event in listeners:
+            listeners.remove(self._on_event)
+            return
+        import warnings
+
+        warnings.warn(
+            "retrace_guard could not unregister its jax monitoring "
+            "listener (private API drift); it remains registered as a "
+            "no-op for this process",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+
+
+@contextlib.contextmanager
+def retrace_guard(budget: int = 0) -> Iterator[RetraceCounter]:
+    """Fail with :class:`RetraceBudgetExceeded` when more than ``budget``
+    jit traces happen inside the block.
+
+    The budget is over TRACE events (cache misses); ``counter.compiles``
+    additionally reports how many reached XLA.  Warm up every shape the
+    block will touch before entering — the guard asserts steady state,
+    not first-touch compilation.
+    """
+    counter = RetraceCounter()
+    counter.start()
+    try:
+        yield counter
+    finally:
+        counter.stop()
+    if counter.traces > budget:
+        raise RetraceBudgetExceeded(
+            f"retrace budget exceeded: {counter.traces} jit trace(s) "
+            f"({counter.compiles} backend compile(s)) inside a "
+            f"budget-{budget} block — warm-path shapes/static metadata "
+            "changed mid-stream"
+        )

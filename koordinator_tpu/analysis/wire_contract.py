@@ -1,0 +1,457 @@
+"""wire-contract: one-sided wire edits fail lint, not a Sync frame.
+
+The BatchedScorer seam has THREE codecs that must agree byte-for-byte:
+``bridge/scorer.proto`` (from which bridge/codegen.py's checked-in
+``scorer_pb2`` is emitted), the hand-rolled Go protowire codec in
+``go/scorerclient/wire.go`` + ``delta.go``, and the independent Python
+mirror ``bridge/wirecheck.py``.  The runtime tests can only exercise the
+Python pair (no Go toolchain in the image), so the Go half is checked
+STATICALLY here: the marshal/unmarshal functions are parsed out of the
+Go source and diffed against the proto —
+
+* field names (snake_case -> CamelCase, ``_id`` -> ``ID``),
+* field numbers and emit ORDER (ascending order is what makes the
+  marshaling byte-stable against the Python runtime),
+* integer widths (proto int32/int64 -> appendPackedInt32/Int64 etc.),
+* endianness helpers for the packed little-endian byte payloads
+  (``// i32 LE`` / ``i64 LE`` annotations in the proto are the spec),
+* the shared delta-encoding constant (delta.go DefaultMaxDeltaRatio
+  must equal state.py numpy_to_tensor's default max_delta_ratio),
+
+plus a runtime probe that the checked-in ``scorer_pb2`` descriptor
+matches the .proto (catching a stale regen).  All functions take source
+TEXT so tests can seed one-sided regressions.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from koordinator_tpu.analysis.core import Violation
+
+RULE = "wire-contract"
+
+_SCALARS = {"int32", "int64", "uint32", "uint64", "bool", "string",
+            "bytes", "double", "float", "sint32", "sint64", "fixed32",
+            "fixed64"}
+
+# proto (type, repeated) -> the wire.go append helper that emits it
+_EXPECTED_HELPER = {
+    ("int64", True): "appendPackedInt64",
+    ("int64", False): "appendVarintField",
+    ("int32", True): "appendPackedInt32",
+    ("bool", True): "appendPackedBools",
+    ("bool", False): "appendVarintField",
+    ("string", True): "appendRepeatedString",
+    ("string", False): "appendStringField",
+    ("bytes", False): "appendBytesField",
+}
+
+# reply fields the Go client deliberately does not decode
+_ALLOWED_UNDECODED = {("ScoreReply", 1)}  # legacy per-pod lists; Go is flat-only
+
+
+@dataclasses.dataclass
+class ProtoField:
+    num: int
+    name: str
+    ptype: str
+    repeated: bool
+    le_width: Optional[int]  # 32/64 from an "iNN LE" comment annotation
+
+    @property
+    def is_message(self) -> bool:
+        return self.ptype not in _SCALARS
+
+
+def camel(snake: str) -> str:
+    return "".join(
+        "ID" if seg == "id" else seg.capitalize()
+        for seg in snake.split("_")
+    )
+
+
+# ---- parsers ----
+
+_MSG_RE = re.compile(r"^message\s+(\w+)\s*\{", re.M)
+_FIELD_RE = re.compile(
+    r"^\s*(repeated\s+)?(\w+)\s+(\w+)\s*=\s*(\d+)\s*;(.*)$"
+)
+# inline form allows several fields on the message's own line (the empty
+# 5th group keeps _field_of's comment-annotation slot aligned)
+_FIELD_INLINE_RE = re.compile(
+    r"(repeated\s+)?(\w+)\s+(\w+)\s*=\s*(\d+)\s*;()"
+)
+_LE_RE = re.compile(r"i(32|64)\s+LE")
+
+
+def parse_proto(text: str) -> Dict[str, List[ProtoField]]:
+    out: Dict[str, List[ProtoField]] = {}
+    current: Optional[str] = None
+    for line in text.splitlines():
+        m = _MSG_RE.match(line.strip())
+        if m:
+            current = m.group(1)
+            out[current] = []
+            # single-line message: "message GangTable { repeated ... }"
+            rest = line.split("{", 1)[1]
+            for fm in _FIELD_INLINE_RE.finditer(rest):
+                out[current].append(_field_of(fm))
+            if "}" in rest:
+                current = None
+            continue
+        if current is None:
+            continue
+        if line.strip().startswith("}"):
+            current = None
+            continue
+        fm = _FIELD_RE.match(line)
+        if fm:
+            out[current].append(_field_of(fm))
+    return out
+
+
+def _field_of(m: "re.Match") -> ProtoField:
+    trail = m.group(5) or ""
+    le = _LE_RE.search(trail)
+    return ProtoField(
+        num=int(m.group(4)),
+        name=m.group(3),
+        ptype=m.group(2),
+        repeated=bool(m.group(1)),
+        le_width=int(le.group(1)) if le else None,
+    )
+
+
+@dataclasses.dataclass
+class GoEmit:
+    num: int
+    helper: str
+    field: Optional[str]  # receiver field name the value came from
+    line: int
+
+
+_GO_MARSHAL_HEAD = re.compile(
+    r"^func \((\w+) \*(\w+)\) [Mm]arshal\(\) \[\]byte \{"
+)
+_GO_RANGE = re.compile(r"for\s+\w+,\s*(\w+)\s*:=\s*range\s+(\w+)\.(\w+)")
+_GO_GUARD = re.compile(r"if\s+(\w+)\.(\w+)\s*\{")
+_GO_EMIT = re.compile(r"=\s*(append\w+)\(b,\s*(\d+),\s*(.+)\)\s*(?://.*)?$")
+
+
+def parse_go_marshals(text: str) -> Dict[str, List[GoEmit]]:
+    """struct name -> ordered field emissions of its marshal function."""
+    out: Dict[str, List[GoEmit]] = {}
+    recv = struct = None
+    loop_fields: Dict[str, str] = {}
+    guard_field: Optional[str] = None
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        head = _GO_MARSHAL_HEAD.match(line)
+        if head:
+            recv, struct = head.group(1), head.group(2)
+            out[struct] = []
+            loop_fields = {}
+            guard_field = None
+            continue
+        if struct is None:
+            continue
+        if line.startswith("}"):
+            recv = struct = None
+            continue
+        if line.strip() == "}":
+            guard_field = None  # inner block closed: the guard is over
+            continue
+        rng = _GO_RANGE.search(line)
+        if rng and rng.group(2) == recv:
+            loop_fields[rng.group(1)] = rng.group(3)
+        grd = _GO_GUARD.search(line)
+        if grd and grd.group(1) == recv:
+            guard_field = grd.group(2)
+        emit = _GO_EMIT.search(line)
+        if not emit:
+            continue
+        helper, num, expr = emit.group(1), int(emit.group(2)), emit.group(3)
+        field: Optional[str] = None
+        fm = re.match(rf"{recv}\.(\w+)", expr)
+        if fm:
+            field = fm.group(1)
+        elif expr in loop_fields:
+            field = loop_fields[expr]
+        elif guard_field is not None:
+            # e.g. `if r.Flat { appendVarintField(b, 3, 1) }` — consume
+            # the guard so a later local-variable emit is not
+            # mis-attributed to it
+            field = guard_field
+            guard_field = None
+        out[struct].append(GoEmit(num, helper, field, lineno))
+    return out
+
+
+_GO_UNMARSHAL_HEAD = re.compile(r"^func Unmarshal(\w+)\(b \[\]byte\)")
+_GO_CASE = re.compile(r"^\s*case\s+(\d+):")
+_GO_ASSIGN = re.compile(r"r\.((?:Flat\.)?\w+)(?:\s*=|\s*=\s*append\()")
+_GO_LE_HELPER = re.compile(r"=\s*(le\w+|string|float64FromBits|packedInt32)")
+
+
+def parse_go_unmarshals(text: str) -> Dict[str, List[Tuple[int, str, str, int]]]:
+    """Unmarshal functions -> [(case_num, assigned_field, helper, line)].
+    Nested switches (FlatScores inside ScoreReply) associate with the
+    nearest preceding ``case N:`` — assignments to ``Flat.X`` carry the
+    inner field number."""
+    out: Dict[str, List[Tuple[int, str, str, int]]] = {}
+    current: Optional[str] = None
+    last_case = -1
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        head = _GO_UNMARSHAL_HEAD.match(line)
+        if head:
+            current = head.group(1)
+            out[current] = []
+            last_case = -1
+            continue
+        if current is None:
+            continue
+        if line.startswith("}"):
+            current = None
+            continue
+        cm = _GO_CASE.match(line)
+        if cm:
+            last_case = int(cm.group(1))
+            continue
+        am = _GO_ASSIGN.search(line)
+        if am and last_case >= 0:
+            hm = _GO_LE_HELPER.search(line)
+            helper = hm.group(1) if hm else ""
+            out[current].append((last_case, am.group(1), helper, lineno))
+    return out
+
+
+# ---- the diff ----
+
+def diff_proto_go(
+    proto_text: str,
+    wire_go_text: str,
+    go_path: str = "go/scorerclient/wire.go",
+) -> List[Violation]:
+    proto = parse_proto(proto_text)
+    marshals = parse_go_marshals(wire_go_text)
+    unmarshals = parse_go_unmarshals(wire_go_text)
+    out: List[Violation] = []
+
+    def v(line: int, msg: str) -> None:
+        out.append(Violation(rule=RULE, path=go_path, line=line, message=msg))
+
+    # -- marshal side: Go -> Python requests --
+    for struct, emits in marshals.items():
+        fields = proto.get(struct)
+        if fields is None:
+            v(emits[0].line if emits else 0,
+              f"Go struct {struct} has a marshal but no proto message")
+            continue
+        by_num = {f.num: f for f in fields}
+        nums = [e.num for e in emits]
+        if nums != sorted(nums):
+            v(emits[0].line,
+              f"{struct}.marshal emits fields out of ascending order "
+              f"({nums}): byte-stability against the Python runtime "
+              "requires ascending field numbers")
+        seen = set()
+        for e in emits:
+            seen.add(e.num)
+            f = by_num.get(e.num)
+            if f is None:
+                v(e.line,
+                  f"{struct}.marshal emits field {e.num} which does not "
+                  f"exist in proto message {struct}")
+                continue
+            want_name = camel(f.name)
+            if e.field is not None and e.field != want_name:
+                v(e.line,
+                  f"{struct}.marshal field {e.num}: Go emits {e.field!r} "
+                  f"but proto field {e.num} is '{f.name}' "
+                  f"(expected Go field {want_name})")
+            if f.is_message:
+                if e.helper != "appendMessage":
+                    v(e.line,
+                      f"{struct}.{want_name} (field {e.num}) is a message "
+                      f"({f.ptype}) but is emitted with {e.helper}")
+            else:
+                want_helper = _EXPECTED_HELPER.get((f.ptype, f.repeated))
+                if want_helper and e.helper != want_helper:
+                    v(e.line,
+                      f"{struct}.{want_name} (field {e.num}, "
+                      f"{'repeated ' if f.repeated else ''}{f.ptype}) "
+                      f"emitted with {e.helper}; width/kind contract "
+                      f"expects {want_helper}")
+        for f in fields:
+            if f.num not in seen:
+                v(0,
+                  f"{struct}.marshal never emits proto field {f.num} "
+                  f"('{f.name}'): a populated value would be dropped "
+                  "from the wire")
+
+    # -- unmarshal side: Python replies -> Go --
+    for msg, cases in unmarshals.items():
+        fields = proto.get(msg)
+        if fields is None:
+            continue
+        flat_fields = proto.get("FlatScores", [])
+        flat_by_num = {f.num: f for f in flat_fields}
+        by_num = {f.num: f for f in fields}
+        decoded = set()
+        for num, assigned, helper, line in cases:
+            if assigned.startswith("Flat."):
+                f = flat_by_num.get(num)
+                scope, name = "FlatScores", assigned[len("Flat."):]
+            else:
+                f = by_num.get(num)
+                scope, name = msg, assigned
+                decoded.add(num)
+            if name == "HasFlat":
+                continue  # presence marker, not a wire field
+            if f is None:
+                v(line,
+                  f"Unmarshal{msg} decodes field {num} into {assigned} "
+                  f"but proto message {scope} has no field {num}")
+                continue
+            want_name = camel(f.name)
+            if name != want_name:
+                v(line,
+                  f"Unmarshal{msg} field {num}: Go assigns {assigned!r} "
+                  f"but proto field {num} is '{f.name}' "
+                  f"(expected {want_name})")
+            if f.le_width and helper and helper != f"leInt{f.le_width}s":
+                v(line,
+                  f"{scope}.{f.name} is annotated i{f.le_width} LE but "
+                  f"Unmarshal{msg} decodes it with {helper}; wrong width "
+                  "or endianness silently corrupts the payload")
+        for f in fields:
+            if f.num not in decoded and (msg, f.num) not in _ALLOWED_UNDECODED:
+                v(0,
+                  f"Unmarshal{msg} never decodes proto field {f.num} "
+                  f"('{f.name}')")
+    return out
+
+
+_GO_RATIO = re.compile(r"DefaultMaxDeltaRatio\s*=\s*([0-9.]+)")
+
+
+def python_delta_ratio_default(state_py_text: str) -> Optional[float]:
+    """state.py numpy_to_tensor's max_delta_ratio default, via AST."""
+    tree = ast.parse(state_py_text)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "numpy_to_tensor":
+            args = node.args
+            defaults = dict(
+                zip([a.arg for a in args.args][-len(args.defaults):],
+                    args.defaults)
+            ) if args.defaults else {}
+            d = defaults.get("max_delta_ratio")
+            if isinstance(d, ast.Constant) and isinstance(d.value, (int, float)):
+                return float(d.value)
+    return None
+
+
+def check_delta_constants(
+    delta_go_text: str,
+    state_py_text: str,
+    go_path: str = "go/scorerclient/delta.go",
+) -> List[Violation]:
+    out: List[Violation] = []
+    m = _GO_RATIO.search(delta_go_text)
+    py = python_delta_ratio_default(state_py_text)
+    if m is None:
+        out.append(Violation(RULE, go_path, 0,
+                             "DefaultMaxDeltaRatio constant not found"))
+    elif py is not None and abs(float(m.group(1)) - py) > 1e-12:
+        line = delta_go_text[: m.start()].count("\n") + 1
+        out.append(Violation(
+            RULE, go_path, line,
+            f"DefaultMaxDeltaRatio={m.group(1)} but bridge/state.py "
+            f"numpy_to_tensor defaults max_delta_ratio={py}: the two "
+            "sides would disagree on when a delta frame is worth it",
+        ))
+    for field in ("DeltaIdx", "DeltaVal"):
+        if not re.search(rf"t\.{field}\s*=\s*LEInt64Bytes\(", delta_go_text):
+            out.append(Violation(
+                RULE, go_path, 0,
+                f"DeltaTensor does not pack {field} with LEInt64Bytes: "
+                "delta payloads are little-endian int64 by contract "
+                "(state.py decode_tensor np.frombuffer '<i8')",
+            ))
+    return out
+
+
+def check_pb2_descriptor(
+    proto_text: str, pb2_module=None
+) -> List[Violation]:
+    """The emitted layout: the checked-in scorer_pb2 must match the
+    .proto (a stale regen would silently skew codegen from the contract
+    the Go side is diffed against)."""
+    if pb2_module is None:
+        from koordinator_tpu.bridge.codegen import pb2 as pb2_module
+    proto = parse_proto(proto_text)
+    out: List[Violation] = []
+    path = "koordinator_tpu/bridge/scorer_pb2.py"
+    for msg, fields in proto.items():
+        cls = getattr(pb2_module, msg, None)
+        if cls is None:
+            out.append(Violation(
+                RULE, path, 0,
+                f"proto message {msg} missing from emitted scorer_pb2",
+            ))
+            continue
+        emitted = {
+            f.name: f.number for f in cls.DESCRIPTOR.fields
+        }
+        for f in fields:
+            got = emitted.pop(f.name, None)
+            if got is None:
+                out.append(Violation(
+                    RULE, path, 0,
+                    f"{msg}.{f.name} missing from emitted scorer_pb2 "
+                    "(stale regen?)",
+                ))
+            elif got != f.num:
+                out.append(Violation(
+                    RULE, path, 0,
+                    f"{msg}.{f.name} is field {got} in scorer_pb2 but "
+                    f"{f.num} in scorer.proto (stale regen)",
+                ))
+        for name, num in emitted.items():
+            out.append(Violation(
+                RULE, path, 0,
+                f"scorer_pb2 {msg}.{name} (field {num}) does not exist "
+                "in scorer.proto (stale regen)",
+            ))
+    return out
+
+
+def check_repo(root: str) -> List[Violation]:
+    def read(*parts: str) -> Optional[str]:
+        path = os.path.join(root, *parts)
+        if not os.path.exists(path):
+            return None
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read()
+
+    proto = read("koordinator_tpu", "bridge", "scorer.proto")
+    if proto is None:
+        return [Violation(RULE, "koordinator_tpu/bridge/scorer.proto", 0,
+                          "scorer.proto not found")]
+    out: List[Violation] = []
+    wire = read("go", "scorerclient", "wire.go")
+    if wire is not None:
+        out.extend(diff_proto_go(proto, wire))
+    delta = read("go", "scorerclient", "delta.go")
+    state = read("koordinator_tpu", "bridge", "state.py")
+    if delta is not None and state is not None:
+        out.extend(check_delta_constants(delta, state))
+    try:
+        out.extend(check_pb2_descriptor(proto))
+    except ImportError:  # no protobuf runtime: the static diff still ran
+        pass
+    return out

@@ -326,6 +326,14 @@ def _jax_devices() -> List[Dict]:
         _JAX_UNAVAILABLE = True
         return []
     except Exception:
+        # an unhealthy backend (dead TPU tunnel) must read as "no
+        # devices", but not invisibly: the scrape path keeps running and
+        # the reason lands in the debug log
+        import logging
+
+        logging.getLogger(__name__).debug(
+            "jax device enumeration failed", exc_info=True
+        )
         return []
 
 

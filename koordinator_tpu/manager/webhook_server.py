@@ -64,6 +64,10 @@ class CertManager:
         self.rotate_before = rotate_before_seconds
         self.clock = clock
         self.rotations = 0
+        # set when generation tooling is PROVEN absent (FileNotFoundError
+        # from the openssl exec): a condition that cannot change at
+        # runtime, so later ticks skip the attempt instead of re-warning
+        self._tooling_absent = False
         os.makedirs(cert_dir, exist_ok=True)
 
     @property
@@ -80,11 +84,37 @@ class CertManager:
 
     def ensure(self) -> bool:
         """Generate certs if absent or near expiry; returns True when new
-        certs were written (the caller re-wraps its TLS socket)."""
-        if not os.path.exists(self.cert_path) or self._near_expiry():
+        certs were written (the caller re-wraps its TLS socket).
+
+        When generation fails but a cert EXISTS (no tooling on a minimal
+        image, a read-only operator-mounted cert_dir, transient ENOSPC),
+        the existing cert keeps being served with a warning naming the
+        real error instead of crashing the rotate tick — safe because
+        both generators write to temp names and commit with os.replace
+        only after every artifact succeeded, so a failed attempt never
+        tears the served cert/CA pair.  A MISSING cert still raises
+        (nothing to serve).  Proven-absent tooling (FileNotFoundError
+        from the openssl exec — cannot change at runtime) is cached so
+        the warning fires once, not every tick."""
+        missing = not os.path.exists(self.cert_path)
+        if not missing and (self._tooling_absent or not self._near_expiry()):
+            return False
+        try:
             self._generate()
-            return True
-        return False
+        except OSError as exc:
+            if missing:
+                raise
+            if isinstance(exc, FileNotFoundError):
+                self._tooling_absent = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "cannot rotate webhook certs (%s); continuing to serve "
+                "the existing certificate",
+                exc,
+            )
+            return False
+        return True
 
     def ca_bundle(self) -> str:
         """base64 CA cert — what the webhook-configuration controller
@@ -115,11 +145,17 @@ class CertManager:
         # the same field ("notAfter=<C-locale date> GMT")
         import subprocess
 
-        proc = subprocess.run(
-            ["openssl", "x509", "-enddate", "-noout", "-in", self.cert_path],
-            capture_output=True,
-            text=True,
-        )
+        try:
+            proc = subprocess.run(
+                ["openssl", "x509", "-enddate", "-noout", "-in", self.cert_path],
+                capture_output=True,
+                text=True,
+            )
+        except OSError:
+            # no openssl binary either: honor the documented "None when
+            # unreadable" contract; ensure() then decides whether to
+            # keep serving the existing cert or fail loudly
+            return None
         if proc.returncode != 0:
             return None
         # openssl prints C-locale dates ("notAfter=Aug  3 05:00:00 2027
@@ -145,6 +181,37 @@ class CertManager:
         except (IndexError, KeyError, ValueError):
             return None
 
+    def _commit_triple(self, ca_tmp: str, cert_tmp: str, key_tmp: str) -> None:
+        """Atomically-as-possible swap the generated temp files into
+        place.  Three files cannot be renamed as one transaction, so a
+        mid-commit failure rolls already-replaced files back from saved
+        bytes (best-effort) — the served cert/key/CA triple must never
+        be left mismatched (a new ca.crt that did not sign the served
+        tls.crt breaks every webhook call until the next rotation)."""
+        saved = {}
+        for final in (self.ca_path, self.cert_path, self.key_path):
+            if os.path.exists(final):
+                with open(final, "rb") as fh:
+                    saved[final] = fh.read()
+        done = []
+        try:
+            for tmp, final in (
+                (ca_tmp, self.ca_path),
+                (cert_tmp, self.cert_path),
+                (key_tmp, self.key_path),
+            ):
+                os.replace(tmp, final)
+                done.append(final)
+        except OSError:
+            for final in done:
+                if final in saved:
+                    try:
+                        with open(final, "wb") as fh:
+                            fh.write(saved[final])
+                    except OSError:
+                        pass  # best-effort: the original raise wins
+            raise
+
     def _generate(self) -> None:
         """Self-signed CA + SAN server cert, via the ``cryptography``
         package when importable, else the openssl CLI (same artifacts:
@@ -167,6 +234,13 @@ class CertManager:
         ca_key = os.path.join(self.cert_dir, "ca.key")
         csr = os.path.join(self.cert_dir, "server.csr")
         cnf = os.path.join(self.cert_dir, "openssl.cnf")
+        srl = os.path.join(self.cert_dir, "ca.srl")
+        # generate into temp names; only a fully successful sequence is
+        # committed (os.replace), so a mid-sequence failure can never
+        # leave a mismatched cert/key/CA triple being served
+        ca_tmp, cert_tmp, key_tmp = (
+            p + ".tmp" for p in (self.ca_path, self.cert_path, self.key_path)
+        )
         sans = ",".join(
             f"DNS:{n}" for n in tuple(self.dns_names) + ("localhost",)
         )
@@ -187,22 +261,40 @@ class CertManager:
                 f"subjectAltName = {sans}\n"
             )
         days = str(self.validity_days)
-        run(
-            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
-            "-keyout", ca_key, "-out", self.ca_path, "-days", days,
-            "-subj", "/CN=koordinator-webhook-ca",
-            "-config", cnf, "-extensions", "v3_ca",
-        )
-        run(
-            "openssl", "req", "-newkey", "rsa:2048", "-nodes",
-            "-keyout", self.key_path, "-out", csr,
-            "-subj", f"/CN={self.dns_names[0]}", "-config", cnf,
-        )
-        run(
-            "openssl", "x509", "-req", "-in", csr, "-CA", self.ca_path,
-            "-CAkey", ca_key, "-CAcreateserial", "-out", self.cert_path,
-            "-days", days, "-extfile", cnf, "-extensions", "v3_server",
-        )
+        try:
+            run(
+                "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+                "-keyout", ca_key, "-out", ca_tmp, "-days", days,
+                "-subj", "/CN=koordinator-webhook-ca",
+                "-config", cnf, "-extensions", "v3_ca",
+            )
+            run(
+                "openssl", "req", "-newkey", "rsa:2048", "-nodes",
+                "-keyout", key_tmp, "-out", csr,
+                "-subj", f"/CN={self.dns_names[0]}", "-config", cnf,
+            )
+            run(
+                "openssl", "x509", "-req", "-in", csr, "-CA", ca_tmp,
+                "-CAkey", ca_key, "-CAcreateserial", "-out", cert_tmp,
+                "-days", days, "-extfile", cnf, "-extensions", "v3_server",
+            )
+            self._commit_triple(ca_tmp, cert_tmp, key_tmp)
+        finally:
+            # parity with the cryptography path, which keeps the CA key
+            # in memory only: a CA key (or CSR/config/serial scratch)
+            # left in cert_dir would let anything that reads the dir —
+            # or a volume snapshot of it — mint certs chaining to the
+            # installed caBundle.  Runs even when the openssl binary is
+            # absent (FileNotFoundError from the first run).
+            # -CAcreateserial names the serial after the -CA file
+            # (ca.crt.tmp -> ca.crt.srl); sweep both spellings
+            for scratch in (ca_key, csr, cnf, srl,
+                            os.path.splitext(ca_tmp)[0] + ".srl",
+                            ca_tmp, cert_tmp, key_tmp):
+                try:
+                    os.unlink(scratch)
+                except OSError:
+                    pass
 
     def _generate_cryptography(self) -> None:
         from cryptography import x509
@@ -254,18 +346,36 @@ class CertManager:
             .sign(ca_key, hashes.SHA256())
         )
 
-        with open(self.ca_path, "wb") as fh:
-            fh.write(ca_cert.public_bytes(serialization.Encoding.PEM))
-        with open(self.cert_path, "wb") as fh:
-            fh.write(cert.public_bytes(serialization.Encoding.PEM))
-        with open(self.key_path, "wb") as fh:
-            fh.write(
+        # temp-then-rename: a mid-write failure (ENOSPC, kill) must not
+        # leave a new ca.crt beside an old tls.crt — ca_bundle() would
+        # publish a CA that never signed the served cert
+        payloads = (
+            (self.ca_path, ca_cert.public_bytes(serialization.Encoding.PEM)),
+            (self.cert_path, cert.public_bytes(serialization.Encoding.PEM)),
+            (
+                self.key_path,
                 key.private_bytes(
                     serialization.Encoding.PEM,
                     serialization.PrivateFormat.TraditionalOpenSSL,
                     serialization.NoEncryption(),
-                )
+                ),
+            ),
+        )
+        try:
+            for path, data in payloads:
+                with open(path + ".tmp", "wb") as fh:
+                    fh.write(data)
+            self._commit_triple(
+                self.ca_path + ".tmp",
+                self.cert_path + ".tmp",
+                self.key_path + ".tmp",
             )
+        finally:
+            for path, _ in payloads:
+                try:
+                    os.unlink(path + ".tmp")
+                except OSError:
+                    pass
 
 
 # ---------------------------------------------------------------------------

@@ -80,7 +80,11 @@ jax.tree_util.register_dataclass(
         "matched",
         "affinity_required",
     ],
-    meta_fields=["names", "uids"],
+    # names/uids are static metadata for the embedded extras path only
+    # (host-side reply/report assembly); the bridge hot path never ships
+    # a ReservationTable, and a changed reservation set already retraces
+    # through the [V]-shape change itself
+    meta_fields=["names", "uids"],  # koordlint: disable=retrace-hazard(embedded extras path; shape change dominates)
 )
 
 

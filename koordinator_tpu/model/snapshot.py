@@ -259,7 +259,13 @@ for _cls, _data in (
     (GangTable, ["min_member", "valid"]),
     (QuotaTable, ["runtime", "used", "limited", "valid"]),
 ):
-    jax.tree_util.register_dataclass(_cls, data_fields=_data, meta_fields=["names"])
+    # names are static metadata ON PURPOSE for the embedded API (reply
+    # assembly reads them host-side); the hot bridge path strips them to
+    # () before any jit sees the snapshot (bridge/state.py builds every
+    # resident table with names=()), so the jit cache never keys on them
+    jax.tree_util.register_dataclass(  # koordlint: disable=retrace-hazard(names stripped on the resident path; embedded API only)
+        _cls, data_fields=_data, meta_fields=["names"]
+    )
 jax.tree_util.register_dataclass(
     ClusterSnapshot, data_fields=["nodes", "pods", "gangs", "quotas"], meta_fields=[]
 )

@@ -92,10 +92,24 @@ class SchedulerServer:
         if state_dir:
             import koordinator_tpu
 
-            os.makedirs(state_dir, exist_ok=True)
-            koordinator_tpu.configure_compilation_cache(
-                os.path.join(state_dir, "xla-cache")
-            )
+            try:
+                os.makedirs(state_dir, exist_ok=True)
+            except OSError as exc:
+                # the compile cache is an optimization: an unwritable
+                # default state dir (readOnlyRootFilesystem, no HOME)
+                # must cost the restart-compile, not the daemon
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "state dir %s unavailable (%s); persistent compile "
+                    "cache disabled for this run",
+                    state_dir,
+                    exc,
+                )
+            else:
+                koordinator_tpu.configure_compilation_cache(
+                    os.path.join(state_dir, "xla-cache")
+                )
         cfg = DEFAULT_CYCLE_CONFIG
         self.profiles = []
         if config_path:

@@ -50,7 +50,10 @@ def apply_flat_delta(arr: "jax.Array", idx, val) -> "jax.Array":
     ``idx``/``val`` are host arrays in the UNPADDED mirror's flat index
     space; because padding only appends rows, the same flat indices address
     the same cells in the row-padded resident array.  Returns the updated
-    array; the input array is donated (dead) afterwards.
+    array; the input array is donated (dead) afterwards — callers must
+    re-bind or drop their reference (the koordlint ``donation-safety``
+    rule enforces this for module-local call sites; cross-module callers
+    own the contract, see docs/ANALYSIS.md).
     """
     idx = np.asarray(idx, np.int64)
     val = np.asarray(val, np.int64)

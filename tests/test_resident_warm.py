@@ -482,6 +482,69 @@ class TestEpochContinuity:
             srv2.stop()
 
 
+class TestRetraceBudget:
+    """ISSUE 2: the warm path's compile economics, locked in at test
+    time.  After one warm-up cycle has compiled every program the warm
+    loop touches (the bucket-1 scatter, the cycle scan), a steady
+    delta-Sync/Assign sequence must run with ZERO jit cache misses —
+    any retrace means static metadata or geometry leaked into the trace
+    signature (the PR-1 name-tuple bug class)."""
+
+    def _warm_step(self, sv, state):
+        prev = state["node_usage"].copy()
+        state["node_usage"][0, 1] += 1
+        req = pb2.SyncRequest()
+        req.nodes.usage.CopyFrom(numpy_to_tensor(state["node_usage"], prev))
+        assert req.nodes.usage.delta_idx  # rides as a sparse delta
+        sv.sync(req)
+        assert sv.state.last_sync_path == "warm"
+        return sv.assign(pb2.AssignRequest(snapshot_id=sv.snapshot_id()))
+
+    def test_warm_sync_assign_sequence_is_retrace_free(self):
+        from koordinator_tpu.analysis import retrace_guard
+
+        rng = np.random.RandomState(21)
+        state = _random_state(rng, n_nodes=5, n_pods=12, with_quota=False)
+        sv = ScorerServicer()
+        sv.sync(_full_sync_request(state))
+        sv.state.snapshot()
+        # warm-up cycle: compiles the delta scatter + the cycle program
+        first = self._warm_step(sv, state)
+        with retrace_guard(budget=0) as counter:
+            for _ in range(4):
+                reply = self._warm_step(sv, state)
+        assert counter.traces == 0 and counter.compiles == 0
+        assert len(reply.assignment) == len(first.assignment)
+
+    def test_guard_actually_counts(self):
+        """Negative control: a fresh jit inside the guard must trip it —
+        otherwise a broken counter would pass the budget test vacuously."""
+        import jax
+        import jax.numpy as jnp
+
+        from koordinator_tpu.analysis import (
+            RetraceBudgetExceeded,
+            retrace_guard,
+        )
+
+        with pytest.raises(RetraceBudgetExceeded, match="retrace budget"):
+            with retrace_guard(budget=0) as counter:
+                jax.jit(lambda x: x + 1)(jnp.zeros(3))
+        assert counter.traces > 0
+
+    def test_guard_is_inert_outside_the_block(self):
+        import jax
+        import jax.numpy as jnp
+
+        from koordinator_tpu.analysis import retrace_guard
+
+        with retrace_guard(budget=1) as counter:
+            pass
+        before = counter.traces
+        jax.jit(lambda x: x - 1)(jnp.zeros(5))  # after stop(): not counted
+        assert counter.traces == before
+
+
 _CACHE_CHILD = r"""
 import logging, os, sys
 logging.basicConfig(stream=sys.stderr, level=logging.DEBUG)

@@ -272,6 +272,97 @@ class TestWebhookServer:
         t[0] += 23.5 * 3600  # within rotate_before of the 1-day expiry
         assert cm.ensure() and cm.rotations == 2
 
+    def test_openssl_fallback_leaves_no_ca_key_on_disk(self, tmp_path,
+                                                       monkeypatch):
+        """The CLI path must match the cryptography path's security
+        posture: the CA private key (and CSR/config/serial scratch) is
+        deleted after generation — a ca.key left in cert_dir would let
+        anything that reads the dir mint certs chaining to the
+        installed caBundle."""
+        import builtins
+        import os
+        import sys
+
+        real_import = builtins.__import__
+
+        def no_crypto(name, *args, **kw):
+            if name == "cryptography" or name.startswith("cryptography."):
+                raise ImportError(name)
+            return real_import(name, *args, **kw)
+
+        monkeypatch.setattr(builtins, "__import__", no_crypto)
+        for mod in [m for m in list(sys.modules) if m.startswith("cryptography")]:
+            monkeypatch.delitem(sys.modules, mod)
+        from koordinator_tpu.manager.webhook_server import CertManager
+
+        cm = CertManager(str(tmp_path / "certs"))
+        assert cm.ensure()
+        left = sorted(os.listdir(tmp_path / "certs"))
+        assert left == ["ca.crt", "tls.crt", "tls.key"], left
+        assert cm._cert_expiry() is not None  # openssl expiry probe works
+
+    def test_no_tooling_keeps_serving_existing_cert(self, tmp_path,
+                                                    monkeypatch):
+        """Neither cryptography nor openssl (operator-mounted certs on a
+        minimal image): ensure() keeps serving an existing cert with a
+        warning instead of crashing every rotate tick; a MISSING cert
+        still raises."""
+        from koordinator_tpu.manager.webhook_server import CertManager
+
+        cm = CertManager(str(tmp_path / "certs"))
+        cm.ensure()  # real generation while tooling exists
+
+        def no_tooling(self):
+            raise FileNotFoundError("openssl")
+
+        calls = []
+
+        def counting_no_tooling(self):
+            calls.append(1)
+            raise FileNotFoundError("openssl")
+
+        monkeypatch.setattr(CertManager, "_generate", counting_no_tooling)
+        monkeypatch.setattr(CertManager, "_cert_expiry", lambda self: None)
+        assert cm.ensure() is False  # near-expiry (unreadable) but served
+        assert cm.ensure() is False  # proven-absent tooling: no re-attempt
+        assert calls == [1]
+        missing = CertManager(str(tmp_path / "empty"))
+        with pytest.raises(OSError):
+            missing.ensure()
+
+    def test_failed_rotation_never_tears_the_served_pair(self, tmp_path,
+                                                         monkeypatch):
+        """A mid-sequence generation failure must leave the old
+        cert/key/CA triple fully intact (temp-then-rename commit)."""
+        import os
+
+        from koordinator_tpu.manager.webhook_server import CertManager
+
+        cm = CertManager(str(tmp_path / "certs"))
+        cm.ensure()
+        before = {
+            n: open(os.path.join(tmp_path, "certs", n), "rb").read()
+            for n in ("ca.crt", "tls.crt", "tls.key")
+        }
+
+        real_replace = os.replace
+
+        def failing_replace(src, dst):
+            if dst.endswith("tls.key"):
+                raise OSError(28, "No space left on device")
+            return real_replace(src, dst)
+
+        monkeypatch.setattr(os, "replace", failing_replace)
+        monkeypatch.setattr(CertManager, "_cert_expiry", lambda self: None)
+        assert cm.ensure() is False  # failure surfaced as kept-serving
+        monkeypatch.setattr(os, "replace", real_replace)
+        after = {
+            n: open(os.path.join(tmp_path, "certs", n), "rb").read()
+            for n in ("ca.crt", "tls.crt", "tls.key")
+        }
+        # the commit rolled back: the full OLD triple is still served
+        assert after == before
+
 
 class TestCRIProxyBoundary:
     def test_proxy_interposes_over_real_sockets(self, tmp_path):
