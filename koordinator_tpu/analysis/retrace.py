@@ -19,6 +19,12 @@ Three statically detectable shapes of the PR-1 name-tuple retrace:
    ``register_pytree_node``) keys every downstream jit cache on object
    names — the exact PR-1 bug.  Intentional embedded-API registrations
    carry a reasoned disable tag instead.
+4. TRACED wave knobs at a jit boundary: a jitted function that takes
+   ``wave`` or ``top_m`` without declaring it static traces the wave
+   width into the program — the loop structure then re-specializes on
+   every distinct value, a silent per-cycle retrace of the hottest
+   program in the repo (solver/wave.py, the wave Pallas kernel, and
+   parallel/shard_assign.py all pass them via ``static_argnames``).
 """
 
 from __future__ import annotations
@@ -175,6 +181,35 @@ def _static_call_args(source: SourceFile) -> List[Violation]:
     return out
 
 
+# cycle-batching knobs that select loop structure: traced values here
+# mean one retrace per distinct width (rule docstring, shape 4)
+_WAVE_STATIC_PARAMS = ("wave", "top_m")
+
+
+def _traced_wave_knobs(source: SourceFile, spec: jitscope.JitSpec) -> List[Violation]:
+    if spec.func is None:
+        return []
+    static = spec.static_params()
+    out: List[Violation] = []
+    for pname in spec.params():
+        if pname in _WAVE_STATIC_PARAMS and pname not in static:
+            out.append(
+                Violation(
+                    rule=RULE,
+                    path=source.path,
+                    line=spec.line,
+                    message=(
+                        f"jit boundary {spec.name}() takes '{pname}' as a "
+                        "TRACED argument: the wave width selects loop "
+                        "structure, so every distinct value retraces the "
+                        "cycle silently; declare it in static_argnames "
+                        "(it is configuration, like cfg)"
+                    ),
+                )
+            )
+    return out
+
+
 def _pytree_metadata(source: SourceFile) -> List[Violation]:
     out: List[Violation] = []
     for node in ast.walk(source.tree):
@@ -212,6 +247,9 @@ def check(source: SourceFile) -> List[Violation]:
     out: List[Violation] = []
     for spec in jitscope.jitted_defs(source.tree):
         out.extend(_tracer_branches(source, spec))
+        out.extend(_traced_wave_knobs(source, spec))
+    for spec in jitscope.jit_assignments(source.tree).values():
+        out.extend(_traced_wave_knobs(source, spec))
     out.extend(_static_call_args(source))
     out.extend(_pytree_metadata(source))
     return out

@@ -141,16 +141,24 @@ class ScorerServicer:
                     _record_success,
                 )
 
+                # the CycleConfig wave knobs thread through to the
+                # round-based sharded cycle; wave=1 (the per-pod default)
+                # keeps the multichip path's own proven width
+                wave = self.cfg.wave if self.cfg.wave > 1 else 32
+                top_m = self.cfg.top_m
                 bucket = (
                     "shard",
                     int(snap.nodes.allocatable.shape[0]),
                     int(snap.pods.capacity),
                     self.mesh.size,
+                    wave,
+                    top_m,
                 )
                 if not _demoted(bucket):
                     try:
                         result, _rounds = greedy_assign_waves(
-                            snap, self.mesh, self.cfg
+                            snap, self.mesh, self.cfg,
+                            wave=wave, top_m=top_m,
                         )
                         # materialize INSIDE the guard: with async
                         # dispatch a late device fault would otherwise

@@ -41,7 +41,6 @@ from jax.sharding import Mesh, PartitionSpec as P
 from koordinator_tpu.config import (
     CycleConfig,
     DEFAULT_CYCLE_CONFIG,
-    MOST_ALLOCATED,
 )
 from koordinator_tpu.constraints.gang import gang_satisfaction
 from koordinator_tpu.model.snapshot import ClusterSnapshot
@@ -60,9 +59,16 @@ from koordinator_tpu.solver.greedy import (
     step_feasible_scores,
 )
 
-# scores are bounded by plugin weights * MAX_NODE_SCORE (tiny); this
-# sentinel for infeasible nodes leaves the packed key far from i64 limits
-_NEG = jnp.int64(-(2**40))
+# the packed-key encode/decode and the in-wave certification are the ONE
+# shared implementation (solver/wave.py) this path and the single-chip
+# wave_assign both consume — no copy-pasted math
+from koordinator_tpu.solver.wave import (
+    is_most_allocated,
+    pack_keys,
+    decode_key,
+    resolve_wave,
+    score_feasible,
+)
 
 
 def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
@@ -240,13 +246,11 @@ def _assign_sharded(
             if xscores is not None:
                 total = total + xscores[p]
 
-            masked = jnp.where(feasible, total, _NEG)
             # ONE collective per step: packed (score, lowest-index) max
-            key = masked * N + (N - 1 - gidx)
+            key = pack_keys(total, feasible, gidx, N)
             gkey = lax.pmax(jnp.max(key), ax)
-            best_score = gkey // N  # floor div decodes negatives too
-            chosen = (N - 1 - (gkey - best_score * N)).astype(jnp.int32)
-            any_feasible = best_score > (_NEG // 2)
+            best_score, chosen = decode_key(gkey, N)
+            any_feasible = score_feasible(best_score)
             chosen = jnp.where(any_feasible, chosen, -1)
 
             local = chosen - offset.astype(jnp.int32)
@@ -374,11 +378,8 @@ def _assign_waves(
     node_spec = P(ax, None)
     rep = P()
 
-    SENT_TH = _NEG * N // 2  # keys below this decode as infeasible
     # MostAllocated needs the upper-bound certificate (docstring bullet 4)
-    most_alloc = cfg.enable_fit_score and (
-        cfg.fit_scoring_strategy == MOST_ALLOCATED
-    )
+    most_alloc = is_most_allocated(cfg)
 
     def body(
         alloc, req0, usage, uprod, node_ok_def, node_ok_pr, fresh,
@@ -410,8 +411,7 @@ def _assign_waves(
                 feasible = feasible & xmask[p]
             if xscores is not None:
                 total = total + xscores[p]
-            key = total * N + (N - 1 - gidx)
-            return jnp.where(feasible, key, _NEG * N + (N - 1 - gidx))
+            return pack_keys(total, feasible, gidx, N)
 
         def wave_round(carry):
             ptr, nreq, nest, quse, chosen_buf, nwaves = carry
@@ -506,18 +506,27 @@ def _assign_waves(
                 R_ = alloc.shape[1]
                 u_gid = gathered["u_gid"].reshape(-1)  # [U = S*W*M]
                 U = u_gid.shape[0]
-                u_alloc = gathered["u_alloc"].reshape(U, R_)
-                u_nreq = gathered["u_nreq"].reshape(U, R_)
-                u_nest = gathered["u_nest"].reshape(U, R_)
-                u_usage = gathered["u_usage"].reshape(U, R_)
-                u_okd = gathered["u_okd"].reshape(U)
-                u_fresh = gathered["u_fresh"].reshape(U)
+                universe = dict(
+                    gid=u_gid,
+                    alloc=gathered["u_alloc"].reshape(U, R_),
+                    nreq=gathered["u_nreq"].reshape(U, R_),
+                    nest=gathered["u_nest"].reshape(U, R_),
+                    usage=gathered["u_usage"].reshape(U, R_),
+                    okd=gathered["u_okd"].reshape(U),
+                    fresh=gathered["u_fresh"].reshape(U),
+                    # [S, W, W*M] -> [W, U] aligned with u_gid's (s, k)
+                    # order
+                    xval=jnp.moveaxis(
+                        gathered["u_xval"], 0, 1
+                    ).reshape(W, U),
+                    xfeas=jnp.moveaxis(
+                        gathered["u_xfeas"], 0, 1
+                    ).reshape(W, U),
+                )
                 if prod_sensitive:
-                    u_uprod = gathered["u_uprod"].reshape(U, R_)
-                    u_okp = gathered["u_okp"].reshape(U)
-                # [S, W, W*M] -> [W, U] aligned with u_gid's (s, k) order
-                u_xval = jnp.moveaxis(gathered["u_xval"], 0, 1).reshape(W, U)
-                u_xfeas = jnp.moveaxis(gathered["u_xfeas"], 0, 1).reshape(W, U)
+                    universe["uprod"] = gathered["u_uprod"].reshape(U, R_)
+                    universe["okp"] = gathered["u_okp"].reshape(U)
+                cand = None
             else:
                 g = {k: _flat(v) for k, v in gathered.items()}
                 gkeys, gsel = lax.top_k(g["key"], M)  # [W, M] global candidates
@@ -530,176 +539,28 @@ def _assign_waves(
 
                 cand = {k: take(v) for k, v in g.items() if k != "key"}
                 cand_key = gkeys
+                universe = None
 
-            psreq_wave = psreq[ps]
-            pqid_wave = pqid[ps]
-            pvalid_wave = pvalid[ps]
-            pprod_wave = pprod[ps]
-
-            def resolve(i, st):
-                choices, committed, active, done, quse_w, ncommit = st
-                req = preq_wave[i]
-                est = pest_wave[i]
-                sreq = psreq_wave[i]
-                qid = pqid_wave[i]
-                qi = jnp.maximum(qid, 0)
-                earlier = committed & (iota_w < i)
-
-                k_m = cand_key[i, M - 1]
-                # k_M at sentinel: fewer than M nodes were feasible at
-                # frozen state, so ALL feasible nodes are candidates —
-                # and committed load never turns an infeasible node
-                # feasible under either strategy
-                sentinel_m = k_m <= SENT_TH
-
-                if most_alloc:
-                    # universe certificate (docstring bullet 4): re-key
-                    # the WHOLE closed candidate universe exactly for
-                    # this pod — frozen rows + replicated in-wave commit
-                    # deltas — then certify against the frozen k_M
-                    hit_u = earlier[:, None] & (
-                        choices[:, None] == u_gid[None, :]
-                    )  # [W, U]
-                    dreq_u = jnp.einsum(
-                        "wu,wr->ur", hit_u.astype(jnp.int64), preq_wave
-                    )
-                    dest_u = jnp.einsum(
-                        "wu,wr->ur", hit_u.astype(jnp.int64), pest_wave
-                    )
-                    if prod_sensitive:
-                        usage_u = jnp.where(
-                            pprod_wave[i], u_uprod, u_usage
-                        )
-                        ok_u = jnp.where(pprod_wave[i], u_okp, u_okd)
-                    else:
-                        usage_u = u_usage
-                        ok_u = u_okd
-                    re_feas, re_total = step_feasible_scores(
-                        u_nreq + dreq_u,
-                        u_nest + dest_u,
-                        quse_w,
-                        u_alloc,
-                        usage_u,
-                        u_fresh,
-                        ok_u,
-                        req,
-                        sreq,
-                        est,
-                        jnp.int32(-1),
-                        jnp.bool_(True),
-                        qrt,
-                        qlim,
-                        cfg,
-                    )
-                    re_total = re_total + jnp.where(
-                        u_xfeas[i], u_xval[i], 0
-                    )
-                    re_feas = re_feas & u_xfeas[i]
-                    cur = jnp.where(
-                        re_feas,
-                        re_total * N + (N - 1 - u_gid),
-                        _NEG * N + (N - 1 - u_gid),
-                    )  # [U]
-                    best_key = jnp.max(cur)
-                    best_node = u_gid[jnp.argmax(cur)]
-                    # pod 0 has no earlier in-wave commits: frozen keys
-                    # are current, its frozen top-1 is in the universe
-                    # (liveness: every round commits at least one pod)
-                    certified = (best_key >= k_m) | sentinel_m | (i == 0)
-                else:
-                    # candidate current keys (recomputed when dirtied
-                    # in-wave)
-                    c_nodes = cand["gid"][i]  # [M]
-                    hit = earlier[:, None] & (
-                        choices[:, None] == c_nodes[None, :]
-                    )  # [W, M]
-                    dreq = jnp.einsum(
-                        "wm,wr->mr", hit.astype(jnp.int64), preq_wave
-                    )
-                    dest = jnp.einsum(
-                        "wm,wr->mr", hit.astype(jnp.int64), pest_wave
-                    )
-                    dirty = jnp.any(hit, axis=0)  # [M]
-                    # re-key dirtied candidates with the SAME step
-                    # semantics the scan path and the frozen wave scoring
-                    # use — the candidate rows stand in as an M-node
-                    # block, quota disabled (qid=-1; admission is the
-                    # replicated recheck below).  No third copy of
-                    # Filter+Score exists here.
-                    re_feas, re_total = step_feasible_scores(
-                        cand["nreq"][i] + dreq,
-                        cand["nest"][i] + dest,
-                        quse_w,
-                        cand["alloc"][i],
-                        cand["usage"][i],
-                        cand["fresh"][i],
-                        cand["ok"][i],
-                        req,
-                        sreq,
-                        est,
-                        jnp.int32(-1),
-                        jnp.bool_(True),
-                        qrt,
-                        qlim,
-                        cfg,
-                    )
-                    re_total = re_total + jnp.where(
-                        cand["xfeas"][i], cand["xval"][i], 0
-                    )
-                    re_feas = re_feas & cand["xfeas"][i]
-                    rekeys = jnp.where(
-                        re_feas,
-                        re_total * N + (N - 1 - c_nodes),
-                        _NEG * N + (N - 1 - c_nodes),
-                    )
-                    cur = jnp.where(dirty, rekeys, cand_key[i])  # [M]
-                    best_key = jnp.max(cur)
-                    best_node = c_nodes[jnp.argmax(cur)]
-                    certified = (best_key >= k_m) | sentinel_m
-                feas = best_key > SENT_TH
-
-                qblocked = (qid >= 0) & jnp.any(
-                    qlim[qi] & (quse_w[qi] + req > qrt[qi])
-                )
-                usable = pvalid_wave[i] & ~qblocked & wvalid[i]
-                choice = jnp.where(feas & usable, best_node, -1)
-                # a -1 outcome is exact only when it is node-INDEPENDENT
-                # (quota-blocked / invalid pod / padding lane) or when
-                # sentinel_m says every frozen-feasible node is already a
-                # candidate (infeasible stays infeasible under commits).
-                # With k_M > sentinel, "no candidate feasible" proves
-                # nothing about nodes OUTSIDE the gathered set — feasible
-                # frozen nodes below k_M may remain, so the pod must end
-                # the commit prefix and rerun next round against fresh
-                # state (certification via sentinel_m is already in
-                # `certified`; adding ~feas here would wrongly commit
-                # schedulable pods as unschedulable).
-                certified = certified | ~usable
-
-                commit = active & certified
-                take_node = commit & (choice >= 0)
-                choices = choices.at[i].set(jnp.where(take_node, choice, -1))
-                committed = committed.at[i].set(take_node)
-                done = done.at[i].set(commit)
-                quse_w = jnp.where(
-                    take_node & (qid >= 0),
-                    quse_w.at[qi].add(req),
-                    quse_w,
-                )
-                ncommit = ncommit + jnp.where(commit, 1, 0)
-                active = active & certified
-                return (choices, committed, active, done, quse_w, ncommit)
-
-            st0 = (
-                jnp.full((W,), -1, jnp.int64),
-                jnp.zeros((W,), bool),
-                jnp.bool_(True),
-                jnp.zeros((W,), bool),
-                quse,
-                jnp.int64(0),
-            )
-            choices, committed, _, done, quse_new, ncommit = lax.fori_loop(
-                0, W, resolve, st0
+            # the SHARED certification resolver (solver/wave.py): commit
+            # targets and pod vectors are replicated, so every device
+            # derives the identical prefix
+            choices, committed, done, quse_new, ncommit = resolve_wave(
+                cand_key,
+                cand=cand,
+                universe=universe,
+                preq_wave=preq_wave,
+                pest_wave=pest_wave,
+                psreq_wave=psreq[ps],
+                pqid_wave=pqid[ps],
+                pvalid_wave=pvalid[ps],
+                pprod_wave=pprod[ps],
+                wvalid=wvalid,
+                qrt=qrt,
+                qlim=qlim,
+                quse=quse,
+                cfg=cfg,
+                n_total=N,
+                prod_sensitive=prod_sensitive,
             )
 
             # apply the committed prefix to the local shard state
@@ -764,6 +625,7 @@ def _assign_waves(
             node_requested=node_requested,
             node_estimated=node_estimated,
             quota_used=quota_used,
+            rounds=nwaves,
             path="shard",
         ),
         nwaves,

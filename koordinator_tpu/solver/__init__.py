@@ -6,6 +6,7 @@ from koordinator_tpu.solver.greedy import (  # noqa: F401
     score_cycle,
     greedy_assign,
 )
+from koordinator_tpu.solver.wave import wave_assign  # noqa: F401
 
 
 # (variant, backend, node-bucket, pod-bucket, extras) combos where a Pallas
@@ -117,6 +118,13 @@ def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None, i32_ok=Non
     fallback; elsewhere the lax.scan path runs.  All are bit-identical
     (tests/test_pallas_cycle.py).
 
+    ``cfg.wave > 1`` selects the wave-batched cycle: the wide kernel
+    runs its in-VMEM wave rounds (and is tried FIRST — the dense kernel
+    keeps its per-pod loop and ignores the knobs, placements identical
+    either way), and the CPU path runs ``solver.wave.wave_assign``
+    instead of the scan.  The knobs ride the static config, so a warm
+    Sync/Assign stream stays retrace-free (tests/test_resident_warm.py).
+
     ``i32_ok``: callers that already know whether the snapshot fits the
     kernel's i32 arithmetic (e.g. the bridge server, which checks host-side
     numpy mirrors at Sync time) pass it to skip the per-cycle device check.
@@ -134,13 +142,20 @@ def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None, i32_ok=Non
         int(snapshot.nodes.allocatable.shape[0]),
         int(snapshot.pods.capacity),
         has_extras,
+        # the wave knobs compile distinct programs — a failing wave
+        # kernel must not demote the per-pod bucket (or vice versa)
+        int(cfg.wave),
+        int(cfg.top_m),
     )
     extras_ok = True
+    scores_hi = None
     if extra_scores is not None:
         import jax.numpy as jnp
 
-        # extended-plugin scores join the kernel's i32 accumulation
-        extras_ok = int(jnp.max(jnp.abs(extra_scores))) < 2**29
+        # ONE device reduction serves both bounds: the kernel's i32
+        # accumulation headroom and the wave path's packed-key range
+        scores_hi = int(jnp.max(jnp.abs(extra_scores)))
+        extras_ok = scores_hi < 2**29
     if (
         backend != "cpu"
         # data-dependent, not shape-dependent: no demotion on failure
@@ -155,8 +170,14 @@ def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None, i32_ok=Non
         from koordinator_tpu.solver.pallas_cycle import greedy_assign_pallas
         from koordinator_tpu.solver.pallas_dense import greedy_assign_dense
 
-        for variant, fn in (("dense", greedy_assign_dense),
-                            ("wide", greedy_assign_pallas)):
+        variants = (("dense", greedy_assign_dense),
+                    ("wide", greedy_assign_pallas))
+        if cfg.wave > 1:
+            # the wave inner loop lives in the wide kernel; try it first
+            # so the requested batching actually runs
+            variants = (("wide", greedy_assign_pallas),
+                        ("dense", greedy_assign_dense))
+        for variant, fn in variants:
             bucket = (variant,) + shape_key
             if _demoted(bucket):
                 continue
@@ -188,4 +209,12 @@ def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None, i32_ok=Non
                     bucket,
                     pallas_demotions().get(bucket, (0, 0))[1],
                 )
+    if cfg.wave > 1 and (scores_hi is None or scores_hi < 2**31):
+        # run_cycle never raises for in-contract inputs: extra_scores
+        # beyond the packed-key range take the bit-identical scan below
+        # instead of tripping wave_assign's magnitude guard
+        return wave_assign(
+            snapshot, cfg, extra_mask=extra_mask, extra_scores=extra_scores,
+            scores_hi=scores_hi,
+        )
     return greedy_assign(snapshot, cfg, extra_mask=extra_mask, extra_scores=extra_scores)

@@ -57,10 +57,16 @@ class CycleResult:
     node_requested: Optional[jnp.ndarray] = None  # i64[N, R] post-cycle
     node_estimated: Optional[jnp.ndarray] = None  # i64[N, R] post-cycle
     quota_used: Optional[jnp.ndarray] = None  # i64[Q, R] post-cycle
+    # sequential round count of the wave-batched paths (solver/wave.py,
+    # the wave Pallas kernel, parallel/shard_assign.py): ~P/wave-prefix
+    # rounds vs P scan steps — surfaced so bench.py can publish the win;
+    # None on the per-pod paths
+    rounds: Optional[jnp.ndarray] = None
     # which code path produced the result ("pallas" single-kernel cycle,
-    # "scan" lax.scan, "shard" multi-chip shard_map) — static metadata so
-    # callers (bridge AssignReply, bench) can surface degraded-path runs;
-    # VERDICT r2 flagged the silent-fallback invisibility
+    # "scan" lax.scan, "wave" round-based single chip, "shard" multi-chip
+    # shard_map) — static metadata so callers (bridge AssignReply, bench)
+    # can surface degraded-path runs; VERDICT r2 flagged the
+    # silent-fallback invisibility
     path: Optional[str] = None
 
 
@@ -73,6 +79,7 @@ jax.tree_util.register_dataclass(
         "node_requested",
         "node_estimated",
         "quota_used",
+        "rounds",
     ],
     meta_fields=["path"],
 )

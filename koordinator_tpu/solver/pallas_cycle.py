@@ -138,6 +138,44 @@ def _weighted(per_res, w_row, w_sum: int):
     return _exact_div(s, _i32(w_sum), np.float32(1.0 / w_sum))
 
 
+def _kernel_filter_fit(nreq, req, alloc):
+    """NodeResourcesFit over a [rows, 128] state block: only requested
+    resources constrain.  i32 violation count, not jnp.all: a bool lane
+    reduction lowers to an i1 reduce_min Mosaic rejects ("Unsupported
+    element type for the selected reduction")."""
+    need = req > _i32(0)
+    fviol = jnp.where(need & (nreq + req > alloc), _i32(1), _i32(0))
+    return jnp.max(fviol, axis=-1, keepdims=True) == _i32(0)
+
+
+def _kernel_scores(
+    nreq, nest, alloc, usage, fresh, sreq, est, recip,
+    fit_w_row, la_w_row, fit_w_sum, la_w_sum, cfg: CycleConfig,
+):
+    """The plugin Score sum in exact i32 over a [rows, 128] state block
+    — the ONE in-kernel mirror of solver.greedy.step_feasible_scores,
+    shared by the per-pod and wave kernels (flag lanes in ``usage``
+    never contribute: the weight rows are zero beyond the resources)."""
+    total = jnp.zeros((alloc.shape[0], 1), jnp.int32)
+    if cfg.enable_fit_score:
+        t = nreq + sreq
+        if cfg.fit_scoring_strategy == MOST_ALLOCATED:
+            per_res = _most_requested(t, alloc, recip)
+        else:
+            per_res = _least_requested(t, alloc, recip)
+        total = total + _i32(cfg.fit_plugin_weight) * _weighted(
+            per_res, fit_w_row, fit_w_sum
+        )
+    if cfg.enable_loadaware:
+        est_used = usage + nest + est
+        per_res = _least_requested(est_used, alloc, recip)
+        la = _weighted(per_res, la_w_row, la_w_sum)
+        total = total + _i32(cfg.loadaware_plugin_weight) * jnp.where(
+            fresh, la, _i32(0)
+        )
+    return total
+
+
 def _cycle_kernel(
     # scalar prefetch (SMEM)
     qid_ref,  # i32[P] quota id per sorted pod (-1 = none)
@@ -245,16 +283,7 @@ def _cycle_kernel(
 
         nreq = nreq_ref[:]
         # Filter: Fit (only requested resources constrain) + node flags
-        need = req > _i32(0)
-        # i32 violation count, not jnp.all: a bool lane reduction lowers
-        # to an i1 reduce_min Mosaic rejects ("Unsupported element type
-        # for the selected reduction")
-        fviol = jnp.where(
-            need & (nreq + req > alloc), _i32(1), _i32(0)
-        )
-        fits = (
-            jnp.max(fviol, axis=-1, keepdims=True) == _i32(0)
-        )
+        fits = _kernel_filter_fit(nreq, req, alloc)
         # ElasticQuota admission on limited dimensions
         quse_row = quse_ref[pl.ds(qidx, 1), :]
         # scalar reduce in i32 (a scalar bool `jnp.all` does not lower on
@@ -281,21 +310,10 @@ def _cycle_kernel(
             feasible = feasible & (xv != _i32(XCOMB_INFEASIBLE))
 
         # Score: NodeResourcesFit + LoadAware, exact integer math
-        total = jnp.zeros((n_rows, 1), jnp.int32)
-        if cfg.enable_fit_score:
-            t = nreq + sreq
-            if cfg.fit_scoring_strategy == MOST_ALLOCATED:
-                per_res = _most_requested(t, alloc, recip)
-            else:
-                per_res = _least_requested(t, alloc, recip)
-            total = total + _i32(cfg.fit_plugin_weight) * _weighted(
-                per_res, fit_w_row, fit_w_sum
-            )
-        if cfg.enable_loadaware:
-            est_used = usage_p + nest_ref[:] + est
-            per_res = _least_requested(est_used, alloc, recip)
-            la = _weighted(per_res, la_w_row, la_w_sum)
-            total = total + _i32(cfg.loadaware_plugin_weight) * jnp.where(fresh, la, _i32(0))
+        total = _kernel_scores(
+            nreq, nest_ref[:], alloc, usage_p, fresh, sreq, est, recip,
+            fit_w_row, la_w_row, fit_w_sum, la_w_sum, cfg,
+        )
         if has_extras:
             total = total + jnp.where(
                 xv == _i32(XCOMB_INFEASIBLE), _i32(0), xv
@@ -325,11 +343,387 @@ def _cycle_kernel(
     lax.fori_loop(jnp.int32(0), jnp.int32(block), step, jnp.int32(0))
 
 
-@partial(jax.jit, static_argnames=("cfg", "block", "interpret"))
+def _wave_cycle_kernel(
+    # scalar prefetch (SMEM)
+    qid_ref,  # i32[P] quota id per sorted pod (-1 = none)
+    pvalid_ref,  # i32[P]
+    pprod_ref,  # i32[P]
+    # inputs (VMEM) — same layout as _cycle_kernel
+    preq_ref,
+    psreq_ref,
+    pest_ref,
+    alloc_ref,
+    usage_ref,
+    qrt_ref,
+    qlim_ref,
+    quse0_ref,
+    w_ref,
+    *rest,
+    block: int,
+    cfg: CycleConfig,
+    has_extras: bool,
+    has_prod: bool,
+    wave: int,
+    top_m: int,
+):
+    """Wave-batched inner loop: the solver/wave.py rounds, in VMEM.
+
+    Instead of one Filter/Score/argmax/Reserve dispatch per pod, each
+    sequential round freezes the next ``wave`` pods' top-``top_m``
+    candidate (score, node) pairs against round-start state, then
+    resolves the wave in queue order with the SAME certification the
+    jnp paths use — re-keyed candidates vs the frozen M-th key, queue
+    prefix commits, node-invariant quota recheck.  Differences from the
+    i64 resolver, both exactness-preserving:
+
+    * keys stay UNPACKED (score, index) with a lexicographic compare —
+      the packed ``score * N + idx`` key would overflow i32;
+    * Reserve lands on the state refs LIVE during resolution, so a
+      later pod's re-key reads frozen rows + earlier in-wave deltas
+      directly (the frozen candidate keys were captured before any
+      commit of the round);
+    * the MostAllocated closed universe is refined to {own top-M} ∪
+      {nodes committed-to earlier in the round}: commits land only on
+      wave candidates, every other node's key-for-this-pod is frozen
+      below its k_M, so re-keying that union bounds the true best
+      exactly (docs/KERNEL.md "Wave batching").
+
+    Waves never cross the 128-pod grid blocks the existing streaming
+    provides (``wvalid`` masks the tail); wave segmentation does not
+    affect placements, only round counts.  The round total accumulates
+    in the stats output so callers can surface the sequential-round win.
+    """
+    if has_prod:
+        uprod_ref = rest[0]
+        rest = rest[1:]
+    else:
+        uprod_ref = None
+    if has_extras:
+        xcomb_ref = rest[0]
+        rest = rest[1:]
+    else:
+        xcomb_ref = None
+    (chosen_ref, nreq_ref, nest_ref, quse_ref, rounds_ref,
+     cand_s_ref, cand_i_ref) = rest
+
+    i = pl.program_id(0)
+    W = wave
+    n_rows = alloc_ref.shape[0]
+    # the frozen candidate (score, index) slots live one-per-lane in the
+    # 128-lane scratch rows, so M is capped at LANES as well as the node
+    # count — a shallower M changes round counts, never placements (any
+    # M >= 1 certifies exactly)
+    M = max(1, min(top_m, n_rows, LANES))
+    most_alloc = cfg.enable_fit_score and (
+        cfg.fit_scoring_strategy == MOST_ALLOCATED
+    )
+
+    @pl.when(i == _i32(0))
+    def _init():
+        lane = lax.broadcasted_iota(jnp.int32, alloc_ref.shape, 1)
+        rolled = pltpu.roll(alloc_ref[:], _i32(LANES - REQ0_LANE_OFFSET), 1)
+        nreq_ref[:] = jnp.where(
+            lane < _i32(res.NUM_RESOURCES), rolled, _i32(0)
+        )
+        nest_ref[:] = jnp.zeros_like(nest_ref)
+        quse_ref[:] = quse0_ref[:]
+        rounds_ref[:] = jnp.zeros_like(rounds_ref)
+
+    alloc = alloc_ref[:]
+    row_iota = lax.broadcasted_iota(jnp.int32, (n_rows, 1), 0)
+    lane_iota = lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    sub_iota_w = lax.broadcasted_iota(jnp.int32, (W, 1), 0)
+    fit_w_row = w_ref[0:1, :]
+    la_w_row = w_ref[1:2, :]
+    fit_w_sum = sum(res.weights_vector(dict(cfg.fit_resource_weights)))
+    la_w_sum = sum(res.weights_vector(dict(cfg.loadaware.resource_weights)))
+    recip = 1.0 / jnp.maximum(alloc, _i32(1)).astype(jnp.float32)
+
+    def _lane(row, m):
+        """Lane m (traced) of a [1, 128] row -> i32 scalar (dynamic lane
+        slicing is costly on the VPU; a masked lane sum is one vector op)."""
+        return jnp.sum(
+            jnp.where(lane_iota == m, row, _i32(0)), dtype=jnp.int32
+        )
+
+    def frozen_masked(j):
+        """Frozen masked scores [n_rows, 1] for block-row j.  Quota is
+        handled at resolution (node-invariant), matching one_pod_keys in
+        the jnp wave paths."""
+        p = i * block + j
+        req = preq_ref[pl.ds(j, 1), :]
+        sreq = psreq_ref[pl.ds(j, 1), :]
+        est = pest_ref[pl.ds(j, 1), :]
+        is_valid = pvalid_ref[p] != _i32(0)
+        if has_prod:
+            is_prod = pprod_ref[p] != _i32(0)
+            node_ok_p = (
+                jnp.where(
+                    is_prod,
+                    usage_ref[:, FLAG_LANE_PROD_OK : FLAG_LANE_PROD_OK + 1],
+                    usage_ref[:, FLAG_LANE_OK : FLAG_LANE_OK + 1],
+                )
+                != _i32(0)
+            )
+            usage_p = jnp.where(is_prod, uprod_ref[:], usage_ref[:])
+        else:
+            node_ok_p = (
+                usage_ref[:, FLAG_LANE_OK : FLAG_LANE_OK + 1] != _i32(0)
+            )
+            usage_p = usage_ref[:]
+        fresh = usage_ref[:, FLAG_LANE_FRESH : FLAG_LANE_FRESH + 1] != _i32(0)
+        feasible = (
+            _kernel_filter_fit(nreq_ref[:], req, alloc)
+            & node_ok_p
+            & is_valid
+        )
+        total = _kernel_scores(
+            nreq_ref[:], nest_ref[:], alloc, usage_p, fresh, sreq, est,
+            recip, fit_w_row, la_w_row, fit_w_sum, la_w_sum, cfg,
+        )
+        if has_extras:
+            xv = jnp.sum(
+                jnp.where(lane_iota == j, xcomb_ref[:], _i32(0)),
+                axis=1,
+                keepdims=True,
+                dtype=jnp.int32,
+            )
+            feasible = feasible & (xv != _i32(XCOMB_INFEASIBLE))
+            total = total + jnp.where(
+                xv == _i32(XCOMB_INFEASIBLE), _i32(0), xv
+            )
+        return jnp.where(feasible, total, I32_MIN)
+
+    def rekey(c, j, req, sreq, est, is_prod):
+        """Current score of node c for the pod at block-row j, or
+        I32_MIN when infeasible.  The state refs already carry this
+        round's earlier commits (live Reserve), so the read IS frozen
+        rows + in-wave deltas — the same quantity the i64 resolver
+        reconstructs from gathered rows."""
+        a = alloc_ref[pl.ds(c, 1), :]
+        nr = nreq_ref[pl.ds(c, 1), :]
+        ne = nest_ref[pl.ds(c, 1), :]
+        u_row = usage_ref[pl.ds(c, 1), :]
+        fresh = u_row[:, FLAG_LANE_FRESH : FLAG_LANE_FRESH + 1] != _i32(0)
+        if has_prod:
+            ok_col = (
+                jnp.where(
+                    is_prod,
+                    u_row[:, FLAG_LANE_PROD_OK : FLAG_LANE_PROD_OK + 1],
+                    u_row[:, FLAG_LANE_OK : FLAG_LANE_OK + 1],
+                )
+                != _i32(0)
+            )
+            usage_row = jnp.where(is_prod, uprod_ref[pl.ds(c, 1), :], u_row)
+        else:
+            ok_col = u_row[:, FLAG_LANE_OK : FLAG_LANE_OK + 1] != _i32(0)
+            usage_row = u_row
+        recip_c = 1.0 / jnp.maximum(a, _i32(1)).astype(jnp.float32)
+        feas = _kernel_filter_fit(nr, req, a) & ok_col  # [1, 1]
+        total = _kernel_scores(
+            nr, ne, a, usage_row, fresh, sreq, est, recip_c,
+            fit_w_row, la_w_row, fit_w_sum, la_w_sum, cfg,
+        )
+        feas_s = jnp.sum(
+            jnp.where(feas, _i32(1), _i32(0)), dtype=jnp.int32
+        ) != _i32(0)
+        score = jnp.sum(total, dtype=jnp.int32)
+        if has_extras:
+            xv = _lane(xcomb_ref[pl.ds(c, 1), :], j)
+            feas_s = feas_s & (xv != _i32(XCOMB_INFEASIBLE))
+            score = score + jnp.where(
+                xv == _i32(XCOMB_INFEASIBLE), _i32(0), xv
+            )
+        return jnp.where(feas_s, score, I32_MIN)
+
+    def wave_round(carry):
+        ptr, rounds = carry
+
+        # Phase A: freeze the wave's top-M (score, node) pairs against
+        # round-start state (no ref is written until resolution below)
+        def score_one(w, _):
+            j = ptr + w
+            in_block = j < _i32(block)
+            j_eff = jnp.minimum(j, _i32(block - 1))
+            masked = jnp.where(in_block, frozen_masked(j_eff), I32_MIN)
+            srow = jnp.full((1, LANES), I32_MIN, jnp.int32)
+            irow = jnp.zeros((1, LANES), jnp.int32)
+
+            def pick(m, st):
+                rem, srow, irow = st
+                best = jnp.max(rem)
+                # first index achieving the max == jnp.argmax tie-break
+                bidx = jnp.min(
+                    jnp.where(rem == best, row_iota, _i32(n_rows))
+                )
+                srow = jnp.where(lane_iota == m, best, srow)
+                irow = jnp.where(lane_iota == m, bidx, irow)
+                rem = jnp.where(row_iota == bidx, I32_MIN, rem)
+                return (rem, srow, irow)
+
+            _, srow, irow = lax.fori_loop(
+                jnp.int32(0), jnp.int32(M), pick, (masked, srow, irow)
+            )
+            cand_s_ref[pl.ds(w, 1), :] = srow
+            cand_i_ref[pl.ds(w, 1), :] = irow
+            return jnp.int32(0)
+
+        lax.fori_loop(jnp.int32(0), jnp.int32(W), score_one, jnp.int32(0))
+
+        # Phase B: resolve the wave in queue order (solver/wave.py
+        # resolve_wave semantics, i32)
+        def resolve(i_w, st):
+            choices_col, committed_col, active, ncommit = st
+            j = ptr + i_w
+            in_block = j < _i32(block)
+            j_eff = jnp.minimum(j, _i32(block - 1))
+            p = i * block + j_eff
+            req = preq_ref[pl.ds(j_eff, 1), :]
+            sreq = psreq_ref[pl.ds(j_eff, 1), :]
+            est = pest_ref[pl.ds(j_eff, 1), :]
+            qid = qid_ref[p]
+            qidx = jnp.maximum(qid, _i32(0))
+            is_valid = pvalid_ref[p] != _i32(0)
+            is_prod = (pprod_ref[p] != _i32(0)) if has_prod else None
+            srow = cand_s_ref[pl.ds(i_w, 1), :]
+            irow = cand_i_ref[pl.ds(i_w, 1), :]
+            k_s = _lane(srow, _i32(M - 1))
+            k_i = _lane(irow, _i32(M - 1))
+            # k_M at sentinel: every frozen-feasible node is already a
+            # candidate, and committed load never turns an infeasible
+            # node feasible
+            sentinel_m = k_s == I32_MIN
+
+            # current best over the pod's own candidates — unpacked
+            # (score, lowest-index) lexicographic max
+            bs = I32_MIN
+            bi = _i32(0)
+            for m in range(M):  # static unroll, M is tiny
+                c = _lane(irow, _i32(m))
+                fs = _lane(srow, _i32(m))
+                cs = rekey(c, j_eff, req, sreq, est, is_prod)
+                # a sentinel slot (fewer than m+1 frozen-feasible nodes)
+                # stays sentinel: its index is not a real candidate
+                cs = jnp.where(fs == I32_MIN, I32_MIN, cs)
+                better = (cs > bs) | ((cs == bs) & (c < bi))
+                bs = jnp.where(better, cs, bs)
+                bi = jnp.where(better, c, bi)
+
+            if most_alloc:
+                # refined closed universe (kernel docstring): nodes
+                # committed-to earlier this round are the only
+                # non-candidates whose keys moved
+                def consider(w, st2):
+                    bs2, bi2 = st2
+                    cw = jnp.sum(
+                        jnp.where(sub_iota_w == w, choices_col, _i32(0)),
+                        dtype=jnp.int32,
+                    )
+                    comm = jnp.sum(
+                        jnp.where(sub_iota_w == w, committed_col, _i32(0)),
+                        dtype=jnp.int32,
+                    ) != _i32(0)
+                    live = comm & (w < i_w)
+                    cw_eff = jnp.maximum(cw, _i32(0))
+                    cs2 = jnp.where(
+                        live,
+                        rekey(cw_eff, j_eff, req, sreq, est, is_prod),
+                        I32_MIN,
+                    )
+                    better2 = (cs2 > bs2) | ((cs2 == bs2) & (cw_eff < bi2))
+                    return (
+                        jnp.where(better2, cs2, bs2),
+                        jnp.where(better2, cw_eff, bi2),
+                    )
+
+                bs, bi = lax.fori_loop(
+                    jnp.int32(0), jnp.int32(W), consider, (bs, bi)
+                )
+                lex_ge = (bs > k_s) | ((bs == k_s) & (bi <= k_i))
+                # pod 0 has no earlier in-wave commits: frozen keys ARE
+                # current (liveness)
+                certified = lex_ge | sentinel_m | (i_w == _i32(0))
+            else:
+                lex_ge = (bs > k_s) | ((bs == k_s) & (bi <= k_i))
+                certified = lex_ge | sentinel_m
+            feas = bs > I32_MIN
+
+            # ElasticQuota admission against the LIVE in-wave quota state
+            quse_row = quse_ref[pl.ds(qidx, 1), :]
+            qviol = jnp.where(
+                (qlim_ref[pl.ds(qidx, 1), :] != _i32(0))
+                & (quse_row + req > qrt_ref[pl.ds(qidx, 1), :]),
+                jnp.int32(1),
+                jnp.int32(0),
+            )
+            qblocked = (qid >= _i32(0)) & (jnp.max(qviol) != _i32(0))
+            usable = is_valid & ~qblocked & in_block
+            choice = jnp.where(feas & usable, bi, _i32(-1))
+            # a -1 outcome certifies only when node-independent or at
+            # the sentinel (see solver/wave.py) — otherwise the pod ends
+            # the commit prefix and reruns next round
+            certified = certified | ~usable
+            active_b = active != _i32(0)
+            commit = active_b & certified
+            take_node = commit & (choice >= _i32(0))
+
+            # live Reserve: later pods re-key against these rows
+            cidx = jnp.maximum(choice, _i32(0))
+            take = jnp.where(take_node, req, _i32(0))
+            nreq_ref[pl.ds(cidx, 1), :] = nreq_ref[pl.ds(cidx, 1), :] + take
+            nest_ref[pl.ds(cidx, 1), :] = nest_ref[
+                pl.ds(cidx, 1), :
+            ] + jnp.where(take_node, est, _i32(0))
+            quse_ref[pl.ds(qidx, 1), :] = quse_row + jnp.where(
+                take_node & (qid >= _i32(0)), req, _i32(0)
+            )
+
+            # uncommitted rows keep their value: they rerun in a later
+            # round (the committed set is always a queue prefix)
+            prev = chosen_ref[pl.ds(j_eff, 1), :]
+            chosen_ref[pl.ds(j_eff, 1), :] = jnp.where(
+                commit & in_block, choice, prev
+            )
+
+            choices_col = jnp.where(
+                sub_iota_w == i_w,
+                jnp.where(take_node, choice, _i32(-1)),
+                choices_col,
+            )
+            committed_col = jnp.where(
+                sub_iota_w == i_w,
+                jnp.where(take_node, _i32(1), _i32(0)),
+                committed_col,
+            )
+            ncommit = ncommit + jnp.where(commit, _i32(1), _i32(0))
+            active = jnp.where(commit, active, _i32(0))
+            return (choices_col, committed_col, active, ncommit)
+
+        st0 = (
+            jnp.full((W, 1), -1, jnp.int32),
+            jnp.zeros((W, 1), jnp.int32),
+            jnp.int32(1),
+            jnp.int32(0),
+        )
+        _, _, _, ncommit = lax.fori_loop(
+            jnp.int32(0), jnp.int32(W), resolve, st0
+        )
+        return (ptr + ncommit, rounds + _i32(1))
+
+    _, rounds = lax.while_loop(
+        lambda c: c[0] < _i32(block),
+        wave_round,
+        (jnp.int32(0), jnp.int32(0)),
+    )
+    rounds_ref[:] = rounds_ref[:] + rounds
+
+
+@partial(jax.jit, static_argnames=("cfg", "block", "interpret", "wave", "top_m"))
 def _run_cycle(
     preq, psreq, pest, qid, pvalid, pprod, alloc, usage, qrt,
     qlim, quse0, weights, uprod=None, xcomb=None, *,
-    cfg: CycleConfig, block: int, interpret: bool
+    cfg: CycleConfig, block: int, interpret: bool,
+    wave: int = 0, top_m: int = 0
 ):
     P = preq.shape[0]
     N = alloc.shape[0]
@@ -361,29 +755,56 @@ def _run_cycle(
         )
         in_specs += [xtra_spec]
         operands += [xcomb]
+    out_specs = [pod_spec, node_spec, node_spec, quota_spec]
+    out_shape = [
+        jax.ShapeDtypeStruct((P, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((N, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((N, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((Q, LANES), jnp.int32),
+    ]
+    if wave > 1:
+        # wave-batched inner loop: the round-count stats row joins the
+        # outputs and the frozen candidate tables ride scratch VMEM
+        W_k = min(wave, block)  # waves never cross the 128-pod blocks
+        kernel = partial(
+            _wave_cycle_kernel,
+            block=block,
+            cfg=cfg,
+            has_extras=has_extras,
+            has_prod=has_prod,
+            wave=W_k,
+            top_m=top_m,
+        )
+        out_specs = out_specs + [
+            pl.BlockSpec(
+                (8, LANES), lambda i, *_: (_z, _z), memory_space=pltpu.VMEM
+            )
+        ]
+        out_shape = out_shape + [jax.ShapeDtypeStruct((8, LANES), jnp.int32)]
+        scratch_shapes = [
+            pltpu.VMEM((W_k, LANES), jnp.int32),  # frozen cand scores
+            pltpu.VMEM((W_k, LANES), jnp.int32),  # frozen cand indices
+        ]
+    else:
+        kernel = partial(
+            _cycle_kernel,
+            block=block,
+            cfg=cfg,
+            has_extras=has_extras,
+            has_prod=has_prod,
+        )
+        scratch_shapes = []
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=grid,
         in_specs=in_specs,
-        out_specs=[pod_spec, node_spec, node_spec, quota_spec],
-    )
-
-    kernel = partial(
-        _cycle_kernel,
-        block=block,
-        cfg=cfg,
-        has_extras=has_extras,
-        has_prod=has_prod,
+        out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
     )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((P, LANES), jnp.int32),
-            jax.ShapeDtypeStruct((N, LANES), jnp.int32),
-            jax.ShapeDtypeStruct((N, LANES), jnp.int32),
-            jax.ShapeDtypeStruct((Q, LANES), jnp.int32),
-        ],
+        out_shape=out_shape,
         interpret=interpret,
     )(qid, pvalid, pprod, *operands)
 
@@ -396,6 +817,10 @@ def greedy_assign_pallas(
     extra_scores=None,  # i64[P, N] extended-plugin Score tensor
 ) -> CycleResult:
     """Drop-in replacement for solver.greedy.greedy_assign on TPU.
+
+    ``cfg.wave > 1`` swaps the per-pod inner loop for the wave-batched
+    rounds (``_wave_cycle_kernel``, docs/KERNEL.md "Wave batching") —
+    bit-identical placements, ``rounds`` set on the result.
 
     Raises ValueError when ``extra_scores`` exceed the i32 headroom the
     kernel's accumulation needs — direct callers must not get silent
@@ -536,7 +961,8 @@ def _greedy_assign_pallas(
         req0[:, : res.NUM_RESOURCES],
         (0, REQ0_LANE_OFFSET),
     )
-    chosen, nreq, nest, quse = _run_cycle(
+    use_wave = cfg.wave > 1
+    outs = _run_cycle(
         preq,
         psreq,
         pest,
@@ -554,7 +980,15 @@ def _greedy_assign_pallas(
         cfg=cfg,
         block=block,
         interpret=interpret,
+        wave=cfg.wave if use_wave else 0,
+        top_m=cfg.top_m if use_wave else 0,
     )
+    if use_wave:
+        chosen, nreq, nest, quse, stats = outs
+        rounds = stats[0, 0].astype(jnp.int64)
+    else:
+        chosen, nreq, nest, quse = outs
+        rounds = None
 
     assignment = jnp.full((P,), -1, jnp.int32).at[order].set(chosen[:P, 0])
     status = jnp.where(assignment >= 0, STATUS_ASSIGNED, STATUS_UNSCHEDULABLE)
@@ -572,5 +1006,6 @@ def _greedy_assign_pallas(
         node_requested=nreq[:N, :R].astype(jnp.int64),
         node_estimated=nest[:N, :R].astype(jnp.int64),
         quota_used=quse[:nq, :R].astype(jnp.int64),
+        rounds=rounds,
         path="pallas",
     )

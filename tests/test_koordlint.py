@@ -242,6 +242,46 @@ class TestRetraceHazard:
         assert any("unhashable" in m for m in msgs)
         assert any("tuple-of-str" in m for m in msgs)
 
+    def test_traced_wave_knobs_caught(self):
+        """A jit boundary taking wave/top_m traced is a silent per-cycle
+        retrace (the width selects loop structure); both decorator and
+        call-form jit spellings must be caught, and the static spelling
+        must pass (rule shape 4)."""
+        got = lint("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def cycle(snapshot, cfg, wave, top_m):
+            return snapshot
+
+        def _inner(snapshot, wave):
+            return snapshot
+
+        batched = jax.jit(_inner)
+        """)
+        msgs = [(v.rule, v.message) for v in got]
+        assert len(msgs) == 3
+        assert all(r == "retrace-hazard" for r, _ in msgs)
+        assert sum("'wave'" in m for _, m in msgs) == 2
+        assert sum("'top_m'" in m for _, m in msgs) == 1
+        assert all("static_argnames" in m for _, m in msgs)
+
+    def test_static_wave_knobs_are_clean(self):
+        assert lint("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg", "wave", "top_m"))
+        def cycle(snapshot, cfg, wave, top_m):
+            return snapshot
+
+        def _inner(snapshot, wave):
+            return snapshot
+
+        batched = jax.jit(_inner, static_argnames=("wave",))
+        """) == []
+
     def test_namey_pytree_metadata(self):
         got = lint("""
         import dataclasses

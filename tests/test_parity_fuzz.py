@@ -213,3 +213,117 @@ def test_scan_waves_parity_fuzz(seed):
     got, rounds = greedy_assign_waves(snap, make_mesh(), cfg)
     _assert_matches(want, got, seed)
     assert rounds >= 1
+
+
+# the ISSUE-3 sweep: wave widths x candidate depths, every feature
+# dimension of _fuzz_snapshot sampled underneath
+WAVE_GRID = [(1, 1), (8, 4), (32, 1), (32, 4)]
+
+
+@pytest.mark.parametrize("seed", range(3))
+@pytest.mark.parametrize("wave,top_m", WAVE_GRID)
+def test_scan_wave_assign_parity_fuzz(seed, wave, top_m):
+    """The single-chip wave path (solver/wave.py wave_assign) is
+    bit-identical with the scan across the full random feature matrix,
+    at every (wave, top_m) knob setting."""
+    from koordinator_tpu.solver import wave_assign
+
+    snap, cfg = _fuzz_snapshot(seed + 200)
+    want = greedy_assign(snap, cfg)
+    got = wave_assign(snap, cfg, wave=wave, top_m=top_m)
+    _assert_matches(want, got, seed)
+    rounds = int(np.asarray(got.rounds))
+    assert 1 <= rounds <= snap.pods.capacity
+
+
+@pytest.mark.parametrize("seed", range(2))
+@pytest.mark.parametrize("wave,top_m", [(8, 4), (32, 1)])
+def test_wave_pallas_parity_fuzz(seed, wave, top_m):
+    """The wave Pallas kernel (interpret mode) holds the same fuzzed
+    invariant through its i32 unpacked-key resolution."""
+    import dataclasses
+
+    snap, cfg = _fuzz_snapshot(seed + 300)
+    cfg = dataclasses.replace(cfg, wave=wave, top_m=top_m)
+    want = greedy_assign(snap, cfg)  # the scan ignores the wave knobs
+    got = greedy_assign_pallas(snap, cfg, interpret=True)
+    _assert_matches(want, got, seed)
+    assert int(np.asarray(got.rounds)) >= 1
+
+
+class TestWaveDirectedCases:
+    """The adversarial shapes the certification argument must survive
+    (ISSUE 3): gang minMember boundaries, quota exhaustion mid-wave, and
+    total contention where every wave degrades to a single commit."""
+
+    def test_gang_minmember_boundary(self):
+        """Gangs sized exactly at/below minMember: WAIT_GANG statuses
+        must match the scan bit-for-bit through the wave path."""
+        from koordinator_tpu.harness import generators
+        from koordinator_tpu.model import encode_snapshot
+        from koordinator_tpu.solver import wave_assign
+
+        # 2 nodes x small gangs: some gangs land exactly minMember
+        # members, some fall short and must WAIT
+        nodes, pods, gangs, quotas = generators.gang_batch(
+            seed=3, pods=48, nodes=2, min_member=5
+        )
+        snap = encode_snapshot(nodes, pods, gangs, quotas)
+        want = greedy_assign(snap)
+        got = wave_assign(snap, wave=8, top_m=2)
+        _assert_matches(want, got, "gang-boundary")
+        # the boundary is actually exercised: both statuses present
+        status = np.asarray(got.status)[: len(pods)]
+        assert (status == 2).any(), "no gang WAITed; boundary not hit"
+
+    def test_quota_exhaustion_mid_wave(self):
+        """Quotas sized to run dry midway through a wave: the blocked
+        pods commit as unschedulable in-wave (node-invariant recheck)
+        and quota accounting matches the scan exactly."""
+        from koordinator_tpu.harness import generators
+        from koordinator_tpu.solver import wave_assign
+
+        snap = generators.quota_colocation_snapshot(pods=96, nodes=8)[0]
+        want = greedy_assign(snap)
+        got = wave_assign(snap, wave=16, top_m=4)
+        _assert_matches(want, got, "quota-mid-wave")
+
+    @pytest.mark.parametrize("top_m", [1, 4])
+    def test_all_pods_contending_for_one_node(self, top_m):
+        """Worst case: one big node dominates scoring, every pod's top
+        candidate is the same node, and each wave certifies exactly one
+        commit — parity must hold and rounds approach pod count."""
+        from koordinator_tpu.model import encode_snapshot
+        from koordinator_tpu.solver import wave_assign
+
+        Gi2 = 1 << 30
+        nodes = [
+            {
+                "name": "big",
+                "allocatable": {"cpu": "64000m", "memory": 64 * Gi2,
+                                "pods": 110},
+            }
+        ] + [
+            {
+                "name": f"tiny-{i}",
+                "allocatable": {"cpu": "2000m", "memory": 2 * Gi2,
+                                "pods": 110},
+            }
+            for i in range(7)
+        ]
+        pods = [
+            {
+                "name": f"p{i}",
+                "requests": {"cpu": "900m", "memory": Gi2 // 2, "pods": 1},
+            }
+            for i in range(24)
+        ]
+        snap = encode_snapshot(nodes, pods, [], [])
+        want = greedy_assign(snap)
+        got = wave_assign(snap, wave=8, top_m=top_m)
+        _assert_matches(want, got, f"contention-top{top_m}")
+        rounds = int(np.asarray(got.rounds))
+        # with top_m=1 the contended waves degrade toward one commit
+        # per round; the point here is exactness, not speed
+        assert rounds >= 1
+        assert int((np.asarray(got.assignment) >= 0).sum()) == 24

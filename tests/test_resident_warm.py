@@ -516,6 +516,28 @@ class TestRetraceBudget:
         assert counter.traces == 0 and counter.compiles == 0
         assert len(reply.assignment) == len(first.assignment)
 
+    def test_warm_sync_wave_assign_sequence_is_retrace_free(self):
+        """The wave-batched cycle holds the same compile economics: with
+        wave/top_m riding the STATIC CycleConfig, a warm delta-Sync +
+        wave-Assign stream must hit zero jit cache misses after one
+        warm-up cycle (a traced wave width would retrace every cycle —
+        the hazard the koordlint rule rejects statically)."""
+        from koordinator_tpu.analysis import retrace_guard
+        from koordinator_tpu.config import CycleConfig
+
+        rng = np.random.RandomState(23)
+        state = _random_state(rng, n_nodes=5, n_pods=12, with_quota=False)
+        sv = ScorerServicer(CycleConfig(wave=8, top_m=2))
+        sv.sync(_full_sync_request(state))
+        sv.state.snapshot()
+        first = self._warm_step(sv, state)
+        with retrace_guard(budget=0) as counter:
+            for _ in range(4):
+                reply = self._warm_step(sv, state)
+        assert counter.traces == 0 and counter.compiles == 0
+        assert reply.path == "wave"
+        assert len(reply.assignment) == len(first.assignment)
+
     def test_guard_actually_counts(self):
         """Negative control: a fresh jit inside the guard must trip it —
         otherwise a broken counter would pass the budget test vacuously."""
