@@ -99,6 +99,29 @@ def _validate_artifact(line: Optional[str]) -> list:
     rd = doc.get("rounds")
     if rd is not None and (isinstance(rd, bool) or not isinstance(rd, int) or rd < 0):
         problems.append("'rounds' must be an int >= 0")
+    # per-stage span summary (ISSUE 4): stage name -> milliseconds, or
+    # null for a stage that measured nothing (a failed best-effort leg
+    # must stay VISIBLE as null, never invented) — so BENCH_*.json
+    # trajectories carry stage breakdowns, not just headline numbers
+    spans = doc.get("spans")
+    if spans is not None:
+        if not isinstance(spans, dict):
+            problems.append("'spans' must be an object")
+        else:
+            for name, v in spans.items():
+                if not isinstance(name, str) or not name:
+                    problems.append("'spans' keys must be non-empty strings")
+                elif v is not None and (
+                    isinstance(v, bool)
+                    or not isinstance(v, (int, float))
+                    or v != v
+                    or v in (float("inf"), float("-inf"))
+                    or v < 0
+                ):
+                    problems.append(
+                        f"'spans.{name}' must be null or a finite "
+                        "number >= 0"
+                    )
     return problems
 
 
@@ -134,6 +157,16 @@ def child(platform: str) -> None:
     def phase(name, **kw):
         print(json.dumps({"phase": name, **kw}), flush=True)
 
+    # per-stage span summary for the artifact (ISSUE 4): every key is
+    # pre-seeded so a stage that measured nothing publishes null — the
+    # schema (_validate_artifact) accepts exactly that shape
+    spans = {
+        "init": None, "rtt_floor": None, "snapshot": None,
+        "lowering_probe": None, "compile": None, "steady": None,
+        "wave_compile": None, "wave": None,
+        "cpu_native": None, "cpu_native_mt": None,
+    }
+
     t0 = time.perf_counter()
     import jax  # noqa: E402  (may hang; parent enforces the timeout)
 
@@ -141,7 +174,8 @@ def child(platform: str) -> None:
         jax.config.update("jax_platforms", "cpu")
     backend = jax.default_backend()
     n_dev = len(jax.devices())
-    phase("init", backend=backend, devices=n_dev, ms=_ms(t0))
+    spans["init"] = round(_ms(t0), 2)
+    phase("init", backend=backend, devices=n_dev, ms=spans["init"])
 
     # fixed dispatch+transfer floor of the platform (the tunneled axon
     # backend pays a network round trip per materialized result, measured
@@ -155,6 +189,7 @@ def child(platform: str) -> None:
     rtt_ms = min(
         _timed(lambda: np.asarray(_trivial(_x))) for _ in range(5)
     )
+    spans["rtt_floor"] = round(rtt_ms, 2)
     phase("rtt_floor", ms=round(rtt_ms, 2))
 
     t0 = time.perf_counter()
@@ -167,7 +202,8 @@ def child(platform: str) -> None:
     snap, nodes, pods, gangs, quotas, qdicts = _quota_snapshot(
         encode_snapshot, generators, res, build_quota_table_inputs
     )
-    phase("snapshot", ms=_ms(t0))
+    spans["snapshot"] = round(_ms(t0), 2)
+    phase("snapshot", ms=spans["snapshot"])
 
     on_tpu = backend != "cpu"
     if on_tpu:
@@ -186,7 +222,8 @@ def child(platform: str) -> None:
         )
         r = greedy_assign_dense(small)
         np.asarray(r.assignment)
-        phase("pallas_lowering_probe", ms=_ms(t0), path=r.path)
+        spans["lowering_probe"] = round(_ms(t0), 2)
+        phase("pallas_lowering_probe", ms=spans["lowering_probe"], path=r.path)
 
         run = lambda: greedy_assign_dense(snap)
         path = "pallas"
@@ -206,6 +243,7 @@ def child(platform: str) -> None:
     result = run()
     np.asarray(result.assignment)
     compile_ms = _ms(t0)
+    spans["compile"] = round(compile_ms, 2)
     phase("compile", ms=compile_ms, path=path)
 
     times = []
@@ -217,6 +255,7 @@ def child(platform: str) -> None:
     # min over 6 reps: the tunneled backend adds tens of ms of per-call
     # jitter; the min tracks the device+transport floor stably
     ms = min(times)
+    spans["steady"] = round(ms, 2)
     assigned = int((np.asarray(result.assignment)[:PODS] >= 0).sum())
     assert assigned > 0, "benchmark snapshot scheduled nothing"
     assert result.path == path, f"expected {path} path, ran {result.path}"
@@ -233,6 +272,8 @@ def child(platform: str) -> None:
         wave_ms, wave_rounds, wassign, wpath, wcompile = _wave_measure(
             snap, on_tpu, reps=2
         )
+        spans["wave_compile"] = round(wcompile, 2)
+        spans["wave"] = round(wave_ms, 2)
         wave_parity = bool(
             (wassign[:PODS] == np.asarray(result.assignment)[:PODS]).all()
         )
@@ -267,6 +308,7 @@ def child(platform: str) -> None:
         try:
             binary, golden = _native_prepare(nodes, pods, gangs, quotas, tmp)
             cpu_native_ms, _, _ = _native_run(binary, golden)
+            spans["cpu_native"] = cpu_native_ms
             phase("cpu_native_baseline", ms=cpu_native_ms)
         except Exception as exc:  # noqa: BLE001
             phase("cpu_native_baseline_failed", error=str(exc)[:200])
@@ -281,6 +323,7 @@ def child(platform: str) -> None:
                     binary, golden, iters=2, threads=16
                 )
                 hw_threads = mt_info.get("hw_concurrency")
+                spans["cpu_native_mt"] = cpu_native_mt_ms
                 phase(
                     "cpu_native_mt",
                     ms=cpu_native_mt_ms,
@@ -332,6 +375,10 @@ def child(platform: str) -> None:
                 "wave_speedup": (
                     round(ms / wave_ms, 3) if wave_ms else None
                 ),
+                # per-stage breakdown (ISSUE 4): null = the stage
+                # measured nothing (failed best-effort leg, or a stage
+                # this platform never runs)
+                "spans": spans,
             }
         ),
         flush=True,
@@ -816,6 +863,11 @@ def child_config(platform: str, config: str) -> None:
                     "wave": 32,
                     "rounds": wave_rounds,
                     "wave_ms": round(wave_ms, 2),
+                    "spans": {
+                        "compile": round(compile_ms, 2),
+                        "steady": round(steady_ms, 2),
+                        "wave": round(wave_ms, 2),
+                    },
                 }
             ),
             flush=True,
@@ -984,6 +1036,15 @@ def child_config(platform: str, config: str) -> None:
                     "delta_sync_bytes": len(warm_payload),
                     "score_top32_ms": round(score_ms, 1),
                     "score_build_ms": round(score.build_ms, 2),
+                    # the warm-cycle stage breakdown a scraper of the
+                    # daemon's /metrics histogram sees, artifact-side
+                    "spans": {
+                        "sync": round(sync_ms, 2),
+                        "delta_sync": round(delta_sync_ms, 2),
+                        "warm_assign": round(warm_ms, 2),
+                        "cold_assign": round(cold_ms, 2),
+                        "score_top32": round(score_ms, 2),
+                    },
                 }
             ),
             flush=True,

@@ -39,6 +39,17 @@ import numpy as np  # noqa: E402
 import koordinator_tpu  # noqa: F401,E402
 from koordinator_tpu.bridge.codegen import pb2  # noqa: E402
 from koordinator_tpu.bridge.server import ScorerServicer  # noqa: E402
+
+
+def _golden_servicer(epoch: str) -> ScorerServicer:
+    """A servicer with a PINNED boot epoch: the epoch rides every
+    snapshot id ("s<epoch>-<gen>") into the fixtures, and a random
+    uuid there would rewrite every .bin + expected.json on each regen
+    — unreviewable binary churn for zero semantic change."""
+    sv = ScorerServicer()
+    sv._epoch = epoch
+    sv.telemetry.spans.epoch = epoch  # minted cycle ids stay aligned
+    return sv
 from koordinator_tpu.harness import generators  # noqa: E402
 from koordinator_tpu.harness.golden import build_sync_request  # noqa: E402
 
@@ -84,7 +95,7 @@ def plugin_flow_fixtures(blobs: dict, expected: dict) -> None:
         mirror, False, names, a1, r1, u1, f1, "plugin-pod-1", pod_vec, 0
     )
 
-    sv = ScorerServicer()
+    sv = _golden_servicer("f1edf1ed")
     reply1 = sv.sync(pb2.SyncRequest.FromString(sync1))
     mirror.names, mirror.alloc, mirror.requested, mirror.usage = (
         names, a1, r1, u1,
@@ -103,6 +114,7 @@ def plugin_flow_fixtures(blobs: dict, expected: dict) -> None:
         snapshot_id=reply2.snapshot_id, top_k=0, flat=True
     )
     score_reply = sv.score(score_req)
+    score_reply.build_ms = 0.125  # measured timing pinned: regen determinism
 
     # both encoders must agree byte-for-byte before the bytes become truth
     for raw in (sync1, sync2):
@@ -158,14 +170,20 @@ def main() -> None:
         nodes, pods, gangs, quotas, node_bucket=8, pod_bucket=32
     )
 
-    sv = ScorerServicer()
+    sv = _golden_servicer("0601den0")
     sync_reply = sv.sync(req)
     score_req = pb2.ScoreRequest(
         snapshot_id=sync_reply.snapshot_id, top_k=TOP_K, flat=True
     )
     score_reply = sv.score(score_req)
-    assign_req = pb2.AssignRequest(snapshot_id=sync_reply.snapshot_id)
+    assign_req = pb2.AssignRequest(
+        snapshot_id=sync_reply.snapshot_id, cycle_id="golden-cycle-1"
+    )
     assign_reply = sv.assign(assign_req)
+    # measured timings pinned to exact float64 constants: a fixture
+    # regen with zero semantic change must be byte-identical
+    score_reply.build_ms = 0.125
+    assign_reply.cycle_ms = 1.5
 
     blobs = {
         "sync_request.bin": req.SerializeToString(),
@@ -219,10 +237,16 @@ def main() -> None:
             ).tolist(),
             "score": np.frombuffer(score_reply.flat.score, "<i8").tolist(),
         },
+        "assign_request": {
+            # the correlation id the sidecar echoes (and stamps on its
+            # span/flight telemetry); byte-parity tests re-marshal it
+            "cycle_id": assign_req.cycle_id,
+        },
         "assign_reply": {
             "assignment": list(assign_reply.assignment),
             "status": list(assign_reply.status),
             "path": assign_reply.path,
+            "cycle_id": assign_reply.cycle_id,
         },
     }
     plugin_flow_fixtures(blobs, expected)

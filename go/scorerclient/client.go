@@ -141,9 +141,20 @@ func (c *Client) ScoreFlat(topK int64) (*ScoreReply, error) {
 	return reply, nil
 }
 
-// Assign runs the full batched scheduling cycle.
+// Assign runs the full batched scheduling cycle.  The server mints the
+// reply's CycleID; pass one explicitly via AssignCycle to correlate
+// with caller-side logs.
 func (c *Client) Assign() (*AssignReply, error) {
-	req := AssignRequest{SnapshotID: c.SnapshotID}
+	return c.AssignCycle("")
+}
+
+// AssignCycle runs the cycle under an explicit correlation id: the
+// sidecar stamps its span records, flight-recorder dumps and
+// koord_scorer_* telemetry with this id and echoes it in the reply, so
+// a bad cycle found in plugin logs is directly addressable in the
+// sidecar's /metrics and --state-dir flight dumps.
+func (c *Client) AssignCycle(cycleID string) (*AssignReply, error) {
+	req := AssignRequest{SnapshotID: c.SnapshotID, CycleID: cycleID}
 	body, err := c.call(MethodAssign, req.Marshal())
 	if err != nil {
 		return nil, err

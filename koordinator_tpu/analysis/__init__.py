@@ -19,7 +19,12 @@ Rules (each suppressible per line with ``# koordlint: disable=<rule>``):
   tuple-of-str static args at call sites, and name/str payloads inside
   pytree registrations (the PR-1 name-tuple retrace).
 * ``host-sync-in-jit``  — ``np.asarray``, ``.item()``, ``float()``/
-  ``int()`` on jnp values, and ``print()`` inside jitted functions.
+  ``int()`` on jnp values, ``print()``, and the obs span/telemetry API
+  (koordinator_tpu/obs/) inside jitted functions — instrumentation
+  records AROUND device programs, never inside them.
+* ``span-leak``         — raw ``begin_span`` calls must guarantee the
+  matching ``end_span`` on every exit path (context manager or
+  try/finally); a leaked span poisons every later flight record.
 * ``broad-except``      — ``except Exception:`` handlers must re-raise,
   log, or surface the bound error; silent swallowers need a reasoned
   ``# koordlint: disable=broad-except(<reason>)`` tag.
@@ -49,5 +54,6 @@ RULES = (
     "retrace-hazard",
     "host-sync-in-jit",
     "broad-except",
+    "span-leak",
     "wire-contract",
 )

@@ -119,7 +119,13 @@ def run_rules_on_source(
 ) -> List[Violation]:
     """Run the AST rules over one file's source text (the unit-test seam:
     seeded-regression fixtures feed synthetic sources through here)."""
-    from koordinator_tpu.analysis import donation, excepts, hostsync, retrace
+    from koordinator_tpu.analysis import (
+        donation,
+        excepts,
+        hostsync,
+        retrace,
+        spanleak,
+    )
 
     try:
         source = SourceFile(path, text)
@@ -138,6 +144,7 @@ def run_rules_on_source(
         "retrace-hazard": retrace.check,
         "host-sync-in-jit": hostsync.check,
         "broad-except": excepts.check,
+        "span-leak": spanleak.check,
     }
     for rule, fn in table.items():
         if rules is not None and rule not in rules:

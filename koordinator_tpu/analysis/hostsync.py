@@ -13,6 +13,14 @@ excluding nested non-jitted closures only when they are themselves
 jit-wrapped.  ``int()``/``float()`` are flagged only when applied to an
 obvious jnp/jax expression — ``int(shape[0])`` and enum coercions are
 host-side constants and stay legal.
+
+The obs span API (koordinator_tpu/obs/spans.py) is covered too: a
+``begin_span``/``end_span``/``.span()``/``.note()`` inside jitted code
+would record trace-time wall clock ONCE and then never run again (the
+bare-print trap), and a note of a live tracer value would force a
+concretization.  Telemetry instruments around device programs, never
+inside them — that is the subsystem's zero-overhead contract
+(tests/test_resident_warm.py locks it in at zero jit cache misses).
 """
 
 from __future__ import annotations
@@ -28,6 +36,11 @@ RULE = "host-sync-in-jit"
 _NP_MODULES = ("np", "numpy", "onp", "_np")
 _JNP_MODULES = ("jnp", "jax")
 _NP_SYNC_FUNCS = ("asarray", "array", "copy")
+# obs span API: begin/end are unambiguous names; span/note/commit only
+# count on a receiver that is recognizably the telemetry/span recorder
+_OBS_METHODS = ("begin_span", "end_span")
+_OBS_RECEIVERS = ("obs", "spans", "telemetry", "recorder", "span_recorder")
+_OBS_RECEIVER_METHODS = ("span", "note", "commit", "commit_cycle")
 
 
 def _root_module(node: ast.AST) -> str:
@@ -36,6 +49,18 @@ def _root_module(node: ast.AST) -> str:
     if isinstance(node, ast.Name):
         return node.id
     return ""
+
+
+def _attr_chain(node: ast.AST):
+    """All names along an attribute chain: ``self.telemetry.spans.note``
+    -> ("self", "telemetry", "spans", "note")."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return tuple(reversed(parts))
 
 
 def _mentions_jnp(node: ast.AST) -> bool:
@@ -101,6 +126,31 @@ def check(source: SourceFile) -> List[Violation]:
                             f"{fn.id}() on a jnp value inside jitted "
                             f"{spec.name}() concretizes the tracer (host "
                             "sync); compute on device or hoist the check"
+                        ),
+                    )
+                )
+            # obs span/telemetry API: trace-time-only wall clock (and a
+            # tracer note forces a host sync); instrument OUTSIDE jit
+            elif isinstance(fn, ast.Attribute) and (
+                fn.attr in _OBS_METHODS
+                or (
+                    fn.attr in _OBS_RECEIVER_METHODS
+                    and any(
+                        seg in _OBS_RECEIVERS for seg in _attr_chain(fn)[:-1]
+                    )
+                )
+            ):
+                out.append(
+                    Violation(
+                        rule=RULE,
+                        path=source.path,
+                        line=node.lineno,
+                        message=(
+                            f"obs span API .{fn.attr}() inside jitted "
+                            f"{spec.name}() records trace-time wall "
+                            "clock once (and concretizes tracer "
+                            "arguments); instrument around the jitted "
+                            "call, not inside it"
                         ),
                     )
                 )

@@ -54,31 +54,63 @@ class RetraceCounter:
         self._active = True
 
     def stop(self) -> None:
-        from jax._src import monitoring
-
         self._active = False
-        unregister = getattr(
-            monitoring, "_unregister_event_duration_listener_by_callback", None
-        )
-        if unregister is not None:
-            unregister(self._on_event)
-            return
-        # private-API drift fallback: unhook by hand, or at least warn —
-        # a long-lived process must not silently accumulate one no-op
-        # listener per guard use
-        listeners = getattr(monitoring, "_event_duration_secs_listeners", None)
-        if isinstance(listeners, list) and self._on_event in listeners:
-            listeners.remove(self._on_event)
-            return
-        import warnings
+        _unregister_listener(self._on_event)
 
-        warnings.warn(
-            "retrace_guard could not unregister its jax monitoring "
-            "listener (private API drift); it remains registered as a "
-            "no-op for this process",
-            RuntimeWarning,
-            stacklevel=2,
-        )
+
+def _unregister_listener(fn) -> None:
+    """Drift-tolerant removal of a jax monitoring-bus listener — a
+    long-lived process must not silently accumulate no-op listeners."""
+    from jax._src import monitoring
+
+    unregister = getattr(
+        monitoring, "_unregister_event_duration_listener_by_callback", None
+    )
+    if unregister is not None:
+        unregister(fn)
+        return
+    # private-API drift fallback: unhook by hand, or at least warn
+    listeners = getattr(monitoring, "_event_duration_secs_listeners", None)
+    if isinstance(listeners, list) and fn in listeners:
+        listeners.remove(fn)
+        return
+    import warnings
+
+    warnings.warn(
+        "retrace_guard could not unregister its jax monitoring "
+        "listener (private API drift); it remains registered as a "
+        "no-op for this process",
+        RuntimeWarning,
+        stacklevel=2,
+    )
+
+
+def watch_cache_misses(callback) -> "callable":
+    """Register a PERSISTENT jit cache-miss listener (the obs metric
+    families' feed): ``callback(kind)`` fires with ``"trace"`` per jaxpr
+    trace and ``"compile"`` per backend compile, for the life of the
+    process or until the returned unhook callable is invoked.
+
+    Unlike :func:`retrace_guard` (a scoped assertion for tests), this is
+    the serving-path counter: the bridge daemon exports the counts as
+    ``koord_scorer_jit_cache_miss_total`` so a warm stream that starts
+    retracing is visible on /metrics, not only in a failed test.  The
+    callback runs on whatever thread jax traces on — keep it to a
+    counter bump."""
+    from jax._src import monitoring
+
+    def _on_event(name: str, *args, **kw) -> None:
+        if name == _TRACE_EVENT:
+            callback("trace")
+        elif name == _COMPILE_EVENT:
+            callback("compile")
+
+    monitoring.register_event_duration_secs_listener(_on_event)
+
+    def unhook() -> None:
+        _unregister_listener(_on_event)
+
+    return unhook
 
 
 @contextlib.contextmanager

@@ -1,0 +1,161 @@
+"""span-leak: every ``begin_span`` must end on ALL exit paths.
+
+The obs span recorder (koordinator_tpu/obs/spans.py) exposes a raw
+``begin_span(name) -> handle`` / ``end_span(handle)`` pair for call
+sites where the context-manager form can't be used (e.g. a span whose
+recorder may be None).  A raw ``begin_span`` whose ``end_span`` only
+runs on the happy path leaks the span whenever the stage raises — the
+flight recorder then shows a stage that "never finished" on every
+cycle AFTER the bad one, which is exactly the misleading artifact a
+post-mortem tool must not produce.
+
+Accepted shapes (anything else is a violation):
+
+* ``with recorder.span("stage"): ...`` — the context-manager form
+  (no raw begin/end at the call site at all; preferred).
+* ``h = r.begin_span("x")`` immediately followed by a ``try:`` whose
+  ``finally:`` calls ``end_span`` (the canonical raw form).
+* ``begin_span`` anywhere inside a ``try`` whose ``finally`` calls
+  ``end_span``.
+* ``begin_span`` inside an ``__enter__`` whose class's ``__exit__``
+  calls ``end_span`` (the context-manager *implementation* pattern —
+  obs/spans.py itself).
+
+Suppressible per line like every rule:
+``# koordlint: disable=span-leak(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from koordinator_tpu.analysis.core import SourceFile, Violation
+
+RULE = "span-leak"
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _contains_call(node: ast.AST, name: str) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _call_name(sub) == name:
+            return True
+    return False
+
+
+def _ends_in_finally(try_node: ast.Try) -> bool:
+    return any(_contains_call(stmt, "end_span") for stmt in try_node.finalbody)
+
+
+def _parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _in_protected_try(node: ast.AST, parents) -> bool:
+    """Inside a Try (body/handlers/orelse — not the finally itself)
+    whose finalbody ends the span."""
+    child = node
+    while child in parents:
+        parent = parents[child]
+        if isinstance(parent, ast.Try) and _ends_in_finally(parent):
+            # `child` is the Try's direct child on the ancestor path;
+            # anywhere but the finalbody itself counts as protected
+            if child not in parent.finalbody:
+                return True
+        child = parent
+    return False
+
+
+def _followed_by_protected_try(node: ast.AST, parents) -> bool:
+    """The canonical raw form: the begin_span statement's NEXT sibling
+    is a Try whose finally ends the span."""
+    stmt = node
+    while stmt in parents and not isinstance(stmt, ast.stmt):
+        stmt = parents[stmt]
+    if not isinstance(stmt, ast.stmt) or stmt not in parents:
+        return False
+    block_owner = parents[stmt]
+    for field in ("body", "orelse", "finalbody"):
+        block = getattr(block_owner, field, None)
+        if isinstance(block, list) and stmt in block:
+            i = block.index(stmt)
+            if i + 1 < len(block):
+                nxt = block[i + 1]
+                return isinstance(nxt, ast.Try) and _ends_in_finally(nxt)
+            return False
+    # statements inside an except handler live on the handler, not the Try
+    if isinstance(block_owner, ast.excepthandler):
+        block = block_owner.body
+        if stmt in block:
+            i = block.index(stmt)
+            if i + 1 < len(block):
+                nxt = block[i + 1]
+                return isinstance(nxt, ast.Try) and _ends_in_finally(nxt)
+    return False
+
+
+def _in_enter_with_exit(node: ast.AST, parents) -> bool:
+    """The CM implementation pattern: begin in __enter__, end in the
+    same class's __exit__."""
+    child = node
+    func: Optional[ast.AST] = None
+    while child in parents:
+        parent = parents[child]
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func = parent
+            break
+        child = parent
+    if func is None or func.name != "__enter__" or func not in parents:
+        return False
+    cls = parents[func]
+    if not isinstance(cls, ast.ClassDef):
+        return False
+    for stmt in cls.body:
+        if (
+            isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and stmt.name == "__exit__"
+            and _contains_call(stmt, "end_span")
+        ):
+            return True
+    return False
+
+
+def check(source: SourceFile) -> List[Violation]:
+    parents = _parents(source.tree)
+    out: List[Violation] = []
+    for node in ast.walk(source.tree):
+        if not (isinstance(node, ast.Call) and _call_name(node) == "begin_span"):
+            continue
+        if _in_protected_try(node, parents):
+            continue
+        if _followed_by_protected_try(node, parents):
+            continue
+        if _in_enter_with_exit(node, parents):
+            continue
+        out.append(
+            Violation(
+                rule=RULE,
+                path=source.path,
+                line=node.lineno,
+                message=(
+                    "begin_span() without a guaranteed end_span() on "
+                    "every exit: an exception here leaks the span into "
+                    "every later flight record.  Use "
+                    "`with recorder.span(...)`, or follow begin_span "
+                    "immediately with try/finally calling end_span"
+                ),
+            )
+        )
+    return out

@@ -24,11 +24,18 @@ from jax import lax
 from koordinator_tpu.bridge.codegen import SERVICE, pb2
 from koordinator_tpu.bridge.state import ResidentState
 from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG
+from koordinator_tpu.obs import CycleTelemetry
 from koordinator_tpu.solver import run_cycle, score_cycle
 
 
 class ScorerServicer:
-    def __init__(self, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG, mesh=None):
+    def __init__(
+        self,
+        cfg: CycleConfig = DEFAULT_CYCLE_CONFIG,
+        mesh=None,
+        state_dir=None,
+        telemetry: Optional[CycleTelemetry] = None,
+    ):
         """``mesh``: a ``jax.sharding.Mesh`` turns the ASSIGN RPC into
         the round-based multi-chip cycle (parallel/shard_assign.py
         greedy_assign_waves, bit-identical with the single-chip path);
@@ -37,7 +44,13 @@ class ScorerServicer:
         the resident tensors must fit one device's memory; the mesh buys
         cycle wall-clock, not snapshot capacity.  A shard-path failure
         falls back to the single-chip cycle for that RPC (placements are
-        bit-identical either way)."""
+        bit-identical either way).
+
+        ``state_dir``: where flight-recorder dumps land (obs/flight.py;
+        the daemon passes its --state-dir).  ``telemetry`` injects a
+        pre-built CycleTelemetry (tests); by default one is created with
+        this servicer's epoch so cycle ids ("c<epoch>-<seq>") correlate
+        with snapshot ids ("s<epoch>-<gen>")."""
         self.cfg = cfg
         self.mesh = mesh
         self.state = ResidentState()
@@ -48,9 +61,14 @@ class ScorerServicer:
         # and would then delta-sync onto a foreign baseline; the epoch
         # makes the restart unmistakable (ADVICE r5)
         self._epoch = uuid.uuid4().hex[:8]
+        self.telemetry = telemetry or CycleTelemetry(
+            epoch=self._epoch, cfg=cfg, state_dir=state_dir
+        )
         # one lock over state-mutating Sync and state-reading Score/Assign:
         # the server runs on a thread pool, and a Sync racing a Score would
         # otherwise let one cycle mix tensors from two generations
+        # (telemetry rides under the same lock: cycle records never
+        # interleave two RPCs' spans)
         self._lock = threading.Lock()
 
     def snapshot_id(self) -> str:
@@ -74,8 +92,32 @@ class ScorerServicer:
     # -- RPC bodies (request -> reply functions) --
     def sync(self, req: "pb2.SyncRequest", ctx=None) -> "pb2.SyncReply":
         with self._lock:
-            self.state.apply_sync(req)
+            self.telemetry.flush_backlog()
+            try:
+                info = self.state.apply_sync(req, spans=self.telemetry.spans)
+            except Exception as exc:
+                # ValueError = a frame validation REJECTED (bad delta
+                # shape/index, missing first-sync tensors): the
+                # CLIENT's bug, at the client's rate — error counter
+                # only.  No flight record, no dump, and crucially no
+                # commit of the pending cycle: another client's sync
+                # spans may be on it awaiting THEIR Assign, and a
+                # looping bad client must be able to churn neither the
+                # 64-slot ring nor the dump directory.  Anything else
+                # is an unexpected server-side failure: full
+                # abort (ring record + disk dump).
+                if isinstance(exc, ValueError):
+                    self.telemetry.metrics.count_cycle_error("sync")
+                else:
+                    self.telemetry.abort_cycle("sync", exc)
+                raise
             self._generation += 1
+            self.telemetry.record_sync(
+                info,
+                snapshot_id=self.snapshot_id(),
+                epoch=self._epoch,
+                generation=self._generation,
+            )
             # counts come from the host mirrors.  A warm frame lands its
             # deltas straight on the resident device tensors inside
             # apply_sync (state.last_sync_path == "warm"); only a cold
@@ -89,76 +131,162 @@ class ScorerServicer:
     def score(self, req: "pb2.ScoreRequest", ctx=None) -> "pb2.ScoreReply":
         with self._lock:
             self._check_generation(req, ctx)
-            snap = self.state.snapshot()
+            spans = self.telemetry.spans
+            # a pending cycle holds the Sync stages (sync_decode,
+            # delta_scatter) waiting for the Assign that correlates
+            # them under the client's cycle_id.  In the standard
+            # Sync→Score→Assign flow Score must NOT commit it — the
+            # assign flight record would lose exactly the sync spans
+            # the correlation promises.  Score's spans ride along
+            # (score_* names, no collision) and only a Score with no
+            # pending cycle commits its own record.
+            self.telemetry.flush_backlog()
+            pending = spans.has_pending()
+            spans.current(snapshot_id=self.snapshot_id())
+            t_cycle = time.perf_counter()
+            try:
+                reply = self._score_body(req, spans)
+            except Exception as exc:
+                self.telemetry.abort_cycle("score", exc)
+                raise
+            latency_ms = (time.perf_counter() - t_cycle) * 1000.0
+            if pending:
+                self.telemetry.metrics.observe_cycle(
+                    latency_ms, path="score", wave=self.cfg.wave
+                )
+            else:
+                self.telemetry.commit_cycle(
+                    latency_ms, path="score", wave=self.cfg.wave
+                )
+            return reply
+
+    def _score_body(self, req: "pb2.ScoreRequest", spans) -> "pb2.ScoreReply":
+        snap = self.state.snapshot()
+        with spans.span("score_dispatch"):
             scores, feasible = score_cycle(snap, self.cfg)
-            masked = jnp.where(feasible, scores, jnp.iinfo(jnp.int64).min)
+            masked = jnp.where(
+                feasible, scores, jnp.iinfo(jnp.int64).min
+            )
             P = snap.pods.capacity
-            reply = pb2.ScoreReply()
             k = int(req.top_k) or snap.nodes.capacity
             k = min(k, snap.nodes.capacity)
             top_scores, top_idx = lax.top_k(masked, k)
-            # one device->host transfer, then numpy-only reply assembly
+        reply = pb2.ScoreReply()
+        with spans.span("score_readback"):
+            # one device->host transfer, then numpy-only assembly
             top_scores = np.asarray(top_scores)
             top_idx = np.asarray(top_idx).astype(np.int32)
-            ok = np.take_along_axis(np.asarray(feasible), top_idx, axis=1)
+            ok = np.take_along_axis(
+                np.asarray(feasible), top_idx, axis=1
+            )
             valid = np.asarray(snap.pods.valid)[:P].astype(bool)
-            t0 = time.perf_counter()
-            if req.flat:
-                # flat layout (round-3 review #8): O(1) Python calls —
-                # boolean indexing + tobytes, no per-pod message building
-                ok_v = ok[:P][valid]
-                reply.flat.pod_index = (
-                    np.flatnonzero(valid).astype("<i4").tobytes()
-                )
-                reply.flat.counts = ok_v.sum(axis=1).astype("<i4").tobytes()
-                reply.flat.node_index = (
-                    top_idx[:P][valid][ok_v].astype("<i4").tobytes()
-                )
-                reply.flat.score = (
-                    top_scores[:P][valid][ok_v].astype("<i8").tobytes()
-                )
-            else:
-                # legacy per-pod lists: per-valid-pod Python loop
-                for p in np.flatnonzero(valid):
-                    entry = reply.pods.add()
-                    m = ok[p]
-                    entry.node_index.extend(top_idx[p, m].tolist())
-                    entry.score.extend(top_scores[p, m].tolist())
-            reply.build_ms = (time.perf_counter() - t0) * 1000.0
-            return reply
+        t0 = time.perf_counter()
+        if req.flat:
+            # flat layout (round-3 review #8): O(1) Python calls —
+            # boolean indexing + tobytes, no per-pod message building
+            ok_v = ok[:P][valid]
+            reply.flat.pod_index = (
+                np.flatnonzero(valid).astype("<i4").tobytes()
+            )
+            reply.flat.counts = ok_v.sum(axis=1).astype("<i4").tobytes()
+            reply.flat.node_index = (
+                top_idx[:P][valid][ok_v].astype("<i4").tobytes()
+            )
+            reply.flat.score = (
+                top_scores[:P][valid][ok_v].astype("<i8").tobytes()
+            )
+        else:
+            # legacy per-pod lists: per-valid-pod Python loop
+            for p in np.flatnonzero(valid):
+                entry = reply.pods.add()
+                m = ok[p]
+                entry.node_index.extend(top_idx[p, m].tolist())
+                entry.score.extend(top_scores[p, m].tolist())
+        reply.build_ms = (time.perf_counter() - t0) * 1000.0
+        return reply
 
     def assign(self, req: "pb2.AssignRequest", ctx=None) -> "pb2.AssignReply":
         with self._lock:
             self._check_generation(req, ctx)
-            snap = self.state.snapshot()
+            spans = self.telemetry.spans
+            # adopt the client's correlation id when it sent one; the id
+            # (ours or theirs) is echoed in the reply either way
+            cycle = spans.current(
+                snapshot_id=self.snapshot_id(),
+                cycle_id=req.cycle_id or None,
+            )
             t0 = time.perf_counter()
-            result = None
-            if self.mesh is not None:
-                from koordinator_tpu.parallel import greedy_assign_waves
-                from koordinator_tpu.solver import (
-                    _demoted,
-                    _record_failure,
-                    _record_success,
+            try:
+                result, rounds, eff_wave = self._assign_cycle(spans)
+                with spans.span("readback"):
+                    assignment = np.asarray(result.assignment)
+                    status = np.asarray(result.status)
+                    # same cached snapshot _assign_cycle ran against
+                    # (no Sync can interleave: we hold the lock)
+                    valid = np.asarray(
+                        self.state.snapshot().pods.valid
+                    ).astype(bool)
+                ms = (time.perf_counter() - t0) * 1000.0
+                reply = pb2.AssignReply(
+                    cycle_ms=ms,
+                    path=result.path or "",
+                    cycle_id=cycle.cycle_id,
                 )
+                reply.assignment.extend(assignment[valid].tolist())
+                reply.status.extend(status[valid].tolist())
+            except Exception as exc:
+                # count + flight-dump the bad cycle before surfacing it
+                self.telemetry.abort_cycle("assign", exc)
+                raise
+            self.telemetry.commit_cycle(
+                ms,
+                path=result.path or "unknown",
+                wave=eff_wave,
+                rounds=rounds,
+            )
+            return reply
 
-                # the CycleConfig wave knobs thread through to the
-                # round-based sharded cycle; wave=1 (the per-pod default)
-                # keeps the multichip path's own proven width
-                wave = self.cfg.wave if self.cfg.wave > 1 else 32
-                top_m = self.cfg.top_m
-                bucket = (
-                    "shard",
-                    int(snap.nodes.allocatable.shape[0]),
-                    int(snap.pods.capacity),
-                    self.mesh.size,
-                    wave,
-                    top_m,
-                )
-                if not _demoted(bucket):
-                    try:
-                        result, _rounds = greedy_assign_waves(
+    def _assign_cycle(self, spans):
+        """Run the device cycle (shard-first when a mesh is configured)
+        and return ``(materialized CycleResult, rounds or None,
+        effective wave width)`` — the shard path widens cfg.wave<=1 to
+        its own default, and the telemetry labels must say what actually
+        ran.  Caller holds the lock and owns error accounting."""
+        snap = self.state.snapshot()
+        result = None
+        rounds = None
+        eff_wave = self.cfg.wave
+        if self.mesh is not None:
+            from koordinator_tpu.parallel import greedy_assign_waves
+            from koordinator_tpu.solver import (
+                _demoted,
+                _record_failure,
+                _record_success,
+            )
+
+            # the CycleConfig wave knobs thread through to the
+            # round-based sharded cycle; wave=1 (the per-pod default)
+            # keeps the multichip path's own proven width
+            wave = self.cfg.wave if self.cfg.wave > 1 else 32
+            top_m = self.cfg.top_m
+            bucket = (
+                "shard",
+                int(snap.nodes.allocatable.shape[0]),
+                int(snap.pods.capacity),
+                self.mesh.size,
+                wave,
+                top_m,
+            )
+            if not _demoted(bucket):
+                try:
+                    # distinct name from the fallback's "dispatch": a
+                    # failed shard attempt followed by the single-chip
+                    # cycle must not leave two same-named spans a
+                    # post-mortem reader would double-count
+                    with spans.span("dispatch_shard"):
+                        result, nwaves = greedy_assign_waves(
                             snap, self.mesh, self.cfg,
-                            wave=wave, top_m=top_m,
+                            wave=wave, top_m=top_m, spans=spans,
                         )
                         # materialize INSIDE the guard: with async
                         # dispatch a late device fault would otherwise
@@ -171,34 +299,39 @@ class ScorerServicer:
                             assignment=np.asarray(result.assignment),
                             status=np.asarray(result.status),
                         )
-                        _record_success(bucket)
-                    except Exception:
-                        # the run_cycle demotion philosophy, shared
-                        # machinery: back off this shape bucket instead
-                        # of re-paying a failed shard compile on every
-                        # RPC; the single-chip cycle is bit-identical
-                        # and path in the reply shows the degradation
-                        _record_failure(bucket)
-                        result = None
-                        import logging
+                    # device-derived stat, materialized AFTER the device
+                    # program completed — one scalar transfer, no retrace
+                    rounds = int(np.asarray(nwaves))
+                    eff_wave = wave
+                    _record_success(bucket)
+                except Exception as exc:
+                    # the run_cycle demotion philosophy, shared
+                    # machinery: back off this shape bucket instead
+                    # of re-paying a failed shard compile on every
+                    # RPC; the single-chip cycle is bit-identical
+                    # and path in the reply shows the degradation
+                    _record_failure(bucket)
+                    result = None
+                    # the cycle record must say the shard attempt
+                    # failed, not just show a closed dispatch_shard
+                    # span next to the fallback's dispatch
+                    spans.note("shard_error", f"{exc!r:.200}")
+                    import logging
 
-                        logging.getLogger(__name__).exception(
-                            "sharded assign failed; serving single-chip "
-                            "and backing off bucket %r",
-                            bucket,
-                        )
-            if result is None:
+                    logging.getLogger(__name__).exception(
+                        "sharded assign failed; serving single-chip "
+                        "and backing off bucket %r",
+                        bucket,
+                    )
+        if result is None:
+            eff_wave = self.cfg.wave
+            with spans.span("dispatch"):
                 result = run_cycle(
                     snap, self.cfg, i32_ok=self.state.i32_fits()
                 )
-            assignment = np.asarray(result.assignment)
-            status = np.asarray(result.status)
-            ms = (time.perf_counter() - t0) * 1000.0
-            valid = np.asarray(snap.pods.valid).astype(bool)
-            reply = pb2.AssignReply(cycle_ms=ms, path=result.path or "")
-            reply.assignment.extend(assignment[valid].tolist())
-            reply.status.extend(status[valid].tolist())
-            return reply
+            if result.rounds is not None:
+                rounds = int(np.asarray(result.rounds))
+        return result, rounds, eff_wave
 
 
 def _handler(fn, req_cls):

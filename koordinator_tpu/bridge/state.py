@@ -235,12 +235,57 @@ class ResidentState:
         #  "warm" = resident tensors updated in place)
         self.last_sync_path = "cold"
 
-    def apply_sync(self, reqmsg: "pb2.SyncRequest") -> None:
+    def apply_sync(self, reqmsg: "pb2.SyncRequest", spans=None) -> dict:
         """Decode EVERYTHING first, commit only if every tensor decoded:
         a rejected frame (bad delta shape/index, missing first-sync
         tensors) must leave the resident state untouched — a torn
         half-applied sync would hand every OTHER client a corrupted
-        delta baseline behind an unbumped generation."""
+        delta baseline behind an unbumped generation.
+
+        ``spans``: an optional ``obs.spans.SpanRecorder``; the host-side
+        decode ("sync_decode") and the on-device warm update
+        ("delta_scatter") are recorded as stages of the upcoming cycle.
+        Returns a summary dict for the scorer metric families:
+        ``{"path": "warm"|"cold", "delta_tensors": n, "full_tensors": n}``.
+        """
+        from koordinator_tpu.obs.spans import maybe_span
+
+        with maybe_span(spans, "sync_decode"):
+            staged, tinfo = self._decode_sync(reqmsg)
+        # device-update plan, computed against the PRE-commit mirrors
+        plan = self._warm_plan(staged, tinfo)
+        # atomic commit point: nothing above mutated self
+        for key, value in staged.items():
+            setattr(self, key, value)
+        if plan is None:
+            self._snapshot = None  # cold: rebuilt lazily at snapshot()
+            self.last_sync_path = "cold"
+        else:
+            try:
+                with maybe_span(spans, "delta_scatter"):
+                    self._snapshot = self._apply_warm(plan)
+                self.last_sync_path = "warm"
+            except Exception:
+                # a torn device update may have donated buffers out of the
+                # old snapshot: drop residency, the mirrors stay truthful
+                # and the next snapshot() cold-rebuilds from them
+                logger.exception(
+                    "warm device update failed; falling back to cold rebuild"
+                )
+                self._snapshot = None
+                self.last_sync_path = "cold"
+        self._i32_ok = None
+        kinds = [kind for kind, _, _ in tinfo.values()]
+        return {
+            "path": self.last_sync_path,
+            "delta_tensors": kinds.count("delta"),
+            "full_tensors": kinds.count("full"),
+        }
+
+    def _decode_sync(self, reqmsg: "pb2.SyncRequest"):
+        """The pure decode/validate half of apply_sync: returns the
+        staged mirror values and per-tensor wire info without mutating
+        any resident state."""
         n = reqmsg.nodes
         p = reqmsg.pods
         wire = {
@@ -305,28 +350,7 @@ class ResidentState:
             staged["pod_requests"].shape[0],
         )
         self._reset_companions(staged, tinfo)
-        # device-update plan, computed against the PRE-commit mirrors
-        plan = self._warm_plan(staged, tinfo)
-        # atomic commit point: nothing above mutated self
-        for key, value in staged.items():
-            setattr(self, key, value)
-        if plan is None:
-            self._snapshot = None  # cold: rebuilt lazily at snapshot()
-            self.last_sync_path = "cold"
-        else:
-            try:
-                self._snapshot = self._apply_warm(plan)
-                self.last_sync_path = "warm"
-            except Exception:
-                # a torn device update may have donated buffers out of the
-                # old snapshot: drop residency, the mirrors stay truthful
-                # and the next snapshot() cold-rebuilds from them
-                logger.exception(
-                    "warm device update failed; falling back to cold rebuild"
-                )
-                self._snapshot = None
-                self.last_sync_path = "cold"
-        self._i32_ok = None
+        return staged, tinfo
 
     # -- companion resets (ADVICE r5) --
     def _reset_companions(self, staged: Dict[str, object], tinfo) -> None:

@@ -19,19 +19,24 @@ One connection may carry any number of sequential request/reply pairs.
 
 from __future__ import annotations
 
+import logging
 import os
 import socket
 import struct
 import threading
-from typing import Optional
+from typing import Optional, Tuple
 
 from koordinator_tpu.bridge.codegen import pb2
 from koordinator_tpu.bridge.server import ScorerServicer
 from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG
 
+logger = logging.getLogger(__name__)
+
 METHOD_SYNC = 1
 METHOD_SCORE = 2
 METHOD_ASSIGN = 3
+_METHOD_NAMES = {METHOD_SYNC: "sync", METHOD_SCORE: "score",
+                 METHOD_ASSIGN: "assign"}
 
 # Sized to the largest realistic SyncRequest (10k pods x 2k nodes of i64
 # request/capacity vectors serializes to a few MB); anything larger is a
@@ -42,14 +47,26 @@ _MAX_FRAME = 64 << 20
 _MAX_CONNS = 32
 
 
-def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+def _recv_or_eof(conn: socket.socket, n: int) -> Tuple[Optional[bytes], int]:
+    """Read exactly ``n`` bytes; on EOF returns (None, bytes_read) so
+    the caller can tell a clean between-frames close (0) from a
+    truncated frame (> 0) — the latter is a protocol violation worth a
+    counter and a log line, not a silent drop."""
     buf = bytearray()
     while len(buf) < n:
-        chunk = conn.recv(n - len(buf))
+        try:
+            chunk = conn.recv(n - len(buf))
+        except OSError:
+            chunk = b""  # reset mid-read counts as the EOF it is
         if not chunk:
-            return None
+            return None, len(buf)
         buf.extend(chunk)
-    return bytes(buf)
+    return bytes(buf), n
+
+
+def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    out, _ = _recv_or_eof(conn, n)
+    return out
 
 
 class RawUdsServer:
@@ -143,23 +160,65 @@ class RawUdsServer:
                 self._conns.discard(conn)
             self._conn_slots.release()
 
+    def _metrics(self):
+        """The servicer's scorer metric families (None-tolerant: a bare
+        test servicer without telemetry still serves)."""
+        telemetry = getattr(self.servicer, "telemetry", None)
+        return getattr(telemetry, "metrics", None)
+
+    def _count_malformed(self, reason: str, detail: str) -> None:
+        """A malformed frame is COUNTED and LOGGED, never silently
+        dropped: a misbehaving client (or codec drift) used to look like
+        an ordinary disconnect, invisible until placements went wrong.
+        Frames cut short by our OWN stop() closing live connections are
+        not client violations — the shutdown path must not pollute the
+        counter operators alert on."""
+        if self._stop.is_set():
+            return
+        metrics = self._metrics()
+        if metrics is not None:
+            metrics.count_uds_malformed(reason)
+        logger.warning("malformed UDS frame (%s): %s", reason, detail)
+
     def _serve_conn_inner(self, conn: socket.socket) -> None:
         with conn:
             while not self._stop.is_set():
-                header = _recv_exact(conn, 5)
+                header, nread = _recv_or_eof(conn, 5)
                 if header is None:
+                    if nread:
+                        self._count_malformed(
+                            "truncated-header",
+                            f"connection closed {nread} bytes into a "
+                            "5-byte frame header",
+                        )
                     return
                 method, length = struct.unpack(">BI", header)
                 if length > _MAX_FRAME:
+                    self._count_malformed(
+                        "oversized",
+                        f"method {method} frame of {length} bytes exceeds "
+                        f"the {_MAX_FRAME}-byte cap",
+                    )
                     self._reply(conn, 1, b"frame too large")
                     return
-                payload = _recv_exact(conn, length)
+                payload, nread = _recv_or_eof(conn, length)
                 if payload is None:
+                    self._count_malformed(
+                        "truncated-payload",
+                        f"connection closed {nread}/{length} bytes into "
+                        f"a method-{method} payload",
+                    )
                     return
                 entry = self._methods.get(method)
                 if entry is None:
+                    self._count_malformed(
+                        "unknown-method", f"method byte {method}"
+                    )
                     self._reply(conn, 1, f"unknown method {method}".encode())
                     continue
+                metrics = self._metrics()
+                if metrics is not None:
+                    metrics.count_uds_frame(_METHOD_NAMES[method])
                 req_cls, fn = entry
                 try:
                     req = req_cls.FromString(payload)
@@ -176,6 +235,8 @@ class RawUdsServer:
                             if method == METHOD_SCORE
                             else ""
                         )
+                        if metrics is not None:
+                            metrics.count_uds_error()
                         self._reply(
                             conn,
                             1,
@@ -187,6 +248,8 @@ class RawUdsServer:
                         continue
                     self._reply(conn, 0, reply.SerializeToString())
                 except Exception as exc:  # surfaced to the client, not lost
+                    if metrics is not None:
+                        metrics.count_uds_error()
                     self._reply(conn, 1, str(exc).encode())
 
     @staticmethod

@@ -1,0 +1,197 @@
+"""Cycle telemetry for the TPU scoring pipeline (ISSUE 4).
+
+Three layers, composed by :class:`CycleTelemetry` (one per
+ScorerServicer, one per bridge daemon):
+
+* **spans** (obs/spans.py) — a monotonic span recorder with explicit
+  cycle ids ("c<epoch>-<seq>", correlating with "s<epoch>-<gen>"
+  snapshot ids and echoed to clients in AssignReply.cycle_id).  Records
+  host-side stages (Sync decode, delta scatter, dispatch, readback) and
+  device-derived stats the solver already returns (rounds, path,
+  wave_ms) — never from inside jitted code (koordlint's host-sync and
+  span-leak rules gate the API statically).
+* **metrics** (obs/scorer_metrics.py) — the koord_scorer_* Prometheus
+  families over koordlet/metrics.py, served on the bridge daemon's
+  /metrics (scheduler/server.py; MetricsRegistry.wsgi_app is the WSGI
+  form).
+* **flight** (obs/flight.py) — a ring buffer of the last K cycles'
+  records + config knobs + snapshot ids, dumped as schema-validated
+  JSON under --state-dir on cycle error, kernel demotion, or SIGUSR1.
+
+The overhead contract is locked in by tests/test_resident_warm.py: a
+warm delta-Sync/Assign stream with telemetry enabled (it always is on
+the bridge) holds ZERO jit cache misses — instrumentation lives
+entirely outside the traced programs.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from koordinator_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    validate_flight_dump,
+)
+from koordinator_tpu.obs.scorer_metrics import ScorerMetrics
+from koordinator_tpu.obs.spans import SpanRecorder  # noqa: F401
+
+logger = logging.getLogger(__name__)
+
+
+def _config_knobs(cfg) -> Dict[str, object]:
+    """The CycleConfig knobs worth reconstructing a bad cycle from."""
+    if cfg is None:
+        return {}
+    return {
+        "wave": int(getattr(cfg, "wave", 1)),
+        "top_m": int(getattr(cfg, "top_m", 0)),
+        "fit_scoring_strategy": getattr(cfg, "fit_scoring_strategy", ""),
+        "enable_loadaware": bool(getattr(cfg, "enable_loadaware", False)),
+        "enable_fit_score": bool(getattr(cfg, "enable_fit_score", False)),
+    }
+
+
+class CycleTelemetry:
+    """Spans + scorer metrics + flight recorder, wired to the process
+    feeds (jit cache misses via analysis.retrace_guard, kernel
+    demotions via solver.register_demotion_listener)."""
+
+    def __init__(
+        self,
+        epoch: str = "",
+        cfg=None,
+        state_dir: Optional[str] = None,
+        capacity: int = 64,
+        registry=None,
+    ):
+        self.spans = SpanRecorder(epoch=epoch)
+        self.metrics = ScorerMetrics(registry=registry)
+        self.registry = self.metrics.registry
+        self.flight = FlightRecorder(
+            capacity=capacity, state_dir=state_dir,
+            config={"epoch": epoch, **_config_knobs(cfg)},
+        )
+        self._unhooks = []
+        self._install_feeds()
+
+    # -- process-wide feeds --
+    def _install_feeds(self) -> None:
+        # the listener closure must NOT hold self (or metrics) strongly:
+        # watch_cache_misses keeps its callback for the life of the
+        # process, and a strong cycle would pin every telemetry — and
+        # its servicer — created by every test ever.  A weakref shim
+        # no-ops and self-unhooks once the telemetry is collected.
+        import weakref
+
+        metrics_ref = weakref.ref(self.metrics)
+        cell: Dict[str, object] = {}
+
+        def _on_miss(kind: str) -> None:
+            metrics = metrics_ref()
+            if metrics is None:
+                unhook = cell.pop("unhook", None)
+                if unhook is not None:
+                    unhook()
+                return
+            metrics.count_jit_miss(kind)
+
+        try:
+            from koordinator_tpu.analysis.retrace_guard import (
+                watch_cache_misses,
+            )
+
+            cell["unhook"] = watch_cache_misses(_on_miss)
+            self._unhooks.append(lambda: cell.pop("unhook", lambda: None)())
+        except Exception:  # koordlint: disable=broad-except(jax private monitoring API may drift; telemetry must degrade, not fail the server)
+            logger.warning(
+                "jit cache-miss feed unavailable; "
+                "koord_scorer_jit_cache_miss_total will not populate",
+                exc_info=True,
+            )
+        from koordinator_tpu import solver
+
+        self._unhooks.append(
+            solver.register_demotion_listener(self.on_demotion)
+        )
+
+    def close(self) -> None:
+        """Unhook the process-wide feeds (tests; daemons run for life)."""
+        for unhook in self._unhooks:
+            try:
+                unhook()
+            except Exception:  # koordlint: disable=broad-except(best-effort teardown; one failed unhook must not keep the rest hooked)
+                logger.warning("telemetry unhook failed", exc_info=True)
+        self._unhooks = []
+
+    # -- event sinks --
+    def on_demotion(self, bucket, failures) -> None:
+        """Kernel demotions are PROCESS-global (solver module state) and
+        this fires on the demoting thread, which may not be this
+        telemetry's servicer thread — so only thread-safe sinks here:
+        the locked registry and the RLock'd flight recorder.  Never the
+        span recorder (unlocked by design; owned by the RPC thread).
+        The demoted bucket rides the dump itself."""
+        self.metrics.count_demotion()
+        self.flight.dump(
+            "demotion",
+            extra={
+                "bucket": "/".join(map(str, bucket)),
+                "failures": int(failures),
+            },
+        )
+
+    def record_sync(self, info, snapshot_id: str, epoch: str,
+                    generation: int) -> None:
+        self.metrics.record_sync(info)
+        self.metrics.set_snapshot(epoch, generation)
+        spans = self.spans
+        spans.current(snapshot_id=snapshot_id)
+        spans.note("sync_path", info.get("path"))
+
+    def commit_cycle(
+        self,
+        latency_ms: float,
+        path: str,
+        wave: int = 1,
+        rounds: Optional[int] = None,
+    ) -> Dict[str, object]:
+        """Close the current cycle: metrics + flight ring."""
+        self.metrics.observe_cycle(latency_ms, path, wave, rounds=rounds)
+        spans = self.spans
+        spans.note("path", path)
+        spans.note("latency_ms", round(float(latency_ms), 3))
+        if rounds is not None:
+            spans.note("rounds", int(rounds))
+        record = spans.commit()
+        self.flight.record(record)
+        return record
+
+    def abort_cycle(self, stage: str, exc: BaseException) -> None:
+        """An UNEXPECTED failure on the cycle pipeline: count it, commit
+        the partial record with the error attached, and dump the ring
+        for the post-mortem.  Client-rejectable errors (a malformed
+        frame bounced by validation) must NOT come here — they are
+        counted via ``metrics.count_cycle_error`` alone, so a looping
+        bad client can neither churn the ring/dump directory nor commit
+        a pending cycle out from under another client's correlation."""
+        self.metrics.count_cycle_error(stage)
+        record = self.spans.commit(error=f"{stage}: {exc!r:.300}")
+        self.flight.record(record)
+        self.flight.dump("cycle-error")
+
+    # Sync/Score-only streams (e.g. a non-leader replica whose Assign
+    # is refused) never reach commit_cycle; without a backstop their
+    # spans pile onto one immortal pending cycle and the flight ring
+    # stays empty forever.  Past this many buffered spans the pending
+    # cycle is committed as a backlog record at the next frame boundary.
+    PENDING_COMMIT_SPANS = 64
+
+    def flush_backlog(self) -> None:
+        spans = self.spans
+        if (
+            spans.has_pending()
+            and len(spans.current().spans) >= self.PENDING_COMMIT_SPANS
+        ):
+            spans.note("backlog", True)
+            self.flight.record(spans.commit())

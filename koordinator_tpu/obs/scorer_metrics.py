@@ -1,0 +1,169 @@
+"""Scorer metric families over the koordlet Prometheus-text registry.
+
+One place declares every family the bridge daemon exports on /metrics
+(koordlet/metrics.py renders the exposition text; the registration is
+idempotent, so a restarted daemon re-registering is a no-op).  Families:
+
+====================================== ========= ==========================
+family                                 kind      labels
+====================================== ========= ==========================
+koord_scorer_cycle_latency_ms          histogram path, wave
+koord_scorer_cycle_rounds              gauge     path
+koord_scorer_rounds_total              counter   path
+koord_scorer_cycles_total              counter   path
+koord_scorer_cycle_errors_total        counter   stage
+koord_scorer_sync_total                counter   kind (delta|full|mixed|scalar)
+koord_scorer_sync_tensors_total        counter   kind (delta|full)
+koord_scorer_jit_cache_miss_total      counter   kind (trace|compile)
+koord_scorer_snapshot_generation       gauge     —
+koord_scorer_resident_epoch            gauge     epoch (value always 1)
+koord_scorer_resident_warm             gauge     — (last Sync: 1 warm/0 cold)
+koord_scorer_kernel_demotions_total    counter   —
+koord_scorer_uds_frames_total          counter   method
+koord_scorer_uds_malformed_total       counter   reason
+koord_scorer_uds_errors_total          counter   —
+====================================== ========= ==========================
+
+The jit cache-miss counter is fed by
+``analysis.retrace_guard.watch_cache_misses`` — the runtime companion of
+the koordlint retrace rules — so a warm Sync/Assign stream that starts
+retracing shows up on the scrape, not only in a failed budget test.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+from koordinator_tpu.koordlet.metrics import DEFAULT_BUCKETS_MS, MetricsRegistry
+
+CYCLE_LATENCY = "koord_scorer_cycle_latency_ms"
+CYCLE_ROUNDS = "koord_scorer_cycle_rounds"
+ROUNDS_TOTAL = "koord_scorer_rounds_total"
+CYCLES_TOTAL = "koord_scorer_cycles_total"
+CYCLE_ERRORS = "koord_scorer_cycle_errors_total"
+SYNC_TOTAL = "koord_scorer_sync_total"
+SYNC_TENSORS = "koord_scorer_sync_tensors_total"
+JIT_CACHE_MISS = "koord_scorer_jit_cache_miss_total"
+SNAPSHOT_GENERATION = "koord_scorer_snapshot_generation"
+RESIDENT_EPOCH = "koord_scorer_resident_epoch"
+RESIDENT_WARM = "koord_scorer_resident_warm"
+DEMOTIONS_TOTAL = "koord_scorer_kernel_demotions_total"
+UDS_FRAMES = "koord_scorer_uds_frames_total"
+UDS_MALFORMED = "koord_scorer_uds_malformed_total"
+UDS_ERRORS = "koord_scorer_uds_errors_total"
+
+_FAMILIES = (
+    (CYCLE_LATENCY, "histogram",
+     "end-to-end Assign/Score cycle latency on the bridge, by device "
+     "path and wave width"),
+    (CYCLE_ROUNDS, "gauge",
+     "sequential device rounds of the last wave-batched cycle (~P/wave "
+     "certified-prefix rounds vs P per-pod steps)"),
+    (ROUNDS_TOTAL, "counter", "cumulative wave-cycle rounds, by path"),
+    (CYCLES_TOTAL, "counter", "completed scoring cycles, by device path"),
+    (CYCLE_ERRORS, "counter", "cycles that raised, by pipeline stage"),
+    (SYNC_TOTAL, "counter",
+     "Sync frames by how their tensors rode the wire (delta/full/mixed)"),
+    (SYNC_TENSORS, "counter", "synced tensors by encoding (delta/full)"),
+    (JIT_CACHE_MISS, "counter",
+     "jit cache misses observed process-wide (trace) and those that "
+     "reached XLA (compile); a warm stream must not grow this"),
+    (SNAPSHOT_GENERATION, "gauge",
+     "generation of the resident snapshot (the <gen> of s<epoch>-<gen>)"),
+    (RESIDENT_EPOCH, "gauge",
+     "per-boot epoch of the resident snapshot as a label; value is "
+     "always 1"),
+    (RESIDENT_WARM, "gauge",
+     "1 when the last Sync landed on the resident device tensors "
+     "(warm), 0 when it dropped residency (cold)"),
+    (DEMOTIONS_TOTAL, "counter",
+     "Pallas kernel shape-bucket demotions to a fallback path"),
+    (UDS_FRAMES, "counter", "raw-UDS request frames served, by method"),
+    (UDS_MALFORMED, "counter",
+     "malformed raw-UDS frames (oversized, unknown method, truncated "
+     "mid-frame), by reason"),
+    (UDS_ERRORS, "counter", "raw-UDS requests answered with an error frame"),
+)
+
+
+class ScorerMetrics:
+    """Typed facade over the registry for the scorer families.  All
+    methods take host-side Python scalars only — values must be
+    materialized BEFORE they reach here (never call from jitted code;
+    koordlint's host-sync rule enforces that statically)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or MetricsRegistry()
+        for name, kind, help_text in _FAMILIES:
+            self.registry.register(
+                name, kind, help_text,
+                buckets=DEFAULT_BUCKETS_MS if kind == "histogram" else None,
+            )
+
+    # -- cycle completion --
+    def observe_cycle(
+        self,
+        latency_ms: float,
+        path: str,
+        wave: int,
+        rounds: Optional[int] = None,
+    ) -> None:
+        labels = {"path": path or "unknown", "wave": str(int(wave))}
+        self.registry.histogram_observe(CYCLE_LATENCY, latency_ms, labels)
+        self.registry.counter_add(
+            CYCLES_TOTAL, 1, {"path": path or "unknown"}
+        )
+        if rounds is not None:
+            self.registry.gauge_set(
+                CYCLE_ROUNDS, rounds, {"path": path or "unknown"}
+            )
+            self.registry.counter_add(
+                ROUNDS_TOTAL, rounds, {"path": path or "unknown"}
+            )
+
+    def count_cycle_error(self, stage: str) -> None:
+        self.registry.counter_add(CYCLE_ERRORS, 1, {"stage": stage})
+
+    # -- sync --
+    def record_sync(self, info: Mapping[str, object]) -> None:
+        """``info`` is bridge/state.py apply_sync's summary dict."""
+        delta = int(info.get("delta_tensors", 0))
+        full = int(info.get("full_tensors", 0))
+        if delta and full:
+            kind = "mixed"
+        elif delta:
+            kind = "delta"
+        elif full:
+            kind = "full"
+        else:
+            # scalar-columns-only frame (freshness/priority churn): no
+            # tensors rode the wire at all — don't claim a delta
+            kind = "scalar"
+        self.registry.counter_add(SYNC_TOTAL, 1, {"kind": kind})
+        if delta:
+            self.registry.counter_add(SYNC_TENSORS, delta, {"kind": "delta"})
+        if full:
+            self.registry.counter_add(SYNC_TENSORS, full, {"kind": "full"})
+        self.registry.gauge_set(
+            RESIDENT_WARM, 1 if info.get("path") == "warm" else 0
+        )
+
+    def set_snapshot(self, epoch: str, generation: int) -> None:
+        self.registry.gauge_set(SNAPSHOT_GENERATION, generation)
+        self.registry.gauge_set(RESIDENT_EPOCH, 1, {"epoch": epoch})
+
+    # -- feeds --
+    def count_jit_miss(self, kind: str) -> None:
+        self.registry.counter_add(JIT_CACHE_MISS, 1, {"kind": kind})
+
+    def count_demotion(self) -> None:
+        self.registry.counter_add(DEMOTIONS_TOTAL, 1)
+
+    def count_uds_frame(self, method: str) -> None:
+        self.registry.counter_add(UDS_FRAMES, 1, {"method": method})
+
+    def count_uds_malformed(self, reason: str) -> None:
+        self.registry.counter_add(UDS_MALFORMED, 1, {"reason": reason})
+
+    def count_uds_error(self) -> None:
+        self.registry.counter_add(UDS_ERRORS, 1)

@@ -1,0 +1,205 @@
+"""Low-overhead monotonic span recorder for the TPU scoring pipeline.
+
+One *cycle* is the unit of correlation: everything that happens between
+two Assign (or Score) completions — delta-Sync decodes, device
+scatters, dispatch, readback — accumulates on the current
+:class:`CycleSpans` under one explicit ``cycle_id``, and ``commit()``
+turns it into a plain-dict record for the metric families and the
+flight recorder (obs/flight.py).
+
+Design constraints (the acceptance criteria of ISSUE 4):
+
+* **No host syncs, no retraces.**  The recorder only ever touches
+  host-side Python scalars: ``begin_span``/``end_span`` read a
+  monotonic clock and append to a list; ``note()`` stores values the
+  caller already materialized.  Nothing here imports jax, and calling
+  the span API inside jitted code is rejected statically by koordlint's
+  ``host-sync-in-jit`` rule (a span inside a traced function would
+  record trace time once and then never run again — the same trap as a
+  bare ``print``).  Device-derived stats (``rounds``, ``path``,
+  ``wave_ms``) enter through ``note()`` AFTER the caller materialized
+  the result, never from inside the device program.
+* **Bounded memory.**  A cycle caps its span count; a serve loop that
+  never commits (Score-only traffic was the hazard) cannot grow without
+  bound — overflowing spans are counted, not stored.
+* **Leak-proof spans.**  ``span()`` is the context-manager form and the
+  only one most call sites should use; raw ``begin_span`` callers must
+  end the span on every exit path (enforced by koordlint's
+  ``span-leak`` rule: try/finally or the context manager).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+# hard cap on spans buffered per cycle: a runaway instrumentation loop
+# must cost a counter bump, not memory
+MAX_SPANS_PER_CYCLE = 256
+
+
+class CycleSpans:
+    """Span + note accumulator for one cycle.  Plain lists, no locks:
+    the owner (ScorerServicer) already serializes RPC bodies."""
+
+    __slots__ = (
+        "cycle_id", "snapshot_id", "started_unix", "_t0", "_clock",
+        "spans", "notes", "error", "overflow",
+    )
+
+    def __init__(self, cycle_id: str, clock=time.perf_counter,
+                 wall_clock=time.time):
+        self.cycle_id = cycle_id
+        self.snapshot_id: Optional[str] = None
+        self.started_unix = wall_clock()
+        self._clock = clock
+        self._t0 = clock()
+        # each span is [name, start_s, end_s|None] in monotonic seconds
+        # relative to the cycle's _t0
+        self.spans: List[list] = []
+        self.notes: Dict[str, object] = {}
+        self.error: Optional[str] = None
+        self.overflow = 0
+
+    def begin(self, name: str) -> int:
+        """Open a span; returns the handle ``end()`` closes.  A handle
+        of -1 means the cycle's span buffer is full (the matching
+        ``end(-1)`` is a no-op, so callers never branch)."""
+        if len(self.spans) >= MAX_SPANS_PER_CYCLE:
+            self.overflow += 1
+            return -1
+        self.spans.append([name, self._clock() - self._t0, None])
+        return len(self.spans) - 1
+
+    def end(self, handle: int) -> None:
+        # the upper bound guards a handle minted by a PREVIOUS cycle
+        # (begin before a commit, end after): closing a stranger's span
+        # — or crashing the RPC on IndexError — is worse than dropping
+        # the stale end
+        if handle < 0 or handle >= len(self.spans):
+            return
+        self.spans[handle][2] = self._clock() - self._t0
+
+    def to_record(self) -> Dict[str, object]:
+        """Flight-recorder/bench shape: durations in milliseconds; a
+        span that never ended carries ``dur_ms: None`` (visible, not
+        invented)."""
+        return {
+            "cycle_id": self.cycle_id,
+            "snapshot_id": self.snapshot_id,
+            "started_unix": self.started_unix,
+            "spans": [
+                {
+                    "name": name,
+                    "start_ms": round(start * 1000.0, 3),
+                    "dur_ms": (
+                        round((end - start) * 1000.0, 3)
+                        if end is not None else None
+                    ),
+                }
+                for name, start, end in self.spans
+            ],
+            "notes": dict(self.notes),
+            "error": self.error,
+            "span_overflow": self.overflow,
+        }
+
+
+class _SpanContext:
+    """Tiny re-usable with-block over begin/end.  Not @contextmanager:
+    a generator frame per span is measurable overhead on the warm path."""
+
+    __slots__ = ("_recorder", "_name", "_handle")
+
+    def __init__(self, recorder: "SpanRecorder", name: str):
+        self._recorder = recorder
+        self._name = name
+        self._handle = -1
+
+    def __enter__(self) -> "_SpanContext":
+        self._handle = self._recorder.begin_span(self._name)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._recorder.end_span(self._handle)
+        return False
+
+
+class SpanRecorder:
+    """Owns the current cycle and mints cycle ids ("c<epoch>-<seq>",
+    correlating with the sidecar's "s<epoch>-<gen>" snapshot ids)."""
+
+    def __init__(self, epoch: str = "", clock=time.perf_counter,
+                 wall_clock=time.time):
+        self.epoch = epoch
+        self._clock = clock
+        self._wall_clock = wall_clock
+        self._seq = 0
+        self._cycle: Optional[CycleSpans] = None
+
+    # -- cycle lifecycle --
+    def has_pending(self) -> bool:
+        """Whether an uncommitted cycle is already accumulating spans
+        (e.g. a delta-Sync waiting for the Assign that correlates it)."""
+        return self._cycle is not None
+
+    def current(self, snapshot_id: Optional[str] = None,
+                cycle_id: Optional[str] = None) -> CycleSpans:
+        """The open cycle, created on first touch.  ``cycle_id`` adopts
+        a caller-supplied correlation id (the AssignRequest's) for the
+        open cycle; ``snapshot_id`` stamps the resident snapshot it ran
+        against."""
+        if self._cycle is None:
+            self._seq += 1
+            self._cycle = CycleSpans(
+                cycle_id or f"c{self.epoch}-{self._seq}",
+                clock=self._clock, wall_clock=self._wall_clock,
+            )
+        elif cycle_id:
+            self._cycle.cycle_id = cycle_id
+        if snapshot_id is not None:
+            self._cycle.snapshot_id = snapshot_id
+        return self._cycle
+
+    def commit(self, error: Optional[str] = None) -> Dict[str, object]:
+        """Close the current cycle and return its record (an empty cycle
+        is created if nothing was recorded, so commit() is total)."""
+        cycle = self.current()
+        if error is not None:
+            cycle.error = error
+        record = cycle.to_record()
+        self._cycle = None
+        return record
+
+    # -- span API --
+    def begin_span(self, name: str) -> int:
+        return self.current().begin(name)
+
+    def end_span(self, handle: int) -> None:
+        if self._cycle is not None:
+            self._cycle.end(handle)
+
+    def span(self, name: str) -> _SpanContext:
+        """``with recorder.span("dispatch"): ...`` — the leak-proof
+        form (koordlint span-leak enforces raw begin/end callers use
+        try/finally)."""
+        return _SpanContext(self, name)
+
+    def note(self, key: str, value) -> None:
+        """Attach a device-derived or config stat to the current cycle.
+        ``value`` must already be a host-side Python scalar/str — pass
+        ``int(np.asarray(x))`` results, never live tracers."""
+        self.current().notes[key] = value
+
+
+_NULL_CONTEXT = contextlib.nullcontext()
+
+
+def maybe_span(recorder: Optional[SpanRecorder], name: str):
+    """``with maybe_span(spans, "stage"):`` for recorder-optional call
+    sites (bridge/state.py, parallel/shard_assign.py take ``spans=None``
+    by default) — leak-proof by construction, no handle bookkeeping."""
+    if recorder is None:
+        return _NULL_CONTEXT
+    return recorder.span(name)

@@ -640,6 +640,7 @@ def greedy_assign_waves(
     extra_scores: Optional[jnp.ndarray] = None,
     wave: int = 32,
     top_m: int = 4,
+    spans=None,
 ):
     """Round-based sharded assignment (see _assign_waves): bit-identical
     with greedy_assign, one all_gather per round instead of one pmax per
@@ -651,7 +652,13 @@ def greedy_assign_waves(
     non-candidate nodes (round-4 review #5; see the _assign_waves
     docstring).  The reference parallelizes Score identically for both
     (``frameworkext/framework_extender.go:216``,
-    ``plugins/nodenumaresource/most_allocated.go``)."""
+    ``plugins/nodenumaresource/most_allocated.go``).
+
+    ``spans``: optional ``obs.spans.SpanRecorder``.  Only the HOST-side
+    stages are timed (pad/prep vs the sharded rounds' dispatch) — the
+    recorder never enters ``_assign_waves``' traced body, so the spans
+    add no host syncs and no retraces; round counts come from the
+    result the device already returns."""
     if extra_scores is not None:
         hi = int(jnp.max(jnp.abs(extra_scores)))
         if hi >= 2**31:
@@ -659,29 +666,33 @@ def greedy_assign_waves(
                 f"extra_scores magnitude {hi} too large for the packed-key "
                 "collective (must be < 2^31); use solver.greedy_assign"
             )
-    n_dev = mesh.size
-    orig_n = snapshot.nodes.allocatable.shape[0]
-    snapshot = _pad_nodes_to(snapshot, n_dev)
-    padded_n = snapshot.nodes.allocatable.shape[0]
-    if extra_mask is not None and extra_mask.shape[1] != padded_n:
-        extra_mask = jnp.pad(
-            extra_mask, ((0, 0), (0, padded_n - extra_mask.shape[1]))
+    from koordinator_tpu.obs.spans import maybe_span
+
+    with maybe_span(spans, "shard_prep"):
+        n_dev = mesh.size
+        orig_n = snapshot.nodes.allocatable.shape[0]
+        snapshot = _pad_nodes_to(snapshot, n_dev)
+        padded_n = snapshot.nodes.allocatable.shape[0]
+        if extra_mask is not None and extra_mask.shape[1] != padded_n:
+            extra_mask = jnp.pad(
+                extra_mask, ((0, 0), (0, padded_n - extra_mask.shape[1]))
+            )
+        if extra_scores is not None and extra_scores.shape[1] != padded_n:
+            extra_scores = jnp.pad(
+                extra_scores, ((0, 0), (0, padded_n - extra_scores.shape[1]))
+            )
+    with maybe_span(spans, "shard_rounds"):
+        result, nwaves = _assign_waves(
+            snapshot,
+            extra_mask,
+            extra_scores,
+            cfg=cfg,
+            mesh=mesh,
+            has_mask=extra_mask is not None,
+            has_scores=extra_scores is not None,
+            wave=wave,
+            top_m=top_m,
         )
-    if extra_scores is not None and extra_scores.shape[1] != padded_n:
-        extra_scores = jnp.pad(
-            extra_scores, ((0, 0), (0, padded_n - extra_scores.shape[1]))
-        )
-    result, nwaves = _assign_waves(
-        snapshot,
-        extra_mask,
-        extra_scores,
-        cfg=cfg,
-        mesh=mesh,
-        has_mask=extra_mask is not None,
-        has_scores=extra_scores is not None,
-        wave=wave,
-        top_m=top_m,
-    )
     if result.node_requested.shape[0] != orig_n:
         result = dc.replace(
             result,

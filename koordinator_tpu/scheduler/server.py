@@ -55,8 +55,8 @@ class _LeaderGatedServicer(ScorerServicer):
     """Assign requires leadership; Score/Sync serve on any replica (they
     are read-only against the resident snapshot)."""
 
-    def __init__(self, cfg, is_leader, mesh=None):
-        super().__init__(cfg, mesh=mesh)
+    def __init__(self, cfg, is_leader, mesh=None, state_dir=None):
+        super().__init__(cfg, mesh=mesh, state_dir=state_dir)
         self._is_leader = is_leader
 
     def assign(self, req, ctx=None):
@@ -133,7 +133,10 @@ class SchedulerServer:
 
             mesh = make_mesh(jax.devices())
         self.servicer = _LeaderGatedServicer(
-            cfg, lambda: self.elector.is_leader, mesh=mesh
+            cfg, lambda: self.elector.is_leader, mesh=mesh,
+            # flight-recorder dumps (obs/flight.py) land under
+            # <state-dir>/flight on cycle error / demotion / SIGUSR1
+            state_dir=state_dir,
         )
         self.api = APIService()
         self.uds_path = uds_path
@@ -173,14 +176,23 @@ class SchedulerServer:
                     reply_text(self, format_thread_stacks())
                     return
                 if self.path == "/metrics":
-                    reply_text(
-                        self,
-                        "# TYPE koord_scheduler_leader gauge\n"
-                        f"koord_scheduler_leader {int(outer.elector.is_leader)}\n"
-                        "# TYPE koord_scheduler_kernel_demotions gauge\n"
-                        "koord_scheduler_kernel_demotions "
-                        f"{len(pallas_demotions())}\n",
+                    # the scorer families (koord_scorer_* cycle latency
+                    # histogram, rounds, sync delta/full, jit cache
+                    # misses, UDS counters — obs/scorer_metrics.py) plus
+                    # the daemon gauges, all through the ONE registry so
+                    # every family renders exactly once.
+                    # MetricsRegistry.wsgi_app serves the same body for
+                    # WSGI embedders.
+                    registry = outer.servicer.telemetry.registry
+                    registry.gauge_set(
+                        "koord_scheduler_leader",
+                        int(outer.elector.is_leader),
                     )
+                    registry.gauge_set(
+                        "koord_scheduler_kernel_demotions",
+                        len(pallas_demotions()),
+                    )
+                    reply_text(self, registry.render())
                     return
                 path, _, query = self.path.partition("?")
                 q = dict(
@@ -206,6 +218,9 @@ class SchedulerServer:
 
     def start(self) -> "SchedulerServer":
         os.makedirs(os.path.dirname(self.uds_path) or ".", exist_ok=True)
+        # operator seam: `kill -USR1 <pid>` dumps the last K cycles'
+        # spans under <state-dir>/flight (no-op off the main thread)
+        self.servicer.telemetry.flight.install_sigusr1()
         self._raw_server = RawUdsServer(
             self.uds_path + ".raw", servicer=self.servicer
         ).start()

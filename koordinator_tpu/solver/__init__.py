@@ -45,11 +45,62 @@ def _demoted(bucket) -> bool:
         return True
 
 
+# demotion observers (obs/ telemetry: counter bump + flight-recorder
+# dump).  Weak references: a ScorerServicer built per test must not pin
+# its telemetry alive — or keep firing — through this module-level list.
+import weakref as _weakref
+
+_DEMOTION_LISTENERS = []
+
+
+def register_demotion_listener(cb):
+    """``cb(bucket, failures)`` fires on every kernel demotion (after
+    the backoff state updated).  Held weakly; returns an unregister
+    callable.  Callbacks run on the scheduling path — keep them cheap
+    and never raise (exceptions are swallowed and logged: a telemetry
+    sink must not take the cycle's fallback path down)."""
+    try:
+        ref = _weakref.WeakMethod(cb)
+    except TypeError:
+        ref = _weakref.ref(cb)
+    with _PALLAS_LOCK:
+        _DEMOTION_LISTENERS.append(ref)
+
+    def unregister() -> None:
+        with _PALLAS_LOCK:
+            if ref in _DEMOTION_LISTENERS:
+                _DEMOTION_LISTENERS.remove(ref)
+
+    return unregister
+
+
+def _notify_demotion(bucket, failures) -> None:
+    with _PALLAS_LOCK:
+        live = [ref() for ref in _DEMOTION_LISTENERS]
+        _DEMOTION_LISTENERS[:] = [
+            ref for ref, cb in zip(_DEMOTION_LISTENERS, live) if cb is not None
+        ]
+        live = [cb for cb in live if cb is not None]
+    for cb in live:
+        try:
+            cb(bucket, failures)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "demotion listener failed for bucket %r", bucket
+            )
+
+
 def _record_failure(bucket) -> None:
     with _PALLAS_LOCK:
         state = _PALLAS_FAILURES.setdefault(bucket, [0, 0])
         state[0] += 1
         state[1] = min(_RETRY_CAP, _RETRY_BASE ** min(state[0], 4))
+        failures = state[0]
+    # outside the lock: a listener reading pallas_demotions() (or doing
+    # anything slow) must not deadlock or serialize the solver
+    _notify_demotion(bucket, failures)
 
 
 def _record_success(bucket) -> None:

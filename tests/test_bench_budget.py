@@ -125,3 +125,42 @@ class TestArtifactSchemaWaveFields:
         assert bench._validate_artifact(self._line(wave="32"))
         assert bench._validate_artifact(self._line(rounds=-1))
         assert bench._validate_artifact(self._line(rounds=1.5))
+
+
+class TestArtifactSchemaSpans:
+    """ISSUE 4: BENCH_*.json trajectories carry per-stage span
+    summaries; a stage that measured nothing publishes null, and a
+    malformed breakdown must not be archived as a measurement."""
+
+    def _line(self, **extra):
+        doc = {"metric": "m", "value": 1.0, "unit": "ms"}
+        doc.update(extra)
+        return json.dumps(doc)
+
+    def test_valid_spans_pass(self):
+        assert bench._validate_artifact(self._line(spans={})) == []
+        assert bench._validate_artifact(
+            self._line(spans={"init": 12.5, "compile": 1500, "wave": None})
+        ) == []
+        # spans are optional: a span-less artifact stays valid
+        assert bench._validate_artifact(self._line()) == []
+
+    def test_malformed_spans_fail(self):
+        assert bench._validate_artifact(self._line(spans=[1, 2]))
+        assert bench._validate_artifact(self._line(spans={"init": "fast"}))
+        assert bench._validate_artifact(self._line(spans={"init": True}))
+        assert bench._validate_artifact(self._line(spans={"init": -1.0}))
+        assert bench._validate_artifact(
+            self._line(spans={"init": float("nan")})
+        )
+        assert bench._validate_artifact(self._line(spans={"": 1.0}))
+
+    def test_headline_child_seeds_every_stage_null_safe(self):
+        """The headline child pre-seeds its span keys so a crashed
+        best-effort leg shows as null, not as a missing key a reader
+        would misread as 'stage did not exist'."""
+        import inspect
+
+        src = inspect.getsource(bench.child)
+        for key in ("lowering_probe", "wave_compile", "cpu_native_mt"):
+            assert f'"{key}": None' in src

@@ -352,6 +352,153 @@ class TestHostSyncInJit:
         """) == []
 
 
+class TestSpanLeak:
+    """ISSUE 4: the obs span API must end every span on every exit."""
+
+    def test_naked_begin_span_caught(self):
+        got = lint("""
+        def stage(recorder):
+            h = recorder.begin_span("dispatch")
+            do_work()
+            recorder.end_span(h)
+        """)
+        assert [(v.rule, v.line) for v in got] == [("span-leak", 3)]
+        assert "try/finally" in got[0].message
+
+    def test_try_finally_form_is_clean(self):
+        assert lint("""
+        def stage(recorder):
+            h = recorder.begin_span("dispatch")
+            try:
+                do_work()
+            finally:
+                recorder.end_span(h)
+        """) == []
+
+    def test_begin_inside_protected_try_is_clean(self):
+        assert lint("""
+        def stage(recorder):
+            try:
+                h = recorder.begin_span("x")
+                work()
+            finally:
+                recorder.end_span(h)
+        """) == []
+
+    def test_begin_in_finally_is_not_protected_by_itself(self):
+        # a begin inside the very finalbody that ends OTHER spans has no
+        # guarantee of its own
+        got = lint("""
+        def stage(recorder):
+            try:
+                work()
+            finally:
+                h = recorder.begin_span("cleanup")
+                recorder.end_span(other)
+        """)
+        assert [(v.rule, v.line) for v in got] == [("span-leak", 6)]
+
+    def test_try_without_end_in_finally_caught(self):
+        got = lint("""
+        def stage(recorder):
+            h = recorder.begin_span("dispatch")
+            try:
+                do_work()
+            finally:
+                log("done")
+        """)
+        assert [(v.rule, v.line) for v in got] == [("span-leak", 3)]
+
+    def test_context_manager_implementation_is_clean(self):
+        # obs/spans.py's own _SpanContext shape: begin in __enter__,
+        # end in __exit__
+        assert lint("""
+        class Ctx:
+            def __enter__(self):
+                self.h = self.rec.begin_span(self.name)
+                return self
+
+            def __exit__(self, *exc):
+                self.rec.end_span(self.h)
+        """) == []
+
+    def test_enter_without_matching_exit_caught(self):
+        got = lint("""
+        class Ctx:
+            def __enter__(self):
+                self.h = self.rec.begin_span(self.name)
+                return self
+
+            def __exit__(self, *exc):
+                pass
+        """)
+        assert [(v.rule, v.line) for v in got] == [("span-leak", 4)]
+
+    def test_suppression_tag(self):
+        assert lint("""
+        def stage(recorder):
+            h = recorder.begin_span("dispatch")  # koordlint: disable=span-leak(caller owns the end)
+            return h
+        """) == []
+
+
+class TestHostSyncObsAPI:
+    """The obs API inside jitted code is the print() trap plus a
+    potential tracer concretization — the host-sync rule covers it."""
+
+    def test_obs_calls_in_jit_caught(self):
+        got = lint("""
+        import jax
+
+        @jax.jit
+        def cycle(x, spans):
+            h = spans.begin_span("inner")
+            try:
+                y = x + 1
+            finally:
+                spans.end_span(h)
+            with spans.span("scale"):
+                y = y * 2
+            spans.note("rounds", y)
+            return y
+        """)
+        assert [v.line for v in got] == [6, 10, 11, 13]
+        assert all(v.rule == "host-sync-in-jit" for v in got)
+        assert all("obs span API" in v.message for v in got)
+
+    def test_telemetry_receiver_chain_caught(self):
+        got = lint("""
+        import jax
+
+        @jax.jit
+        def cycle(x, self):
+            self.telemetry.spans.note("path", "scan")
+            return x
+        """)
+        assert [(v.rule, v.line) for v in got] == [("host-sync-in-jit", 6)]
+
+    def test_obs_outside_jit_is_clean(self):
+        assert lint("""
+        def serve(recorder, snap):
+            with recorder.span("dispatch"):
+                result = run_cycle(snap)
+            recorder.note("path", result.path)
+            return result
+        """) == []
+
+    def test_unrelated_span_named_method_is_clean(self):
+        # .span()/.note() only count on a telemetry-ish receiver
+        assert lint("""
+        import jax
+
+        @jax.jit
+        def f(x, tree):
+            y = tree.span(x)
+            tree.note(y)
+            return y
+        """) == []
+
+
 class TestBroadExcept:
     def test_silent_swallow_caught_and_tag_respected(self):
         got = lint("""
@@ -538,9 +685,9 @@ class TestWireContract:
         # a field added to the proto but absent from the emitted module
         grown = self._edit(
             sources["proto"],
-            "message AssignRequest { string snapshot_id = 1; }",
-            "message AssignRequest { string snapshot_id = 1; "
-            "int64 deadline_ms = 2; }",
+            "message AssignRequest {\n  string snapshot_id = 1;",
+            "message AssignRequest {\n  string snapshot_id = 1;\n"
+            "  int64 deadline_ms = 3;",
         )
         got = wire_contract.check_pb2_descriptor(grown)
         assert any(

@@ -538,6 +538,56 @@ class TestRetraceBudget:
         assert reply.path == "wave"
         assert len(reply.assignment) == len(first.assignment)
 
+    def test_warm_sequence_with_telemetry_enabled_is_retrace_free(self):
+        """ISSUE 4 acceptance: telemetry is ALWAYS on in the servicer
+        (spans, scorer metric families, flight ring), and the warm
+        delta-Sync/Assign stream still holds ZERO jit cache misses —
+        instrumentation must live entirely outside the traced programs.
+        The same stream must actually POPULATE the telemetry: a
+        zero-overhead subsystem that recorded nothing would pass
+        vacuously."""
+        import tempfile
+
+        from koordinator_tpu.analysis import retrace_guard
+        from koordinator_tpu.obs import validate_flight_dump
+
+        rng = np.random.RandomState(29)
+        state = _random_state(rng, n_nodes=5, n_pods=12, with_quota=False)
+        sv = ScorerServicer(state_dir=tempfile.mkdtemp())
+        sv.sync(_full_sync_request(state))
+        sv.state.snapshot()
+        self._warm_step(sv, state)  # warm-up compiles
+        reg = sv.telemetry.registry
+        miss_before = reg.get(
+            "koord_scorer_jit_cache_miss_total", {"kind": "trace"}
+        ) or 0
+        with retrace_guard(budget=0) as counter:
+            for _ in range(4):
+                reply = self._warm_step(sv, state)
+        assert counter.traces == 0 and counter.compiles == 0
+        # the process-wide miss counter agrees with the guard: no new
+        # misses landed during the telemetry-enabled warm stream
+        miss_after = reg.get(
+            "koord_scorer_jit_cache_miss_total", {"kind": "trace"}
+        ) or 0
+        assert miss_after == miss_before
+        # ... and the stream populated the families + the flight ring
+        count, _total = reg.get_histogram(
+            "koord_scorer_cycle_latency_ms",
+            {"path": reply.path, "wave": "1"},
+        )
+        assert count >= 4
+        assert reg.get("koord_scorer_sync_total", {"kind": "delta"}) >= 4
+        records = sv.telemetry.flight.snapshot()
+        assert len(records) >= 4
+        names = [s["name"] for s in records[-1]["spans"]]
+        assert "sync_decode" in names and "delta_scatter" in names
+        assert "dispatch" in names and "readback" in names
+        # the ring dumps schema-valid under the guard's own contract
+        assert validate_flight_dump(
+            sv.telemetry.flight.document("test")
+        ) == []
+
     def test_guard_actually_counts(self):
         """Negative control: a fresh jit inside the guard must trip it —
         otherwise a broken counter would pass the budget test vacuously."""
