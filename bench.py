@@ -31,8 +31,10 @@ backend is unhealthy):
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
+import threading
 import time
 from typing import Optional
 
@@ -56,6 +58,94 @@ CPU_TIMEOUT = int(os.environ.get("KOORD_BENCH_CPU_TIMEOUT", "900"))
 # line exists under every failure mode before the driver's axe falls.
 TOTAL_BUDGET = 2400.0  # default for KOORD_BENCH_TOTAL_BUDGET, seconds
 
+# Best-known progress of the parent process, mutated as stages run and
+# read by the hard-deadline/SIGTERM flush (_ArtifactDeadline): when the
+# axe falls mid-stage, the truncated artifact says WHERE.
+_PROGRESS = {"stage": "start", "errors": []}
+
+
+class _ArtifactDeadline:
+    """Hard wall-clock deadline for the WHOLE bench process (the real
+    fix for the BENCH_r05 rc=124-no-artifact class: the budget
+    accountant bounds the windows bench grants itself, but a stage that
+    HANGS past its window — or a driver timeout shorter than the budget
+    — used to kill the process with nothing on stdout).  Two triggers,
+    one idempotent flush:
+
+    * a daemon watchdog thread fires ``margin_s`` before the configured
+      budget elapses and emits a schema-valid artifact line with
+      ``"truncated": true`` plus the last stage reached, then exits;
+    * a SIGTERM handler (the first signal ``timeout`` sends) does the
+      same immediately, covering drivers whose deadline is SHORTER than
+      ours.
+
+    ``clock``/``sleep``/``on_fire`` are injectable so the stdlib-only
+    regression test (tests/test_bench_budget.py) can replay a slow
+    stage under a fake clock without waiting wall time."""
+
+    def __init__(self, total_s: float, emit=None, margin_s: float = 30.0,
+                 clock=time.monotonic, sleep=time.sleep, on_fire=None,
+                 metric: str = METRIC):
+        self._emit = emit or _emit_artifact
+        self._clock = clock
+        self._sleep = sleep
+        self._on_fire = on_fire or (lambda rc: os._exit(rc))
+        self._metric = metric
+        self.deadline = clock() + max(1.0, total_s - margin_s)
+        self._fired = threading.Lock()  # acquired once, never released
+
+    def artifact_line(self, reason: str) -> str:
+        return json.dumps(
+            {
+                "metric": self._metric,
+                "value": -1,
+                "unit": "ms",
+                "vs_baseline": 0.0,
+                "truncated": True,
+                "error": (
+                    f"{reason}; last stage: {_PROGRESS['stage']}"
+                    + (
+                        "; " + "; ".join(_PROGRESS["errors"][-2:])
+                        if _PROGRESS["errors"]
+                        else ""
+                    )
+                ),
+            }
+        )
+
+    def fire(self, reason: str) -> None:
+        """Flush the truncated artifact exactly once, then exit(1).
+        ``os._exit`` (not sys.exit): the main thread may be blocked in
+        subprocess.run and must not get a chance to swallow the exit."""
+        if not self._fired.acquire(blocking=False):
+            return
+        self._emit(self.artifact_line(reason))
+        sys.stdout.flush()
+        self._on_fire(1)
+
+    def cancel(self) -> None:
+        """A real artifact made it out: the flush must never fire."""
+        self._fired.acquire(blocking=False)
+
+    def watch(self) -> None:
+        while True:
+            left = self.deadline - self._clock()
+            if left <= 0:
+                break
+            self._sleep(min(left, 1.0))
+        self.fire("hard wall-clock deadline reached before an artifact")
+
+    def install(self) -> "_ArtifactDeadline":
+        threading.Thread(target=self.watch, daemon=True).start()
+        try:
+            signal.signal(
+                signal.SIGTERM,
+                lambda signum, frame: self.fire("SIGTERM from the driver"),
+            )
+        except ValueError:
+            pass  # non-main thread (tests); the watchdog still covers us
+        return self
+
 
 def _validate_artifact(line: Optional[str]) -> list:
     """Small schema over the one BENCH_*.json line: a crashed or
@@ -78,6 +168,10 @@ def _validate_artifact(line: Optional[str]) -> list:
         problems.append("'value' must be finite")
     if "error" in doc and not isinstance(doc["error"], str):
         problems.append("'error' must be a string")
+    # a deadline-flushed partial artifact must SAY so, and say it as a
+    # real boolean — "truncated": "maybe" is not a measurement state
+    if "truncated" in doc and not isinstance(doc["truncated"], bool):
+        problems.append("'truncated' must be a boolean")
     if "error" not in doc:
         # a real measurement also names its unit; error artifacts may not
         unit = doc.get("unit")
@@ -99,6 +193,32 @@ def _validate_artifact(line: Optional[str]) -> list:
     rd = doc.get("rounds")
     if rd is not None and (isinstance(rd, bool) or not isinstance(rd, int) or rd < 0):
         problems.append("'rounds' must be an int >= 0")
+    # coalesced-dispatch probe fields (ISSUE 5): the concurrent-clients
+    # speedup is the number the acceptance tracks across rounds, so a
+    # malformed one must not be archived
+    conc = doc.get("concurrency")
+    if conc is not None and (
+        isinstance(conc, bool) or not isinstance(conc, int) or conc < 1
+    ):
+        problems.append("'concurrency' must be an int >= 1")
+
+    def _finite_nonneg(key, minimum=0.0):
+        v = doc.get(key)
+        if v is None:
+            return
+        if (
+            isinstance(v, bool)
+            or not isinstance(v, (int, float))
+            or v != v
+            or v in (float("inf"), float("-inf"))
+            or v < minimum
+        ):
+            problems.append(f"'{key}' must be null or a finite number >= {minimum:g}")
+
+    _finite_nonneg("coalesce_batch_mean", minimum=1.0)
+    _finite_nonneg("p50_score_ms")
+    _finite_nonneg("p99_score_ms")
+    _finite_nonneg("score_concurrent_speedup")
     # per-stage span summary (ISSUE 4): stage name -> milliseconds, or
     # null for a stage that measured nothing (a failed best-effort leg
     # must stay VISIBLE as null, never invented) — so BENCH_*.json
@@ -125,6 +245,9 @@ def _validate_artifact(line: Optional[str]) -> list:
     return problems
 
 
+_DEADLINE: Optional["_ArtifactDeadline"] = None
+
+
 def _emit_artifact(line: Optional[str]) -> bool:
     """Validate-then-print gate for every artifact line; schema failures
     go to stderr and the caller exits non-zero instead of publishing."""
@@ -136,7 +259,14 @@ def _emit_artifact(line: Optional[str]) -> bool:
             file=sys.stderr,
         )
         return False
-    print(line)
+    # one artifact per run: claim the deadline's once-flag BEFORE
+    # printing — a SIGTERM landing between the print and a
+    # cancel-afterwards would emit a second, "truncated" line behind a
+    # successful one.  (fire() itself holds the flag already, so its
+    # own emit is unaffected.)
+    if _DEADLINE is not None:
+        _DEADLINE.cancel()
+    print(line, flush=True)
     return True
 
 
@@ -476,6 +606,95 @@ def _recv_exact(conn, n: int) -> bytes:
     if out is None:
         raise ConnectionError("socket closed mid-frame")
     return out
+
+
+def _score_storm(sock_path, snapshot_id, clients=8, per_client=3, top_k=32,
+                 on_start=None):
+    """Concurrent-clients Score probe (ISSUE 5): ``clients`` raw-UDS
+    connections each fire ``per_client`` flat top-k Scores at once
+    (after one untimed warm-up each, so neither compile nor connect
+    cost pollutes the comparison).  Returns ``(wall_s, sorted
+    per-request latencies ms, reply digest set, errors)`` — the digest
+    set proves the demultiplexed coalesced replies are byte-identical
+    to the serialized server's for the same snapshot."""
+    import hashlib
+    import socket
+    import struct
+
+    from koordinator_tpu.bridge.codegen import pb2
+    from koordinator_tpu.bridge.udsserver import METHOD_SCORE
+
+    body = pb2.ScoreRequest(
+        snapshot_id=snapshot_id, top_k=top_k, flat=True
+    ).SerializeToString()
+    lats, digests, errors = [], set(), []
+    lock = threading.Lock()
+    # +1 on both barriers: the main thread snapshots baseline stats
+    # (on_start) and starts the wall clock BETWEEN them — after every
+    # warm-up completed, strictly before any timed request can run
+    warmed = threading.Barrier(clients + 1)
+    released = threading.Barrier(clients + 1)
+
+    def worker():
+        try:
+            conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            conn.connect(sock_path)
+
+            def call():
+                conn.sendall(
+                    struct.pack(">BI", METHOD_SCORE, len(body)) + body
+                )
+                status, ln = struct.unpack(">BI", _recv_exact(conn, 5))
+                out = _recv_exact(conn, ln)
+                assert status == 0, out
+                return out
+
+            call()  # warm-up: compile + cold snapshot build, untimed
+            warmed.wait()
+            released.wait()
+            for _ in range(per_client):
+                t0 = time.perf_counter()
+                out = call()
+                ms = _ms(t0)
+                flat = pb2.ScoreReply.FromString(out).flat
+                digest = hashlib.sha256(
+                    flat.pod_index + flat.counts + flat.node_index
+                    + flat.score
+                ).hexdigest()
+                with lock:
+                    lats.append(ms)
+                    digests.add(digest)
+            conn.close()
+        except Exception as exc:  # noqa: BLE001  (collected, asserted by caller)
+            with lock:
+                errors.append(repr(exc))
+            for b in (warmed, released):
+                try:
+                    b.abort()
+                except threading.BrokenBarrierError:
+                    pass  # already broken by another failed worker
+
+    threads = [
+        threading.Thread(target=worker, daemon=True) for _ in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        warmed.wait()
+        if on_start is not None:
+            # snapshot dispatcher stats AFTER the untimed warm-ups and
+            # BEFORE any worker is released, so batch-occupancy means
+            # measure only the storm itself (no race with the first
+            # timed request)
+            on_start()
+        t0 = time.perf_counter()
+        released.wait()
+    except threading.BrokenBarrierError:
+        t0 = time.perf_counter()  # a worker failed; error is collected
+    for t in threads:
+        t.join(timeout=600)
+    wall_s = time.perf_counter() - t0
+    return wall_s, sorted(lats), digests, errors
 
 
 def _ms(t0: float) -> float:
@@ -1008,6 +1227,91 @@ def child_config(platform: str, config: str) -> None:
                 t0 = time.perf_counter()
                 score = pb2.ScoreReply.FromString(call(METHOD_SCORE, sreq))
                 score_ms = _ms(t0)
+
+                # concurrent-clients probe (ISSUE 5): 8 clients firing
+                # flat top-32 Scores at once.  The baseline server pins
+                # coalesce_max_batch=1 — every request pays its own
+                # device launch and readback, the pre-coalescing
+                # serialized-lock behavior — while the main server's
+                # dispatcher stacks concurrent requests into shared
+                # launches.  Same snapshot, digest-identical replies.
+                from koordinator_tpu.bridge.server import ScorerServicer
+
+                conc = int(os.environ.get("KOORD_BENCH_SCORE_CLIENTS", "8"))
+                per_client = int(
+                    os.environ.get("KOORD_BENCH_SCORE_REPS", "3")
+                )
+                serial_sock = os.path.join(tmp, "serial.sock")
+                serial_server = RawUdsServer(
+                    serial_sock,
+                    servicer=ScorerServicer(coalesce_max_batch=1),
+                ).start()
+                try:
+                    sconn = socket.socket(
+                        socket.AF_UNIX, socket.SOCK_STREAM
+                    )
+                    sconn.connect(serial_sock)
+                    try:
+                        sconn.sendall(
+                            struct.pack(">BI", METHOD_SYNC, len(payload))
+                            + payload
+                        )
+                        st, ln = struct.unpack(
+                            ">BI", _recv_exact(sconn, 5)
+                        )
+                        sbody = _recv_exact(sconn, ln)
+                        assert st == 0, sbody
+                        serial_sid = pb2.SyncReply.FromString(
+                            sbody
+                        ).snapshot_id
+                    finally:
+                        sconn.close()
+                    wall_serial, lat_serial, dig_serial, errs = _score_storm(
+                        serial_sock, serial_sid, conc, per_client
+                    )
+                    assert not errs, f"serial storm errors: {errs}"
+                    stats_at_start = {}
+                    wall_coal, lat_coal, dig_coal, errs = _score_storm(
+                        sock_path, sync.snapshot_id, conc, per_client,
+                        on_start=lambda: stats_at_start.update(
+                            server.servicer.dispatch.stats()
+                        ),
+                    )
+                    assert not errs, f"coalesced storm errors: {errs}"
+                    before = stats_at_start
+                    # every reply across both servers decodes the same
+                    # snapshot: the coalesced demux must be
+                    # byte-identical with the serialized execution
+                    assert len(dig_serial) == 1 and dig_serial == dig_coal, (
+                        "coalesced replies diverged from serial execution"
+                    )
+                    after = server.servicer.dispatch.stats()
+                    batches = after["batches"] - before["batches"]
+                    coalesce_batch_mean = (
+                        (after["requests"] - before["requests"]) / batches
+                        if batches else 1.0
+                    )
+                    score_speedup = (
+                        wall_serial / wall_coal if wall_coal > 0 else None
+                    )
+                    p50 = lat_coal[len(lat_coal) // 2]
+                    p99 = lat_coal[
+                        min(len(lat_coal) - 1,
+                            int(round(0.99 * (len(lat_coal) - 1))))
+                    ]
+                    phase(
+                        "score_storm",
+                        concurrency=conc,
+                        serial_wall_ms=round(wall_serial * 1000.0, 1),
+                        coalesced_wall_ms=round(wall_coal * 1000.0, 1),
+                        speedup=(
+                            round(score_speedup, 3)
+                            if score_speedup is not None else None
+                        ),
+                        batch_mean=round(coalesce_batch_mean, 2),
+                    )
+                finally:
+                    serial_server.stop()
             finally:
                 conn.close()
                 server.stop()
@@ -1036,6 +1340,21 @@ def child_config(platform: str, config: str) -> None:
                     "delta_sync_bytes": len(warm_payload),
                     "score_top32_ms": round(score_ms, 1),
                     "score_build_ms": round(score.build_ms, 2),
+                    # coalesced-dispatch probe (ISSUE 5): aggregate
+                    # Score throughput of N concurrent clients vs the
+                    # serialized-lock baseline (max_batch=1), with the
+                    # mean batch occupancy the dispatcher achieved and
+                    # the client-observed latency quantiles
+                    "concurrency": conc,
+                    "coalesce_batch_mean": round(coalesce_batch_mean, 2),
+                    "p50_score_ms": round(p50, 2),
+                    "p99_score_ms": round(p99, 2),
+                    "score_serial_wall_ms": round(wall_serial * 1000.0, 1),
+                    "score_coalesced_wall_ms": round(wall_coal * 1000.0, 1),
+                    "score_concurrent_speedup": (
+                        round(score_speedup, 3)
+                        if score_speedup is not None else None
+                    ),
                     # the warm-cycle stage breakdown a scraper of the
                     # daemon's /metrics histogram sees, artifact-side
                     "spans": {
@@ -1044,6 +1363,8 @@ def child_config(platform: str, config: str) -> None:
                         "warm_assign": round(warm_ms, 2),
                         "cold_assign": round(cold_ms, 2),
                         "score_top32": round(score_ms, 2),
+                        "score_storm_serial": round(wall_serial * 1000.0, 2),
+                        "score_storm_coalesced": round(wall_coal * 1000.0, 2),
                     },
                 }
             ),
@@ -1149,8 +1470,15 @@ def _spawn(flag, platform, env_extra, timeout, config=None):
             out = out.decode(errors="replace")
         # a child that already printed its metric line but hung in a later
         # best-effort stage (e.g. the native baseline) still produced a
-        # valid artifact — never discard a finished measurement
-        finals = [l for l in out.splitlines() if l.startswith('{"metric"')]
+        # valid artifact — never discard a finished measurement.  A
+        # truncated line is NOT one (children don't arm the deadline, but
+        # a group-wide SIGTERM from the driver can still reach them): let
+        # the fallback chain keep trying instead of publishing value -1.
+        finals = [
+            l
+            for l in out.splitlines()
+            if l.startswith('{"metric"') and '"truncated": true' not in l
+        ]
         if finals:
             return True, finals[-1], ""
         phases = [l for l in out.splitlines() if l.startswith('{"phase"')]
@@ -1270,9 +1598,11 @@ def parent() -> int:
         # CPU child's window is still intact when the fallback runs
         reserve=CPU_TIMEOUT + 60.0,
     )
+    _PROGRESS["stage"] = "tpu_probe"
     tpu_alive, errors = _probe_until(
         budget, _env_seconds("KOORD_BENCH_TPU_WAIT", 2400.0)
     )
+    _PROGRESS["errors"] = errors
     if tpu_alive:
         # fight for the TPU across the remaining window: up to three
         # attempts with a fresh backend probe between retries, so a
@@ -1284,6 +1614,7 @@ def parent() -> int:
             if timeout <= 60:
                 errors.append("tpu attempt skipped: budget exhausted")
                 break
+            _PROGRESS["stage"] = f"tpu_attempt_{attempt + 1}"
             ok, final, err = _spawn("--child", "default", {}, timeout)
             if ok:
                 if _emit_artifact(final):
@@ -1313,6 +1644,7 @@ def parent() -> int:
     # the reserve guarantees a full CPU slot in every normal run.
     cpu_window = budget.window(CPU_TIMEOUT, reserve=0.0)
     if cpu_window > 0:
+        _PROGRESS["stage"] = "cpu_fallback"
         ok, final, err = _spawn("--child", "cpu", _CPU_ENV, cpu_window)
     else:
         ok, final, err = False, None, "cpu fallback skipped: budget exhausted"
@@ -1370,6 +1702,20 @@ def main() -> int:
     if args.config and args.child:
         child_config(args.platform, args.config)
         return 0
+    if args.child:
+        child(args.platform)
+        return 0
+    # ONLY parent paths beyond this point — they own the one-artifact
+    # contract: arm the hard deadline + SIGTERM flush so rc=124 can
+    # never again mean "no artifact".  Children must NOT arm it: they
+    # are bounded by the parent's _spawn windows, and a truncated child
+    # line on the stdout pipe would read as a finished measurement in
+    # _spawn's timeout salvage.
+    global _DEADLINE
+    _DEADLINE = _ArtifactDeadline(
+        _env_seconds("KOORD_BENCH_TOTAL_BUDGET", TOTAL_BUDGET),
+        metric=args.config or METRIC,
+    ).install()
     if args.config:
         # same probe + budget machinery as the headline parent (shorter
         # default probe window: configs are secondary artifacts)
@@ -1377,12 +1723,14 @@ def main() -> int:
             _env_seconds("KOORD_BENCH_TOTAL_BUDGET", TOTAL_BUDGET),
             reserve=CPU_TIMEOUT + 60.0,
         )
+        _PROGRESS["stage"] = f"config_{args.config}_probe"
         tpu_alive, errors = _probe_until(
             budget, _env_seconds("KOORD_BENCH_TPU_WAIT_CONFIG", 240.0)
         )
         if tpu_alive:
             window = budget.window(TPU_TIMEOUT)
             if window > 60:
+                _PROGRESS["stage"] = f"config_{args.config}_tpu"
                 ok, out, err = _spawn(
                     "--child", "default", {}, window, config=args.config
                 )
@@ -1395,6 +1743,7 @@ def main() -> int:
                 errors.append("tpu attempt skipped: budget exhausted")
         cpu_window = budget.window(CPU_TIMEOUT, reserve=0.0)
         if cpu_window > 0:
+            _PROGRESS["stage"] = f"config_{args.config}_cpu"
             ok, out, err = _spawn(
                 "--child", "cpu", _CPU_ENV, cpu_window, config=args.config
             )
@@ -1414,9 +1763,6 @@ def main() -> int:
             )
         )
         return 1
-    if args.child:
-        child(args.platform)
-        return 0
     return parent()
 
 

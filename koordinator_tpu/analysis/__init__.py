@@ -25,6 +25,11 @@ Rules (each suppressible per line with ``# koordlint: disable=<rule>``):
 * ``span-leak``         — raw ``begin_span`` calls must guarantee the
   matching ``end_span`` on every exit path (context manager or
   try/finally); a leaked span poisons every later flight record.
+* ``lock-held-dispatch`` — blocking device readbacks (``np.asarray``,
+  ``.item()``, ``.block_until_ready()``, ``jax.device_get``) inside a
+  ``with <state lock>:`` block — the serialized-daemon bug class the
+  coalescing dispatch engine (ISSUE 5) removed: capture under the
+  lock, read back outside it.
 * ``broad-except``      — ``except Exception:`` handlers must re-raise,
   log, or surface the bound error; silent swallowers need a reasoned
   ``# koordlint: disable=broad-except(<reason>)`` tag.
@@ -55,5 +60,6 @@ RULES = (
     "host-sync-in-jit",
     "broad-except",
     "span-leak",
+    "lock-held-dispatch",
     "wire-contract",
 )

@@ -123,6 +123,7 @@ def run_rules_on_source(
         donation,
         excepts,
         hostsync,
+        lockdispatch,
         retrace,
         spanleak,
     )
@@ -145,6 +146,7 @@ def run_rules_on_source(
         "host-sync-in-jit": hostsync.check,
         "broad-except": excepts.check,
         "span-leak": spanleak.check,
+        "lock-held-dispatch": lockdispatch.check,
     }
     for rule, fn in table.items():
         if rules is not None and rule not in rules:

@@ -1,0 +1,121 @@
+"""lock-held-dispatch: blocking device readbacks under a state lock.
+
+The coalescing dispatch engine (ISSUE 5, bridge/server.py) exists
+because the daemon once held ONE servicer lock across every RPC body —
+including the device dispatch and the blocking ``np.asarray`` readback,
+so sixteen parallel Score workers queued single-file behind a single
+transfer.  The refactor's invariant is lexical and therefore checkable:
+a ``with <...state lock...>:`` block must never contain a blocking
+device->host transfer (``np.asarray``/``np.array``/``np.copy`` on
+device values, ``.item()``, ``.block_until_ready()``,
+``jax.device_get``).  Capture references under the lock; launch and
+read back outside it (the device-dispatch queue serializes launches).
+
+Scope: with-blocks whose context expression's terminal attribute names
+a state/servicer lock (``_state_lock``, ``state_lock``,
+``_servicer_lock``, or a bare ``_lock`` — the pre-split servicer's
+spelling).  Nested function *definitions* inside the block are skipped:
+a closure defined under the lock does not run under it.  Host-only
+registries that guard plain dict/list state under a ``_lock`` never
+trip the rule because they perform no device readbacks; a with-block
+that legitimately must read back under a lock (none should) can carry
+``# koordlint: disable=lock-held-dispatch``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from koordinator_tpu.analysis.core import SourceFile, Violation
+
+RULE = "lock-held-dispatch"
+
+_NP_MODULES = ("np", "numpy", "onp", "_np")
+_NP_SYNC_FUNCS = ("asarray", "array", "copy")
+_JAX_MODULES = ("jax",)
+_LOCK_NAMES = ("_state_lock", "state_lock", "_servicer_lock", "_lock")
+
+
+def _terminal_name(node: ast.AST) -> str:
+    """The last segment of a Name/Attribute chain (``self.x._lock`` ->
+    "_lock"); '' for anything else (calls like ``maybe_span(...)``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _root_module(node: ast.AST) -> str:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _is_state_lock_with(node: ast.With) -> bool:
+    return any(
+        _terminal_name(item.context_expr) in _LOCK_NAMES
+        for item in node.items
+    )
+
+
+def _walk_skip_defs(nodes) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/class
+    definitions (a closure defined under the lock runs elsewhere)."""
+    stack = list(nodes)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                   ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check(source: SourceFile) -> List[Violation]:
+    out: List[Violation] = []
+    for node in ast.walk(source.tree):
+        if not isinstance(node, ast.With) or not _is_state_lock_with(node):
+            continue
+        for sub in _walk_skip_defs(node.body):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            flagged = None
+            if isinstance(fn, ast.Attribute) and (
+                _root_module(fn) in _NP_MODULES
+                and fn.attr in _NP_SYNC_FUNCS
+            ):
+                flagged = f"np.{fn.attr}()"
+            elif isinstance(fn, ast.Attribute) and fn.attr == "item":
+                flagged = ".item()"
+            elif isinstance(fn, ast.Attribute) and (
+                fn.attr == "block_until_ready"
+            ):
+                flagged = ".block_until_ready()"
+            elif isinstance(fn, ast.Attribute) and (
+                _root_module(fn) in _JAX_MODULES
+                and fn.attr == "device_get"
+            ):
+                flagged = "jax.device_get()"
+            if flagged is not None:
+                out.append(
+                    Violation(
+                        rule=RULE,
+                        path=source.path,
+                        line=sub.lineno,
+                        message=(
+                            f"{flagged} while the servicer state lock "
+                            "is held serializes every RPC behind one "
+                            "device->host transfer; capture references "
+                            "under the lock and read back outside it "
+                            "(the device-dispatch queue orders launches)"
+                        ),
+                    )
+                )
+    return out

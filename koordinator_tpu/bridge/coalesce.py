@@ -1,0 +1,237 @@
+"""Coalescing device dispatch for the BatchedScorer bridge (ISSUE 5).
+
+The daemon used to serialize every RPC under one servicer lock: the Go
+scheduler's 16 parallel Score workers arrive over thread-per-connection
+transports and then queued single-file, each paying its own device
+launch and its own blocking readback.  This module is the continuous-
+batching shape from inference serving applied to that seam: concurrent
+Score requests that arrive while the device is busy (or within a small
+gather window) are stacked into ONE batched launch against the resident
+snapshot, and the replies are demultiplexed per caller.
+
+The dispatcher is deliberately generic — it owns the queueing, the
+device critical section, and per-request result/error routing, while the
+*meaning* of a batch (the padded ``top_k`` launch, the single stacked
+readback, the telemetry) stays in ``bridge/server.py`` where the
+snapshot lives.  That split keeps this file unit-testable with a fake
+executor (tests/test_coalesce.py) and keeps the servicer free to change
+its device programs without touching the concurrency machinery.
+
+Concurrency contract (the lock order is device -> state, never state ->
+device while holding state):
+
+* ``submit()`` enqueues and then either *leads* (first thread to take
+  the device lock drains up to ``max_batch`` entries and executes them)
+  or *follows* (waits for a leader to publish its result).  FIFO: a
+  batch is always a prefix of the queue.
+* ``run_exclusive(fn)`` runs a non-coalescible device section (Assign's
+  cycle launch+readback, Sync's donating delta scatter) under the same
+  device lock, so a donation can never invalidate a buffer a coalesced
+  Score batch captured but has not yet read back.
+* Queue delay and batch occupancy per entry are stamped by the leader;
+  the executor forwards them to the ``koord_scorer_coalesce_*`` metric
+  families (obs/scorer_metrics.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+# One launch serves at most this many stacked Score requests; the Go
+# scheduler dispatches 16 parallel Score workers, so a full worker burst
+# coalesces into a single device program.
+DEFAULT_MAX_BATCH = 16
+
+
+class SnapshotNotResident(ValueError):
+    """A coalesced request named a snapshot that is no longer resident
+    (the same condition ``ScorerServicer._check_generation`` rejects on
+    the serial paths; callers translate to FAILED_PRECONDITION)."""
+
+
+class PendingRequest:
+    """One caller's slot in a coalesced batch.  The executor fills
+    ``reply`` (or ``error``); the dispatcher stamps queue/batch stats
+    and flips ``done`` under the queue condition."""
+
+    __slots__ = (
+        "req", "enqueued_at", "reply", "error", "done",
+        "queue_delay_ms", "batch_size",
+    )
+
+    def __init__(self, req, enqueued_at: float):
+        self.req = req
+        self.enqueued_at = enqueued_at
+        self.reply = None
+        self.error: Optional[BaseException] = None
+        self.done = False
+        self.queue_delay_ms = 0.0
+        self.batch_size = 0
+
+
+class CoalescingDispatcher:
+    """Queue + device critical section + per-caller demux.
+
+    ``execute_batch(entries)`` runs with the device lock held and must
+    set ``entry.reply`` or ``entry.error`` for every entry it accepts;
+    an exception it raises becomes the error of every entry still
+    unfilled.  It may return a callable: a post-batch hook the leader
+    runs AFTER the device lock is released and followers are notified —
+    host-side bookkeeping (telemetry) must not extend the device
+    critical section every queued launch waits on; a hook failure is
+    logged, never surfaced to callers whose replies already succeeded.
+    ``max_batch=1`` degenerates to the pre-coalescing serialized
+    behavior (every request pays its own launch) — the bench uses that
+    as the speedup baseline.
+    """
+
+    def __init__(
+        self,
+        execute_batch: Callable[[List[PendingRequest]], None],
+        max_batch: int = DEFAULT_MAX_BATCH,
+        gather_window_s: float = 0.0,
+        clock=time.perf_counter,
+        sleep=time.sleep,
+    ):
+        self._execute_batch = execute_batch
+        self.max_batch = max(1, int(max_batch))
+        # > 0: a leader that finds the device idle waits this long for
+        # stragglers before launching (trades a little lone-request
+        # latency for occupancy under bursty clients).  The default 0
+        # keeps serial latency untouched — "arrived while the device is
+        # busy" is what forms batches under real concurrency.
+        self.gather_window_s = max(0.0, float(gather_window_s))
+        self._clock = clock
+        self._sleep = sleep
+        self._device = threading.Lock()
+        self._cond = threading.Condition()
+        self._queue: List[PendingRequest] = []
+        # lifetime stats (under _cond): the bench's coalesce_batch_mean
+        # and the parity tests read these
+        self.batches = 0
+        self.requests = 0
+        self.max_occupancy = 0
+
+    # -- public API --
+    def submit(self, req) -> PendingRequest:
+        """Enqueue ``req`` and block until a batch containing it ran.
+        Returns the finished entry; raises its error if the executor
+        (or the batch as a whole) failed."""
+        entry = PendingRequest(req, self._clock())
+        with self._cond:
+            self._queue.append(entry)
+        while True:
+            if self._device.acquire(blocking=False):
+                hook = None
+                try:
+                    if not entry.done:
+                        hook = self._lead()
+                finally:
+                    self._device.release()
+                with self._cond:
+                    if self._queue:
+                        self._cond.notify_all()
+                if hook is not None:
+                    try:
+                        hook()
+                    except Exception:  # koordlint: disable=broad-except(post-batch bookkeeping must not fail callers whose replies already succeeded)
+                        import logging
+
+                        logging.getLogger(__name__).exception(
+                            "post-batch hook failed"
+                        )
+                if entry.done:
+                    break
+                continue  # batch cap left us queued: lead the next one
+            with self._cond:
+                # ``done`` flips under this condition, so the check and
+                # the wait cannot race a leader's notify.  Device holders
+                # notify under this condition only AFTER releasing, so
+                # checking the device here closes the other wakeup race:
+                # a release landing between our failed acquire above and
+                # this block shows as an unlocked device — retry leading
+                # immediately instead of sleeping a poll interval while
+                # the device sits idle.
+                if entry.done:
+                    break
+                if self._device.locked():
+                    self._cond.wait(timeout=0.05)
+            if entry.done:
+                break
+        if entry.error is not None:
+            raise entry.error
+        return entry
+
+    def run_exclusive(self, fn):
+        """Run a non-coalescible device section (Assign cycle, Sync's
+        donating scatter) under the device-dispatch lock, then wake any
+        Score waiters that queued behind it."""
+        self._device.acquire()
+        try:
+            return fn()
+        finally:
+            self._device.release()
+            with self._cond:
+                if self._queue:
+                    self._cond.notify_all()
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "batches": self.batches,
+                "requests": self.requests,
+                "max_occupancy": self.max_occupancy,
+                "batch_mean": (
+                    self.requests / self.batches if self.batches else 0.0
+                ),
+            }
+
+    # -- leader path (device lock held); returns the executor's
+    #    post-batch hook (run by submit() after the lock drops) --
+    def _lead(self):
+        if self.gather_window_s > 0.0:
+            deadline = self._clock() + self.gather_window_s
+            while True:
+                with self._cond:
+                    n = len(self._queue)
+                if n >= self.max_batch:
+                    break
+                left = deadline - self._clock()
+                if left <= 0.0:
+                    break
+                self._sleep(min(left, 0.0005))
+        with self._cond:
+            batch = self._queue[: self.max_batch]
+            del self._queue[: self.max_batch]
+        if not batch:
+            return None
+        now = self._clock()
+        for entry in batch:
+            entry.queue_delay_ms = (now - entry.enqueued_at) * 1000.0
+            entry.batch_size = len(batch)
+        hook = None
+        try:
+            hook = self._execute_batch(batch)
+        except Exception as exc:
+            # a whole-batch failure is every unfilled caller's failure;
+            # per-entry errors the executor already routed stay theirs
+            for entry in batch:
+                if entry.reply is None and entry.error is None:
+                    entry.error = exc
+        with self._cond:
+            # count only entries the executor ACCEPTED (reply set, no
+            # error): rejected entries (stale snapshot) and failed
+            # batches performed no device launch, and the stats here
+            # must agree with the koord_scorer_coalesce_* counters,
+            # which are fed per accepted request
+            n_ok = sum(1 for entry in batch if entry.error is None)
+            if n_ok:
+                self.batches += 1
+                self.requests += n_ok
+                self.max_occupancy = max(self.max_occupancy, n_ok)
+            for entry in batch:
+                entry.done = True
+            self._cond.notify_all()
+        return hook if callable(hook) else None

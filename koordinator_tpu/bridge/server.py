@@ -5,6 +5,33 @@ The service composition mirrors the reference's hook-server dispatch
 the device, callers talk UDS.  Score/Assign run the same device programs
 as the in-process API (solver.run_cycle / solver.score_cycle), so bridge
 clients get identical placements to embedded users.
+
+Concurrency (ISSUE 5 — the coalescing dispatch engine; docs/PIPELINE.md
+has the full picture).  The pre-PR daemon held ONE lock across every
+RPC body, so the Go scheduler's 16 parallel Score workers queued
+single-file, each paying its own device launch and blocking readback.
+That lock is now split three ways:
+
+* ``_sync_lock`` serializes Sync RPCs and pins the mirror baseline for
+  the protobuf->numpy decode — which runs OUTSIDE the device critical
+  section, so decode of Sync k+1 overlaps the (async) on-device delta
+  scatter of cycle k (a depth-2 decode/scatter pipeline).
+* ``_state_lock`` guards the resident mirrors, the generation counter
+  and telemetry sequencing.  It is never held across a device dispatch
+  or a blocking readback (koordlint's ``lock-held-dispatch`` rule
+  rejects that statically).
+* the **device-dispatch queue** (bridge/coalesce.py): Score requests
+  that arrive while the device is busy (or within a small gather
+  window) coalesce into one padded batched launch — ``top_k`` padded to
+  the sticky power-of-two bucket so coalescing introduces zero jit
+  cache misses on the warm path — with ONE stacked readback per launch
+  and replies demultiplexed per caller.  Assign's cycle and Sync's
+  donating delta scatter ride the same queue via ``run_exclusive`` so
+  a donation can never invalidate a buffer a captured batch has not
+  read back.
+
+The wire contract is untouched: replies are byte-identical to the
+serialized daemon's, only the internal concurrency changed.
 """
 
 from __future__ import annotations
@@ -13,17 +40,24 @@ import threading
 import time
 import uuid
 from concurrent import futures
-from typing import Optional
+from typing import List, Optional
 
 import numpy as np
 
 import grpc
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 from koordinator_tpu.bridge.codegen import SERVICE, pb2
+from koordinator_tpu.bridge.coalesce import (
+    CoalescingDispatcher,
+    PendingRequest,
+    SnapshotNotResident,
+)
 from koordinator_tpu.bridge.state import ResidentState
 from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG
+from koordinator_tpu.model.snapshot import pad_bucket
 from koordinator_tpu.obs import CycleTelemetry
 from koordinator_tpu.solver import run_cycle, score_cycle
 
@@ -35,6 +69,8 @@ class ScorerServicer:
         mesh=None,
         state_dir=None,
         telemetry: Optional[CycleTelemetry] = None,
+        coalesce_max_batch: int = 16,
+        coalesce_window_ms: float = 0.0,
     ):
         """``mesh``: a ``jax.sharding.Mesh`` turns the ASSIGN RPC into
         the round-based multi-chip cycle (parallel/shard_assign.py
@@ -50,7 +86,14 @@ class ScorerServicer:
         the daemon passes its --state-dir).  ``telemetry`` injects a
         pre-built CycleTelemetry (tests); by default one is created with
         this servicer's epoch so cycle ids ("c<epoch>-<seq>") correlate
-        with snapshot ids ("s<epoch>-<gen>")."""
+        with snapshot ids ("s<epoch>-<gen>").
+
+        ``coalesce_max_batch``: Score requests sharing one device launch
+        at most (1 = the pre-coalescing serialized behavior, the bench
+        baseline).  ``coalesce_window_ms``: how long an idle-device
+        leader waits for stragglers before launching (0 keeps lone-
+        request latency untouched; batches still form whenever requests
+        arrive while the device is busy)."""
         self.cfg = cfg
         self.mesh = mesh
         self.state = ResidentState()
@@ -64,37 +107,58 @@ class ScorerServicer:
         self.telemetry = telemetry or CycleTelemetry(
             epoch=self._epoch, cfg=cfg, state_dir=state_dir
         )
-        # one lock over state-mutating Sync and state-reading Score/Assign:
-        # the server runs on a thread pool, and a Sync racing a Score would
-        # otherwise let one cycle mix tensors from two generations
-        # (telemetry rides under the same lock: cycle records never
-        # interleave two RPCs' spans)
-        self._lock = threading.Lock()
+        # the lock split (module docstring): _sync_lock serializes Sync
+        # decodes against the mirror baseline; _state_lock guards mirror
+        # commits, the generation counter and telemetry sequencing — and
+        # is NEVER held across a device dispatch or blocking readback;
+        # the dispatcher's device lock serializes launches.  Lock order
+        # where nesting happens: device -> state.
+        self._sync_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self.dispatch = CoalescingDispatcher(
+            self._score_execute_batch,
+            max_batch=coalesce_max_batch,
+            gather_window_s=coalesce_window_ms / 1000.0,
+        )
 
     def snapshot_id(self) -> str:
         return f"s{self._epoch}-{self._generation}"
 
-    def _check_generation(self, req, ctx) -> None:
-        want = getattr(req, "snapshot_id", "")
-        # the FULL id must match, epoch included: accepting a bare
-        # legacy "s<gen>" here would re-open for Score/Assign the very
-        # restart-coincidence the epoch closes (clients echo the Sync
-        # reply's id verbatim, so nothing legitimate constructs one)
-        if want and want != self.snapshot_id():
-            msg = (
-                f"snapshot {want!r} is not resident "
-                f"(current {self.snapshot_id()})"
+    def _stale_snapshot(
+        self, want: str, sid: Optional[str] = None
+    ) -> Optional[SnapshotNotResident]:
+        """The ONE stale-snapshot test — serial ``_check_generation`` and
+        the coalesced batch's per-entry validation share it, so the
+        matching rule and the message can never drift apart.  The FULL id
+        must match, epoch included: accepting a bare legacy "s<gen>"
+        would re-open for Score/Assign the very restart-coincidence the
+        epoch closes (clients echo the Sync reply's id verbatim, so
+        nothing legitimate constructs one).  Returns the error to raise,
+        or None."""
+        sid = self.snapshot_id() if sid is None else sid
+        if want and want != sid:
+            return SnapshotNotResident(
+                f"snapshot {want!r} is not resident (current {sid})"
             )
+        return None
+
+    def _check_generation(self, req, ctx) -> None:
+        exc = self._stale_snapshot(getattr(req, "snapshot_id", ""))
+        if exc is not None:
             if ctx is not None:
-                ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, msg)
-            raise ValueError(msg)
+                ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(exc))
+            raise exc
 
     # -- RPC bodies (request -> reply functions) --
     def sync(self, req: "pb2.SyncRequest", ctx=None) -> "pb2.SyncReply":
-        with self._lock:
-            self.telemetry.flush_backlog()
+        # Phase 1 under _sync_lock only: the protobuf->numpy decode +
+        # validation runs while the device may still be scattering the
+        # PREVIOUS sync's deltas (async dispatch) and while coalesced
+        # Scores launch — the old single lock serialized all of that.
+        with self._sync_lock:
+            t0 = time.perf_counter()
             try:
-                info = self.state.apply_sync(req, spans=self.telemetry.spans)
+                staged = self.state.stage_sync(req)
             except Exception as exc:
                 # ValueError = a frame validation REJECTED (bad delta
                 # shape/index, missing first-sync tensors): the
@@ -106,80 +170,160 @@ class ScorerServicer:
                 # 64-slot ring nor the dump directory.  Anything else
                 # is an unexpected server-side failure: full
                 # abort (ring record + disk dump).
-                if isinstance(exc, ValueError):
-                    self.telemetry.metrics.count_cycle_error("sync")
-                else:
-                    self.telemetry.abort_cycle("sync", exc)
+                with self._state_lock:
+                    if isinstance(exc, ValueError):
+                        self.telemetry.metrics.count_cycle_error("sync")
+                    else:
+                        self.telemetry.abort_cycle("sync", exc)
                 raise
-            self._generation += 1
-            self.telemetry.record_sync(
-                info,
-                snapshot_id=self.snapshot_id(),
-                epoch=self._epoch,
-                generation=self._generation,
-            )
-            # counts come from the host mirrors.  A warm frame lands its
-            # deltas straight on the resident device tensors inside
-            # apply_sync (state.last_sync_path == "warm"); only a cold
-            # frame defers the full padded build to the next Score/Assign
-            return pb2.SyncReply(
-                snapshot_id=self.snapshot_id(),
-                nodes=self.state.node_alloc.shape[0],
-                pods=self.state.pod_requests.shape[0],
-            )
+            decode_s = time.perf_counter() - t0
+
+            # Phase 2 — atomic commit + the donating device scatter,
+            # under device -> state: the donation must not invalidate
+            # buffers a coalesced Score batch captured but has not read
+            # back, and the mirrors/generation/telemetry move together.
+            def commit() -> "pb2.SyncReply":
+                with self._state_lock:
+                    self.telemetry.flush_backlog()
+                    spans = self.telemetry.spans
+                    spans.add_measured("sync_decode", decode_s)
+                    try:
+                        info = self.state.commit_sync(staged, spans=spans)
+                    except Exception as exc:
+                        self.telemetry.abort_cycle("sync", exc)
+                        raise
+                    self._generation += 1
+                    self.telemetry.record_sync(
+                        info,
+                        snapshot_id=self.snapshot_id(),
+                        epoch=self._epoch,
+                        generation=self._generation,
+                    )
+                    # counts come from the host mirrors.  A warm frame
+                    # lands its deltas straight on the resident device
+                    # tensors inside commit_sync (state.last_sync_path ==
+                    # "warm"); only a cold frame defers the full padded
+                    # build to the next Score/Assign
+                    return pb2.SyncReply(
+                        snapshot_id=self.snapshot_id(),
+                        nodes=self.state.node_alloc.shape[0],
+                        pods=self.state.pod_requests.shape[0],
+                    )
+
+            return self.dispatch.run_exclusive(commit)
 
     def score(self, req: "pb2.ScoreRequest", ctx=None) -> "pb2.ScoreReply":
-        with self._lock:
-            self._check_generation(req, ctx)
-            spans = self.telemetry.spans
-            # a pending cycle holds the Sync stages (sync_decode,
-            # delta_scatter) waiting for the Assign that correlates
-            # them under the client's cycle_id.  In the standard
-            # Sync→Score→Assign flow Score must NOT commit it — the
-            # assign flight record would lose exactly the sync spans
-            # the correlation promises.  Score's spans ride along
-            # (score_* names, no collision) and only a Score with no
-            # pending cycle commits its own record.
-            self.telemetry.flush_backlog()
-            pending = spans.has_pending()
-            spans.current(snapshot_id=self.snapshot_id())
-            t_cycle = time.perf_counter()
+        # the coalescer runs the batch in whichever caller leads; this
+        # caller's slot carries its reply or its error back here
+        try:
+            entry = self.dispatch.submit(req)
+        except SnapshotNotResident as exc:
+            if ctx is not None:
+                ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(exc))
+            raise
+        return entry.reply
+
+    # -- coalesced Score execution (leader thread, device lock held) --
+    def _score_execute_batch(self, batch: List[PendingRequest]) -> None:
+        # capture a consistent view under the state lock, then leave it:
+        # the launch and the stacked readback must not serialize Syncs
+        with self._state_lock:
+            sid = self.snapshot_id()
+            accepted = []
+            for entry in batch:
+                err = self._stale_snapshot(
+                    getattr(entry.req, "snapshot_id", ""), sid
+                )
+                if err is not None:
+                    entry.error = err
+                else:
+                    accepted.append(entry)
+            if not accepted:
+                return None
             try:
-                reply = self._score_body(req, spans)
+                snap = self.state.snapshot()
             except Exception as exc:
+                # a failed cold rebuild is a server-side cycle failure
+                # the serial path counted and flight-dumped; keep that
+                # (abort_cycle under the state lock, as Sync does)
                 self.telemetry.abort_cycle("score", exc)
                 raise
-            latency_ms = (time.perf_counter() - t_cycle) * 1000.0
-            if pending:
-                self.telemetry.metrics.observe_cycle(
-                    latency_ms, path="score", wave=self.cfg.wave
-                )
-            else:
-                self.telemetry.commit_cycle(
-                    latency_ms, path="score", wave=self.cfg.wave
-                )
-            return reply
-
-    def _score_body(self, req: "pb2.ScoreRequest", spans) -> "pb2.ScoreReply":
-        snap = self.state.snapshot()
-        with spans.span("score_dispatch"):
+        try:
+            # execution clock starts HERE: the cycle-latency histogram
+            # keeps the serialized daemon's semantics (device dispatch +
+            # readback + assembly, no queue wait — queue wait has its
+            # own koord_scorer_coalesce_queue_delay_ms family)
+            t_exec = time.perf_counter()
+            N = snap.nodes.capacity
+            P = snap.pods.capacity
+            ks = [
+                min(int(e.req.top_k) or N, N) for e in accepted
+            ]
+            # ONE launch serves every caller: top_k runs at the batch
+            # max, padded to the sticky power-of-two bucket so varying
+            # batch composition cannot mint new compiled shapes (zero
+            # jit cache misses on the warm path); each caller's k is a
+            # prefix of the padded result (lax.top_k sorts descending
+            # with index tie-breaks, so prefixes are exact)
+            k_launch = min(pad_bucket(max(ks)), N)
+            t0 = t_exec
             scores, feasible = score_cycle(snap, self.cfg)
             masked = jnp.where(
                 feasible, scores, jnp.iinfo(jnp.int64).min
             )
-            P = snap.pods.capacity
-            k = int(req.top_k) or snap.nodes.capacity
-            k = min(k, snap.nodes.capacity)
-            top_scores, top_idx = lax.top_k(masked, k)
-        reply = pb2.ScoreReply()
-        with spans.span("score_readback"):
-            # one device->host transfer, then numpy-only assembly
-            top_scores = np.asarray(top_scores)
-            top_idx = np.asarray(top_idx).astype(np.int32)
-            ok = np.take_along_axis(
-                np.asarray(feasible), top_idx, axis=1
+            top_scores, top_idx = lax.top_k(masked, k_launch)
+            dispatch_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            # one stacked device->host transfer for the whole batch
+            # (the serialized daemon paid one blocking readback per
+            # request), then numpy-only per-caller assembly
+            top_scores, top_idx, feasible_np, valid_np = jax.device_get(
+                (top_scores, top_idx, feasible, snap.pods.valid)
             )
-            valid = np.asarray(snap.pods.valid)[:P].astype(bool)
+            readback_s = time.perf_counter() - t0
+            top_idx = top_idx.astype(np.int32)
+            valid = valid_np[:P].astype(bool)
+            # host-side assembly failures are per-entry: the launch
+            # served everyone else, so one bad demux must not fail
+            # callers whose replies are already built — and routing them
+            # per-entry is what keeps the dispatcher's lifetime stats
+            # (which count error-free entries) agreeing with the
+            # koord_scorer_coalesce_* counters the hook below feeds
+            assembled = []
+            n_failed = 0
+            for entry, k in zip(accepted, ks):
+                try:
+                    entry.reply = self._assemble_score_reply(
+                        entry.req, k, top_scores, top_idx, feasible_np,
+                        valid, P,
+                    )
+                    assembled.append(entry)
+                except Exception as exc:  # koordlint: disable=broad-except(routed to the one caller as its RPC error; sibling replies stand)
+                    entry.error = exc
+                    n_failed += 1
+            exec_ms = (time.perf_counter() - t_exec) * 1000.0
+        except Exception as exc:
+            with self._state_lock:
+                self.telemetry.abort_cycle("score", exc)
+            raise
+        # returned as the post-batch hook: the dispatcher runs it after
+        # the device lock drops, so telemetry never extends the device
+        # critical section queued launches wait on
+        return lambda: self._score_telemetry(
+            assembled, sid, dispatch_s, readback_s, exec_ms, n_failed
+        )
+
+    def _assemble_score_reply(
+        self, req, k, top_scores, top_idx, feasible_np, valid, P
+    ) -> "pb2.ScoreReply":
+        """Demux one caller's reply from the shared readback: slice the
+        k-prefix of the padded top-k (bit-identical with a serial
+        ``lax.top_k(masked, k)``), then the same flat/legacy assembly
+        the serialized path used."""
+        ts = top_scores[:, :k]
+        ti = top_idx[:, :k]
+        ok = np.take_along_axis(feasible_np, ti, axis=1)
+        reply = pb2.ScoreReply()
         t0 = time.perf_counter()
         if req.flat:
             # flat layout (round-3 review #8): O(1) Python calls —
@@ -190,23 +334,72 @@ class ScorerServicer:
             )
             reply.flat.counts = ok_v.sum(axis=1).astype("<i4").tobytes()
             reply.flat.node_index = (
-                top_idx[:P][valid][ok_v].astype("<i4").tobytes()
+                ti[:P][valid][ok_v].astype("<i4").tobytes()
             )
             reply.flat.score = (
-                top_scores[:P][valid][ok_v].astype("<i8").tobytes()
+                ts[:P][valid][ok_v].astype("<i8").tobytes()
             )
         else:
             # legacy per-pod lists: per-valid-pod Python loop
             for p in np.flatnonzero(valid):
                 entry = reply.pods.add()
                 m = ok[p]
-                entry.node_index.extend(top_idx[p, m].tolist())
-                entry.score.extend(top_scores[p, m].tolist())
+                entry.node_index.extend(ti[p, m].tolist())
+                entry.score.extend(ts[p, m].tolist())
         reply.build_ms = (time.perf_counter() - t0) * 1000.0
         return reply
 
+    def _score_telemetry(self, assembled, sid, dispatch_s, readback_s,
+                         exec_ms, n_failed=0):
+        """Per-batch telemetry, sequenced under the state lock.  The
+        pending-cycle contract is unchanged from the serial daemon: a
+        pending cycle holds Sync stages awaiting the Assign that
+        correlates them, so a Score must NOT commit it — its spans ride
+        along (score_* names) and only a pending-free batch commits one
+        record.  The cycle-latency histogram gets ONE observation per
+        request, all at the batch's shared execution time (dispatch +
+        readback + assembly — the same quantity the serialized daemon
+        observed per request), so serial and coalesced streams count
+        identically and queue wait stays in its own
+        koord_scorer_coalesce_queue_delay_ms family.  Runs as the
+        dispatcher's post-batch hook — after the device lock dropped.
+        ``assembled`` holds only the entries whose replies were delivered
+        (per-entry assembly failures were routed as those callers' RPC
+        errors and arrive here as ``n_failed``), so every family below
+        counts exactly what the dispatcher's lifetime stats count."""
+        with self._state_lock:
+            tel = self.telemetry
+            for _ in range(n_failed):
+                tel.metrics.count_cycle_error("score")
+            if not assembled:
+                return
+            tel.flush_backlog()
+            spans = tel.spans
+            pending = spans.has_pending()
+            spans.current(snapshot_id=sid)
+            spans.add_measured("score_dispatch", dispatch_s)
+            spans.add_measured("score_readback", readback_s)
+            if len(assembled) > 1:
+                spans.note("coalesced", len(assembled))
+            tel.metrics.record_coalesce(
+                len(assembled), [e.queue_delay_ms for e in assembled]
+            )
+            n_observe = len(assembled) if pending else len(assembled) - 1
+            if not pending:
+                tel.commit_cycle(exec_ms, path="score", wave=self.cfg.wave)
+            for _ in range(n_observe):
+                tel.metrics.observe_cycle(
+                    exec_ms, path="score", wave=self.cfg.wave
+                )
+
     def assign(self, req: "pb2.AssignRequest", ctx=None) -> "pb2.AssignReply":
-        with self._lock:
+        # the cycle clock starts inside the device section (below), so
+        # cycle_ms and the latency histogram keep the serialized
+        # daemon's meaning — device cycle + readback, NOT time spent
+        # queued behind other launches (the coalesce families carry
+        # queueing)
+        t0 = [0.0]
+        with self._state_lock:
             self._check_generation(req, ctx)
             spans = self.telemetry.spans
             # adopt the client's correlation id when it sent one; the id
@@ -215,44 +408,80 @@ class ScorerServicer:
                 snapshot_id=self.snapshot_id(),
                 cycle_id=req.cycle_id or None,
             )
-            t0 = time.perf_counter()
-            try:
-                result, rounds, eff_wave = self._assign_cycle(spans)
-                with spans.span("readback"):
-                    assignment = np.asarray(result.assignment)
-                    status = np.asarray(result.status)
-                    # same cached snapshot _assign_cycle ran against
-                    # (no Sync can interleave: we hold the lock)
-                    valid = np.asarray(
-                        self.state.snapshot().pods.valid
-                    ).astype(bool)
-                ms = (time.perf_counter() - t0) * 1000.0
-                reply = pb2.AssignReply(
-                    cycle_ms=ms,
-                    path=result.path or "",
-                    cycle_id=cycle.cycle_id,
-                )
-                reply.assignment.extend(assignment[valid].tolist())
-                reply.status.extend(status[valid].tolist())
-            except Exception as exc:
-                # count + flight-dump the bad cycle before surfacing it
+            cycle_id = cycle.cycle_id
+
+        def launch():
+            # capture INSIDE the device section: a pipelined Sync's
+            # delta scatter DONATES the pre-delta resident buffers, so
+            # a snapshot captured before this RPC held the device lock
+            # could be deleted out from under the cycle (the stress
+            # test in tests/test_coalesce.py reproduces exactly that).
+            # The generation re-check keeps the serial semantics: if a
+            # Sync committed while we queued, a pinned snapshot_id is
+            # now stale and must FAILED_PRECONDITION, same as if the
+            # RPCs had serialized Sync-first.
+            t0[0] = time.perf_counter()
+            with self._state_lock:
+                self._check_generation(req, None)
+                snap = self.state.snapshot()
+                i32_ok = self.state.i32_fits()
+            return self._assign_launch(snap, spans, i32_ok)
+
+        try:
+            # the device section (launch + the single stacked readback)
+            # rides the dispatch queue: serialized against coalesced
+            # Score launches and Sync's donating scatters, off the
+            # state lock so neither blocks behind the transfer
+            result, rounds, eff_wave, assignment, status, valid = (
+                self.dispatch.run_exclusive(launch)
+            )
+        except SnapshotNotResident as exc:
+            # displaced mid-queue by another client's Sync: a client
+            # protocol condition (the Go client full-resyncs on it),
+            # not a cycle failure — no flight dump
+            if ctx is not None:
+                ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(exc))
+            raise
+        except Exception as exc:
+            # count + flight-dump the bad cycle before surfacing it
+            with self._state_lock:
                 self.telemetry.abort_cycle("assign", exc)
-                raise
+            raise
+        ms = (time.perf_counter() - t0[0]) * 1000.0
+        with self._state_lock:
+            reply = pb2.AssignReply(
+                cycle_ms=ms,
+                path=result.path or "",
+                cycle_id=cycle_id,
+            )
+            reply.assignment.extend(assignment[valid].tolist())
+            reply.status.extend(status[valid].tolist())
             self.telemetry.commit_cycle(
                 ms,
                 path=result.path or "unknown",
                 wave=eff_wave,
                 rounds=rounds,
             )
-            return reply
+        return reply
 
-    def _assign_cycle(self, spans):
+    def _assign_launch(self, snap, spans, i32_ok):
+        """Device section of Assign (device lock held, state lock NOT):
+        run the cycle, then ONE stacked readback for assignment, status
+        and the validity mask of the very snapshot the cycle ran
+        against."""
+        result, rounds, eff_wave = self._assign_cycle(snap, spans, i32_ok)
+        with spans.span("readback"):
+            assignment, status, valid = jax.device_get(
+                (result.assignment, result.status, snap.pods.valid)
+            )
+        return result, rounds, eff_wave, assignment, status, valid.astype(bool)
+
+    def _assign_cycle(self, snap, spans, i32_ok):
         """Run the device cycle (shard-first when a mesh is configured)
-        and return ``(materialized CycleResult, rounds or None,
-        effective wave width)`` — the shard path widens cfg.wave<=1 to
-        its own default, and the telemetry labels must say what actually
-        ran.  Caller holds the lock and owns error accounting."""
-        snap = self.state.snapshot()
+        and return ``(CycleResult, rounds or None, effective wave
+        width)`` — the shard path widens cfg.wave<=1 to its own
+        default, and the telemetry labels must say what actually ran.
+        Caller holds the device lock and owns error accounting."""
         result = None
         rounds = None
         eff_wave = self.cfg.wave
@@ -326,9 +555,7 @@ class ScorerServicer:
         if result is None:
             eff_wave = self.cfg.wave
             with spans.span("dispatch"):
-                result = run_cycle(
-                    snap, self.cfg, i32_ok=self.state.i32_fits()
-                )
+                result = run_cycle(snap, self.cfg, i32_ok=i32_ok)
             if result.rounds is not None:
                 rounds = int(np.asarray(result.rounds))
         return result, rounds, eff_wave
@@ -345,9 +572,13 @@ def _handler(fn, req_cls):
 def make_server(
     servicer: Optional[ScorerServicer] = None,
     cfg: CycleConfig = DEFAULT_CYCLE_CONFIG,
-    max_workers: int = 4,
+    max_workers: int = 16,
     mesh=None,
 ) -> grpc.Server:
+    """``max_workers`` defaults to the reference scheduler's 16 parallel
+    Score workers: with the coalescing dispatcher a full worker burst
+    now shares one device launch instead of queueing on a lock, so the
+    transport should not be the narrower funnel."""
     servicer = servicer or ScorerServicer(cfg, mesh=mesh)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     handlers = {

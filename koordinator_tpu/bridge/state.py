@@ -247,11 +247,40 @@ class ResidentState:
         ("delta_scatter") are recorded as stages of the upcoming cycle.
         Returns a summary dict for the scorer metric families:
         ``{"path": "warm"|"cold", "delta_tensors": n, "full_tensors": n}``.
+
+        One-shot convenience over the two-phase ``stage_sync`` /
+        ``commit_sync`` seam the coalescing pipeline uses (ISSUE 5): the
+        server runs the protobuf->numpy decode OUTSIDE its device
+        critical section — decode of Sync k+1 overlaps the on-device
+        delta scatter of cycle k — and commits under its state lock.
         """
+        return self.commit_sync(
+            self.stage_sync(reqmsg, spans=spans), spans=spans
+        )
+
+    def stage_sync(self, reqmsg: "pb2.SyncRequest", spans=None):
+        """Phase 1 — pure decode/validate.  Mutates NOTHING; every
+        validation error (bad delta shape/index, duplicate indices,
+        missing first-sync tensors, pre-resize companions) raises here,
+        so a frame that passes staging always commits.  The caller must
+        hold whatever serializes Syncs (the servicer's ``_sync_lock``):
+        deltas are validated against the current mirrors, so another
+        Sync committing mid-decode would invalidate the staging."""
         from koordinator_tpu.obs.spans import maybe_span
 
         with maybe_span(spans, "sync_decode"):
-            staged, tinfo = self._decode_sync(reqmsg)
+            return self._decode_sync(reqmsg)
+
+    def commit_sync(self, staged_tinfo, spans=None) -> dict:
+        """Phase 2 — atomic commit of a staged frame + the device-side
+        warm update.  The delta scatter donates the pre-delta resident
+        buffers, so the caller must hold the device-dispatch lock
+        (bridge/coalesce.py run_exclusive) to keep the donation from
+        invalidating arrays a coalesced Score batch captured but has
+        not read back yet."""
+        from koordinator_tpu.obs.spans import maybe_span
+
+        staged, tinfo = staged_tinfo
         # device-update plan, computed against the PRE-commit mirrors
         plan = self._warm_plan(staged, tinfo)
         # atomic commit point: nothing above mutated self

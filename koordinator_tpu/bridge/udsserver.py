@@ -85,7 +85,11 @@ class RawUdsServer:
             os.unlink(path)
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._sock.bind(path)
-        self._sock.listen(8)
+        # backlog matches _MAX_CONNS: with the coalescing dispatcher the
+        # intended client is a 16-way worker burst dialing at once, and
+        # a listen(8) backlog was refusing dials the connection cap
+        # would have accepted
+        self._sock.listen(_MAX_CONNS)
         self._stop = threading.Event()
         self._conn_slots = threading.BoundedSemaphore(_MAX_CONNS)
         # live connections, closed on stop(): a stopped server must not
@@ -254,8 +258,22 @@ class RawUdsServer:
 
     @staticmethod
     def _reply(conn: socket.socket, status: int, payload: bytes) -> None:
+        """Write header+payload with one gathered ``sendmsg`` instead of
+        concatenating (which copies the payload — a full-matrix flat
+        Score reply is tens of MB) or two ``sendall`` calls (two
+        syscalls per reply on the hot path).  Partial sends are resumed
+        across the buffer list; stream UDS sockets rarely split small
+        frames, so the common case is exactly one syscall."""
+        bufs = [memoryview(struct.pack(">BI", status, len(payload))),
+                memoryview(payload)]
         try:
-            conn.sendall(struct.pack(">BI", status, len(payload)) + payload)
+            while bufs:
+                sent = conn.sendmsg(bufs)
+                while bufs and sent >= len(bufs[0]):
+                    sent -= len(bufs[0])
+                    bufs.pop(0)
+                if bufs and sent:
+                    bufs[0] = bufs[0][sent:]
         except OSError:
             pass
 

@@ -173,7 +173,9 @@ class MetricCache:
                 arrays[f"ts_{i}"] = s.ts[: s.count]
                 arrays[f"v_{i}"] = s.values[: s.count]
                 index.append(repr(key))
-            arrays["index"] = np.array(index)
+            # host-only string array for the npz index — no device
+            # value ever enters this cache, so nothing can block here
+            arrays["index"] = np.array(index)  # koordlint: disable=lock-held-dispatch
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         np.savez_compressed(path, **arrays)
 

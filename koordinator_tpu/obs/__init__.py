@@ -189,9 +189,8 @@ class CycleTelemetry:
 
     def flush_backlog(self) -> None:
         spans = self.spans
-        if (
-            spans.has_pending()
-            and len(spans.current().spans) >= self.PENDING_COMMIT_SPANS
-        ):
+        # pending_spans() is atomic on the recorder (the coalescer's
+        # batch leaders call this concurrently with Sync commits)
+        if spans.pending_spans() >= self.PENDING_COMMIT_SPANS:
             spans.note("backlog", True)
             self.flight.record(spans.commit())

@@ -22,7 +22,18 @@ koord_scorer_kernel_demotions_total    counter   —
 koord_scorer_uds_frames_total          counter   method
 koord_scorer_uds_malformed_total       counter   reason
 koord_scorer_uds_errors_total          counter   —
+koord_scorer_coalesce_queue_delay_ms   histogram —
+koord_scorer_coalesce_batch_occupancy  histogram —
+koord_scorer_coalesce_batches_total    counter   —
+koord_scorer_coalesce_requests_total   counter   —
 ====================================== ========= ==========================
+
+The ``koord_scorer_coalesce_*`` families observe the coalescing
+dispatch engine (ISSUE 5, bridge/coalesce.py): how long a Score request
+waited in the gather queue before its batch launched, and how many
+requests shared each device launch — occupancy near 1 under heavy
+concurrency means the engine is not batching (gather window too small,
+or the clients are actually serial).
 
 The jit cache-miss counter is fed by
 ``analysis.retrace_guard.watch_cache_misses`` — the runtime companion of
@@ -51,6 +62,15 @@ DEMOTIONS_TOTAL = "koord_scorer_kernel_demotions_total"
 UDS_FRAMES = "koord_scorer_uds_frames_total"
 UDS_MALFORMED = "koord_scorer_uds_malformed_total"
 UDS_ERRORS = "koord_scorer_uds_errors_total"
+COALESCE_QUEUE_DELAY = "koord_scorer_coalesce_queue_delay_ms"
+COALESCE_OCCUPANCY = "koord_scorer_coalesce_batch_occupancy"
+COALESCE_BATCHES = "koord_scorer_coalesce_batches_total"
+COALESCE_REQUESTS = "koord_scorer_coalesce_requests_total"
+
+# occupancy is a count-of-requests-per-launch, not a latency: its own
+# power-of-two buckets (the dispatcher caps batches at 16 by default;
+# 32/64 leave headroom for tuned deployments)
+_OCCUPANCY_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, float("inf"))
 
 _FAMILIES = (
     (CYCLE_LATENCY, "histogram",
@@ -83,7 +103,19 @@ _FAMILIES = (
      "malformed raw-UDS frames (oversized, unknown method, truncated "
      "mid-frame), by reason"),
     (UDS_ERRORS, "counter", "raw-UDS requests answered with an error frame"),
+    (COALESCE_QUEUE_DELAY, "histogram",
+     "time a Score request waited in the coalescer's gather queue "
+     "before its batch launched"),
+    (COALESCE_OCCUPANCY, "histogram",
+     "Score requests sharing one coalesced device launch"),
+    (COALESCE_BATCHES, "counter", "coalesced Score launches"),
+    (COALESCE_REQUESTS, "counter",
+     "Score requests served through the coalescer (requests/batches = "
+     "mean occupancy)"),
 )
+
+# per-family bucket overrides (histograms default to DEFAULT_BUCKETS_MS)
+_BUCKET_OVERRIDES = {COALESCE_OCCUPANCY: _OCCUPANCY_BUCKETS}
 
 
 class ScorerMetrics:
@@ -97,7 +129,10 @@ class ScorerMetrics:
         for name, kind, help_text in _FAMILIES:
             self.registry.register(
                 name, kind, help_text,
-                buckets=DEFAULT_BUCKETS_MS if kind == "histogram" else None,
+                buckets=(
+                    _BUCKET_OVERRIDES.get(name, DEFAULT_BUCKETS_MS)
+                    if kind == "histogram" else None
+                ),
             )
 
     # -- cycle completion --
@@ -167,3 +202,16 @@ class ScorerMetrics:
 
     def count_uds_error(self) -> None:
         self.registry.counter_add(UDS_ERRORS, 1)
+
+    def record_coalesce(self, batch_size: int, queue_delays_ms) -> None:
+        """One coalesced launch: how many requests shared it and how
+        long each waited in the gather queue.  Called by the batch
+        leader AFTER the stacked readback (never under the device
+        lock's critical path a follower is waiting on)."""
+        self.registry.counter_add(COALESCE_BATCHES, 1)
+        self.registry.counter_add(COALESCE_REQUESTS, int(batch_size))
+        self.registry.histogram_observe(COALESCE_OCCUPANCY, float(batch_size))
+        for delay_ms in queue_delays_ms:
+            self.registry.histogram_observe(
+                COALESCE_QUEUE_DELAY, float(delay_ms)
+            )

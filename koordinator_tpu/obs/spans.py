@@ -26,11 +26,20 @@ Design constraints (the acceptance criteria of ISSUE 4):
   only one most call sites should use; raw ``begin_span`` callers must
   end the span on every exit path (enforced by koordlint's
   ``span-leak`` rule: try/finally or the context manager).
+* **Thread-safe recorder.**  Since the coalescing dispatch engine
+  (ISSUE 5, bridge/coalesce.py) split the servicer's single lock, RPC
+  bodies no longer serialize the recorder for free: a Score batch
+  leader, a pipelined Sync commit and an Assign device section can all
+  touch the current cycle.  :class:`SpanRecorder` therefore guards its
+  public API with a small RLock (host-side, ~100ns — invisible next to
+  a device launch); :class:`CycleSpans` itself stays lock-free and is
+  only reached through the recorder.
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -80,6 +89,21 @@ class CycleSpans:
         if handle < 0 or handle >= len(self.spans):
             return
         self.spans[handle][2] = self._clock() - self._t0
+
+    def add_measured(self, name: str, dur_s: float) -> None:
+        """Record an already-measured stage as a closed span ending now.
+
+        The coalescing pipeline measures some stages OUTSIDE the lock
+        that guards this recorder (a Sync's protobuf->numpy decode, a
+        batch leader's shared dispatch/readback) and attaches them at
+        the commit point; the start is back-computed and clamped to the
+        cycle origin (a decode can legitimately begin before the cycle
+        it lands on exists)."""
+        if len(self.spans) >= MAX_SPANS_PER_CYCLE:
+            self.overflow += 1
+            return
+        end = self._clock() - self._t0
+        self.spans.append([name, max(0.0, end - max(0.0, dur_s)), end])
 
     def to_record(self) -> Dict[str, object]:
         """Flight-recorder/bench shape: durations in milliseconds; a
@@ -137,12 +161,16 @@ class SpanRecorder:
         self._wall_clock = wall_clock
         self._seq = 0
         self._cycle: Optional[CycleSpans] = None
+        # reentrant: commit() calls current(); the lock makes each call
+        # atomic against the coalescer's concurrent batch leaders
+        self._lock = threading.RLock()
 
     # -- cycle lifecycle --
     def has_pending(self) -> bool:
         """Whether an uncommitted cycle is already accumulating spans
         (e.g. a delta-Sync waiting for the Assign that correlates it)."""
-        return self._cycle is not None
+        with self._lock:
+            return self._cycle is not None
 
     def current(self, snapshot_id: Optional[str] = None,
                 cycle_id: Optional[str] = None) -> CycleSpans:
@@ -150,35 +178,51 @@ class SpanRecorder:
         a caller-supplied correlation id (the AssignRequest's) for the
         open cycle; ``snapshot_id`` stamps the resident snapshot it ran
         against."""
-        if self._cycle is None:
-            self._seq += 1
-            self._cycle = CycleSpans(
-                cycle_id or f"c{self.epoch}-{self._seq}",
-                clock=self._clock, wall_clock=self._wall_clock,
-            )
-        elif cycle_id:
-            self._cycle.cycle_id = cycle_id
-        if snapshot_id is not None:
-            self._cycle.snapshot_id = snapshot_id
-        return self._cycle
+        with self._lock:
+            if self._cycle is None:
+                self._seq += 1
+                self._cycle = CycleSpans(
+                    cycle_id or f"c{self.epoch}-{self._seq}",
+                    clock=self._clock, wall_clock=self._wall_clock,
+                )
+            elif cycle_id:
+                self._cycle.cycle_id = cycle_id
+            if snapshot_id is not None:
+                self._cycle.snapshot_id = snapshot_id
+            return self._cycle
 
     def commit(self, error: Optional[str] = None) -> Dict[str, object]:
         """Close the current cycle and return its record (an empty cycle
         is created if nothing was recorded, so commit() is total)."""
-        cycle = self.current()
-        if error is not None:
-            cycle.error = error
-        record = cycle.to_record()
-        self._cycle = None
-        return record
+        with self._lock:
+            cycle = self.current()
+            if error is not None:
+                cycle.error = error
+            record = cycle.to_record()
+            self._cycle = None
+            return record
 
     # -- span API --
     def begin_span(self, name: str) -> int:
-        return self.current().begin(name)
+        with self._lock:
+            return self.current().begin(name)
 
     def end_span(self, handle: int) -> None:
-        if self._cycle is not None:
-            self._cycle.end(handle)
+        with self._lock:
+            if self._cycle is not None:
+                self._cycle.end(handle)
+
+    def add_measured(self, name: str, dur_s: float) -> None:
+        """Attach a stage measured outside the recorder (see
+        ``CycleSpans.add_measured``) to the current cycle."""
+        with self._lock:
+            self.current().add_measured(name, dur_s)
+
+    def pending_spans(self) -> int:
+        """Span count buffered on the open cycle (0 when none) — the
+        backlog-flush threshold check, made atomic for the coalescer."""
+        with self._lock:
+            return len(self._cycle.spans) if self._cycle is not None else 0
 
     def span(self, name: str) -> _SpanContext:
         """``with recorder.span("dispatch"): ...`` — the leak-proof
@@ -190,7 +234,8 @@ class SpanRecorder:
         """Attach a device-derived or config stat to the current cycle.
         ``value`` must already be a host-side Python scalar/str — pass
         ``int(np.asarray(x))`` results, never live tracers."""
-        self.current().notes[key] = value
+        with self._lock:
+            self.current().notes[key] = value
 
 
 _NULL_CONTEXT = contextlib.nullcontext()

@@ -54,6 +54,15 @@ def apply_flat_delta(arr: "jax.Array", idx, val) -> "jax.Array":
     re-bind or drop their reference (the koordlint ``donation-safety``
     rule enforces this for module-local call sites; cross-module callers
     own the contract, see docs/ANALYSIS.md).
+
+    Cross-THREAD donation contract (ISSUE 5): since the bridge daemon
+    stopped serializing RPCs under one lock, a concurrent Score batch
+    may hold a captured reference to the pre-delta snapshot.  Callers
+    must launch this scatter under the device-dispatch lock
+    (bridge/coalesce.py ``run_exclusive``) so the donation only
+    invalidates buffers no in-flight launch can still read back; the
+    scatter itself is a non-blocking async launch, which is what lets
+    the next Sync's decode overlap it (docs/PIPELINE.md).
     """
     idx = np.asarray(idx, np.int64)
     val = np.asarray(val, np.int64)

@@ -15,6 +15,8 @@ import importlib.util
 import json
 import os
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _spec = importlib.util.spec_from_file_location(
@@ -106,6 +108,99 @@ class TestBudgetInvariant:
         assert b.window(1000.0, reserve=0.0) <= 10.0
         now[0] = 200.0
         assert b.window(1000.0, reserve=0.0) == 0.0
+
+
+class TestArtifactDeadline:
+    """ISSUE 5 satellite: the rc=124-no-artifact class, closed for
+    real.  The budget accountant bounds the windows bench GRANTS
+    itself, but a stage that hangs past its window — or a driver
+    timeout shorter than the budget — used to kill the process with
+    nothing on stdout (BENCH_r05).  The hard deadline replays here
+    under an injected clock: a slow stage never returns, the watchdog
+    fires, and a schema-valid artifact with ``"truncated": true`` is
+    flushed before exit."""
+
+    def _deadline(self, total=100.0, start=0.0):
+        emitted = []
+        fired = []
+        now = [start]
+
+        def sleep(s):
+            # the injected clock IS the slow stage: every watchdog nap
+            # burns fake seconds while the "stage" never completes
+            now[0] += s
+
+        d = bench._ArtifactDeadline(
+            total,
+            emit=lambda line: emitted.append(line) or True,
+            clock=lambda: now[0],
+            sleep=sleep,
+            on_fire=lambda rc: fired.append(rc),
+        )
+        return d, emitted, fired, now
+
+    def test_slow_stage_flushes_truncated_artifact(self):
+        d, emitted, fired, _now = self._deadline(total=100.0)
+        bench._PROGRESS["stage"] = "tpu_attempt_2"
+        d.watch()  # fake clock: returns once the deadline elapsed
+        assert fired == [1]
+        assert len(emitted) == 1
+        assert bench._validate_artifact(emitted[0]) == []
+        doc = json.loads(emitted[0])
+        assert doc["truncated"] is True
+        assert "tpu_attempt_2" in doc["error"]
+
+    def test_fire_is_idempotent(self):
+        d, emitted, fired, _now = self._deadline()
+        d.fire("SIGTERM from the driver")
+        d.fire("hard wall-clock deadline reached before an artifact")
+        assert fired == [1] and len(emitted) == 1
+        assert "SIGTERM" in json.loads(emitted[0])["error"]
+
+    def test_cancel_after_real_artifact_suppresses_the_flush(self):
+        d, emitted, fired, _now = self._deadline()
+        d.cancel()  # a real artifact line made it out
+        d.fire("hard wall-clock deadline reached before an artifact")
+        assert emitted == [] and fired == []
+
+    def test_deadline_respects_margin(self):
+        d, _e, _f, _now = self._deadline(total=100.0)
+        assert d.deadline == pytest.approx(70.0)  # 30s margin
+        # tiny budgets never go non-positive
+        d2, _e, _f, _now = self._deadline(total=5.0)
+        assert d2.deadline >= 1.0
+
+    def test_truncated_artifact_line_is_schema_valid(self):
+        d, _e, _f, _now = self._deadline()
+        line = d.artifact_line("reason")
+        assert bench._validate_artifact(line) == []
+
+
+class TestArtifactSchemaTruncatedAndCoalesce:
+    def _line(self, **extra):
+        doc = {"metric": "m", "value": 1.0, "unit": "ms"}
+        doc.update(extra)
+        return json.dumps(doc)
+
+    def test_truncated_must_be_bool(self):
+        assert bench._validate_artifact(self._line(truncated=True)) == []
+        assert bench._validate_artifact(self._line(truncated=False)) == []
+        assert bench._validate_artifact(self._line(truncated="yes"))
+        assert bench._validate_artifact(self._line(truncated=1))
+
+    def test_concurrency_probe_fields(self):
+        assert bench._validate_artifact(self._line(
+            concurrency=8, coalesce_batch_mean=5.3,
+            p50_score_ms=12.0, p99_score_ms=40.5,
+            score_concurrent_speedup=4.2,
+        )) == []
+        assert bench._validate_artifact(self._line(concurrency=0))
+        assert bench._validate_artifact(self._line(concurrency=True))
+        assert bench._validate_artifact(self._line(coalesce_batch_mean=0.5))
+        assert bench._validate_artifact(self._line(p99_score_ms=-1))
+        assert bench._validate_artifact(
+            self._line(score_concurrent_speedup=float("nan"))
+        )
 
 
 class TestArtifactSchemaWaveFields:

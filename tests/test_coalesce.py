@@ -1,0 +1,455 @@
+"""ISSUE 5: the coalescing dispatch engine.
+
+Three layers of proof:
+
+* dispatcher mechanics against a FAKE executor — batches form while the
+  device is busy, FIFO prefixes, the batch cap, the gather window, and
+  error routing (whole-batch and per-entry);
+* concurrency PARITY on the real servicer — N threads firing
+  interleaved Score/Sync/Assign produce replies bit-identical to the
+  same requests issued serially (the acceptance criterion), including
+  mixed top_k values demuxed from one padded launch;
+* the donation race the lock split could have opened — warm delta
+  Syncs (which donate the pre-delta resident buffers) racing coalesced
+  Scores and Assigns must never hand a deleted buffer to a captured
+  batch.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.bridge.coalesce import (
+    CoalescingDispatcher,
+    SnapshotNotResident,
+)
+from koordinator_tpu.bridge.codegen import pb2
+from koordinator_tpu.bridge.server import ScorerServicer
+from koordinator_tpu.bridge.state import numpy_to_tensor
+from test_resident_warm import _full_sync_request, _random_state
+
+
+def _wait_until(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.001)
+    return False
+
+
+class TestDispatcherMechanics:
+    def _collecting_dispatcher(self, **kwargs):
+        batches = []
+        gate = threading.Event()
+        first_started = threading.Event()
+
+        def execute(batch):
+            batches.append([e.req for e in batch])
+            if len(batches) == 1:
+                first_started.set()
+                assert gate.wait(5.0)
+            for e in batch:
+                e.reply = f"ok:{e.req}"
+
+        d = CoalescingDispatcher(execute, **kwargs)
+        return d, batches, gate, first_started
+
+    def test_requests_arriving_while_busy_share_one_launch(self):
+        d, batches, gate, first_started = self._collecting_dispatcher()
+        results = {}
+
+        def submit(name):
+            results[name] = d.submit(name).reply
+
+        t_lead = threading.Thread(target=submit, args=("a",))
+        t_lead.start()
+        assert first_started.wait(5.0)  # "a" holds the device
+        followers = [
+            threading.Thread(target=submit, args=(n,))
+            for n in ("b", "c", "d")
+        ]
+        for t in followers:
+            t.start()
+        # all three queued while the device is busy
+        assert _wait_until(lambda: len(d._queue) == 3)
+        gate.set()
+        for t in [t_lead, *followers]:
+            t.join(timeout=5.0)
+        assert batches[0] == ["a"]
+        assert sorted(batches[1]) == ["b", "c", "d"]  # ONE shared launch
+        assert results == {n: f"ok:{n}" for n in "abcd"}
+        assert d.stats()["max_occupancy"] == 3
+
+    def test_batch_cap_splits_the_queue_fifo(self):
+        d, batches, gate, first_started = self._collecting_dispatcher(
+            max_batch=2
+        )
+        threads = [threading.Thread(target=d.submit, args=("lead",))]
+        threads[0].start()
+        assert first_started.wait(5.0)
+        for name in ("q1", "q2", "q3"):
+            t = threading.Thread(target=d.submit, args=(name,))
+            t.start()
+            threads.append(t)
+            # deterministic FIFO: each enqueues before the next starts
+            assert _wait_until(
+                lambda n=name: any(e.req == n for e in list(d._queue))
+            )
+        gate.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert batches[0] == ["lead"]
+        assert batches[1] == ["q1", "q2"]  # capped prefix, in order
+        assert batches[2] == ["q3"]
+
+    def test_gather_window_stacks_staggered_arrivals(self):
+        batches = []
+
+        def execute(batch):
+            batches.append([e.req for e in batch])
+            for e in batch:
+                e.reply = e.req
+
+        d = CoalescingDispatcher(execute, gather_window_s=0.25)
+        threads = [
+            threading.Thread(target=d.submit, args=(i,)) for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+            time.sleep(0.03)  # staggered inside the window
+        for t in threads:
+            t.join(timeout=5.0)
+        assert len(batches) == 1 and sorted(batches[0]) == [0, 1, 2]
+
+    def test_whole_batch_error_reaches_every_caller(self):
+        def execute(batch):
+            raise RuntimeError("device wedged")
+
+        d = CoalescingDispatcher(execute)
+        with pytest.raises(RuntimeError, match="device wedged"):
+            d.submit("x")
+
+    def test_per_entry_error_spares_the_rest(self):
+        def execute(batch):
+            for e in batch:
+                if e.req == "bad":
+                    e.error = SnapshotNotResident("stale")
+                else:
+                    e.reply = "fine"
+
+        d = CoalescingDispatcher(execute)
+        assert d.submit("good").reply == "fine"
+        with pytest.raises(SnapshotNotResident, match="stale"):
+            d.submit("bad")
+
+    def test_run_exclusive_serializes_against_batches(self):
+        order = []
+        gate = threading.Event()
+        started = threading.Event()
+
+        def execute(batch):
+            order.append("batch")
+            started.set()
+            assert gate.wait(5.0)
+            for e in batch:
+                e.reply = True
+
+        d = CoalescingDispatcher(execute)
+        t = threading.Thread(target=d.submit, args=("x",))
+        t.start()
+        assert started.wait(5.0)
+        excl = threading.Thread(
+            target=lambda: d.run_exclusive(lambda: order.append("excl"))
+        )
+        excl.start()
+        time.sleep(0.05)
+        assert order == ["batch"]  # exclusive section waits its turn
+        gate.set()
+        t.join(timeout=5.0)
+        excl.join(timeout=5.0)
+        assert order == ["batch", "excl"]
+
+    def test_queue_delay_and_occupancy_stamped(self):
+        def execute(batch):
+            for e in batch:
+                e.reply = True
+
+        d = CoalescingDispatcher(execute)
+        entry = d.submit("x")
+        assert entry.batch_size == 1
+        assert entry.queue_delay_ms >= 0.0
+        stats = d.stats()
+        assert stats["batches"] == 1 and stats["requests"] == 1
+        assert stats["batch_mean"] == 1.0
+
+
+def _score_fields(reply):
+    """The deterministic payload of a ScoreReply (build_ms is a timing,
+    deliberately excluded from the bit-identity contract)."""
+    if reply.HasField("flat"):
+        return (
+            reply.flat.pod_index,
+            reply.flat.counts,
+            reply.flat.node_index,
+            reply.flat.score,
+        )
+    return tuple(
+        (tuple(entry.node_index), tuple(entry.score)) for entry in reply.pods
+    )
+
+
+def _servicer(seed=17, **kwargs):
+    rng = np.random.RandomState(seed)
+    state = _random_state(rng, n_nodes=6, n_pods=16, with_quota=True)
+    sv = ScorerServicer(**kwargs)
+    sv.sync(_full_sync_request(state))
+    return sv, state
+
+
+class TestCoalescedScoreParity:
+    def test_concurrent_mixed_topk_bit_identical_to_serial(self):
+        """8 threads, mixed top_k and flat/legacy layouts, all demuxed
+        from shared padded launches — every reply must equal the
+        serially-issued reply for the same request, field for field."""
+        sv, _ = _servicer()
+        sid = sv.snapshot_id()
+        reqs = [
+            pb2.ScoreRequest(snapshot_id=sid, top_k=k, flat=flat)
+            for k in (0, 1, 3, 5)
+            for flat in (True, False)
+        ]
+        serial = [_score_fields(sv.score(req)) for req in reqs]
+
+        for _ in range(3):  # repeat: thread interleavings vary
+            results = [None] * len(reqs)
+            barrier = threading.Barrier(len(reqs))
+
+            def worker(i):
+                barrier.wait()
+                results[i] = _score_fields(sv.score(reqs[i]))
+
+            threads = [
+                threading.Thread(target=worker, args=(i,))
+                for i in range(len(reqs))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30.0)
+            assert results == serial
+        # under a gather window the same workload actually coalesces
+        # (without one, batching depends on device-busy timing)
+        svw, _ = _servicer(coalesce_window_ms=100.0)
+        sidw = svw.snapshot_id()
+        reqsw = [
+            pb2.ScoreRequest(snapshot_id=sidw, top_k=k, flat=True)
+            for k in (1, 3, 5, 0)
+        ]
+        serialw = [_score_fields(svw.score(r)) for r in reqsw]
+        resultsw = [None] * len(reqsw)
+        barrier = threading.Barrier(len(reqsw))
+
+        def workerw(i):
+            barrier.wait()
+            resultsw[i] = _score_fields(svw.score(reqsw[i]))
+
+        threads = [
+            threading.Thread(target=workerw, args=(i,))
+            for i in range(len(reqsw))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert resultsw == serialw
+        assert svw.dispatch.stats()["max_occupancy"] > 1
+
+    def test_stale_snapshot_in_batch_errors_only_that_caller(self):
+        sv, state = _servicer(seed=23, coalesce_window_ms=50.0)
+        good_sid = sv.snapshot_id()
+        outcomes = {}
+        barrier = threading.Barrier(3)
+
+        def fire(name, sid):
+            barrier.wait()
+            try:
+                reply = sv.score(
+                    pb2.ScoreRequest(snapshot_id=sid, top_k=2, flat=True)
+                )
+                outcomes[name] = _score_fields(reply)
+            except ValueError as exc:
+                outcomes[name] = f"error:{exc}"
+
+        threads = [
+            threading.Thread(target=fire, args=("good1", good_sid)),
+            threading.Thread(target=fire, args=("good2", good_sid)),
+            threading.Thread(target=fire, args=("stale", "sdeadbeef-9")),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert "not resident" in outcomes["stale"]
+        want = _score_fields(
+            sv.score(pb2.ScoreRequest(
+                snapshot_id=good_sid, top_k=2, flat=True
+            ))
+        )
+        assert outcomes["good1"] == want and outcomes["good2"] == want
+
+    def test_score_via_dispatcher_raises_valueerror_without_ctx(self):
+        sv, _ = _servicer(seed=29)
+        with pytest.raises(ValueError, match="not resident"):
+            sv.score(pb2.ScoreRequest(snapshot_id="s0-1", top_k=1))
+
+    def test_coalesce_metric_families_populate(self):
+        sv, _ = _servicer(seed=31)
+        sid = sv.snapshot_id()
+        for _ in range(3):
+            sv.score(pb2.ScoreRequest(snapshot_id=sid, top_k=2, flat=True))
+        reg = sv.telemetry.registry
+        assert reg.get("koord_scorer_coalesce_batches_total") == 3
+        assert reg.get("koord_scorer_coalesce_requests_total") == 3
+        count, _total = reg.get_histogram(
+            "koord_scorer_coalesce_batch_occupancy", {}
+        )
+        assert count == 3
+        count, _total = reg.get_histogram(
+            "koord_scorer_coalesce_queue_delay_ms", {}
+        )
+        assert count == 3
+
+
+class TestInterleavedStress:
+    def test_syncs_scores_assigns_race_without_corruption(self):
+        """Warm delta Syncs DONATE the pre-delta resident buffers; the
+        device-dispatch queue must keep a donation from invalidating a
+        buffer a coalesced Score batch (or an Assign cycle) captured
+        but has not read back.  Under the old single lock this race was
+        impossible; here it runs hot for a few hundred iterations."""
+        rng = np.random.RandomState(41)
+        state = _random_state(rng, n_nodes=6, n_pods=12, with_quota=False)
+        sv = ScorerServicer()
+        sv.sync(_full_sync_request(state))
+        sv.state.snapshot()
+        errors = []
+        stop = threading.Event()
+
+        def syncer():
+            local_rng = np.random.RandomState(43)
+            try:
+                for _ in range(60):
+                    prev = state["node_usage"].copy()
+                    state["node_usage"][
+                        local_rng.randint(0, 6), local_rng.randint(0, 13)
+                    ] += 1
+                    req = pb2.SyncRequest()
+                    req.nodes.usage.CopyFrom(
+                        numpy_to_tensor(state["node_usage"], prev)
+                    )
+                    sv.sync(req)
+            except Exception as exc:  # noqa: BLE001  (re-raised via errors)
+                errors.append(repr(exc))
+            finally:
+                stop.set()
+
+        def scorer():
+            try:
+                while not stop.is_set():
+                    reply = sv.score(
+                        pb2.ScoreRequest(snapshot_id="", top_k=3, flat=True)
+                    )
+                    assert reply.HasField("flat")
+            except Exception as exc:  # noqa: BLE001
+                errors.append(repr(exc))
+
+        def assigner():
+            try:
+                while not stop.is_set():
+                    reply = sv.assign(pb2.AssignRequest(snapshot_id=""))
+                    assert len(reply.assignment) == 12
+            except Exception as exc:  # noqa: BLE001
+                errors.append(repr(exc))
+
+        threads = [threading.Thread(target=syncer)] + [
+            threading.Thread(target=scorer) for _ in range(3)
+        ] + [threading.Thread(target=assigner) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        assert not errors, errors
+        # the stream ends on a consistent generation: one more serial
+        # cycle agrees with a cold re-encode of the final state
+        from test_resident_warm import _cold_oracle, _results
+
+        got = _results(sv)
+        want = _results(_cold_oracle(state))
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+
+    def test_concurrent_assigns_match_serial(self):
+        sv, _ = _servicer(seed=47)
+        sid = sv.snapshot_id()
+        serial = sv.assign(pb2.AssignRequest(snapshot_id=sid))
+        results = [None] * 4
+        barrier = threading.Barrier(4)
+
+        def worker(i):
+            barrier.wait()
+            r = sv.assign(pb2.AssignRequest(snapshot_id=sid))
+            results[i] = (list(r.assignment), list(r.status), r.path)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        for got in results:
+            assert got == (
+                list(serial.assignment), list(serial.status), serial.path
+            )
+
+
+class TestUdsReplySendmsg:
+    def test_reply_survives_partial_gathered_sends(self):
+        """_reply writes header+payload as ONE gathered sendmsg; with a
+        payload far beyond the socket buffer the kernel forces partial
+        sends, and the resume loop must deliver every byte in order."""
+        import socket
+
+        from koordinator_tpu.bridge.udsserver import RawUdsServer
+
+        a, b = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 16384)
+            payload = bytes(range(256)) * 4096  # 1 MiB, patterned
+            received = bytearray()
+            done = threading.Event()
+
+            def drain():
+                while len(received) < 5 + len(payload):
+                    chunk = b.recv(65536)
+                    if not chunk:
+                        break
+                    received.extend(chunk)
+                done.set()
+
+            t = threading.Thread(target=drain)
+            t.start()
+            RawUdsServer._reply(a, 0, payload)
+            assert done.wait(10.0)
+            t.join(timeout=5.0)
+            import struct
+
+            status, length = struct.unpack(">BI", bytes(received[:5]))
+            assert status == 0 and length == len(payload)
+            assert bytes(received[5:]) == payload
+        finally:
+            a.close()
+            b.close()

@@ -499,6 +499,97 @@ class TestHostSyncObsAPI:
         """) == []
 
 
+class TestLockHeldDispatch:
+    """ISSUE 5: the serialized-daemon bug class — a blocking device
+    readback performed while the servicer state lock is held serializes
+    every RPC behind one transfer.  The coalescing refactor's invariant
+    is lexical (capture under the lock, read back outside), so the rule
+    checks exactly that."""
+
+    def test_readbacks_under_state_lock_caught(self):
+        out = lint("""
+        import numpy as np
+        import jax
+
+        class Servicer:
+            def score(self):
+                with self._state_lock:
+                    snap = self.state.snapshot()
+                    scores = np.asarray(snap.scores)
+                    n = snap.rounds.item()
+                    snap.result.block_until_ready()
+                    a, b = jax.device_get((snap.a, snap.b))
+                return scores
+        """, ["lock-held-dispatch"])
+        assert len(out) == 4
+        assert all(v.rule == "lock-held-dispatch" for v in out)
+
+    def test_pre_split_servicer_spelling_caught(self):
+        # the pre-refactor servicer held a bare self._lock across the
+        # readback; the rule must catch that spelling too
+        out = lint("""
+        import numpy as np
+
+        class Servicer:
+            def assign(self):
+                with self._lock:
+                    assignment = np.asarray(self.result.assignment)
+                return assignment
+        """, ["lock-held-dispatch"])
+        assert [v.line for v in out] == [7]
+
+    def test_capture_then_readback_outside_is_clean(self):
+        out = lint("""
+        import numpy as np
+        import jax
+
+        class Servicer:
+            def score(self):
+                with self._state_lock:
+                    snap = self.state.snapshot()
+                    sid = self.snapshot_id()
+                scores = np.asarray(snap.scores)
+                a, b = jax.device_get((snap.a, snap.b))
+                return sid, scores
+        """, ["lock-held-dispatch"])
+        assert out == []
+
+    def test_closure_defined_under_lock_is_clean(self):
+        # a closure DEFINED under the lock runs elsewhere (the device
+        # section hands it to the dispatch queue) — not a violation
+        out = lint("""
+        import numpy as np
+
+        class Servicer:
+            def assign(self):
+                with self._state_lock:
+                    def launch():
+                        return np.asarray(self.result.assignment)
+                return self.dispatch.run_exclusive(launch)
+        """, ["lock-held-dispatch"])
+        assert out == []
+
+    def test_unrelated_lock_names_are_clean(self):
+        out = lint("""
+        import numpy as np
+
+        def save(self):
+            with self._PALLAS_LOCK:
+                return np.asarray(self.table)
+        """, ["lock-held-dispatch"])
+        assert out == []
+
+    def test_suppression_tag(self):
+        out = lint("""
+        import numpy as np
+
+        def save(self):
+            with self._lock:
+                return np.array(self.index)  # koordlint: disable=lock-held-dispatch
+        """, ["lock-held-dispatch"])
+        assert out == []
+
+
 class TestBroadExcept:
     def test_silent_swallow_caught_and_tag_respected(self):
         got = lint("""

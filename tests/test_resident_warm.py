@@ -588,6 +588,47 @@ class TestRetraceBudget:
             sv.telemetry.flight.document("test")
         ) == []
 
+    def test_warm_stream_with_coalesced_score_is_retrace_free(self):
+        """ISSUE 5 acceptance: the coalescing dispatch engine is always
+        on in the servicer, and a warm delta-Sync/Score/Assign stream
+        through it still holds ZERO jit cache misses — the padded
+        ``top_k`` launch (k padded to the sticky power-of-two bucket)
+        must not mint new compiled shapes as batch composition varies,
+        and the lock split must not reintroduce per-request retraces."""
+        from koordinator_tpu.analysis import retrace_guard
+
+        rng = np.random.RandomState(31)
+        state = _random_state(rng, n_nodes=5, n_pods=12, with_quota=False)
+        sv = ScorerServicer()
+        sv.sync(_full_sync_request(state))
+        sv.state.snapshot()
+        # warm-up: compiles the scatter, the cycle AND the score/top_k
+        # programs (two top_k values land in the same pad bucket)
+        sv.score(pb2.ScoreRequest(
+            snapshot_id=sv.snapshot_id(), top_k=3, flat=True
+        ))
+        self._warm_step(sv, state)
+        with retrace_guard(budget=0) as counter:
+            for step in range(4):
+                prev = state["node_usage"].copy()
+                state["node_usage"][0, 1] += 1
+                req = pb2.SyncRequest()
+                req.nodes.usage.CopyFrom(
+                    numpy_to_tensor(state["node_usage"], prev)
+                )
+                sv.sync(req)
+                assert sv.state.last_sync_path == "warm"
+                # alternating k within one pad bucket: coalesced batch
+                # composition changing must not change compiled shapes
+                sv.score(pb2.ScoreRequest(
+                    snapshot_id=sv.snapshot_id(),
+                    top_k=3 if step % 2 else 2,
+                    flat=True,
+                ))
+                sv.assign(pb2.AssignRequest(snapshot_id=sv.snapshot_id()))
+        assert counter.traces == 0 and counter.compiles == 0
+        assert sv.dispatch.stats()["batches"] >= 5
+
     def test_guard_actually_counts(self):
         """Negative control: a fresh jit inside the guard must trip it —
         otherwise a broken counter would pass the budget test vacuously."""
