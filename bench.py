@@ -219,6 +219,22 @@ def _validate_artifact(line: Optional[str]) -> list:
     _finite_nonneg("p50_score_ms")
     _finite_nonneg("p99_score_ms")
     _finite_nonneg("score_concurrent_speedup")
+    # pipelined-dispatch probe fields (ISSUE 6): the vs-coalescer
+    # speedup and the device-idle/window health numbers the acceptance
+    # tracks — malformed ones must not be archived
+    _finite_nonneg("score_pipeline_speedup")
+    _finite_nonneg("device_idle_ms")
+    _finite_nonneg("coalesce_window_ms")
+    lo = doc.get("launch_overlaps")
+    if lo is not None and (
+        isinstance(lo, bool) or not isinstance(lo, int) or lo < 0
+    ):
+        problems.append("'launch_overlaps' must be null or an int >= 0")
+    ss = doc.get("score_serial_sample")
+    if ss is not None and (
+        isinstance(ss, bool) or not isinstance(ss, int) or ss < 1
+    ):
+        problems.append("'score_serial_sample' must be null or an int >= 1")
     # per-stage span summary (ISSUE 4): stage name -> milliseconds, or
     # null for a stage that measured nothing (a failed best-effort leg
     # must stay VISIBLE as null, never invented) — so BENCH_*.json
@@ -695,6 +711,18 @@ def _score_storm(sock_path, snapshot_id, clients=8, per_client=3, top_k=32,
         t.join(timeout=600)
     wall_s = time.perf_counter() - t0
     return wall_s, sorted(lats), digests, errors
+
+
+def _extrapolate_serial(wall_s: float, measured: int, total: int) -> float:
+    """Scale a sampled serialized-baseline storm wall to the full
+    request count.  Valid ONLY for the max_batch=1/depth=1 engine:
+    it admits exactly one request into the device section at a time,
+    so storm wall is the sum of per-request service times and grows
+    linearly in the number of requests, independent of client fan-in.
+    ``measured`` <= 0 or >= ``total`` returns the wall unchanged."""
+    if measured <= 0 or measured >= total:
+        return wall_s
+    return wall_s * (total / measured)
 
 
 def _ms(t0: float) -> float:
@@ -1228,48 +1256,93 @@ def child_config(platform: str, config: str) -> None:
                 score = pb2.ScoreReply.FromString(call(METHOD_SCORE, sreq))
                 score_ms = _ms(t0)
 
-                # concurrent-clients probe (ISSUE 5): 8 clients firing
-                # flat top-32 Scores at once.  The baseline server pins
-                # coalesce_max_batch=1 — every request pays its own
-                # device launch and readback, the pre-coalescing
-                # serialized-lock behavior — while the main server's
-                # dispatcher stacks concurrent requests into shared
-                # launches.  Same snapshot, digest-identical replies.
+                # concurrent-clients probe (ISSUE 5/6): a worker storm
+                # firing flat top-32 Scores at once against THREE
+                # engines on the same snapshot — the serialized
+                # baseline (max_batch=1, depth=1: every request pays
+                # its own launch AND its own blocking readback, the
+                # pre-coalescing lock behavior), the ISSUE-5 coalescer
+                # (shared launches, depth=1: the leader still blocks
+                # the device section across its stacked readback), and
+                # the main server's pipelined engine (depth-2 double
+                # buffering + adaptive gather window).  Digest-identical
+                # replies across all three.
                 from koordinator_tpu.bridge.server import ScorerServicer
 
-                conc = int(os.environ.get("KOORD_BENCH_SCORE_CLIENTS", "8"))
+                conc = int(os.environ.get("KOORD_BENCH_SCORE_CLIENTS", "64"))
                 per_client = int(
                     os.environ.get("KOORD_BENCH_SCORE_REPS", "3")
                 )
-                serial_sock = os.path.join(tmp, "serial.sock")
-                serial_server = RawUdsServer(
-                    serial_sock,
-                    servicer=ScorerServicer(coalesce_max_batch=1),
-                ).start()
-                try:
-                    sconn = socket.socket(
+
+                def storm_server(name, **kwargs):
+                    """Start a baseline server, sync the same snapshot,
+                    return (server, snapshot_id)."""
+                    path_ = os.path.join(tmp, f"{name}.sock")
+                    srv = RawUdsServer(
+                        path_, servicer=ScorerServicer(**kwargs)
+                    ).start()
+                    bconn = socket.socket(
                         socket.AF_UNIX, socket.SOCK_STREAM
                     )
-                    sconn.connect(serial_sock)
+                    bconn.connect(path_)
                     try:
-                        sconn.sendall(
+                        bconn.sendall(
                             struct.pack(">BI", METHOD_SYNC, len(payload))
                             + payload
                         )
                         st, ln = struct.unpack(
-                            ">BI", _recv_exact(sconn, 5)
+                            ">BI", _recv_exact(bconn, 5)
                         )
-                        sbody = _recv_exact(sconn, ln)
+                        sbody = _recv_exact(bconn, ln)
                         assert st == 0, sbody
-                        serial_sid = pb2.SyncReply.FromString(
-                            sbody
-                        ).snapshot_id
+                        sid_ = pb2.SyncReply.FromString(sbody).snapshot_id
                     finally:
-                        sconn.close()
+                        bconn.close()
+                    return srv, path_, sid_
+
+                serial_server = coal_server = None
+                try:
+                    serial_server, serial_sock, serial_sid = storm_server(
+                        "serial",
+                        coalesce_max_batch=1,
+                        coalesce_window_ms=0.0,
+                        pipeline_depth=1,
+                    )
+                    coal_server, coal_sock, coal_sid = storm_server(
+                        "coalesce_d1",
+                        coalesce_max_batch=16,
+                        coalesce_window_ms=0.0,
+                        pipeline_depth=1,
+                    )
+                    # The serialized baseline processes strictly one
+                    # request at a time (max_batch=1, depth=1), so its
+                    # storm wall is just n_requests x the mean service
+                    # time regardless of client fan-in.  On the CPU
+                    # scan fallback a single 10k x 2k Score costs
+                    # seconds, and the full 64 x reps baseline alone
+                    # would blow the parent's child window (the
+                    # BENCH_r05 rc=124 class) — so on cpu we measure a
+                    # small sample and extrapolate linearly, publishing
+                    # the sample size in the artifact.  TPU rounds
+                    # measure the full storm.
+                    serial_clients, serial_reps = conc, per_client
+                    if backend == "cpu":
+                        serial_clients = min(conc, int(
+                            os.environ.get("KOORD_BENCH_SERIAL_SAMPLE", "4")
+                        ))
+                        serial_reps = min(per_client, 2)
+                    serial_n = serial_clients * serial_reps
                     wall_serial, lat_serial, dig_serial, errs = _score_storm(
-                        serial_sock, serial_sid, conc, per_client
+                        serial_sock, serial_sid, serial_clients, serial_reps
                     )
                     assert not errs, f"serial storm errors: {errs}"
+                    wall_serial = _extrapolate_serial(
+                        wall_serial, serial_n, conc * per_client
+                    )
+                    wall_d1, _lat_d1, dig_d1, errs = _score_storm(
+                        coal_sock, coal_sid, conc, per_client
+                    )
+                    assert not errs, f"depth-1 storm errors: {errs}"
                     stats_at_start = {}
                     wall_coal, lat_coal, dig_coal, errs = _score_storm(
                         sock_path, sync.snapshot_id, conc, per_client,
@@ -1277,14 +1350,15 @@ def child_config(platform: str, config: str) -> None:
                             server.servicer.dispatch.stats()
                         ),
                     )
-                    assert not errs, f"coalesced storm errors: {errs}"
+                    assert not errs, f"pipelined storm errors: {errs}"
                     before = stats_at_start
-                    # every reply across both servers decodes the same
-                    # snapshot: the coalesced demux must be
+                    # every reply across all three servers decodes the
+                    # same snapshot: the pipelined demux must be
                     # byte-identical with the serialized execution
-                    assert len(dig_serial) == 1 and dig_serial == dig_coal, (
-                        "coalesced replies diverged from serial execution"
-                    )
+                    assert (
+                        len(dig_serial) == 1
+                        and dig_serial == dig_coal == dig_d1
+                    ), "storm replies diverged from serial execution"
                     after = server.servicer.dispatch.stats()
                     batches = after["batches"] - before["batches"]
                     coalesce_batch_mean = (
@@ -1294,6 +1368,21 @@ def child_config(platform: str, config: str) -> None:
                     score_speedup = (
                         wall_serial / wall_coal if wall_coal > 0 else None
                     )
+                    # the ISSUE-6 headline: pipelined vs the ISSUE-5
+                    # coalescer (shared launches, serial readbacks)
+                    pipeline_speedup = (
+                        wall_d1 / wall_coal if wall_coal > 0 else None
+                    )
+                    # device idle while work was queued, across the
+                    # pipelined storm only (stats diffed around it)
+                    device_idle_ms = max(
+                        0.0,
+                        after["device_idle_ms"] - before["device_idle_ms"],
+                    )
+                    window_ms = after["window_ms"]
+                    overlaps = (
+                        after["launch_overlaps"] - before["launch_overlaps"]
+                    )
                     p50 = lat_coal[len(lat_coal) // 2]
                     p99 = lat_coal[
                         min(len(lat_coal) - 1,
@@ -1302,16 +1391,28 @@ def child_config(platform: str, config: str) -> None:
                     phase(
                         "score_storm",
                         concurrency=conc,
+                        serial_sample=serial_n,
                         serial_wall_ms=round(wall_serial * 1000.0, 1),
-                        coalesced_wall_ms=round(wall_coal * 1000.0, 1),
+                        depth1_wall_ms=round(wall_d1 * 1000.0, 1),
+                        pipelined_wall_ms=round(wall_coal * 1000.0, 1),
                         speedup=(
                             round(score_speedup, 3)
                             if score_speedup is not None else None
                         ),
+                        pipeline_speedup=(
+                            round(pipeline_speedup, 3)
+                            if pipeline_speedup is not None else None
+                        ),
                         batch_mean=round(coalesce_batch_mean, 2),
+                        device_idle_ms=round(device_idle_ms, 2),
+                        window_ms=round(window_ms, 3),
+                        launch_overlaps=overlaps,
                     )
                 finally:
-                    serial_server.stop()
+                    if serial_server is not None:
+                        serial_server.stop()
+                    if coal_server is not None:
+                        coal_server.stop()
             finally:
                 conn.close()
                 server.stop()
@@ -1340,21 +1441,39 @@ def child_config(platform: str, config: str) -> None:
                     "delta_sync_bytes": len(warm_payload),
                     "score_top32_ms": round(score_ms, 1),
                     "score_build_ms": round(score.build_ms, 2),
-                    # coalesced-dispatch probe (ISSUE 5): aggregate
+                    # coalesced-dispatch probe (ISSUE 5/6): aggregate
                     # Score throughput of N concurrent clients vs the
-                    # serialized-lock baseline (max_batch=1), with the
-                    # mean batch occupancy the dispatcher achieved and
-                    # the client-observed latency quantiles
+                    # serialized-lock baseline (max_batch=1/depth=1)
+                    # and vs the ISSUE-5 depth-1 coalescer, with the
+                    # mean batch occupancy the dispatcher achieved,
+                    # the client-observed latency quantiles, and the
+                    # pipeline-health numbers (device idle while work
+                    # was queued ~ 0, the live adaptive window, and
+                    # how many launches overlapped an in-flight batch)
                     "concurrency": conc,
+                    # serialized-baseline sample size: < concurrency *
+                    # reps means score_serial_wall_ms was measured on
+                    # this many requests and extrapolated linearly
+                    # (cpu-only; the serial engine is one-at-a-time so
+                    # wall is linear in request count)
+                    "score_serial_sample": serial_n,
                     "coalesce_batch_mean": round(coalesce_batch_mean, 2),
                     "p50_score_ms": round(p50, 2),
                     "p99_score_ms": round(p99, 2),
                     "score_serial_wall_ms": round(wall_serial * 1000.0, 1),
+                    "score_depth1_wall_ms": round(wall_d1 * 1000.0, 1),
                     "score_coalesced_wall_ms": round(wall_coal * 1000.0, 1),
                     "score_concurrent_speedup": (
                         round(score_speedup, 3)
                         if score_speedup is not None else None
                     ),
+                    "score_pipeline_speedup": (
+                        round(pipeline_speedup, 3)
+                        if pipeline_speedup is not None else None
+                    ),
+                    "device_idle_ms": round(device_idle_ms, 2),
+                    "coalesce_window_ms": round(window_ms, 3),
+                    "launch_overlaps": overlaps,
                     # the warm-cycle stage breakdown a scraper of the
                     # daemon's /metrics histogram sees, artifact-side
                     "spans": {
@@ -1364,6 +1483,7 @@ def child_config(platform: str, config: str) -> None:
                         "cold_assign": round(cold_ms, 2),
                         "score_top32": round(score_ms, 2),
                         "score_storm_serial": round(wall_serial * 1000.0, 2),
+                        "score_storm_depth1": round(wall_d1 * 1000.0, 2),
                         "score_storm_coalesced": round(wall_coal * 1000.0, 2),
                     },
                 }

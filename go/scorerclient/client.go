@@ -7,6 +7,7 @@ import (
 	"net"
 	"strconv"
 	"strings"
+	"sync"
 )
 
 // Method bytes of the raw framing (bridge/udsserver.py).
@@ -25,10 +26,40 @@ const MaxFrame = 64 << 20
 //
 //	request: u8 method, u32 BE length, payload
 //	reply:   u8 status (0 ok, 1 error), u32 BE length, payload
+// The framing is strictly sequential per connection (no multiplexing),
+// so a Client serializes its calls under a mutex: two goroutines
+// sharing one Client would otherwise interleave frame bytes on the
+// wire.  For real concurrency — e.g. the scheduler framework's 16-wide
+// parallel Score fan-out — use a Pool (pool.go): N connections, one
+// in-flight call each, so a worker burst reaches the daemon's
+// coalescing dispatcher concurrently instead of queueing client-side.
 type Client struct {
+	mu   sync.Mutex
 	conn net.Conn
 	// SnapshotID of the last acknowledged Sync ("s<generation>").
+	// Guarded by idMu, NOT the call mutex: call() holds mu across the
+	// entire network round-trip, so a Pool fanning a Sync's acked id
+	// out to slots with Score/Assign traffic in flight would stall
+	// behind the slowest RPC if the id shared that lock.  Concurrent
+	// readers must go through snapshotID(); direct field access is
+	// only safe single-goroutine.
+	idMu       sync.Mutex
 	SnapshotID string
+}
+
+// snapshotID reads the last acknowledged id under idMu (Pool.Sync
+// writes it concurrently with Score/Assign callers).
+func (c *Client) snapshotID() string {
+	c.idMu.Lock()
+	defer c.idMu.Unlock()
+	return c.SnapshotID
+}
+
+// setSnapshotID records an acknowledged id under idMu.
+func (c *Client) setSnapshotID(id string) {
+	c.idMu.Lock()
+	c.SnapshotID = id
+	c.idMu.Unlock()
 }
 
 // Dial connects to the scorer's unix socket.
@@ -46,6 +77,8 @@ func NewClient(conn net.Conn) *Client { return &Client{conn: conn} }
 func (c *Client) Close() error { return c.conn.Close() }
 
 func (c *Client) call(method byte, payload []byte) ([]byte, error) {
+	c.mu.Lock()
+	defer c.mu.Unlock()
 	hdr := make([]byte, 5)
 	hdr[0] = method
 	binary.BigEndian.PutUint32(hdr[1:], uint32(len(payload)))
@@ -117,14 +150,14 @@ func (c *Client) Sync(req *SyncRequest) (*SyncReply, error) {
 	if err != nil {
 		return nil, err
 	}
-	c.SnapshotID = reply.SnapshotID
+	c.setSnapshotID(reply.SnapshotID)
 	return reply, nil
 }
 
 // ScoreFlat requests the flat top-k layout (scorer.proto FlatScores) —
 // the O(1)-assembly path on both ends.
 func (c *Client) ScoreFlat(topK int64) (*ScoreReply, error) {
-	req := ScoreRequest{SnapshotID: c.SnapshotID, TopK: topK, Flat: true}
+	req := ScoreRequest{SnapshotID: c.snapshotID(), TopK: topK, Flat: true}
 	body, err := c.call(MethodScore, req.Marshal())
 	if err != nil {
 		return nil, err
@@ -154,7 +187,7 @@ func (c *Client) Assign() (*AssignReply, error) {
 // a bad cycle found in plugin logs is directly addressable in the
 // sidecar's /metrics and --state-dir flight dumps.
 func (c *Client) AssignCycle(cycleID string) (*AssignReply, error) {
-	req := AssignRequest{SnapshotID: c.SnapshotID, CycleID: cycleID}
+	req := AssignRequest{SnapshotID: c.snapshotID(), CycleID: cycleID}
 	body, err := c.call(MethodAssign, req.Marshal())
 	if err != nil {
 		return nil, err

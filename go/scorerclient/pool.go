@@ -1,0 +1,116 @@
+package scorerclient
+
+// Pooled multi-connection dialing (ISSUE 6).
+//
+// The daemon's pipelined coalescing dispatcher turns a concurrent
+// Score burst into a handful of shared device launches — but only if
+// the burst actually ARRIVES concurrently.  A single Client serializes
+// its calls (the framing is sequential per connection), so the
+// scheduler framework's 16-wide parallel Score workers sharing one
+// Client would re-serialize client-side and the daemon would see a
+// trickle.  A Pool dials size independent connections and hands them
+// out round-robin: each worker's call runs on its own socket, the
+// daemon's accept loop spawns one handler thread per connection, and
+// the burst stacks into coalesced launches.
+//
+// Sync stays pinned to the first connection: delta frames are
+// order-sensitive against the last ACKED baseline, and one connection
+// preserves their wire order for free.  The acknowledged SnapshotID is
+// fanned out to every pooled client after each successful Sync so
+// Score/Assign on any slot pin the same snapshot.
+
+import (
+	"fmt"
+	"sync/atomic"
+)
+
+// DefaultPoolSize matches the reference scheduler's parallel Score
+// worker width (and the daemon's coalesce max_batch default): a full
+// worker burst gets a connection each and coalesces into one launch.
+const DefaultPoolSize = 16
+
+// Pool is a fixed-size set of Clients sharing one scorer socket.
+type Pool struct {
+	clients []*Client
+	rr      atomic.Uint64
+}
+
+// DialPool connects size clients to the scorer's unix socket.  On any
+// dial failure the already-opened connections are closed and the error
+// returned — a partially-dialed pool would silently halve the burst
+// width it exists to provide.
+func DialPool(socketPath string, size int) (*Pool, error) {
+	if size < 1 {
+		size = DefaultPoolSize
+	}
+	p := &Pool{clients: make([]*Client, 0, size)}
+	for i := 0; i < size; i++ {
+		c, err := Dial(socketPath)
+		if err != nil {
+			p.Close()
+			return nil, fmt.Errorf("pool dial %d/%d: %w", i+1, size, err)
+		}
+		p.clients = append(p.clients, c)
+	}
+	return p, nil
+}
+
+// NewPool wraps pre-built clients (test seam; mirrors NewClient).
+// At least one client is required: an empty pool has no connection for
+// Get/Sync to use (the zero-size case panics here, at construction,
+// instead of as a modulo-by-zero inside Get).
+func NewPool(clients ...*Client) *Pool {
+	if len(clients) == 0 {
+		panic("scorerclient: NewPool requires at least one client")
+	}
+	return &Pool{clients: clients}
+}
+
+// Size reports the number of pooled connections.
+func (p *Pool) Size() int { return len(p.clients) }
+
+// Get returns the next client round-robin.  Safe for concurrent use;
+// each returned Client still serializes its own calls, so at most
+// Size() RPCs are in flight at once.
+func (p *Pool) Get() *Client {
+	return p.clients[p.rr.Add(1)%uint64(len(p.clients))]
+}
+
+// Close closes every pooled connection, keeping the first error.
+func (p *Pool) Close() error {
+	var first error
+	for _, c := range p.clients {
+		if err := c.Close(); err != nil && first == nil {
+			first = err
+		}
+	}
+	return first
+}
+
+// Sync ships the snapshot on the pinned first connection and fans the
+// acknowledged SnapshotID out to every slot, so a Score/Assign issued
+// on any pooled connection names the snapshot this Sync certified.
+func (p *Pool) Sync(req *SyncRequest) (*SyncReply, error) {
+	reply, err := p.clients[0].Sync(req)
+	if err != nil {
+		return nil, err
+	}
+	for _, c := range p.clients[1:] {
+		c.setSnapshotID(reply.SnapshotID)
+	}
+	return reply, nil
+}
+
+// ScoreFlat runs on the next round-robin connection.
+func (p *Pool) ScoreFlat(topK int64) (*ScoreReply, error) {
+	return p.Get().ScoreFlat(topK)
+}
+
+// Assign runs on the next round-robin connection.
+func (p *Pool) Assign() (*AssignReply, error) { return p.Get().Assign() }
+
+// AssignCycle runs on the next round-robin connection under an
+// explicit correlation id (see Client.AssignCycle).
+func (p *Pool) AssignCycle(cycleID string) (*AssignReply, error) {
+	return p.Get().AssignCycle(cycleID)
+}

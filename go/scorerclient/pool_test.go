@@ -1,0 +1,74 @@
+package scorerclient
+
+import (
+	"net"
+	"testing"
+)
+
+func pipeClients(t *testing.T, n int) ([]*Client, []net.Conn) {
+	t.Helper()
+	clients := make([]*Client, n)
+	servers := make([]net.Conn, n)
+	for i := range clients {
+		cli, srv := net.Pipe()
+		clients[i] = NewClient(cli)
+		servers[i] = srv
+		t.Cleanup(func() { cli.Close(); srv.Close() })
+	}
+	return clients, servers
+}
+
+func TestNewPoolRequiresAtLeastOneClient(t *testing.T) {
+	defer func() {
+		if recover() == nil {
+			t.Fatal("NewPool() with zero clients must panic at construction")
+		}
+	}()
+	NewPool()
+}
+
+func TestPoolGetRoundRobinCoversEverySlot(t *testing.T) {
+	clients, _ := pipeClients(t, 3)
+	p := NewPool(clients...)
+	if p.Size() != 3 {
+		t.Fatalf("Size() = %d, want 3", p.Size())
+	}
+	seen := map[*Client]int{}
+	for i := 0; i < 2*len(clients); i++ {
+		seen[p.Get()]++
+	}
+	for i, c := range clients {
+		if seen[c] != 2 {
+			t.Fatalf("slot %d served %d of 6 Gets, want 2 (round-robin)",
+				i, seen[c])
+		}
+	}
+}
+
+// The pool's one subtle invariant: Sync runs on the pinned first
+// connection, and the acknowledged SnapshotID is fanned out to EVERY
+// slot — a Score/Assign issued on any pooled connection afterwards must
+// pin the snapshot this Sync certified.
+func TestPoolSyncFansAckedSnapshotIDToEverySlot(t *testing.T) {
+	e := loadExpected(t)
+	clients, servers := pipeClients(t, 3)
+	// only slot 0 may see the Sync frame; the other pipes have no
+	// server and would block forever if the pool misrouted it
+	go fakeServer(t, servers[0], [][3][]byte{
+		{{MethodSync}, load(t, "sync_request.bin"), load(t, "sync_reply.bin")},
+	})
+	p := NewPool(clients...)
+	reply, err := p.Sync(buildSyncRequest(e))
+	if err != nil {
+		t.Fatal(err)
+	}
+	if reply.SnapshotID != e.SyncReply.SnapshotID {
+		t.Fatalf("acked id %q, want %q", reply.SnapshotID, e.SyncReply.SnapshotID)
+	}
+	for i, c := range clients {
+		if got := c.snapshotID(); got != reply.SnapshotID {
+			t.Fatalf("slot %d snapshot id %q not fanned out (want %q)",
+				i, got, reply.SnapshotID)
+		}
+	}
+}

@@ -8,6 +8,8 @@ sparse deltas on warm cycles) and gets NodeScoreLists / assignments back.
 
 from __future__ import annotations
 
+import itertools
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -33,29 +35,77 @@ def parse_snapshot_id(snapshot_id: str) -> Tuple[str, int]:
         return epoch, -1
 
 
+class _ChannelPool:
+    """Round-robin pool of independent gRPC channels (ISSUE 6).
+
+    One grpc-python channel multiplexes every in-flight RPC onto ONE
+    HTTP/2 connection, so a 16–64-way Score worker burst serializes on
+    a single socket's flow control and wire ordering long before it
+    reaches the coalescer — the raw-UDS shims (one socket per worker)
+    never had this funnel.  Worse, gRPC core keeps a GLOBAL subchannel
+    pool: two channels to the same target with identical channel args
+    silently share one TCP/UDS connection, so naively creating N
+    channels buys nothing.  Each pool slot therefore carries a distinct
+    ``koord.pool_slot`` channel arg — distinct args key distinct
+    subchannels, giving the burst ``size`` real parallel connections.
+    Callers round-robin over ``channels`` themselves
+    (``ScorerClient._slot`` builds one stub per channel up front and
+    picks per call): cheap, and per-RPC affinity does not matter for
+    unary calls."""
+
+    def __init__(self, target: str, size: int):
+        self.channels = [
+            grpc.insecure_channel(target, options=(("koord.pool_slot", i),))
+            for i in range(max(1, int(size)))
+        ]
+
+    def close(self) -> None:
+        for ch in self.channels:
+            ch.close()
+
+
 class ScorerClient:
-    def __init__(self, target: str):
-        """``target``: "unix:///path.sock" or host:port."""
-        self._channel = grpc.insecure_channel(target)
-        self._sync = self._channel.unary_unary(
-            method_path("Sync"),
-            request_serializer=lambda m: m.SerializeToString(),
-            response_deserializer=pb2.SyncReply.FromString,
-        )
-        self._score = self._channel.unary_unary(
-            method_path("Score"),
-            request_serializer=lambda m: m.SerializeToString(),
-            response_deserializer=pb2.ScoreReply.FromString,
-        )
-        self._assign = self._channel.unary_unary(
-            method_path("Assign"),
-            request_serializer=lambda m: m.SerializeToString(),
-            response_deserializer=pb2.AssignReply.FromString,
-        )
+    def __init__(self, target: str, channels: int = 1):
+        """``target``: "unix:///path.sock" or host:port.
+
+        ``channels``: size of the connection pool Score/Assign calls
+        round-robin over (default 1 keeps the single-channel behavior).
+        Size it to the caller's worker parallelism (the reference
+        scheduler runs 16 Score workers) so a burst reaches the
+        coalescer concurrently instead of serializing on one HTTP/2
+        connection.  Sync stays PINNED to the first channel: delta
+        frames are order-sensitive against the acked baseline, and one
+        connection preserves their wire order for free."""
+        self._pool = _ChannelPool(target, channels)
+        self._channel = self._pool.channels[0]  # Sync's pinned channel
+
+        def unary(channel, method, reply_cls):
+            return channel.unary_unary(
+                method_path(method),
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=reply_cls.FromString,
+            )
+
+        self._sync = unary(self._channel, "Sync", pb2.SyncReply)
+        self._scores = [
+            unary(ch, "Score", pb2.ScoreReply) for ch in self._pool.channels
+        ]
+        self._assigns = [
+            unary(ch, "Assign", pb2.AssignReply)
+            for ch in self._pool.channels
+        ]
+        self._rr = itertools.count()
+        self._rr_lock = threading.Lock()
         # previous-ACKED-sync mirrors (tensor + scalar columns) for delta
         # encoding and full re-sync.  New values are staged per request and
         # promoted only after the server confirms the Sync, so a failed RPC
-        # can never desync the delta baseline.
+        # can never desync the delta baseline.  _baseline_lock makes the
+        # whole sync() read-encode-promote sequence atomic against
+        # _invalidate() running on a pooled worker thread (a concurrent
+        # Score's FAILED_PRECONDITION): an unlocked clear mid-sync would
+        # both corrupt the delta encode and null _generation, silently
+        # disabling the displaced-baseline continuity check.
+        self._baseline_lock = threading.RLock()
         self._prev: Dict[str, np.ndarray] = {}
         self._prev_scalars: Dict[str, tuple] = {}
         self._generation: Optional[int] = None
@@ -63,14 +113,19 @@ class ScorerClient:
         self.snapshot_id: Optional[str] = None
 
     def close(self) -> None:
-        self._channel.close()
+        self._pool.close()
+
+    def _slot(self) -> int:
+        with self._rr_lock:
+            return next(self._rr) % len(self._scores)
 
     def _invalidate(self) -> None:
-        self._prev.clear()
-        self._prev_scalars.clear()
-        self._generation = None
-        self._epoch = None
-        self.snapshot_id = None
+        with self._baseline_lock:
+            self._prev.clear()
+            self._prev_scalars.clear()
+            self._generation = None
+            self._epoch = None
+            self.snapshot_id = None
 
     def sync(
         self,
@@ -171,49 +226,56 @@ class ScorerClient:
             req.quotas.limited.CopyFrom(tensor("qlim"))
             return req
 
-        baseline = self._prev
-        sent_full = False
-        try:
-            reply = self._sync(build(baseline, full=False))
-        except grpc.RpcError:
-            if not baseline:
-                # nothing was delta-encoded; the failure is not recoverable
-                # by resending full state
-                self._invalidate()
-                raise
-            # a restarted sidecar lost its resident tensors and refused the
-            # delta frame — recoverable within the same cycle with one full
-            # re-sync (ADVICE r5); a second failure is surfaced
+        # the lock is held across the RPCs: a pooled Score thread's
+        # _invalidate (FAILED_PRECONDITION on displacement) must not
+        # clear the dict build() is delta-encoding from, nor null
+        # _generation between the reply and the continuity check below
+        # — it waits, then wipes the fresh baseline, and the NEXT sync
+        # ships full state (a re-encode, never silent corruption)
+        with self._baseline_lock:
+            baseline = self._prev
+            sent_full = False
             try:
-                reply = self._sync(build(baseline, full=True))
-                sent_full = True
+                reply = self._sync(build(baseline, full=False))
             except grpc.RpcError:
-                self._invalidate()
-                raise
-        epoch, gen = parse_snapshot_id(reply.snapshot_id)
-        if self._generation is not None and not sent_full and (
-            epoch != self._epoch or gen != self._generation + 1
-        ):
-            # another client synced in between, or the server restarted
-            # (fresh epoch — the bare generation can coincidentally line
-            # up after a restart, so the epoch check is load-bearing):
-            # our deltas were applied onto a base we never saw.  Re-sync
-            # full tensors — from the pre-clear baseline, so fields
-            # omitted this cycle still resend their last acked state.
-            try:
-                reply = self._sync(build(baseline, full=True))
-            except grpc.RpcError:
-                # the server may have applied the full sync before failing;
-                # treat the baseline as unknown
-                self._invalidate()
-                raise
+                if not baseline:
+                    # nothing was delta-encoded; the failure is not
+                    # recoverable by resending full state
+                    self._invalidate()
+                    raise
+                # a restarted sidecar lost its resident tensors and refused
+                # the delta frame — recoverable within the same cycle with
+                # one full re-sync (ADVICE r5); a second failure is surfaced
+                try:
+                    reply = self._sync(build(baseline, full=True))
+                    sent_full = True
+                except grpc.RpcError:
+                    self._invalidate()
+                    raise
             epoch, gen = parse_snapshot_id(reply.snapshot_id)
-        self._prev = dict(baseline, **staged)
-        self._prev_scalars.update(staged_scalars)
-        self._generation = gen
-        self._epoch = epoch
-        self.snapshot_id = reply.snapshot_id
-        return reply
+            if self._generation is not None and not sent_full and (
+                epoch != self._epoch or gen != self._generation + 1
+            ):
+                # another client synced in between, or the server restarted
+                # (fresh epoch — the bare generation can coincidentally line
+                # up after a restart, so the epoch check is load-bearing):
+                # our deltas were applied onto a base we never saw.  Re-sync
+                # full tensors — from the pre-clear baseline, so fields
+                # omitted this cycle still resend their last acked state.
+                try:
+                    reply = self._sync(build(baseline, full=True))
+                except grpc.RpcError:
+                    # the server may have applied the full sync before
+                    # failing; treat the baseline as unknown
+                    self._invalidate()
+                    raise
+                epoch, gen = parse_snapshot_id(reply.snapshot_id)
+            self._prev = dict(baseline, **staged)
+            self._prev_scalars.update(staged_scalars)
+            self._generation = gen
+            self._epoch = epoch
+            self.snapshot_id = reply.snapshot_id
+            return reply
 
     # -- score / assign --
     def _call(self, stub, request):
@@ -229,7 +291,7 @@ class ScorerClient:
 
     def score(self, top_k: int = 0) -> List[List[Tuple[int, int]]]:
         reply = self._call(
-            self._score,
+            self._scores[self._slot()],
             pb2.ScoreRequest(snapshot_id=self.snapshot_id or "", top_k=top_k),
         )
         return [
@@ -244,7 +306,7 @@ class ScorerClient:
         assembly path on both ends (round-3 review #8).  Entry group g
         (pod pod_index[g]) covers counts[g] consecutive entries."""
         reply = self._call(
-            self._score,
+            self._scores[self._slot()],
             pb2.ScoreRequest(
                 snapshot_id=self.snapshot_id or "", top_k=top_k, flat=True
             ),
@@ -272,7 +334,8 @@ class ScorerClient:
         alarm on a degraded-path cycle instead of discovering it in a
         latency graph."""
         reply = self._call(
-            self._assign, pb2.AssignRequest(snapshot_id=self.snapshot_id or "")
+            self._assigns[self._slot()],
+            pb2.AssignRequest(snapshot_id=self.snapshot_id or ""),
         )
         return (
             np.asarray(reply.assignment, np.int32),

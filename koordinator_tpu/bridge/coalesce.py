@@ -1,36 +1,67 @@
-"""Coalescing device dispatch for the BatchedScorer bridge (ISSUE 5).
+"""Pipelined coalescing device dispatch for the BatchedScorer bridge.
 
-The daemon used to serialize every RPC under one servicer lock: the Go
-scheduler's 16 parallel Score workers arrive over thread-per-connection
-transports and then queued single-file, each paying its own device
-launch and its own blocking readback.  This module is the continuous-
-batching shape from inference serving applied to that seam: concurrent
-Score requests that arrive while the device is busy (or within a small
-gather window) are stacked into ONE batched launch against the resident
-snapshot, and the replies are demultiplexed per caller.
+ISSUE 5 built the coalescer: concurrent Score requests that arrive
+while the device is busy (or within a small gather window) stack into
+ONE batched launch against the resident snapshot, and the replies are
+demultiplexed per caller.  But the engine still ran strictly one launch
+at a time — the batch leader held the device critical section across
+its *blocking* stacked readback, so the device sat idle for the entire
+host-side ``device_get`` + demux of batch k before batch k+1 could
+launch.
 
-The dispatcher is deliberately generic — it owns the queueing, the
-device critical section, and per-request result/error routing, while the
-*meaning* of a batch (the padded ``top_k`` launch, the single stacked
-readback, the telemetry) stays in ``bridge/server.py`` where the
-snapshot lives.  That split keeps this file unit-testable with a fake
-executor (tests/test_coalesce.py) and keeps the servicer free to change
-its device programs without touching the concurrency machinery.
+ISSUE 6 rebuilds the device section as a **double-buffered pipeline**.
+The critical section now covers only the *launch* (snapshot capture +
+async device dispatch — JAX returns as soon as the program is enqueued);
+the blocking readback and the numpy demux run OFF the launch lock, so
+the next leader launches batch k+1 while batch k's transfer is still in
+flight.  A depth-``DEFAULT_DEPTH`` in-flight bound keeps memory
+predictable (and ``depth=1`` reproduces the ISSUE-5 serial-readback
+engine for baselines).
 
-Concurrency contract (the lock order is device -> state, never state ->
-device while holding state):
+Executor protocol (the two-phase seam):
+
+* ``launch_batch(entries)`` runs with the **launch lock held** and must
+  only capture state and dispatch device work — never block on a
+  device->host transfer (the ``lock-held-dispatch`` koordlint rule
+  rejects blocking calls inside ``@launch_section`` functions
+  statically).  It either finalizes entries in place (sets ``reply`` /
+  ``error`` and returns ``None`` — the degenerate no-device path, e.g.
+  every entry stale) or returns a **readback closure**.
+* the readback closure runs with the launch lock *released*; it blocks
+  on the stacked transfer, fills each entry's ``reply``/``error``, and
+  may return a post-batch hook the leader runs after followers are
+  notified (host bookkeeping must not extend any critical path).
+
+Concurrency contract (lock order is launch -> state, never state ->
+launch while holding state):
 
 * ``submit()`` enqueues and then either *leads* (first thread to take
-  the device lock drains up to ``max_batch`` entries and executes them)
-  or *follows* (waits for a leader to publish its result).  FIFO: a
-  batch is always a prefix of the queue.
-* ``run_exclusive(fn)`` runs a non-coalescible device section (Assign's
-  cycle launch+readback, Sync's donating delta scatter) under the same
-  device lock, so a donation can never invalidate a buffer a coalesced
-  Score batch captured but has not yet read back.
-* Queue delay and batch occupancy per entry are stamped by the leader;
-  the executor forwards them to the ``koord_scorer_coalesce_*`` metric
-  families (obs/scorer_metrics.py).
+  the launch lock with pipeline headroom drains up to ``max_batch``
+  entries, launches them, then drains its own batch's readback off the
+  lock) or *follows* (waits for a leader to publish its slot).  FIFO: a
+  batch is always a prefix of the queue.  Every state transition
+  (launch-lock release, readback completion, enqueue) notifies the
+  shared condition — followers never poll.
+* ``run_pipelined(fn)`` runs a non-coalescible launch (Assign's cycle)
+  through the same pipeline: ``fn`` executes under the launch lock and
+  returns a readback closure that runs outside it.
+* ``run_exclusive(fn, drain=True)`` is the **donation barrier**: a
+  warm Sync's delta scatter donates the pre-delta resident buffers, so
+  it must not run while any launched-but-unread batch could still be
+  holding python references that a deletion would invalidate.  With
+  ``drain`` the section waits for the in-flight count to reach zero
+  before running ``fn`` (launch lock held throughout, so nothing new
+  launches).  Non-donating commits pass ``drain=False`` and keep the
+  pipeline flowing.
+
+The **gather window** is adaptive by default (ISSUE 6): instead of the
+hand-tuned static ``gather_window_s``, :class:`AdaptiveGatherWindow`
+tracks an EWMA of observed inter-arrival gaps (the same quantity the
+``koord_scorer_coalesce_queue_delay_ms`` samples measure per entry) and
+derives the wait from it — ``min(cap, ewma_gap * (max_batch - 1))``,
+zero when traffic is too sparse for waiting to fill a batch.  A leader
+only gather-waits when the pipeline is *empty*: with a batch already in
+flight, launching immediately is free (the device is busy anyway).
 """
 
 from __future__ import annotations
@@ -43,6 +74,21 @@ from typing import Callable, List, Optional
 # scheduler dispatches 16 parallel Score workers, so a full worker burst
 # coalesces into a single device program.
 DEFAULT_MAX_BATCH = 16
+
+# Launched-but-unread batches allowed at once.  Two is the double
+# buffer: launch k+1 overlaps readback k; deeper queues buy nothing
+# once the device is saturated and multiply in-flight result memory.
+DEFAULT_DEPTH = 2
+
+
+def launch_section(fn):
+    """Marker for functions that run under the dispatcher's launch
+    lock.  Identity at runtime; koordlint's ``lock-held-dispatch`` rule
+    rejects blocking device->host transfers (``jax.device_get``,
+    ``.block_until_ready()``, ``np.asarray``, ``.item()``) inside any
+    function carrying this decorator — only the readback closure (a
+    nested def, exempt) may block."""
+    return fn
 
 
 class SnapshotNotResident(ValueError):
@@ -71,48 +117,129 @@ class PendingRequest:
         self.batch_size = 0
 
 
-class CoalescingDispatcher:
-    """Queue + device critical section + per-caller demux.
+class StaticGatherWindow:
+    """The ISSUE-5 knob: a fixed straggler wait (0 = never wait)."""
 
-    ``execute_batch(entries)`` runs with the device lock held and must
-    set ``entry.reply`` or ``entry.error`` for every entry it accepts;
-    an exception it raises becomes the error of every entry still
-    unfilled.  It may return a callable: a post-batch hook the leader
-    runs AFTER the device lock is released and followers are notified —
-    host-side bookkeeping (telemetry) must not extend the device
-    critical section every queued launch waits on; a hook failure is
-    logged, never surfaced to callers whose replies already succeeded.
-    ``max_batch=1`` degenerates to the pre-coalescing serialized
-    behavior (every request pays its own launch) — the bench uses that
-    as the speedup baseline.
+    def __init__(self, seconds: float = 0.0):
+        self._seconds = max(0.0, float(seconds))
+
+    def observe_arrival(self, now_s: float) -> None:
+        pass
+
+    def window_s(self, max_batch: int) -> float:
+        return self._seconds if max_batch > 1 else 0.0
+
+
+class AdaptiveGatherWindow:
+    """Gather window derived from the observed inter-arrival rate.
+
+    ``observe_arrival`` feeds an EWMA of the gap between consecutive
+    submits (callers hold the dispatcher's condition, so no lock here).
+    The window is::
+
+        0                                  while no gap was observed yet
+        0                                  if ewma_gap >= lone_cutoff_ms
+        min(cap_ms, ewma_gap*(max_batch-1))  otherwise
+
+    Rationale: if requests arrive every ``g`` ms, an idle-device leader
+    that waits ``g*(max_batch-1)`` gathers a full batch; the cap bounds
+    the latency tax, and the lone cutoff turns the window off entirely
+    when traffic is so sparse that a cap-length wait could not gather
+    even one extra request (``lone_cutoff_ms`` defaults to ``cap_ms``:
+    past it, cap/gap < 1).  Burst trains therefore converge onto wide
+    batches while lone requests keep serial latency.
+    """
+
+    def __init__(self, alpha: float = 0.2, cap_ms: float = 5.0,
+                 lone_cutoff_ms: Optional[float] = None):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = float(alpha)
+        self.cap_ms = max(0.0, float(cap_ms))
+        self.lone_cutoff_ms = (
+            self.cap_ms if lone_cutoff_ms is None else float(lone_cutoff_ms)
+        )
+        self._last_arrival_s: Optional[float] = None
+        self._ewma_gap_ms: Optional[float] = None
+
+    def observe_arrival(self, now_s: float) -> None:
+        last = self._last_arrival_s
+        self._last_arrival_s = now_s
+        if last is None:
+            return
+        gap_ms = max(0.0, (now_s - last) * 1000.0)
+        if self._ewma_gap_ms is None:
+            self._ewma_gap_ms = gap_ms
+        else:
+            self._ewma_gap_ms = (
+                self.alpha * gap_ms + (1.0 - self.alpha) * self._ewma_gap_ms
+            )
+
+    def window_s(self, max_batch: int) -> float:
+        if (
+            max_batch <= 1
+            or self._ewma_gap_ms is None
+            or self._ewma_gap_ms >= self.lone_cutoff_ms
+        ):
+            return 0.0
+        return min(self.cap_ms, self._ewma_gap_ms * (max_batch - 1)) / 1000.0
+
+
+class CoalescingDispatcher:
+    """Queue + pipelined launch section + per-caller demux.
+
+    ``max_batch=1, depth=1`` degenerates to the pre-coalescing
+    serialized behavior (every request pays its own launch and its own
+    blocking readback) — the bench uses that as the speedup baseline,
+    and ``depth=1`` alone reproduces the ISSUE-5 coalescer (shared
+    launches, serial readbacks).
     """
 
     def __init__(
         self,
-        execute_batch: Callable[[List[PendingRequest]], None],
+        launch_batch: Callable[[List[PendingRequest]], Optional[Callable]],
         max_batch: int = DEFAULT_MAX_BATCH,
         gather_window_s: float = 0.0,
+        window=None,
+        depth: int = DEFAULT_DEPTH,
         clock=time.perf_counter,
         sleep=time.sleep,
     ):
-        self._execute_batch = execute_batch
+        self._launch_batch = launch_batch
         self.max_batch = max(1, int(max_batch))
-        # > 0: a leader that finds the device idle waits this long for
-        # stragglers before launching (trades a little lone-request
-        # latency for occupancy under bursty clients).  The default 0
-        # keeps serial latency untouched — "arrived while the device is
-        # busy" is what forms batches under real concurrency.
-        self.gather_window_s = max(0.0, float(gather_window_s))
+        self.depth = max(1, int(depth))
+        # ``window`` (a *GatherWindow object) wins; the float keeps the
+        # ISSUE-5 signature for static callers (tests, bench baselines)
+        self.window = (
+            window if window is not None
+            else StaticGatherWindow(gather_window_s)
+        )
         self._clock = clock
         self._sleep = sleep
-        self._device = threading.Lock()
+        # the launch critical section: snapshot capture + async device
+        # dispatch only — blocking readbacks run off it (lock-held-
+        # dispatch rejects them inside @launch_section code statically)
+        self._launch_lock = threading.Lock()
+        # one condition guards the queue, the in-flight count, entry
+        # ``done`` flips and the lifetime stats; EVERY transition
+        # notifies it, so followers wait, never poll
         self._cond = threading.Condition()
         self._queue: List[PendingRequest] = []
+        self._inflight = 0
+        # device-idle bookkeeping: wall time where work was queued but
+        # nothing was in flight (the quantity the pipeline exists to
+        # drive to ~0; the bench publishes it as ``device_idle_ms``)
+        self._idle_since: Optional[float] = None
+        self._launched_once = False
+        self.device_idle_s = 0.0
         # lifetime stats (under _cond): the bench's coalesce_batch_mean
         # and the parity tests read these
         self.batches = 0
         self.requests = 0
         self.max_occupancy = 0
+        # launches that entered the device section while a previous
+        # batch was still in flight — the pipeline actually pipelining
+        self.launch_overlaps = 0
 
     # -- public API --
     def submit(self, req) -> PendingRequest:
@@ -121,61 +248,101 @@ class CoalescingDispatcher:
         (or the batch as a whole) failed."""
         entry = PendingRequest(req, self._clock())
         with self._cond:
+            self.window.observe_arrival(entry.enqueued_at)
             self._queue.append(entry)
+            if self._inflight == 0 and self._idle_since is None:
+                self._idle_since = entry.enqueued_at
+            # an idle leader may be parked: work just arrived
+            self._cond.notify_all()
         while True:
-            if self._device.acquire(blocking=False):
-                hook = None
-                try:
-                    if not entry.done:
-                        hook = self._lead()
-                finally:
-                    self._device.release()
+            if self._try_lead() is None:
                 with self._cond:
-                    if self._queue:
-                        self._cond.notify_all()
-                if hook is not None:
-                    try:
-                        hook()
-                    except Exception:  # koordlint: disable=broad-except(post-batch bookkeeping must not fail callers whose replies already succeeded)
-                        import logging
-
-                        logging.getLogger(__name__).exception(
-                            "post-batch hook failed"
-                        )
-                if entry.done:
-                    break
-                continue  # batch cap left us queued: lead the next one
-            with self._cond:
-                # ``done`` flips under this condition, so the check and
-                # the wait cannot race a leader's notify.  Device holders
-                # notify under this condition only AFTER releasing, so
-                # checking the device here closes the other wakeup race:
-                # a release landing between our failed acquire above and
-                # this block shows as an unlocked device — retry leading
-                # immediately instead of sleeping a poll interval while
-                # the device sits idle.
-                if entry.done:
-                    break
-                if self._device.locked():
-                    self._cond.wait(timeout=0.05)
+                    if entry.done:
+                        break
+                    if not self._can_lead_locked():
+                        # Not a poll: every launch-lock release, readback
+                        # completion and enqueue notifies this condition
+                        # after flipping the state it guards, so the
+                        # wakeup cannot be missed.  The timeout is a
+                        # deadlock backstop only (a lost notify is a bug
+                        # this recovers from at 1 Hz, not a latency tax
+                        # on the hot path).
+                        self._cond.wait(timeout=1.0)
             if entry.done:
                 break
         if entry.error is not None:
             raise entry.error
         return entry
 
-    def run_exclusive(self, fn):
-        """Run a non-coalescible device section (Assign cycle, Sync's
-        donating scatter) under the device-dispatch lock, then wake any
-        Score waiters that queued behind it."""
-        self._device.acquire()
+    def run_pipelined(self, launch_fn: Callable[[], Callable]):
+        """Run a non-coalescible device section through the pipeline:
+        ``launch_fn`` executes under the launch lock (with pipeline
+        headroom reserved) and returns a readback closure; the closure
+        runs with the lock released — so a coalesced Score batch can
+        launch while this section's transfer is still in flight — and
+        its return value is ``run_pipelined``'s."""
+        self._launch_lock.acquire()
+        launched = False
         try:
+            with self._cond:
+                # decrements come from readback threads only, so this
+                # wait cannot race another launcher (we hold the lock)
+                while self._inflight >= self.depth:
+                    self._cond.wait(timeout=1.0)
+                launch_at = self._clock()
+            readback = launch_fn()
+            with self._cond:
+                # accounted only now: a launch_fn that raised (e.g. a
+                # displaced Assign's generation re-check) put nothing
+                # on the device, so the idle gap must stay open and no
+                # overlap may be counted
+                self._note_launch_locked(launch_at)
+                self._inflight += 1
+                launched = True
+        finally:
+            self._launch_lock.release()
+            with self._cond:
+                self._cond.notify_all()
+        try:
+            return readback()
+        finally:
+            if launched:
+                with self._cond:
+                    self._dec_inflight_locked()
+                    self._cond.notify_all()
+
+    def run_exclusive(self, fn, drain=True):
+        """Run a device section that must not overlap in-flight batches.
+
+        With ``drain`` (the default — required for anything that
+        DONATES resident buffers, e.g. a warm Sync's delta scatter) the
+        section waits for every launched batch's readback to complete
+        before running ``fn``; the launch lock is held throughout, so
+        nothing launches concurrently either.  ``drain=False`` skips
+        the barrier for sections that only need launch-ordering (a
+        cold commit that drops residency: in-flight batches hold their
+        own snapshot references, and deletion without donation cannot
+        invalidate them).
+
+        ``drain`` may also be a zero-arg callable, evaluated AFTER the
+        launch lock is acquired: a drain decision that depends on
+        launch-mutable state (e.g. whether the resident snapshot is
+        warm — a concurrent Score's launch section can lazily
+        cold-rebuild it) must be made where that state can no longer
+        move, not at the call site."""
+        self._launch_lock.acquire()
+        try:
+            if callable(drain):
+                drain = drain()
+            if drain:
+                with self._cond:
+                    while self._inflight > 0:
+                        self._cond.wait(timeout=1.0)
             return fn()
         finally:
-            self._device.release()
+            self._launch_lock.release()
             with self._cond:
-                if self._queue:
-                    self._cond.notify_all()
+                self._cond.notify_all()
 
     def stats(self) -> dict:
         with self._cond:
@@ -186,44 +353,149 @@ class CoalescingDispatcher:
                 "batch_mean": (
                     self.requests / self.batches if self.batches else 0.0
                 ),
+                "inflight": self._inflight,
+                "depth": self.depth,
+                "launch_overlaps": self.launch_overlaps,
+                "device_idle_ms": round(self.device_idle_s * 1000.0, 3),
+                "window_ms": round(
+                    self.window.window_s(self.max_batch) * 1000.0, 3
+                ),
             }
 
-    # -- leader path (device lock held); returns the executor's
-    #    post-batch hook (run by submit() after the lock drops) --
-    def _lead(self):
-        if self.gather_window_s > 0.0:
-            deadline = self._clock() + self.gather_window_s
-            while True:
-                with self._cond:
-                    n = len(self._queue)
-                if n >= self.max_batch:
-                    break
-                left = deadline - self._clock()
-                if left <= 0.0:
-                    break
-                self._sleep(min(left, 0.0005))
+    # -- leader path --
+    def _can_lead_locked(self) -> bool:
+        return (
+            bool(self._queue)
+            and self._inflight < self.depth
+            and not self._launch_lock.locked()
+        )
+
+    def _try_lead(self):
+        """Attempt to lead one batch end to end: launch under the lock,
+        read back off it.  Returns the batch led, or None if leading was
+        not possible (lock held, pipeline full, or empty queue)."""
         with self._cond:
-            batch = self._queue[: self.max_batch]
-            del self._queue[: self.max_batch]
+            if not self._queue or self._inflight >= self.depth:
+                return None
+        if not self._launch_lock.acquire(blocking=False):
+            return None
+        batch: List[PendingRequest] = []
+        readback = None
+        launched = False
+        try:
+            with self._cond:
+                headroom = self._inflight < self.depth
+            if headroom:
+                batch, readback, launched = self._launch_locked()
+        finally:
+            self._launch_lock.release()
+            with self._cond:
+                self._cond.notify_all()
         if not batch:
             return None
-        now = self._clock()
-        for entry in batch:
-            entry.queue_delay_ms = (now - entry.enqueued_at) * 1000.0
-            entry.batch_size = len(batch)
-        hook = None
+        if readback is not None:
+            hook = None
+            try:
+                try:
+                    hook = readback()
+                except BaseException as exc:
+                    # a whole-readback failure is every unfilled caller's
+                    # failure; per-entry errors the executor routed stay.
+                    # BaseException too: a KeyboardInterrupt delivered
+                    # mid-device_get must not leak the in-flight slot
+                    # (finally below) or strand followers un-notified —
+                    # two leaks and the depth is gone, deadlocking every
+                    # submit() and run_exclusive(drain=True) forever
+                    for e in batch:
+                        if e.reply is None and e.error is None:
+                            e.error = exc
+                    if not isinstance(exc, Exception):
+                        raise
+            finally:
+                self._finalize(batch, launched=launched)
+            self._run_hook(hook)
+        return batch
+
+    def _launch_locked(self):
+        """Launch phase (launch lock held).  Drains a FIFO prefix, runs
+        the executor's launch half, and accounts the in-flight slot.
+        Returns ``(batch, readback, launched)``; entries are finalized
+        here only when there is nothing to read back."""
+        if self.window.window_s(self.max_batch) > 0.0:
+            self._gather_stragglers()
+        with self._cond:
+            batch = self._queue[: self.max_batch]
+            del self._queue[: len(batch)]
+            if not batch:
+                return [], None, False
+            now = self._clock()
+            for entry in batch:
+                entry.queue_delay_ms = (now - entry.enqueued_at) * 1000.0
+                entry.batch_size = len(batch)
+        readback = None
         try:
-            hook = self._execute_batch(batch)
+            readback = self._launch_batch(batch)
         except Exception as exc:
-            # a whole-batch failure is every unfilled caller's failure;
-            # per-entry errors the executor already routed stay theirs
             for entry in batch:
                 if entry.reply is None and entry.error is None:
                     entry.error = exc
+        if readback is None:
+            # no device work in flight: the executor finalized (or
+            # rejected) every entry during the launch phase — nothing
+            # launched, so the device-idle gap stays open and no
+            # overlap is counted
+            self._finalize(batch, launched=False)
+            return batch, None, False
+        with self._cond:
+            self._note_launch_locked(now)
+            self._inflight += 1
+        return batch, readback, True
+
+    def _gather_stragglers(self) -> None:
+        """Idle-pipeline straggler wait (launch lock held).  Only worth
+        paying when nothing is in flight: with a batch already on the
+        device, launching immediately costs no idle time, and waiting
+        would."""
+        with self._cond:
+            if self._inflight > 0:
+                return
+            deadline = self._clock() + self.window.window_s(self.max_batch)
+        while True:
+            with self._cond:
+                if len(self._queue) >= self.max_batch or self._inflight > 0:
+                    return
+                left = deadline - self._clock()
+            if left <= 0.0:
+                return
+            self._sleep(min(left, 0.0005))
+
+    def _note_launch_locked(self, launch_at: float) -> None:
+        """Account a successful launch that began at ``launch_at``
+        (_cond held): close any open device-idle gap and count
+        pipelined overlaps.  Called only after the executor's launch
+        half returned — a launch that raised put nothing on the device,
+        so the idle gap stays open and no overlap is counted."""
+        if self._idle_since is not None:
+            if self._launched_once:
+                self.device_idle_s += max(0.0, launch_at - self._idle_since)
+            self._idle_since = None
+        if self._inflight > 0:
+            self.launch_overlaps += 1
+        self._launched_once = True
+
+    def _dec_inflight_locked(self) -> None:
+        self._inflight -= 1
+        if self._inflight == 0:
+            self._idle_since = self._clock() if self._queue else None
+
+    def _finalize(self, batch: List[PendingRequest], launched: bool) -> None:
+        """Publish a batch's results: lifetime stats, ``done`` flips and
+        the wakeup, all under the condition.  Runs off the launch lock —
+        followers and the next leader proceed immediately."""
         with self._cond:
             # count only entries the executor ACCEPTED (reply set, no
             # error): rejected entries (stale snapshot) and failed
-            # batches performed no device launch, and the stats here
+            # batches performed no useful launch, and the stats here
             # must agree with the koord_scorer_coalesce_* counters,
             # which are fed per accepted request
             n_ok = sum(1 for entry in batch if entry.error is None)
@@ -233,5 +505,17 @@ class CoalescingDispatcher:
                 self.max_occupancy = max(self.max_occupancy, n_ok)
             for entry in batch:
                 entry.done = True
+            if launched:
+                self._dec_inflight_locked()
             self._cond.notify_all()
-        return hook if callable(hook) else None
+
+    @staticmethod
+    def _run_hook(hook) -> None:
+        if not callable(hook):
+            return
+        try:
+            hook()
+        except Exception:  # koordlint: disable=broad-except(post-batch bookkeeping must not fail callers whose replies already succeeded)
+            import logging
+
+            logging.getLogger(__name__).exception("post-batch hook failed")

@@ -6,29 +6,35 @@ the device, callers talk UDS.  Score/Assign run the same device programs
 as the in-process API (solver.run_cycle / solver.score_cycle), so bridge
 clients get identical placements to embedded users.
 
-Concurrency (ISSUE 5 — the coalescing dispatch engine; docs/PIPELINE.md
-has the full picture).  The pre-PR daemon held ONE lock across every
-RPC body, so the Go scheduler's 16 parallel Score workers queued
-single-file, each paying its own device launch and blocking readback.
-That lock is now split three ways:
+Concurrency (ISSUE 5 coalescing + ISSUE 6 pipelining; docs/PIPELINE.md
+has the full picture).  The pre-PR-5 daemon held ONE lock across every
+RPC body; PR 5 split it three ways and coalesced concurrent Scores into
+shared batched launches; PR 6 made the device section a depth-2
+pipeline:
 
 * ``_sync_lock`` serializes Sync RPCs and pins the mirror baseline for
   the protobuf->numpy decode — which runs OUTSIDE the device critical
   section, so decode of Sync k+1 overlaps the (async) on-device delta
   scatter of cycle k (a depth-2 decode/scatter pipeline).
-* ``_state_lock`` guards the resident mirrors, the generation counter
-  and telemetry sequencing.  It is never held across a device dispatch
-  or a blocking readback (koordlint's ``lock-held-dispatch`` rule
-  rejects that statically).
-* the **device-dispatch queue** (bridge/coalesce.py): Score requests
-  that arrive while the device is busy (or within a small gather
-  window) coalesce into one padded batched launch — ``top_k`` padded to
-  the sticky power-of-two bucket so coalescing introduces zero jit
-  cache misses on the warm path — with ONE stacked readback per launch
-  and replies demultiplexed per caller.  Assign's cycle and Sync's
-  donating delta scatter ride the same queue via ``run_exclusive`` so
-  a donation can never invalidate a buffer a captured batch has not
-  read back.
+* ``_state_lock`` guards the resident mirrors, the generation counter,
+  the Assign result memo and telemetry sequencing.  It is never held
+  across a device dispatch or a blocking readback (koordlint's
+  ``lock-held-dispatch`` rule rejects that statically).
+* the **pipelined dispatch queue** (bridge/coalesce.py): the launch
+  critical section covers only snapshot capture + async device
+  dispatch; the blocking stacked readback and the numpy demux run OFF
+  the launch lock, so batch k+1 launches while batch k's transfer is
+  still in flight (double buffering — the device never idles between
+  coalesced launches).  A warm Sync's donating delta scatter drains
+  the pipeline first (``run_exclusive(drain=True)``) so a donation can
+  never invalidate a buffer an in-flight batch has not read back;
+  non-donating commits keep the pipeline flowing.
+
+Concurrent Assigns against the SAME resident snapshot re-ran identical
+device cycles under PR 5; they are now served from a result memo keyed
+on (snapshot id, CycleConfig), invalidated atomically with every
+generation bump — one cycle runs, its certified result fans out, and
+the replies are bit-identical to serial execution (timing fields aside).
 
 The wire contract is untouched: replies are byte-identical to the
 serialized daemon's, only the internal concurrency changed.
@@ -51,15 +57,40 @@ from jax import lax
 
 from koordinator_tpu.bridge.codegen import SERVICE, pb2
 from koordinator_tpu.bridge.coalesce import (
+    AdaptiveGatherWindow,
     CoalescingDispatcher,
+    DEFAULT_DEPTH,
     PendingRequest,
     SnapshotNotResident,
+    launch_section,
 )
 from koordinator_tpu.bridge.state import ResidentState
 from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG
 from koordinator_tpu.model.snapshot import pad_bucket
 from koordinator_tpu.obs import CycleTelemetry
 from koordinator_tpu.solver import run_cycle, score_cycle
+
+
+class _AssignMemo:
+    """One (snapshot id, CycleConfig)'s certified Assign result.
+
+    The owner (first RPC to miss) runs the device cycle and publishes
+    under the servicer's ``_state_lock``; waiters block on ``done``
+    OUTSIDE every lock.  ``result`` is a host-side tuple — the memo
+    never pins device buffers, so it cannot interact with donation.
+    A generation bump clears the memo dict atomically (same
+    ``_state_lock`` hold that bumps), but an entry already handed to a
+    waiter stays valid: that waiter passed its generation check before
+    the bump, which is exactly the serial schedule where its Assign ran
+    first."""
+
+    __slots__ = ("done", "result", "error")
+
+    def __init__(self):
+        self.done = threading.Event()
+        # (assignment, status, valid, path, rounds, eff_wave, cycle_ms)
+        self.result = None
+        self.error: Optional[BaseException] = None
 
 
 class ScorerServicer:
@@ -70,7 +101,8 @@ class ScorerServicer:
         state_dir=None,
         telemetry: Optional[CycleTelemetry] = None,
         coalesce_max_batch: int = 16,
-        coalesce_window_ms: float = 0.0,
+        coalesce_window_ms: Optional[float] = None,
+        pipeline_depth: int = DEFAULT_DEPTH,
     ):
         """``mesh``: a ``jax.sharding.Mesh`` turns the ASSIGN RPC into
         the round-based multi-chip cycle (parallel/shard_assign.py
@@ -90,10 +122,14 @@ class ScorerServicer:
 
         ``coalesce_max_batch``: Score requests sharing one device launch
         at most (1 = the pre-coalescing serialized behavior, the bench
-        baseline).  ``coalesce_window_ms``: how long an idle-device
-        leader waits for stragglers before launching (0 keeps lone-
-        request latency untouched; batches still form whenever requests
-        arrive while the device is busy)."""
+        baseline).  ``coalesce_window_ms``: ``None`` (the default)
+        derives the gather window adaptively from the observed
+        inter-arrival EWMA (bridge/coalesce.py AdaptiveGatherWindow —
+        lone requests keep serial latency, burst trains converge onto
+        wide batches); a float pins the ISSUE-5 static window (0 = never
+        wait).  ``pipeline_depth``: launched-but-unread batches allowed
+        in flight (2 = double buffering; 1 = the ISSUE-5 serial-readback
+        engine, the pipeline bench baseline)."""
         self.cfg = cfg
         self.mesh = mesh
         self.state = ResidentState()
@@ -109,16 +145,23 @@ class ScorerServicer:
         )
         # the lock split (module docstring): _sync_lock serializes Sync
         # decodes against the mirror baseline; _state_lock guards mirror
-        # commits, the generation counter and telemetry sequencing — and
-        # is NEVER held across a device dispatch or blocking readback;
-        # the dispatcher's device lock serializes launches.  Lock order
-        # where nesting happens: device -> state.
+        # commits, the generation counter, the Assign memo and telemetry
+        # sequencing — and is NEVER held across a device dispatch or
+        # blocking readback; the dispatcher's launch lock serializes
+        # launches.  Lock order where nesting happens: launch -> state.
         self._sync_lock = threading.Lock()
         self._state_lock = threading.Lock()
+        # Assign result memo: (snapshot id, CycleConfig) -> _AssignMemo,
+        # cleared atomically with every generation bump
+        self._assign_memo = {}
         self.dispatch = CoalescingDispatcher(
-            self._score_execute_batch,
+            self._score_launch_batch,
             max_batch=coalesce_max_batch,
-            gather_window_s=coalesce_window_ms / 1000.0,
+            window=(
+                AdaptiveGatherWindow() if coalesce_window_ms is None else None
+            ),
+            gather_window_s=(coalesce_window_ms or 0.0) / 1000.0,
+            depth=pipeline_depth,
         )
 
     def snapshot_id(self) -> str:
@@ -179,8 +222,8 @@ class ScorerServicer:
             decode_s = time.perf_counter() - t0
 
             # Phase 2 — atomic commit + the donating device scatter,
-            # under device -> state: the donation must not invalidate
-            # buffers a coalesced Score batch captured but has not read
+            # under launch -> state: the donation must not invalidate
+            # buffers an in-flight batch captured but has not read
             # back, and the mirrors/generation/telemetry move together.
             def commit() -> "pb2.SyncReply":
                 with self._state_lock:
@@ -188,11 +231,17 @@ class ScorerServicer:
                     spans = self.telemetry.spans
                     spans.add_measured("sync_decode", decode_s)
                     try:
-                        info = self.state.commit_sync(staged, spans=spans)
+                        info = self.state.commit_sync(
+                            staged, spans=spans, plan=plan_cell[0]
+                        )
                     except Exception as exc:
                         self.telemetry.abort_cycle("sync", exc)
                         raise
                     self._generation += 1
+                    # the memo dies with the generation it certified —
+                    # atomically, under the same hold that bumps (an
+                    # Assign checking the memo also holds _state_lock)
+                    self._assign_memo.clear()
                     self.telemetry.record_sync(
                         info,
                         snapshot_id=self.snapshot_id(),
@@ -210,7 +259,25 @@ class ScorerServicer:
                         pods=self.state.pod_requests.shape[0],
                     )
 
-            return self.dispatch.run_exclusive(commit)
+            # the pipeline barrier is donation-scoped: only a warm
+            # delta scatter (which donates the pre-delta buffers) must
+            # wait for in-flight readbacks; cold/full commits keep the
+            # pipeline flowing — in-flight batches hold their own
+            # snapshot references, deletion without donation cannot
+            # invalidate them.  The decision runs as run_exclusive's
+            # drain CALLABLE — i.e. with the launch lock already held:
+            # residency only flips inside a launch section (a Score's
+            # lazy snapshot() cold rebuild), so a plan computed at the
+            # call site could say "cold, no drain" and be warm-with-
+            # donation by the time the lock is acquired.  commit()
+            # then reuses the very plan the barrier was chosen on.
+            plan_cell = [None]
+
+            def _decide_drain() -> bool:
+                plan_cell[0] = self.state.plan_commit(staged)
+                return self.state.commit_donates(staged, plan=plan_cell[0])
+
+            return self.dispatch.run_exclusive(commit, drain=_decide_drain)
 
     def score(self, req: "pb2.ScoreRequest", ctx=None) -> "pb2.ScoreReply":
         # the coalescer runs the batch in whichever caller leads; this
@@ -223,10 +290,13 @@ class ScorerServicer:
             raise
         return entry.reply
 
-    # -- coalesced Score execution (leader thread, device lock held) --
-    def _score_execute_batch(self, batch: List[PendingRequest]) -> None:
+    # -- coalesced Score execution: launch phase (leader thread, launch
+    #    lock held) returning the readback closure the dispatcher runs
+    #    OFF the lock — the pipeline seam --
+    @launch_section
+    def _score_launch_batch(self, batch: List[PendingRequest]):
         # capture a consistent view under the state lock, then leave it:
-        # the launch and the stacked readback must not serialize Syncs
+        # the launch must not serialize Syncs
         with self._state_lock:
             sid = self.snapshot_id()
             accepted = []
@@ -266,52 +336,63 @@ class ScorerServicer:
             # prefix of the padded result (lax.top_k sorts descending
             # with index tie-breaks, so prefixes are exact)
             k_launch = min(pad_bucket(max(ks)), N)
-            t0 = t_exec
             scores, feasible = score_cycle(snap, self.cfg)
             masked = jnp.where(
                 feasible, scores, jnp.iinfo(jnp.int64).min
             )
             top_scores, top_idx = lax.top_k(masked, k_launch)
-            dispatch_s = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            # one stacked device->host transfer for the whole batch
-            # (the serialized daemon paid one blocking readback per
-            # request), then numpy-only per-caller assembly
-            top_scores, top_idx, feasible_np, valid_np = jax.device_get(
-                (top_scores, top_idx, feasible, snap.pods.valid)
-            )
-            readback_s = time.perf_counter() - t0
-            top_idx = top_idx.astype(np.int32)
-            valid = valid_np[:P].astype(bool)
-            # host-side assembly failures are per-entry: the launch
-            # served everyone else, so one bad demux must not fail
-            # callers whose replies are already built — and routing them
-            # per-entry is what keeps the dispatcher's lifetime stats
-            # (which count error-free entries) agreeing with the
-            # koord_scorer_coalesce_* counters the hook below feeds
-            assembled = []
-            n_failed = 0
-            for entry, k in zip(accepted, ks):
-                try:
-                    entry.reply = self._assemble_score_reply(
-                        entry.req, k, top_scores, top_idx, feasible_np,
-                        valid, P,
-                    )
-                    assembled.append(entry)
-                except Exception as exc:  # koordlint: disable=broad-except(routed to the one caller as its RPC error; sibling replies stand)
-                    entry.error = exc
-                    n_failed += 1
-            exec_ms = (time.perf_counter() - t_exec) * 1000.0
+            # launch phase ends with the program ENQUEUED (async
+            # dispatch); everything below blocks, so it lives in the
+            # readback closure the dispatcher runs off the launch lock
+            dispatch_s = time.perf_counter() - t_exec
         except Exception as exc:
             with self._state_lock:
                 self.telemetry.abort_cycle("score", exc)
             raise
-        # returned as the post-batch hook: the dispatcher runs it after
-        # the device lock drops, so telemetry never extends the device
-        # critical section queued launches wait on
-        return lambda: self._score_telemetry(
-            assembled, sid, dispatch_s, readback_s, exec_ms, n_failed
-        )
+
+        def _readback():
+            try:
+                t0 = time.perf_counter()
+                # one stacked device->host transfer for the whole batch
+                # (the serialized daemon paid one blocking readback per
+                # request), overlapped with the NEXT batch's launch by
+                # the pipelined dispatcher
+                ts, ti, feasible_np, valid_np = jax.device_get(
+                    (top_scores, top_idx, feasible, snap.pods.valid)
+                )
+                readback_s = time.perf_counter() - t0
+                ti = ti.astype(np.int32)
+                valid = valid_np[:P].astype(bool)
+                # host-side assembly failures are per-entry: the launch
+                # served everyone else, so one bad demux must not fail
+                # callers whose replies are already built — and routing
+                # them per-entry is what keeps the dispatcher's lifetime
+                # stats (which count error-free entries) agreeing with
+                # the koord_scorer_coalesce_* counters the hook feeds
+                assembled = []
+                n_failed = 0
+                for entry, k in zip(accepted, ks):
+                    try:
+                        entry.reply = self._assemble_score_reply(
+                            entry.req, k, ts, ti, feasible_np, valid, P,
+                        )
+                        assembled.append(entry)
+                    except Exception as exc:  # koordlint: disable=broad-except(routed to the one caller as its RPC error; sibling replies stand)
+                        entry.error = exc
+                        n_failed += 1
+                exec_ms = (time.perf_counter() - t_exec) * 1000.0
+            except Exception as exc:
+                with self._state_lock:
+                    self.telemetry.abort_cycle("score", exc)
+                raise
+            # returned as the post-batch hook: the dispatcher runs it
+            # after followers were notified, so telemetry never extends
+            # the readback path either
+            return lambda: self._score_telemetry(
+                assembled, sid, dispatch_s, readback_s, exec_ms, n_failed
+            )
+
+        return _readback
 
     def _assemble_score_reply(
         self, req, k, top_scores, top_idx, feasible_np, valid, P
@@ -384,6 +465,11 @@ class ScorerServicer:
             tel.metrics.record_coalesce(
                 len(assembled), [e.queue_delay_ms for e in assembled]
             )
+            # pipeline health rides the same hook: the live adaptive
+            # window and the cumulative device-idle wall time
+            stats = self.dispatch.stats()
+            tel.metrics.set_coalesce_window(stats["window_ms"])
+            tel.metrics.set_device_idle(stats["device_idle_ms"])
             n_observe = len(assembled) if pending else len(assembled) - 1
             if not pending:
                 tel.commit_cycle(exec_ms, path="score", wave=self.cfg.wave)
@@ -393,95 +479,236 @@ class ScorerServicer:
                 )
 
     def assign(self, req: "pb2.AssignRequest", ctx=None) -> "pb2.AssignReply":
-        # the cycle clock starts inside the device section (below), so
+        # bounded retry: a waiter that inherited an OWNER's failure
+        # re-runs the memo protocol (the failed entry was removed, so
+        # one waiter promotes to owner); the last attempt bypasses the
+        # memo entirely and computes its own cycle, so a pathologically
+        # failing owner can never starve its waiters
+        for attempt in range(3):
+            outcome = self._assign_once(req, ctx, bypass_memo=attempt == 2)
+            if outcome is not None:
+                return outcome
+        raise RuntimeError("unreachable: memo-bypass attempt returned None")
+
+    def _assign_once(
+        self, req: "pb2.AssignRequest", ctx, bypass_memo: bool = False
+    ) -> Optional["pb2.AssignReply"]:
+        """One pass of the Assign memo protocol.  Returns the reply, or
+        None when this thread waited on a memo owner that failed (the
+        caller retries).  ``bypass_memo`` computes a cycle without
+        consulting or publishing the memo."""
+        t_rpc = time.perf_counter()
+        with self._state_lock:
+            self._check_generation(req, ctx)
+            sid = self.snapshot_id()
+            key = (sid, self.cfg)
+            owner = False
+            entry = None
+            if not bypass_memo:
+                entry = self._assign_memo.get(key)
+                if entry is None:
+                    entry = _AssignMemo()
+                    self._assign_memo[key] = entry
+                    owner = True
+            # per-RPC span scope (the ISSUE-6 correlation fix): the
+            # cycle OWNER — the RPC whose device cycle will close the
+            # Sync→Score→Assign flow — adopts the pending cycle
+            # atomically; memo waiters mint fresh cycles and can no
+            # longer relabel the open one or land stray stamps on it
+            scope = self.telemetry.begin_rpc_scope(
+                snapshot_id=sid,
+                cycle_id=req.cycle_id or None,
+                adopt_pending=owner or bypass_memo,
+            )
+        if entry is not None and not owner:
+            return self._assign_from_memo(entry, scope, t_rpc)
+        try:
+            reply = self._assign_compute(req, ctx, scope, memo=entry)
+        except BaseException as exc:
+            if owner:
+                # unpublish BEFORE waiters act on it: the entry leaves
+                # the dict so the next attempt mints a fresh owner
+                with self._state_lock:
+                    if self._assign_memo.get(key) is entry:
+                        del self._assign_memo[key]
+                    entry.error = exc
+                    entry.done.set()
+            raise
+        return reply
+
+    def _assign_from_memo(
+        self, entry: _AssignMemo, scope, t_rpc: float
+    ) -> Optional["pb2.AssignReply"]:
+        """Serve one Assign from a published (or in-flight) memo entry.
+        Waits OUTSIDE every lock; returns None (caller retries) when the
+        owner failed — its error class may have been specific to that
+        RPC, and serial semantics are re-established by re-running."""
+        entry.done.wait()
+        if entry.error is not None or entry.result is None:
+            # the waiter's private scope must not be abandoned: commit
+            # it to the flight ring (no disk dump, no error counter —
+            # the failed OWNER's abort already did both for the actual
+            # cycle) so the record trail shows this RPC inherited the
+            # owner's failure and retried
+            exc = entry.error or RuntimeError(
+                "memo owner published no result"
+            )
+            with self._state_lock:
+                scope.note("memo_owner_failed", True)
+                self.telemetry.abort_scope(
+                    scope, "assign-memo-wait", exc, dump=False
+                )
+            return None
+        assignment, status, valid, path, rounds, eff_wave, cycle_ms = (
+            entry.result
+        )
+        wait_ms = (time.perf_counter() - t_rpc) * 1000.0
+        with self._state_lock:
+            reply = pb2.AssignReply(
+                # the cycle that certified this assignment cost
+                # ``cycle_ms`` on the device — that is what the field
+                # has always meant; the memo wait itself is this RPC's
+                # latency, carried by the "memo" histogram label
+                cycle_ms=cycle_ms,
+                path=path or "",
+                cycle_id=scope.cycle_id,
+            )
+            reply.assignment.extend(assignment[valid].tolist())
+            reply.status.extend(status[valid].tolist())
+            self.telemetry.metrics.count_assign_memo("hit")
+            scope.note("memo_hit", True)
+            self.telemetry.commit_scope(
+                scope, wait_ms, path="memo", wave=eff_wave, rounds=rounds
+            )
+        return reply
+
+    def _assign_compute(
+        self, req: "pb2.AssignRequest", ctx, scope,
+        memo: Optional[_AssignMemo] = None,
+    ) -> "pb2.AssignReply":
+        """Run one real device cycle through the pipelined dispatcher
+        and (as memo owner) publish its certified result.  ``memo`` is
+        the owner's OWN entry object — published directly, never by
+        dict re-lookup: a Sync's generation bump clears the dict
+        mid-flight, and waiters already blocked on this entry must
+        still be released (their result is serially consistent with
+        the generation check they passed)."""
+        # the cycle clock starts inside the launch section (below), so
         # cycle_ms and the latency histogram keep the serialized
         # daemon's meaning — device cycle + readback, NOT time spent
         # queued behind other launches (the coalesce families carry
         # queueing)
         t0 = [0.0]
-        with self._state_lock:
-            self._check_generation(req, ctx)
-            spans = self.telemetry.spans
-            # adopt the client's correlation id when it sent one; the id
-            # (ours or theirs) is echoed in the reply either way
-            cycle = spans.current(
-                snapshot_id=self.snapshot_id(),
-                cycle_id=req.cycle_id or None,
-            )
-            cycle_id = cycle.cycle_id
 
+        @launch_section
         def launch():
-            # capture INSIDE the device section: a pipelined Sync's
+            # capture INSIDE the launch section: a pipelined Sync's
             # delta scatter DONATES the pre-delta resident buffers, so
-            # a snapshot captured before this RPC held the device lock
+            # a snapshot captured before this RPC held the launch lock
             # could be deleted out from under the cycle (the stress
             # test in tests/test_coalesce.py reproduces exactly that).
-            # The generation re-check keeps the serial semantics: if a
-            # Sync committed while we queued, a pinned snapshot_id is
+            # The generation re-check is the pipeline seam's guard: if
+            # a Sync committed while we queued, a pinned snapshot_id is
             # now stale and must FAILED_PRECONDITION, same as if the
-            # RPCs had serialized Sync-first.
+            # RPCs had serialized Sync-first.  Once launched, the
+            # in-flight slot keeps a donating Sync OUT (run_exclusive
+            # drains) until the readback below completes.
             t0[0] = time.perf_counter()
             with self._state_lock:
                 self._check_generation(req, None)
                 snap = self.state.snapshot()
                 i32_ok = self.state.i32_fits()
-            return self._assign_launch(snap, spans, i32_ok)
+            result, rounds, eff_wave = self._assign_cycle(
+                snap, scope, i32_ok
+            )
+
+            def _readback():
+                # blocking stacked transfer — OFF the launch lock, so a
+                # coalesced Score batch can launch while it drains.
+                # ``rounds`` rides the same stacked device_get: it may
+                # be a device scalar (single-chip wave path), a host int
+                # (shard path, materialized inside its demotion guard)
+                # or None — device_get passes the last two through.
+                with scope.span("readback"):
+                    assignment, status, valid, got_rounds = jax.device_get(
+                        (result.assignment, result.status,
+                         snap.pods.valid, rounds)
+                    )
+                return (
+                    result,
+                    None if got_rounds is None else int(got_rounds),
+                    eff_wave,
+                    assignment, status, valid.astype(bool),
+                )
+
+            return _readback
 
         try:
-            # the device section (launch + the single stacked readback)
-            # rides the dispatch queue: serialized against coalesced
-            # Score launches and Sync's donating scatters, off the
-            # state lock so neither blocks behind the transfer
+            # the launch rides the pipelined dispatch queue: ordered
+            # against coalesced Score launches and Sync's donating
+            # scatters, with the readback off the launch critical
+            # section so neither blocks behind the transfer
             result, rounds, eff_wave, assignment, status, valid = (
-                self.dispatch.run_exclusive(launch)
+                self.dispatch.run_pipelined(launch)
             )
         except SnapshotNotResident as exc:
             # displaced mid-queue by another client's Sync: a client
             # protocol condition (the Go client full-resyncs on it),
-            # not a cycle failure — no flight dump
+            # not a cycle failure — no flight dump, no error counter,
+            # but the RPC's OWN record says what happened instead of
+            # its stamps landing on the pending cycle (the ISSUE-6
+            # correlation fix)
+            with self._state_lock:
+                scope.note("displaced", True)
+                self.telemetry.abort_scope(scope, "assign", exc, dump=False)
             if ctx is not None:
                 ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(exc))
             raise
         except Exception as exc:
             # count + flight-dump the bad cycle before surfacing it
             with self._state_lock:
-                self.telemetry.abort_cycle("assign", exc)
+                self.telemetry.abort_scope(scope, "assign", exc)
             raise
         ms = (time.perf_counter() - t0[0]) * 1000.0
         with self._state_lock:
             reply = pb2.AssignReply(
                 cycle_ms=ms,
                 path=result.path or "",
-                cycle_id=cycle_id,
+                cycle_id=scope.cycle_id,
             )
             reply.assignment.extend(assignment[valid].tolist())
             reply.status.extend(status[valid].tolist())
-            self.telemetry.commit_cycle(
-                ms,
+            # publish for concurrent waiters — on the OWNED entry: if a
+            # Sync bumped the generation while the readback drained,
+            # the dict slot is already gone (cleared under this very
+            # lock) and stays gone, so future Assigns miss; waiters
+            # blocked on this object still consume a result that is
+            # serially consistent with the generation check they passed
+            if memo is not None:
+                memo.result = (
+                    assignment, status, valid,
+                    result.path or "", rounds, eff_wave, ms,
+                )
+                memo.done.set()
+            self.telemetry.metrics.count_assign_memo("miss")
+            self.telemetry.commit_scope(
+                scope, ms,
                 path=result.path or "unknown",
                 wave=eff_wave,
                 rounds=rounds,
             )
         return reply
 
-    def _assign_launch(self, snap, spans, i32_ok):
-        """Device section of Assign (device lock held, state lock NOT):
-        run the cycle, then ONE stacked readback for assignment, status
-        and the validity mask of the very snapshot the cycle ran
-        against."""
-        result, rounds, eff_wave = self._assign_cycle(snap, spans, i32_ok)
-        with spans.span("readback"):
-            assignment, status, valid = jax.device_get(
-                (result.assignment, result.status, snap.pods.valid)
-            )
-        return result, rounds, eff_wave, assignment, status, valid.astype(bool)
-
+    @launch_section
     def _assign_cycle(self, snap, spans, i32_ok):
         """Run the device cycle (shard-first when a mesh is configured)
         and return ``(CycleResult, rounds or None, effective wave
         width)`` — the shard path widens cfg.wave<=1 to its own
         default, and the telemetry labels must say what actually ran.
-        Caller holds the device lock and owns error accounting."""
+        Caller holds the launch lock and owns error accounting.
+
+        ``spans`` is the RPC's CycleScope (obs/spans.py) — same span
+        surface as the recorder, but private to this cycle."""
         result = None
         rounds = None
         eff_wave = self.cfg.wave
@@ -520,17 +747,20 @@ class ScorerServicer:
                         # materialize INSIDE the guard: with async
                         # dispatch a late device fault would otherwise
                         # surface at the reply assembly, outside this
-                        # fallback (the same hazard run_cycle documents)
+                        # fallback (the same hazard run_cycle documents).
+                        # This is the ONE blocking transfer allowed in a
+                        # launch section — the shard path trades a slot
+                        # of pipeline depth for its demotion guard.
                         import dataclasses
 
                         result = dataclasses.replace(
                             result,
-                            assignment=np.asarray(result.assignment),
-                            status=np.asarray(result.status),
+                            assignment=np.asarray(result.assignment),  # koordlint: disable=lock-held-dispatch(shard demotion guard: the fault must surface inside the fallback try, pipeline depth is traded deliberately)
+                            status=np.asarray(result.status),  # koordlint: disable=lock-held-dispatch(shard demotion guard)
                         )
                     # device-derived stat, materialized AFTER the device
                     # program completed — one scalar transfer, no retrace
-                    rounds = int(np.asarray(nwaves))
+                    rounds = int(np.asarray(nwaves))  # koordlint: disable=lock-held-dispatch(shard demotion guard)
                     eff_wave = wave
                     _record_success(bucket)
                 except Exception as exc:
@@ -556,8 +786,12 @@ class ScorerServicer:
             eff_wave = self.cfg.wave
             with spans.span("dispatch"):
                 result = run_cycle(snap, self.cfg, i32_ok=i32_ok)
-            if result.rounds is not None:
-                rounds = int(np.asarray(result.rounds))
+            # device-derived wave count: returned UN-materialized (a
+            # device scalar) — blocking on it here would hold the
+            # launch lock for the whole cycle; the pipelined readback
+            # fetches it in the same stacked device_get as
+            # assignment/status, off the lock
+            rounds = result.rounds
         return result, rounds, eff_wave
 
 
@@ -578,7 +812,9 @@ def make_server(
     """``max_workers`` defaults to the reference scheduler's 16 parallel
     Score workers: with the coalescing dispatcher a full worker burst
     now shares one device launch instead of queueing on a lock, so the
-    transport should not be the narrower funnel."""
+    transport should not be the narrower funnel.  (Client-side, pass
+    ``channels=N`` to ScorerClient so the burst actually arrives over
+    parallel HTTP/2 connections — see bridge/client.py.)"""
     servicer = servicer or ScorerServicer(cfg, mesh=mesh)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     handlers = {

@@ -58,6 +58,10 @@ R = res.NUM_RESOURCES
 
 logger = logging.getLogger(__name__)
 
+# "no precomputed plan" sentinel for commit_donates/commit_sync —
+# distinct from None, which is a real plan value meaning "go cold"
+_PLAN_UNSET = object()
+
 
 def decode_tensor(
     t: "pb2.Tensor", base: Optional[np.ndarray]
@@ -271,18 +275,59 @@ class ResidentState:
         with maybe_span(spans, "sync_decode"):
             return self._decode_sync(reqmsg)
 
-    def commit_sync(self, staged_tinfo, spans=None) -> dict:
+    def plan_commit(self, staged_tinfo):
+        """Compute the device-update plan for a staged frame against
+        the current (pre-commit) mirrors and residency.  Pure planning:
+        mutates nothing.  The plan depends on whether a snapshot is
+        resident, and residency can flip at any *launch* (a Score's
+        launch section lazily cold-rebuilds via ``snapshot()``) — so a
+        plan that will gate or feed a commit must be computed with the
+        dispatch launch lock held (``run_exclusive`` evaluates its
+        ``drain`` callable exactly there) and handed to ``commit_sync``
+        via its ``plan=`` parameter rather than recomputed."""
+        staged, tinfo = staged_tinfo
+        return self._warm_plan(staged, tinfo)
+
+    def commit_donates(self, staged_tinfo, plan=_PLAN_UNSET) -> bool:
+        """Whether committing this staged frame will DONATE resident
+        device buffers (a warm plan with at least one delta scatter —
+        solver/resident.py apply_flat_delta donates the dead pre-delta
+        buffer).  The pipelined dispatcher (ISSUE 6) uses this to pick
+        the commit barrier: a donating commit must drain in-flight
+        launches (``run_exclusive(fn, drain=True)``) because deleting a
+        donated buffer would invalidate python references a launched-
+        but-unread batch still holds, while a cold or full-upload
+        commit only needs launch ordering — in-flight batches keep
+        their own snapshot references alive, so the pipeline keeps
+        flowing.  Pass ``plan=`` from :meth:`plan_commit` to decide on
+        the plan the commit will actually run (and to avoid planning
+        twice); call between ``stage_sync`` and ``commit_sync`` under
+        the same Sync serialization."""
+        if plan is _PLAN_UNSET:
+            plan = self.plan_commit(staged_tinfo)
+        if plan is None:
+            return False
+        tensor_updates, _ = plan
+        return any(u[0] == "delta" for u in tensor_updates.values())
+
+    def commit_sync(self, staged_tinfo, spans=None, plan=_PLAN_UNSET) -> dict:
         """Phase 2 — atomic commit of a staged frame + the device-side
         warm update.  The delta scatter donates the pre-delta resident
         buffers, so the caller must hold the device-dispatch lock
-        (bridge/coalesce.py run_exclusive) to keep the donation from
+        (bridge/coalesce.py run_exclusive, drained when
+        ``commit_donates`` says so) to keep the donation from
         invalidating arrays a coalesced Score batch captured but has
-        not read back yet."""
+        not read back yet.  ``plan=`` accepts the
+        :meth:`plan_commit` result the drain decision was made on, so
+        the barrier and the commit provably act on the same plan (and
+        the full-tensor ``np.array_equal`` sweep runs once per Sync,
+        not twice)."""
         from koordinator_tpu.obs.spans import maybe_span
 
         staged, tinfo = staged_tinfo
-        # device-update plan, computed against the PRE-commit mirrors
-        plan = self._warm_plan(staged, tinfo)
+        if plan is _PLAN_UNSET:
+            # device-update plan against the PRE-commit mirrors
+            plan = self._warm_plan(staged, tinfo)
         # atomic commit point: nothing above mutated self
         for key, value in staged.items():
             setattr(self, key, value)

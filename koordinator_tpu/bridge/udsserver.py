@@ -43,8 +43,11 @@ _METHOD_NAMES = {METHOD_SYNC: "sync", METHOD_SCORE: "score",
 # malformed or hostile frame, not a workload.
 _MAX_FRAME = 64 << 20
 # One thread per connection; bound concurrent connections so a local
-# misbehaving client cannot spawn unbounded threads/buffers.
-_MAX_CONNS = 32
+# misbehaving client cannot spawn unbounded threads/buffers.  Sized
+# above the bench's 64-client storm (ISSUE 6): the pipelined dispatcher
+# is the funnel that turns a burst into a few launches, so the
+# transport must admit the burst first.
+_MAX_CONNS = 96
 
 
 def _recv_or_eof(conn: socket.socket, n: int) -> Tuple[Optional[bytes], int]:

@@ -34,7 +34,7 @@ from koordinator_tpu.obs.flight import (  # noqa: F401
     validate_flight_dump,
 )
 from koordinator_tpu.obs.scorer_metrics import ScorerMetrics
-from koordinator_tpu.obs.spans import SpanRecorder  # noqa: F401
+from koordinator_tpu.obs.spans import CycleScope, SpanRecorder  # noqa: F401
 
 logger = logging.getLogger(__name__)
 
@@ -166,6 +166,55 @@ class CycleTelemetry:
         record = spans.commit()
         self.flight.record(record)
         return record
+
+    # -- per-RPC scopes (ISSUE 6: exact records under concurrency) --
+    def begin_rpc_scope(
+        self,
+        snapshot_id: Optional[str] = None,
+        cycle_id: Optional[str] = None,
+        adopt_pending: bool = True,
+    ):
+        """A private cycle for one RPC (see obs/spans.py CycleScope).
+        The correlating RPC of a Sync→Score→Assign flow adopts the
+        pending cycle atomically; concurrent siblings mint fresh ones
+        and can no longer relabel or stamp it."""
+        return self.spans.open_scope(
+            snapshot_id=snapshot_id, cycle_id=cycle_id,
+            adopt_pending=adopt_pending,
+        )
+
+    def commit_scope(
+        self,
+        scope,
+        latency_ms: float,
+        path: str,
+        wave: int = 1,
+        rounds: Optional[int] = None,
+    ) -> Dict[str, object]:
+        """`commit_cycle`, scoped: metrics + the scope's own record into
+        the flight ring.  The recorder's pending cycle is untouched."""
+        self.metrics.observe_cycle(latency_ms, path, wave, rounds=rounds)
+        scope.note("path", path)
+        scope.note("latency_ms", round(float(latency_ms), 3))
+        if rounds is not None:
+            scope.note("rounds", int(rounds))
+        record = scope.commit()
+        self.flight.record(record)
+        return record
+
+    def abort_scope(
+        self, scope, stage: str, exc: BaseException, dump: bool = True
+    ) -> None:
+        """`abort_cycle`, scoped.  ``dump=False`` records the failed
+        cycle in the ring without a disk dump — the client-protocol
+        conditions (a displaced Assign) that must stay visible in the
+        records but must not churn the dump directory."""
+        if dump:
+            self.metrics.count_cycle_error(stage)
+        record = scope.commit(error=f"{stage}: {exc!r:.300}")
+        self.flight.record(record)
+        if dump:
+            self.flight.dump("cycle-error")
 
     def abort_cycle(self, stage: str, exc: BaseException) -> None:
         """An UNEXPECTED failure on the cycle pipeline: count it, commit

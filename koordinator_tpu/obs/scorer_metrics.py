@@ -26,14 +26,24 @@ koord_scorer_coalesce_queue_delay_ms   histogram —
 koord_scorer_coalesce_batch_occupancy  histogram —
 koord_scorer_coalesce_batches_total    counter   —
 koord_scorer_coalesce_requests_total   counter   —
+koord_scorer_coalesce_window_ms        gauge     —
+koord_scorer_coalesce_device_idle_ms   gauge     — (cumulative)
+koord_scorer_assign_memo_total         counter   result (hit|miss)
 ====================================== ========= ==========================
 
 The ``koord_scorer_coalesce_*`` families observe the coalescing
-dispatch engine (ISSUE 5, bridge/coalesce.py): how long a Score request
-waited in the gather queue before its batch launched, and how many
-requests shared each device launch — occupancy near 1 under heavy
+dispatch engine (ISSUE 5/6, bridge/coalesce.py): how long a Score
+request waited in the gather queue before its batch launched, and how
+many requests shared each device launch — occupancy near 1 under heavy
 concurrency means the engine is not batching (gather window too small,
-or the clients are actually serial).
+or the clients are actually serial).  ISSUE 6's pipelined engine adds
+the current adaptive gather window (``_window_ms``; moves with the
+observed inter-arrival EWMA, clamped) and the cumulative wall time the
+device sat idle while work was queued (``_device_idle_ms``; the
+double-buffered pipeline exists to hold this near zero — watch its
+RATE, a flat line is a saturated pipeline).  ``assign_memo_total``
+counts Assign RPCs served from the (snapshot id, CycleConfig) result
+memo vs. those that ran a device cycle.
 
 The jit cache-miss counter is fed by
 ``analysis.retrace_guard.watch_cache_misses`` — the runtime companion of
@@ -66,6 +76,9 @@ COALESCE_QUEUE_DELAY = "koord_scorer_coalesce_queue_delay_ms"
 COALESCE_OCCUPANCY = "koord_scorer_coalesce_batch_occupancy"
 COALESCE_BATCHES = "koord_scorer_coalesce_batches_total"
 COALESCE_REQUESTS = "koord_scorer_coalesce_requests_total"
+COALESCE_WINDOW = "koord_scorer_coalesce_window_ms"
+COALESCE_DEVICE_IDLE = "koord_scorer_coalesce_device_idle_ms"
+ASSIGN_MEMO = "koord_scorer_assign_memo_total"
 
 # occupancy is a count-of-requests-per-launch, not a latency: its own
 # power-of-two buckets (the dispatcher caps batches at 16 by default;
@@ -112,6 +125,15 @@ _FAMILIES = (
     (COALESCE_REQUESTS, "counter",
      "Score requests served through the coalescer (requests/batches = "
      "mean occupancy)"),
+    (COALESCE_WINDOW, "gauge",
+     "current adaptive gather window (EWMA of inter-arrival gaps, "
+     "clamped; 0 = launch immediately)"),
+    (COALESCE_DEVICE_IDLE, "gauge",
+     "cumulative wall time the device sat idle with work queued; the "
+     "pipelined dispatcher holds the rate near zero"),
+    (ASSIGN_MEMO, "counter",
+     "Assign RPCs served from the (snapshot, config) result memo (hit) "
+     "vs. ran a device cycle (miss)"),
 )
 
 # per-family bucket overrides (histograms default to DEFAULT_BUCKETS_MS)
@@ -215,3 +237,12 @@ class ScorerMetrics:
             self.registry.histogram_observe(
                 COALESCE_QUEUE_DELAY, float(delay_ms)
             )
+
+    def set_coalesce_window(self, window_ms: float) -> None:
+        self.registry.gauge_set(COALESCE_WINDOW, float(window_ms))
+
+    def set_device_idle(self, idle_ms: float) -> None:
+        self.registry.gauge_set(COALESCE_DEVICE_IDLE, float(idle_ms))
+
+    def count_assign_memo(self, result: str) -> None:
+        self.registry.counter_add(ASSIGN_MEMO, 1, {"result": result})

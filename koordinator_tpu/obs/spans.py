@@ -150,6 +150,66 @@ class _SpanContext:
         return False
 
 
+class CycleScope:
+    """One RPC's private cycle (ISSUE 6, the span-correlation fix).
+
+    Under the coalescing pipeline several RPCs run concurrently, and
+    two confirmed record blurs came from them sharing the recorder's
+    ONE open cycle: an Assign adopting (and relabeling) a cycle another
+    RPC was still stamping, and a displaced Assign's stamps landing on
+    the pending cycle awaiting a different client's correlation.
+    ``SpanRecorder.open_scope`` detaches a cycle into this wrapper —
+    atomically claiming the pending cycle when the RPC is its rightful
+    correlator, minting a fresh one otherwise — so concurrent RPCs can
+    never stamp or relabel each other's records.  Replies were always
+    unaffected; this makes the cycle *records* exact too.
+
+    The API mirrors the recorder's span surface (``span``/``note``/
+    ``add_measured``/``begin_span``/``end_span``), so recorder-typed
+    call sites (``maybe_span``, ``_assign_cycle``, the shard path)
+    accept a scope unchanged.  ``commit()`` returns the record; a scope
+    is single-shot and never re-enters the recorder."""
+
+    __slots__ = ("_cycle", "_lock")
+
+    def __init__(self, cycle: CycleSpans):
+        self._cycle = cycle
+        self._lock = threading.RLock()
+
+    @property
+    def cycle_id(self) -> str:
+        return self._cycle.cycle_id
+
+    @property
+    def snapshot_id(self) -> Optional[str]:
+        return self._cycle.snapshot_id
+
+    def begin_span(self, name: str) -> int:
+        with self._lock:
+            return self._cycle.begin(name)
+
+    def end_span(self, handle: int) -> None:
+        with self._lock:
+            self._cycle.end(handle)
+
+    def add_measured(self, name: str, dur_s: float) -> None:
+        with self._lock:
+            self._cycle.add_measured(name, dur_s)
+
+    def span(self, name: str) -> "_SpanContext":
+        return _SpanContext(self, name)
+
+    def note(self, key: str, value) -> None:
+        with self._lock:
+            self._cycle.notes[key] = value
+
+    def commit(self, error: Optional[str] = None) -> Dict[str, object]:
+        with self._lock:
+            if error is not None:
+                self._cycle.error = error
+            return self._cycle.to_record()
+
+
 class SpanRecorder:
     """Owns the current cycle and mints cycle ids ("c<epoch>-<seq>",
     correlating with the sidecar's "s<epoch>-<gen>" snapshot ids)."""
@@ -201,6 +261,37 @@ class SpanRecorder:
             record = cycle.to_record()
             self._cycle = None
             return record
+
+    def open_scope(
+        self,
+        snapshot_id: Optional[str] = None,
+        cycle_id: Optional[str] = None,
+        adopt_pending: bool = True,
+    ) -> CycleScope:
+        """Detach a cycle into a private :class:`CycleScope`.
+
+        With ``adopt_pending`` (the correlating RPC — e.g. the Assign
+        that closes a Sync→Score→Assign flow) the pending cycle, if
+        any, is claimed ATOMICALLY: it leaves the recorder in the same
+        lock hold, so a concurrent RPC can neither relabel it nor land
+        stray stamps on it, and the next ``current()`` starts fresh.
+        ``adopt_pending=False`` (a sibling RPC racing the correlator)
+        always mints a fresh cycle and leaves the pending one alone."""
+        with self._lock:
+            if adopt_pending and self._cycle is not None:
+                cycle = self._cycle
+                self._cycle = None
+                if cycle_id:
+                    cycle.cycle_id = cycle_id
+            else:
+                self._seq += 1
+                cycle = CycleSpans(
+                    cycle_id or f"c{self.epoch}-{self._seq}",
+                    clock=self._clock, wall_clock=self._wall_clock,
+                )
+            if snapshot_id is not None:
+                cycle.snapshot_id = snapshot_id
+            return CycleScope(cycle)
 
     # -- span API --
     def begin_span(self, name: str) -> int:

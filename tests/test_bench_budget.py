@@ -202,6 +202,48 @@ class TestArtifactSchemaTruncatedAndCoalesce:
             self._line(score_concurrent_speedup=float("nan"))
         )
 
+    def test_pipeline_probe_fields(self):
+        # ISSUE 6: the pipelined-dispatch health fields must be archived
+        # well-formed or not at all
+        assert bench._validate_artifact(self._line(
+            score_pipeline_speedup=2.1, device_idle_ms=0.4,
+            coalesce_window_ms=1.5, launch_overlaps=37,
+        )) == []
+        assert bench._validate_artifact(self._line(
+            device_idle_ms=None, coalesce_window_ms=None,
+            score_pipeline_speedup=None, launch_overlaps=None,
+        )) == []
+        assert bench._validate_artifact(self._line(device_idle_ms=-1))
+        assert bench._validate_artifact(
+            self._line(coalesce_window_ms=float("inf"))
+        )
+        assert bench._validate_artifact(
+            self._line(score_pipeline_speedup=float("nan"))
+        )
+        assert bench._validate_artifact(self._line(launch_overlaps=-3))
+        assert bench._validate_artifact(self._line(launch_overlaps=True))
+        assert bench._validate_artifact(self._line(launch_overlaps=1.5))
+
+    def test_serial_sample_field(self):
+        assert bench._validate_artifact(
+            self._line(score_serial_sample=8)
+        ) == []
+        assert bench._validate_artifact(
+            self._line(score_serial_sample=None)
+        ) == []
+        assert bench._validate_artifact(self._line(score_serial_sample=0))
+        assert bench._validate_artifact(self._line(score_serial_sample=True))
+        assert bench._validate_artifact(self._line(score_serial_sample=2.5))
+
+    def test_serial_extrapolation(self):
+        # the serialized baseline is one-request-at-a-time, so a sampled
+        # storm wall scales linearly to the full request count — and a
+        # full (or degenerate) sample passes through unchanged
+        assert bench._extrapolate_serial(70.0, 8, 192) == 70.0 * 24
+        assert bench._extrapolate_serial(70.0, 192, 192) == 70.0
+        assert bench._extrapolate_serial(70.0, 0, 192) == 70.0
+        assert bench._extrapolate_serial(70.0, 200, 192) == 70.0
+
 
 class TestArtifactSchemaWaveFields:
     def _line(self, **extra):

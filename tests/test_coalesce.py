@@ -1,18 +1,26 @@
-"""ISSUE 5: the coalescing dispatch engine.
+"""ISSUE 5 + ISSUE 6: the coalescing dispatch engine and its pipeline.
 
-Three layers of proof:
+Four layers of proof:
 
 * dispatcher mechanics against a FAKE executor — batches form while the
   device is busy, FIFO prefixes, the batch cap, the gather window, and
-  error routing (whole-batch and per-entry);
+  error routing (whole-batch, whole-readback and per-entry);
+* PIPELINE mechanics (ISSUE 6) — launch k+1 enters the device section
+  while batch k's readback is still blocked (double buffering), the
+  depth cap holds, ``run_exclusive(drain=True)`` is a hard barrier
+  against in-flight batches (the donation-safety seam) while
+  ``drain=False`` overlaps, and the adaptive gather window converges on
+  the observed inter-arrival EWMA under an injected clock;
 * concurrency PARITY on the real servicer — N threads firing
   interleaved Score/Sync/Assign produce replies bit-identical to the
   same requests issued serially (the acceptance criterion), including
-  mixed top_k values demuxed from one padded launch;
+  mixed top_k values demuxed from one padded launch, plus the Assign
+  result memo (hit/miss counters, one device cycle fanning out to
+  concurrent waiters, atomic invalidation on generation bump);
 * the donation race the lock split could have opened — warm delta
   Syncs (which donate the pre-delta resident buffers) racing coalesced
-  Scores and Assigns must never hand a deleted buffer to a captured
-  batch.
+  Scores and Assigns must never hand a deleted buffer to a captured or
+  in-flight batch.
 """
 
 import threading
@@ -22,6 +30,7 @@ import numpy as np
 import pytest
 
 from koordinator_tpu.bridge.coalesce import (
+    AdaptiveGatherWindow,
     CoalescingDispatcher,
     SnapshotNotResident,
 )
@@ -184,6 +193,289 @@ class TestDispatcherMechanics:
         stats = d.stats()
         assert stats["batches"] == 1 and stats["requests"] == 1
         assert stats["batch_mean"] == 1.0
+
+
+class TestPipelineMechanics:
+    """ISSUE 6: the two-phase executor protocol.  Launch closures are
+    instant; readback closures block on test-controlled events, so the
+    tests can hold a batch 'in flight' and observe what the dispatcher
+    allows to overlap it."""
+
+    def _pipelined_dispatcher(self, depth=2, max_batch=16):
+        launches = []        # batch payloads, in launch order
+        readback_gates = []  # one Event per launched batch
+        lock = threading.Lock()
+
+        def launch(batch):
+            gate = threading.Event()
+            with lock:
+                launches.append([e.req for e in batch])
+                readback_gates.append(gate)
+
+            def readback():
+                assert gate.wait(10.0)
+                for e in batch:
+                    e.reply = f"ok:{e.req}"
+
+            return readback
+
+        d = CoalescingDispatcher(launch, max_batch=max_batch, depth=depth)
+        return d, launches, readback_gates
+
+    def test_launch_k1_overlaps_inflight_readback_k(self):
+        """The tentpole property: batch k+1's launch enters the device
+        section while batch k's readback is still blocked."""
+        d, launches, gates = self._pipelined_dispatcher()
+        t1 = threading.Thread(target=d.submit, args=("k",))
+        t1.start()
+        assert _wait_until(lambda: len(launches) == 1)
+        # batch k is launched, its readback is blocked on gates[0] —
+        # the device section must be FREE for the next leader
+        t2 = threading.Thread(target=d.submit, args=("k+1",))
+        t2.start()
+        assert _wait_until(lambda: len(launches) == 2), (
+            "launch k+1 did not overlap readback k: the device idled "
+            "for the whole in-flight transfer"
+        )
+        for g in gates:
+            g.set()
+        t1.join(timeout=5.0)
+        t2.join(timeout=5.0)
+        assert launches == [["k"], ["k+1"]]
+        assert d.stats()["launch_overlaps"] >= 1
+        assert d.stats()["inflight"] == 0
+
+    def test_depth_cap_blocks_the_third_launch(self):
+        d, launches, gates = self._pipelined_dispatcher(depth=2)
+        threads = [
+            threading.Thread(target=d.submit, args=(i,)) for i in range(3)
+        ]
+        threads[0].start()
+        assert _wait_until(lambda: len(launches) == 1)
+        threads[1].start()
+        assert _wait_until(lambda: len(launches) == 2)
+        threads[2].start()
+        time.sleep(0.1)
+        assert len(launches) == 2, "third launch exceeded pipeline depth 2"
+        gates[0].set()  # one readback drains -> headroom
+        assert _wait_until(lambda: len(launches) == 3)
+        for g in gates:
+            g.set()
+        for t in threads:
+            t.join(timeout=5.0)
+
+    def test_run_exclusive_drains_inflight_batches(self):
+        """The donation barrier: a draining exclusive section (a warm
+        Sync's donating scatter) must wait for every launched batch's
+        readback — an in-flight batch still holds python references a
+        donation would invalidate."""
+        d, launches, gates = self._pipelined_dispatcher()
+        t1 = threading.Thread(target=d.submit, args=("inflight",))
+        t1.start()
+        assert _wait_until(lambda: len(launches) == 1)
+        ran = threading.Event()
+        excl = threading.Thread(
+            target=lambda: d.run_exclusive(ran.set, drain=True)
+        )
+        excl.start()
+        time.sleep(0.1)
+        assert not ran.is_set(), (
+            "donating section ran while a batch was in flight"
+        )
+        gates[0].set()
+        assert ran.wait(5.0)
+        t1.join(timeout=5.0)
+        excl.join(timeout=5.0)
+
+    def test_run_exclusive_without_drain_overlaps_inflight(self):
+        """A non-donating commit (cold sync) keeps the pipeline
+        flowing: it only needs launch ordering, not the barrier."""
+        d, launches, gates = self._pipelined_dispatcher()
+        t1 = threading.Thread(target=d.submit, args=("inflight",))
+        t1.start()
+        assert _wait_until(lambda: len(launches) == 1)
+        ran = threading.Event()
+        excl = threading.Thread(
+            target=lambda: d.run_exclusive(ran.set, drain=False)
+        )
+        excl.start()
+        assert ran.wait(5.0), (
+            "non-draining section serialized behind an in-flight readback"
+        )
+        gates[0].set()
+        t1.join(timeout=5.0)
+        excl.join(timeout=5.0)
+
+    def test_run_exclusive_callable_drain_decided_under_the_lock(self):
+        """A drain decision can depend on state that only flips at a
+        launch (the servicer's: whether the resident snapshot is warm,
+        which a concurrent Score's lazy ``snapshot()`` rebuild can
+        change).  A callable ``drain`` must therefore be evaluated
+        AFTER the launch lock is acquired — no launch can slip between
+        the decision and the exclusive section."""
+        d, launches, gates = self._pipelined_dispatcher()
+        t1 = threading.Thread(target=d.submit, args=("inflight",))
+        t1.start()
+        assert _wait_until(lambda: len(launches) == 1)
+        seen = {}
+        ran = threading.Event()
+
+        def decide():
+            seen["locked"] = d._launch_lock.locked()
+            seen["inflight"] = d.stats()["inflight"]
+            return True
+
+        excl = threading.Thread(
+            target=lambda: d.run_exclusive(ran.set, drain=decide)
+        )
+        excl.start()
+        time.sleep(0.1)
+        assert seen == {"locked": True, "inflight": 1}, (
+            "drain callable must run with the launch lock held and the "
+            "batch still in flight"
+        )
+        assert not ran.is_set(), (
+            "True from the drain callable must still be a hard barrier"
+        )
+        gates[0].set()
+        assert ran.wait(5.0)
+        t1.join(timeout=5.0)
+        excl.join(timeout=5.0)
+
+    def test_run_pipelined_readback_runs_off_the_launch_lock(self):
+        """Assign's seam: its blocking readback must not hold the
+        device section (a Score batch launches during it)."""
+        launches = []
+
+        def score_launch(batch):
+            launches.append([e.req for e in batch])
+            for e in batch:
+                e.reply = True
+            return None
+
+        d = CoalescingDispatcher(score_launch)
+        in_readback = threading.Event()
+        release = threading.Event()
+        result = []
+
+        def assign_launch():
+            def readback():
+                in_readback.set()
+                assert release.wait(10.0)
+                return "assigned"
+
+            return readback
+
+        t = threading.Thread(
+            target=lambda: result.append(d.run_pipelined(assign_launch))
+        )
+        t.start()
+        assert in_readback.wait(5.0)
+        # while the assign readback is blocked, a Score batch launches
+        t2 = threading.Thread(target=d.submit, args=("score",))
+        t2.start()
+        assert _wait_until(lambda: launches == [["score"]]), (
+            "a Score launch serialized behind an in-flight Assign readback"
+        )
+        release.set()
+        t.join(timeout=5.0)
+        t2.join(timeout=5.0)
+        assert result == ["assigned"]
+
+    def test_readback_failure_routes_to_every_unfilled_entry(self):
+        def launch(batch):
+            def readback():
+                raise RuntimeError("transfer wedged")
+
+            return readback
+
+        d = CoalescingDispatcher(launch)
+        with pytest.raises(RuntimeError, match="transfer wedged"):
+            d.submit("x")
+        # the in-flight slot was released despite the failure
+        assert d.stats()["inflight"] == 0
+
+    def test_device_idle_accumulates_only_between_batches(self):
+        d, launches, gates = self._pipelined_dispatcher()
+        t = threading.Thread(target=d.submit, args=("a",))
+        t.start()
+        assert _wait_until(lambda: len(launches) == 1)
+        gates[0].set()
+        t.join(timeout=5.0)
+        stats = d.stats()
+        # the first launch ever never counts warm-up as device idle
+        assert stats["device_idle_ms"] == 0.0
+        t2 = threading.Thread(target=d.submit, args=("b",))
+        t2.start()
+        assert _wait_until(lambda: len(launches) == 2)
+        gates[1].set()
+        t2.join(timeout=5.0)
+        assert d.stats()["device_idle_ms"] >= 0.0
+
+
+class TestAdaptiveGatherWindow:
+    def test_converges_on_the_interarrival_ewma(self):
+        w = AdaptiveGatherWindow(alpha=0.2, cap_ms=5.0)
+        now = [0.0]
+        for _ in range(200):  # steady 0.2 ms arrivals
+            w.observe_arrival(now[0])
+            now[0] += 0.0002
+        # EWMA of a constant stream IS the constant; window = gap*(B-1)
+        assert w.window_s(16) == pytest.approx(0.0002 * 15, rel=0.05)
+
+    def test_caps_at_the_clamp(self):
+        w = AdaptiveGatherWindow(alpha=0.2, cap_ms=5.0)
+        now = [0.0]
+        for _ in range(200):  # 1 ms gaps -> 15 ms raw window, clamped
+            w.observe_arrival(now[0])
+            now[0] += 0.001
+        assert w.window_s(16) == pytest.approx(0.005)
+
+    def test_sparse_traffic_disables_the_window(self):
+        w = AdaptiveGatherWindow(alpha=0.2, cap_ms=5.0)
+        now = [0.0]
+        for _ in range(50):  # 100 ms gaps: waiting cannot fill a batch
+            w.observe_arrival(now[0])
+            now[0] += 0.1
+        assert w.window_s(16) == 0.0
+
+    def test_no_observation_means_no_wait(self):
+        w = AdaptiveGatherWindow()
+        assert w.window_s(16) == 0.0
+        w.observe_arrival(1.0)  # a single arrival has no gap yet
+        assert w.window_s(16) == 0.0
+
+    def test_single_request_batches_never_wait(self):
+        w = AdaptiveGatherWindow()
+        now = [0.0]
+        for _ in range(50):
+            w.observe_arrival(now[0])
+            now[0] += 0.0001
+        assert w.window_s(1) == 0.0
+
+    def test_burst_then_lull_reconverges(self):
+        """The window must fall back to 0 when a burst train ends —
+        the EWMA forgets, so a lone late request is not taxed."""
+        w = AdaptiveGatherWindow(alpha=0.5, cap_ms=5.0)
+        now = [0.0]
+        for _ in range(50):
+            w.observe_arrival(now[0])
+            now[0] += 0.0002
+        assert w.window_s(16) > 0.0
+        for _ in range(20):  # sparse tail
+            w.observe_arrival(now[0])
+            now[0] += 1.0
+        assert w.window_s(16) == 0.0
+
+    def test_dispatcher_reports_the_live_window(self):
+        def execute(batch):
+            for e in batch:
+                e.reply = True
+
+        d = CoalescingDispatcher(
+            execute, window=AdaptiveGatherWindow(cap_ms=5.0)
+        )
+        assert d.stats()["window_ms"] == 0.0
 
 
 def _score_fields(reply):
@@ -414,6 +706,196 @@ class TestInterleavedStress:
             assert got == (
                 list(serial.assignment), list(serial.status), serial.path
             )
+
+
+class TestAssignMemo:
+    """ISSUE 6: concurrent Assigns against the same resident snapshot
+    re-ran identical certified cycles; now one device cycle runs and
+    its result fans out, invalidated atomically on generation bump."""
+
+    def _memo_counts(self, sv):
+        reg = sv.telemetry.registry
+        return (
+            reg.get("koord_scorer_assign_memo_total", {"result": "miss"})
+            or 0,
+            reg.get("koord_scorer_assign_memo_total", {"result": "hit"})
+            or 0,
+        )
+
+    def test_second_assign_on_same_snapshot_hits(self):
+        sv, _ = _servicer(seed=53)
+        sid = sv.snapshot_id()
+        first = sv.assign(pb2.AssignRequest(snapshot_id=sid))
+        assert self._memo_counts(sv) == (1, 0)
+        second = sv.assign(pb2.AssignRequest(snapshot_id=sid))
+        assert self._memo_counts(sv) == (1, 1)
+        # the reply is bit-identical with re-running the cycle (the
+        # serialized daemon's behavior), including the degraded-path
+        # label and the cycle's device cost
+        assert list(second.assignment) == list(first.assignment)
+        assert list(second.status) == list(first.status)
+        assert second.path == first.path
+        assert second.cycle_ms == pytest.approx(first.cycle_ms)
+        # each RPC still gets its own correlation id
+        assert second.cycle_id != first.cycle_id
+
+    def test_generation_bump_invalidates_atomically(self):
+        sv, state = _servicer(seed=59)
+        sid = sv.snapshot_id()
+        sv.assign(pb2.AssignRequest(snapshot_id=sid))
+        assert sv._assign_memo, "certified result not memoized"
+        # a delta Sync bumps the generation -> the memo dies with it
+        prev = state["node_usage"].copy()
+        state["node_usage"][0, 0] += 7
+        req = pb2.SyncRequest()
+        req.nodes.usage.CopyFrom(numpy_to_tensor(state["node_usage"], prev))
+        sv.sync(req)
+        assert not sv._assign_memo
+        sv.assign(pb2.AssignRequest(snapshot_id=sv.snapshot_id()))
+        assert self._memo_counts(sv) == (2, 0)
+
+    def test_concurrent_assigns_share_one_device_cycle(self):
+        sv, _ = _servicer(seed=61)
+        sid = sv.snapshot_id()
+        n = 6
+        results = [None] * n
+        barrier = threading.Barrier(n)
+
+        def worker(i):
+            barrier.wait()
+            r = sv.assign(pb2.AssignRequest(snapshot_id=sid))
+            results[i] = (list(r.assignment), list(r.status), r.path)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        assert all(r == results[0] for r in results)
+        # exactly ONE cycle ran: the first RPC to miss owns the launch,
+        # every sibling waits on the published entry
+        assert self._memo_counts(sv) == (1, n - 1)
+
+    def test_owner_failure_releases_waiters_to_retry(self):
+        """A failing owner must not poison its waiters: the entry is
+        unpublished, a waiter promotes to owner, and the RPCs still
+        converge on one certified result."""
+        import koordinator_tpu.bridge.server as server_mod
+
+        sv, _ = _servicer(seed=67)
+        sid = sv.snapshot_id()
+        real_run_cycle = server_mod.run_cycle
+        fail_once = threading.Semaphore(1)
+
+        def flaky(*a, **kw):
+            if fail_once.acquire(blocking=False):
+                raise RuntimeError("transient device fault")
+            return real_run_cycle(*a, **kw)
+
+        server_mod.run_cycle = flaky
+        try:
+            n = 4
+            outcomes = [None] * n
+            barrier = threading.Barrier(n)
+
+            def worker(i):
+                barrier.wait()
+                try:
+                    r = sv.assign(pb2.AssignRequest(snapshot_id=sid))
+                    outcomes[i] = (list(r.assignment), r.path)
+                except RuntimeError as exc:
+                    outcomes[i] = f"error:{exc}"
+
+            threads = [
+                threading.Thread(target=worker, args=(i,))
+                for i in range(n)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60.0)
+        finally:
+            server_mod.run_cycle = real_run_cycle
+        ok = [o for o in outcomes if isinstance(o, tuple)]
+        # the owner that hit the injected fault surfaced it; every
+        # waiter retried onto a fresh owner and got the real result
+        assert len(ok) >= n - 1, outcomes
+        assert all(o == ok[0] for o in ok)
+        serial = sv.assign(pb2.AssignRequest(snapshot_id=sid))
+        assert ok[0][0] == list(serial.assignment)
+
+
+class TestDonationSafetyInFlight:
+    def test_donating_sync_waits_for_inflight_assign_readback(self):
+        """The pipeline seam of the donation race: an Assign's snapshot
+        is captured at launch; its readback may still be draining when
+        a warm Sync wants to commit.  The donating scatter must wait
+        for the in-flight count to hit zero — otherwise it deletes the
+        pre-delta buffers out from under the transfer."""
+        rng = np.random.RandomState(71)
+        state = _random_state(rng, n_nodes=5, n_pods=10, with_quota=False)
+        sv = ScorerServicer()
+        sv.sync(_full_sync_request(state))
+        sv.state.snapshot()
+        sid = sv.snapshot_id()
+
+        in_readback = threading.Event()
+        release_readback = threading.Event()
+        orig_run_pipelined = sv.dispatch.run_pipelined
+
+        def slow_pipeline(launch_fn):
+            def wrapped_launch():
+                readback = launch_fn()
+
+                def slow_readback():
+                    in_readback.set()
+                    assert release_readback.wait(30.0)
+                    return readback()
+
+                return slow_readback
+
+            return orig_run_pipelined(wrapped_launch)
+
+        sv.dispatch.run_pipelined = slow_pipeline
+        try:
+            assign_out = []
+            t_assign = threading.Thread(
+                target=lambda: assign_out.append(
+                    sv.assign(pb2.AssignRequest(snapshot_id=sid))
+                )
+            )
+            t_assign.start()
+            assert in_readback.wait(30.0)
+            # warm delta sync -> donating commit; must block on drain
+            prev = state["node_usage"].copy()
+            state["node_usage"][1, 2] += 3
+            req = pb2.SyncRequest()
+            req.nodes.usage.CopyFrom(
+                numpy_to_tensor(state["node_usage"], prev)
+            )
+            synced = []
+            t_sync = threading.Thread(
+                target=lambda: synced.append(sv.sync(req))
+            )
+            t_sync.start()
+            time.sleep(0.15)
+            assert not synced, (
+                "donating Sync committed while an Assign readback was "
+                "in flight"
+            )
+            release_readback.set()
+            t_assign.join(timeout=30.0)
+            t_sync.join(timeout=30.0)
+            assert synced and assign_out
+            assert sv.state.last_sync_path == "warm"
+            # the assign that raced the sync read back intact data:
+            # identical to a cycle on the PRE-sync snapshot (serial
+            # Assign-first order)
+            assert len(assign_out[0].assignment) == 10
+        finally:
+            sv.dispatch.run_pipelined = orig_run_pipelined
 
 
 class TestUdsReplySendmsg:

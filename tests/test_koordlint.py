@@ -589,6 +589,76 @@ class TestLockHeldDispatch:
         """, ["lock-held-dispatch"])
         assert out == []
 
+    # -- ISSUE 6: the pipeline seam (launch sections must not block) --
+    def test_blocking_readback_in_launch_section_caught(self):
+        out = lint("""
+        import jax
+        from koordinator_tpu.bridge.coalesce import launch_section
+
+        class Servicer:
+            @launch_section
+            def _score_launch_batch(self, batch):
+                scores = self.compute(batch)
+                a, b = jax.device_get((scores.a, scores.b))
+                scores.result.block_until_ready()
+                return None
+        """, ["lock-held-dispatch"])
+        assert len(out) == 2
+        assert all("launch critical section" in v.message for v in out)
+
+    def test_attribute_decorator_form_caught(self):
+        out = lint("""
+        import numpy as np
+        from koordinator_tpu.bridge import coalesce
+
+        @coalesce.launch_section
+        def launch(snap):
+            return np.asarray(snap.scores)
+        """, ["lock-held-dispatch"])
+        assert [v.line for v in out] == [7]
+
+    def test_readback_closure_inside_launch_section_is_clean(self):
+        # the nested def IS the readback closure — the only code
+        # allowed to block, run by the dispatcher off the launch lock
+        out = lint("""
+        import jax
+        from koordinator_tpu.bridge.coalesce import launch_section
+
+        class Servicer:
+            @launch_section
+            def _score_launch_batch(self, batch):
+                scores = self.compute(batch)
+
+                def _readback():
+                    return jax.device_get(scores)
+
+                return _readback
+        """, ["lock-held-dispatch"])
+        assert out == []
+
+    def test_launch_lock_with_block_caught(self):
+        out = lint("""
+        import numpy as np
+
+        class Dispatcher:
+            def lead(self):
+                with self._launch_lock:
+                    return np.asarray(self.pending)
+        """, ["lock-held-dispatch"])
+        assert [v.line for v in out] == [7]
+        assert "launch critical section" in out[0].message
+
+    def test_undecorated_launch_helper_not_flagged(self):
+        # lexical rule: only the decorator (or the lock) marks launch
+        # code; a plain helper named "launch" stays out of scope
+        out = lint("""
+        import numpy as np
+
+        def launch(snap):
+            return np.asarray(snap.scores)
+        """, ["lock-held-dispatch"])
+        assert out == []
+
 
 class TestBroadExcept:
     def test_silent_swallow_caught_and_tag_respected(self):

@@ -407,6 +407,105 @@ class TestServicerTelemetry:
         assert len(records) == 2
         assert records[-1]["notes"]["path"] == "score"
 
+    def test_concurrent_assigns_get_exact_records(self, tmp_path):
+        """ISSUE 6 correlation fix #1: each Assign RPC records on its
+        OWN span scope — a sibling can no longer relabel the open cycle
+        or land stray stamps on it.  One record per RPC, each under its
+        own cycle id, exactly one carrying the device-cycle spans."""
+        import threading
+
+        sv, state, reply = _servicer(str(tmp_path))
+        n = 4
+        ids = [f"rpc-{i}" for i in range(n)]
+        barrier = threading.Barrier(n)
+
+        def worker(i):
+            barrier.wait()
+            sv.assign(pb2.AssignRequest(
+                snapshot_id=reply.snapshot_id, cycle_id=ids[i]
+            ))
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        records = sv.telemetry.flight.snapshot()
+        assert sorted(r["cycle_id"] for r in records) == sorted(ids)
+        with_dispatch = [
+            r for r in records
+            if any(s["name"] == "dispatch" for s in r["spans"])
+        ]
+        assert len(with_dispatch) == 1, (
+            "exactly one RPC owns the device cycle; the rest are memo "
+            "records that must not carry its spans"
+        )
+        # the owner's record adopted the pending sync correlation
+        names = [s["name"] for s in with_dispatch[0]["spans"]]
+        assert "sync_decode" in names and "readback" in names
+        for r in records:
+            if r is with_dispatch[0]:
+                continue
+            assert r["notes"].get("memo_hit") is True
+            assert r["notes"]["path"] == "memo"
+            assert not any(
+                s["name"] in ("dispatch", "sync_decode") for s in r["spans"]
+            )
+
+    def test_displaced_assign_records_its_own_cycle(self, tmp_path):
+        """ISSUE 6 correlation fix #2: an Assign displaced mid-queue by
+        another client's Sync used to leave its stamps on the pending
+        cycle (another client's correlation).  Now its own record says
+        'displaced' — ring-visible, no disk dump, no error counter —
+        and the new pending cycle stays pristine."""
+        from koordinator_tpu.bridge.state import numpy_to_tensor
+
+        sv, state, reply = _servicer(str(tmp_path))
+        old_sid = reply.snapshot_id
+
+        prev = state["node_usage"].copy()
+        state["node_usage"][0, 1] += 5
+        delta = pb2.SyncRequest()
+        delta.nodes.usage.CopyFrom(
+            numpy_to_tensor(state["node_usage"], prev)
+        )
+        orig = sv.dispatch.run_pipelined
+
+        def hijack(launch_fn):
+            # a Sync lands between the RPC-entry generation check and
+            # the launch: exactly the displacement interleaving
+            sv.dispatch.run_pipelined = orig
+            sv.sync(delta)
+            return orig(launch_fn)
+
+        sv.dispatch.run_pipelined = hijack
+        with pytest.raises(ValueError, match="not resident"):
+            sv.assign(pb2.AssignRequest(
+                snapshot_id=old_sid, cycle_id="victim"
+            ))
+        records = sv.telemetry.flight.snapshot()
+        assert [r["cycle_id"] for r in records] == ["victim"]
+        assert "not resident" in records[0]["error"]
+        assert records[0]["notes"].get("displaced") is True
+        # client-protocol condition: visible in the ring, but neither a
+        # flight dump nor a cycle error
+        flight_dir = os.path.join(tmp_path, "flight")
+        assert not os.path.isdir(flight_dir) or not os.listdir(flight_dir)
+        assert not sv.telemetry.registry.get(
+            "koord_scorer_cycle_errors_total", {"stage": "assign"}
+        )
+        # the delta Sync's pending correlation survived untouched and
+        # reaches the NEXT assign's record intact
+        assert sv.telemetry.spans.has_pending()
+        sv.assign(pb2.AssignRequest(
+            snapshot_id=sv.snapshot_id(), cycle_id="survivor"
+        ))
+        rec = sv.telemetry.flight.snapshot()[-1]
+        assert rec["cycle_id"] == "survivor"
+        assert "sync_decode" in [s["name"] for s in rec["spans"]]
+
     def test_rejected_sync_frame_counts_only(self, tmp_path):
         """A client-rejectable frame (validation ValueError) bumps the
         error counter and NOTHING else: no ring record (a looping bad
