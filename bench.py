@@ -46,6 +46,10 @@ METRIC = "sched_cycle_10kpod_2knode_ms"
 # hook pins jax_platforms (the tunneled-TPU setup does); the child calls
 # jax.config.update before any backend touch when --platform cpu is passed.
 _CPU_ENV = {"XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+# the mesh config's CPU leg needs a multi-device virtual platform (the
+# forced-host analog of an 8-chip slice) so the sharded snapshot
+# actually spreads; every other config keeps the single-device CPU env
+_MESH_CPU_ENV = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
 # backend-init probes are cheap and discriminate "tunnel dead" (skip
 # straight to CPU) from "compile slow" (give the TPU run its full budget)
 PROBE_TIMEOUT = int(os.environ.get("KOORD_BENCH_PROBE_TIMEOUT", "120"))
@@ -235,6 +239,17 @@ def _validate_artifact(line: Optional[str]) -> list:
         isinstance(ss, bool) or not isinstance(ss, int) or ss < 1
     ):
         problems.append("'score_serial_sample' must be null or an int >= 1")
+    # mesh-sharded snapshot probe fields (ISSUE 7): the per-shard Sync
+    # cost and the mesh-vs-single-chip cycle numbers the acceptance
+    # tracks — malformed ones must not be archived
+    md = doc.get("mesh_devices")
+    if md is not None and (
+        isinstance(md, bool) or not isinstance(md, int) or md < 1
+    ):
+        problems.append("'mesh_devices' must be an int >= 1")
+    _finite_nonneg("shard_sync_ms")
+    _finite_nonneg("mesh_assign_ms")
+    _finite_nonneg("mesh_speedup")
     # per-stage span summary (ISSUE 4): stage name -> milliseconds, or
     # null for a stage that measured nothing (a failed best-effort leg
     # must stay VISIBLE as null, never invented) — so BENCH_*.json
@@ -1150,7 +1165,17 @@ def child_config(platform: str, config: str) -> None:
         payload = req.SerializeToString()
         with tempfile.TemporaryDirectory() as tmp:
             sock_path = os.path.join(tmp, "scorer.sock")
-            server = RawUdsServer(sock_path)
+            # Score memo OFF for every storm engine below: a storm
+            # against an unchanged snapshot would otherwise serve from
+            # the (snapshot, config, k-bucket) prefix memo after its
+            # first batch, and the probe is here to measure the
+            # DISPATCH engines, not the memo short-circuit (the memo
+            # has its own hit/miss counters and tests)
+            from koordinator_tpu.bridge.server import ScorerServicer
+
+            server = RawUdsServer(
+                sock_path, servicer=ScorerServicer(score_memo=False)
+            )
             server.start()
             conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             try:
@@ -1267,8 +1292,6 @@ def child_config(platform: str, config: str) -> None:
                 # the main server's pipelined engine (depth-2 double
                 # buffering + adaptive gather window).  Digest-identical
                 # replies across all three.
-                from koordinator_tpu.bridge.server import ScorerServicer
-
                 conc = int(os.environ.get("KOORD_BENCH_SCORE_CLIENTS", "64"))
                 per_client = int(
                     os.environ.get("KOORD_BENCH_SCORE_REPS", "3")
@@ -1307,12 +1330,14 @@ def child_config(platform: str, config: str) -> None:
                         coalesce_max_batch=1,
                         coalesce_window_ms=0.0,
                         pipeline_depth=1,
+                        score_memo=False,
                     )
                     coal_server, coal_sock, coal_sid = storm_server(
                         "coalesce_d1",
                         coalesce_max_batch=16,
                         coalesce_window_ms=0.0,
                         pipeline_depth=1,
+                        score_memo=False,
                     )
                     # The serialized baseline processes strictly one
                     # request at a time (max_batch=1, depth=1), so its
@@ -1485,6 +1510,165 @@ def child_config(platform: str, config: str) -> None:
                         "score_storm_serial": round(wall_serial * 1000.0, 2),
                         "score_storm_depth1": round(wall_d1 * 1000.0, 2),
                         "score_storm_coalesced": round(wall_coal * 1000.0, 2),
+                    },
+                }
+            ),
+            flush=True,
+        )
+        return
+
+    if config == "mesh":
+        # ISSUE 7 scale point: the MESH-SHARDED resident snapshot — one
+        # cluster spread over every visible device (node tensors split
+        # along the cluster axis, pod/quota rows replicated), warm delta
+        # Syncs landing as shard-local scatters, Assign running the
+        # round-based multi-chip cycle, bit-identical to the single-chip
+        # oracle.  Scale: 100k x 10k where memory permits, else halved
+        # to the largest size fitting KOORD_BENCH_MESH_BYTES (pad
+        # buckets round up to powers of two, so the mesh always
+        # divides).  CPU rounds (8 forced-host devices) measure the
+        # shard-local Sync cost and the capacity math; like the
+        # pipeline probe, the collective/compute overlap the mesh buys
+        # needs real ICI, so mesh_speedup < 1 on CPU is expected.
+        from koordinator_tpu.bridge.codegen import pb2
+        from koordinator_tpu.bridge.state import numpy_to_tensor
+        from koordinator_tpu.bridge.server import ScorerServicer
+        from koordinator_tpu.config import CycleConfig
+        from koordinator_tpu.harness.golden import build_sync_request
+        from koordinator_tpu.parallel import cluster_mesh, pow2_device_count
+
+        devices = jax.devices()
+        # round down to a power-of-two prefix (the daemon's --mesh rule):
+        # node buckets are powers of two, so a 6-device mesh would never
+        # activate and the config would silently measure single-chip
+        # vs single-chip while claiming mesh_devices=6
+        mesh = cluster_mesh(devices[: pow2_device_count(len(devices))])
+        budget_bytes = float(
+            os.environ.get("KOORD_BENCH_MESH_BYTES", 128 * 1024 * 1024)
+        )
+        mesh_pods, mesh_nodes = 100_000, 10_000
+        # ~4 [P, N]-sized i64 intermediates dominate the Score footprint
+        while mesh_pods * mesh_nodes * 32 > budget_bytes and mesh_nodes > 256:
+            mesh_pods //= 2
+            mesh_nodes //= 2
+        mesh_pods = int(os.environ.get("KOORD_BENCH_MESH_PODS", mesh_pods))
+        mesh_nodes = int(os.environ.get("KOORD_BENCH_MESH_NODES", mesh_nodes))
+        phase(
+            "scale", pods=mesh_pods, nodes=mesh_nodes,
+            mesh_devices=mesh.size,
+        )
+        _, nodes, pods, gangs, quotas, _ = generators.quota_colocation_snapshot(
+            pods=mesh_pods, nodes=mesh_nodes
+        )
+        # buckets omitted: the resident state pads to powers of two, so
+        # the node axis always divides over a power-of-two mesh
+        req, _ = build_sync_request(nodes, pods, gangs, quotas)
+        payload = req.SerializeToString()
+        cfg = CycleConfig(wave=32, top_m=4)
+
+        def drive(sv, label):
+            """Full Sync -> cold Assign -> 3 warm delta-Sync/Assign
+            reps; returns (sync_ms, min delta ms, min warm assign ms,
+            final reply)."""
+            t0 = time.perf_counter()
+            sync = sv.sync(pb2.SyncRequest.FromString(payload))
+            sync_ms = _ms(t0)
+            reply = sv.assign(pb2.AssignRequest(snapshot_id=sync.snapshot_id))
+            phase(f"{label}_first_assign", path=reply.path)
+            prev = np.asarray(
+                [list(map(int, res.resource_vector(n.get("usage", {}))))
+                 for n in nodes], dtype=np.int64,
+            )
+            delta_times, warm_times = [], []
+            for rep in range(3):
+                cur = prev.copy()
+                cur[:3, 0] += 500 + rep
+                warm = pb2.SyncRequest()
+                warm.nodes.usage.CopyFrom(numpy_to_tensor(cur, prev))
+                t0 = time.perf_counter()
+                sync = sv.sync(warm)
+                delta_times.append(_ms(t0))
+                prev = cur
+                assert sv.state.last_sync_path == "warm", (
+                    f"{label}: delta sync must land on the resident tensors"
+                )
+                t0 = time.perf_counter()
+                reply = sv.assign(
+                    pb2.AssignRequest(snapshot_id=sync.snapshot_id)
+                )
+                warm_times.append(_ms(t0))
+            phase(
+                f"{label}_warm",
+                assign_ms=round(min(warm_times), 2),
+                delta_sync_ms=round(min(delta_times), 2),
+            )
+            return sync_ms, min(delta_times), min(warm_times), reply
+
+        single = ScorerServicer(cfg, score_memo=False)
+        s_sync_ms, s_delta_ms, s_assign_ms, s_reply = drive(single, "single")
+        meshed = ScorerServicer(
+            cfg, mesh=mesh, mesh_resident=True, score_memo=False
+        )
+        m_sync_ms, m_delta_ms, m_assign_ms, m_reply = drive(meshed, "mesh")
+        # the acceptance bit: mesh-sharded placements == single-chip
+        assert list(m_reply.assignment) == list(s_reply.assignment), (
+            "mesh-sharded cycle diverged from the single-chip oracle"
+        )
+        assert list(m_reply.status) == list(s_reply.status)
+
+        # capacity math: resident bytes one device must hold, sharded vs
+        # replicated-on-one-chip, plus the transient [P, N] Score-tensor
+        # footprint the node sharding divides by the mesh (the 100k x
+        # 10k fp32 cost tensor is the ~4 GB that forces this refactor;
+        # docs/KERNEL.md "Mesh sharding" carries the budget table)
+        snap = meshed.state.snapshot()
+        total = 0
+        per_device = 0
+        for leaf in jax.tree_util.tree_leaves(snap):
+            nbytes = leaf.size * leaf.dtype.itemsize
+            total += nbytes
+            # single-chip fallback placements (indivisible bucket) carry
+            # a SingleDeviceSharding with no .spec — count them whole
+            spec = getattr(leaf.sharding, "spec", None) or ()
+            sharded = any(s is not None for s in spec)
+            per_device += nbytes // mesh.size if sharded else nbytes
+        score_mb = mesh_pods * mesh_nodes * 8 / 1e6
+        print(
+            json.dumps(
+                {
+                    "metric": "mesh_sharded_assign_ms",
+                    "value": round(m_assign_ms, 2),
+                    "unit": "ms",
+                    "backend": backend,
+                    "pods": mesh_pods,
+                    "nodes": mesh_nodes,
+                    "path": m_reply.path,
+                    "mesh_devices": mesh.size,
+                    # warm delta Sync against the SHARDED snapshot: the
+                    # scatter lands on the owning shard only, so this
+                    # stays flat as the mesh grows
+                    "shard_sync_ms": round(m_delta_ms, 2),
+                    "mesh_assign_ms": round(m_assign_ms, 2),
+                    "mesh_speedup": round(s_assign_ms / m_assign_ms, 3)
+                    if m_assign_ms > 0 else None,
+                    "single_assign_ms": round(s_assign_ms, 2),
+                    "single_sync_ms": round(s_delta_ms, 2),
+                    "resident_mb_total": round(total / 1e6, 2),
+                    "resident_mb_per_device": round(per_device / 1e6, 2),
+                    # transient Score-tensor footprint per device: the
+                    # node axis divides it by the mesh — the >= 4x
+                    # single-chip-capacity multiplier at >= 4 devices
+                    "score_tensor_mb": round(score_mb, 1),
+                    "score_tensor_mb_per_device": round(
+                        score_mb / mesh.size, 1
+                    ),
+                    "spans": {
+                        "single_sync": round(s_sync_ms, 2),
+                        "single_delta_sync": round(s_delta_ms, 2),
+                        "single_assign": round(s_assign_ms, 2),
+                        "mesh_sync": round(m_sync_ms, 2),
+                        "mesh_delta_sync": round(m_delta_ms, 2),
+                        "mesh_assign": round(m_assign_ms, 2),
                     },
                 }
             ),
@@ -1809,7 +1993,7 @@ def main() -> int:
         default=None,
         choices=[
             "spark", "loadaware", "gang", "extras", "rebalance", "smoke",
-            "bridge",
+            "bridge", "mesh",
         ],
         help="measure a secondary BASELINE config instead of the headline "
         "10k x 2k quota_colocation cycle (driver contract: no args prints "
@@ -1865,7 +2049,9 @@ def main() -> int:
         if cpu_window > 0:
             _PROGRESS["stage"] = f"config_{args.config}_cpu"
             ok, out, err = _spawn(
-                "--child", "cpu", _CPU_ENV, cpu_window, config=args.config
+                "--child", "cpu",
+                _MESH_CPU_ENV if args.config == "mesh" else _CPU_ENV,
+                cpu_window, config=args.config,
             )
         else:
             ok, out, err = (

@@ -25,6 +25,14 @@ Three statically detectable shapes of the PR-1 name-tuple retrace:
    every distinct value, a silent per-cycle retrace of the hottest
    program in the repo (solver/wave.py, the wave Pallas kernel, and
    parallel/shard_assign.py all pass them via ``static_argnames``).
+   ISSUE 7 extends the same shape to the MESH knobs: a traced ``mesh``
+   / device-count / shard-width argument at a jit boundary
+   re-specializes the partitioned program per value exactly the same
+   way (parallel/mesh.py, solver/resident.py and shard_assign.py all
+   declare ``mesh`` static), and a shard_map BODY taking one of these
+   names as a parameter receives it as a traced per-shard operand —
+   the mesh belongs in the ``shard_map(..., mesh=)`` binding or the
+   closure, never in the operand list.
 """
 
 from __future__ import annotations
@@ -185,6 +193,14 @@ def _static_call_args(source: SourceFile) -> List[Violation]:
 # mean one retrace per distinct width (rule docstring, shape 4)
 _WAVE_STATIC_PARAMS = ("wave", "top_m")
 
+# mesh-partitioning knobs (ISSUE 7): the mesh, the device count and the
+# shard width all select the PARTITIONED program structure — traced,
+# each distinct value re-specializes the sharded cycle silently
+_MESH_STATIC_PARAMS = (
+    "mesh", "device_count", "n_devices", "num_devices",
+    "n_shards", "num_shards", "shard_width",
+)
+
 
 def _traced_wave_knobs(source: SourceFile, spec: jitscope.JitSpec) -> List[Violation]:
     if spec.func is None:
@@ -192,7 +208,9 @@ def _traced_wave_knobs(source: SourceFile, spec: jitscope.JitSpec) -> List[Viola
     static = spec.static_params()
     out: List[Violation] = []
     for pname in spec.params():
-        if pname in _WAVE_STATIC_PARAMS and pname not in static:
+        if pname in static:
+            continue
+        if pname in _WAVE_STATIC_PARAMS:
             out.append(
                 Violation(
                     rule=RULE,
@@ -207,6 +225,101 @@ def _traced_wave_knobs(source: SourceFile, spec: jitscope.JitSpec) -> List[Viola
                     ),
                 )
             )
+        elif pname in _MESH_STATIC_PARAMS:
+            out.append(
+                Violation(
+                    rule=RULE,
+                    path=source.path,
+                    line=spec.line,
+                    message=(
+                        f"jit boundary {spec.name}() takes '{pname}' as a "
+                        "TRACED argument: the mesh/device-count/shard "
+                        "width selects the partitioned program structure, "
+                        "so every distinct value retraces the sharded "
+                        "cycle silently; declare it in static_argnames "
+                        "(it is configuration, like cfg)"
+                    ),
+                )
+            )
+    return out
+
+
+def _shard_map_body_knobs(source: SourceFile) -> List[Violation]:
+    """Mesh knobs in a shard_map BODY's parameter list (rule shape 4,
+    the shard_map boundary): operands of ``shard_map`` are traced
+    per-shard arrays, so a body taking ``mesh``/``n_devices``/... as a
+    parameter would receive the partitioning configuration as a traced
+    value.  The mesh rides the ``shard_map(..., mesh=)`` binding (or
+    the closure); flag the def.
+
+    Resolution is LEXICALLY SCOPED: a ``shard_map(body, ...)`` call
+    resolves ``body`` among the defs of its own enclosing scope first,
+    then the module scope — a file-wide name table would collide on
+    same-named nested defs (``body`` is the natural name; two unrelated
+    ``body`` defs in different functions must not flag each other)."""
+
+    def scope_defs_and_calls(scope_body):
+        """One lexical scope's direct defs and the calls in it, NOT
+        descending into nested function bodies (each gets its own
+        pass)."""
+        defs, calls = {}, []
+        stack = list(scope_body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node  # visible here; body is its own scope
+                continue
+            if isinstance(node, ast.Call):
+                calls.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        return defs, calls
+
+    module_defs, _ = scope_defs_and_calls(source.tree.body)
+    scopes = [source.tree] + [
+        n for n in ast.walk(source.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    out: List[Violation] = []
+    seen = set()
+    for scope in scopes:
+        defs, calls = scope_defs_and_calls(scope.body)
+        for node in calls:
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else ""
+            )
+            if not name.endswith("shard_map") and name != "shard_map_compat":
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Name):
+                continue
+            body = defs.get(node.args[0].id) or module_defs.get(
+                node.args[0].id
+            )
+            if body is None or id(body) in seen:
+                continue
+            seen.add(id(body))
+            params = [a.arg for a in (
+                body.args.posonlyargs + body.args.args + body.args.kwonlyargs
+            )]
+            for pname in params:
+                if pname in _MESH_STATIC_PARAMS:
+                    out.append(
+                        Violation(
+                            rule=RULE,
+                            path=source.path,
+                            line=body.lineno,
+                            message=(
+                                f"shard_map body {body.name}() takes "
+                                f"'{pname}' as a parameter: shard_map "
+                                "operands are traced per-shard values, "
+                                "so the mesh/device-count/shard width "
+                                "would retrace the partitioned program "
+                                "per value; bind it via "
+                                "shard_map(..., mesh=) or the closure "
+                                "instead"
+                            ),
+                        )
+                    )
     return out
 
 
@@ -250,6 +363,7 @@ def check(source: SourceFile) -> List[Violation]:
         out.extend(_traced_wave_knobs(source, spec))
     for spec in jitscope.jit_assignments(source.tree).values():
         out.extend(_traced_wave_knobs(source, spec))
+    out.extend(_shard_map_body_knobs(source))
     out.extend(_static_call_args(source))
     out.extend(_pytree_metadata(source))
     return out

@@ -31,6 +31,11 @@ Executor protocol (the two-phase seam):
   on the stacked transfer, fills each entry's ``reply``/``error``, and
   may return a post-batch hook the leader runs after followers are
   notified (host bookkeeping must not extend any critical path).
+* a closure carrying ``no_device = True`` (ISSUE 7: the Score memo's
+  prefix assembly) also runs off the lock but put NOTHING on the
+  device: no in-flight slot is taken, no launch is accounted (the
+  device-idle gap closes only if queued work drains), and a donating
+  ``run_exclusive(drain=True)`` never waits on it.
 
 Concurrency contract (lock order is launch -> state, never state ->
 launch while holding state):
@@ -115,6 +120,57 @@ class PendingRequest:
         self.done = False
         self.queue_delay_ms = 0.0
         self.batch_size = 0
+
+
+class ScoreMemo:
+    """Host-side memo of one Score launch's padded top-k readback
+    (ISSUE 7 satellite — the ROADMAP item-1 follow-on extending the
+    PR 6 Assign memo to Score).
+
+    Key: ``(snapshot id, CycleConfig)``; the entry records the
+    ``k``-BUCKET it was launched at (``kb`` — the sticky power-of-two
+    ``lax.top_k`` width) plus the host arrays of the stacked readback.
+    A later batch whose every caller needs ``k <= kb`` serves sliced
+    prefixes straight from the entry — no device launch, not even a
+    lazy snapshot rebuild — and prefix slicing of the padded top-k is
+    bit-identical to a fresh launch (``lax.top_k`` sorts descending
+    with index tie-breaks).  A batch needing a LARGER k misses and its
+    launch replaces the entry with the wider bucket.
+
+    Thread contract: the caller serializes access (the servicer's
+    ``_state_lock`` — lookups happen inside the launch section's state
+    capture, publishes after the readback).  Invalidation is the same
+    atomic clear-on-generation-bump the Assign memo uses: entries die
+    with the snapshot id they certified, and because the id is IN the
+    key, a stale publish racing the bump can never serve a future
+    request (the caller also guards the publish on the current id, so
+    the dict stays one-entry-deep per config).  Hit/miss accounting
+    lives on the ``koord_scorer_score_memo_total`` telemetry family,
+    fed by the servicer — not here.
+    """
+
+    __slots__ = ("_entries",)
+
+    def __init__(self):
+        self._entries = {}
+
+    def get(self, sid, cfg):
+        """The memo entry dict for (sid, cfg), or None.  Entry keys:
+        ``kb`` (launched top-k bucket), ``N``/``P`` (node/pod
+        capacities), ``ts``/``ti``/``feasible``/``valid`` (host-side
+        stacked readback)."""
+        return self._entries.get((sid, cfg))
+
+    def put(self, sid, cfg, data) -> None:
+        """Publish a readback; a narrower bucket never replaces a wider
+        one (the wider entry already serves every prefix)."""
+        prev = self._entries.get((sid, cfg))
+        if prev is not None and prev["kb"] >= data["kb"]:
+            return
+        self._entries[(sid, cfg)] = data
+
+    def invalidate(self) -> None:
+        self._entries.clear()
 
 
 class StaticGatherWindow:
@@ -394,6 +450,7 @@ class CoalescingDispatcher:
         if not batch:
             return None
         if readback is not None:
+            no_device = getattr(readback, "no_device", False)
             hook = None
             try:
                 try:
@@ -412,7 +469,7 @@ class CoalescingDispatcher:
                     if not isinstance(exc, Exception):
                         raise
             finally:
-                self._finalize(batch, launched=launched)
+                self._finalize(batch, launched=launched, no_device=no_device)
             self._run_hook(hook)
         return batch
 
@@ -446,6 +503,12 @@ class CoalescingDispatcher:
             # overlap is counted
             self._finalize(batch, launched=False)
             return batch, None, False
+        if getattr(readback, "no_device", False):
+            # off-lock HOST work (memo prefix assembly): the closure
+            # runs with the lock released like a readback, but nothing
+            # is on the device — no in-flight slot, no launch
+            # accounting, and a donating drain never waits on it
+            return batch, readback, False
         with self._cond:
             self._note_launch_locked(now)
             self._inflight += 1
@@ -488,7 +551,12 @@ class CoalescingDispatcher:
         if self._inflight == 0:
             self._idle_since = self._clock() if self._queue else None
 
-    def _finalize(self, batch: List[PendingRequest], launched: bool) -> None:
+    def _finalize(
+        self,
+        batch: List[PendingRequest],
+        launched: bool,
+        no_device: bool = False,
+    ) -> None:
         """Publish a batch's results: lifetime stats, ``done`` flips and
         the wakeup, all under the condition.  Runs off the launch lock —
         followers and the next leader proceed immediately."""
@@ -507,6 +575,16 @@ class CoalescingDispatcher:
                 entry.done = True
             if launched:
                 self._dec_inflight_locked()
+            elif no_device and self._inflight == 0:
+                # a memo-SERVED batch put nothing on the device but did
+                # answer its callers; once it drains the queue, a long
+                # quiet stretch must not count as device idle at the
+                # next real launch (same bookkeeping as
+                # _dec_inflight_locked).  Scoped to no_device batches
+                # only: an executor-REJECTED batch (every entry stale)
+                # served nobody, so its callers' queued time keeps the
+                # documented idle-gap-stays-open semantics.
+                self._idle_since = self._clock() if self._queue else None
             self._cond.notify_all()
 
     @staticmethod

@@ -40,6 +40,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from koordinator_tpu import native
@@ -208,9 +209,31 @@ _COMPANION_DEFAULTS = {"node_names": (), "pod_names": ()}
 
 
 class ResidentState:
-    """Numpy mirrors + the device-resident ClusterSnapshot built from them."""
+    """Numpy mirrors + the device-resident ClusterSnapshot built from them.
 
-    def __init__(self):
+    ``mesh``: a cluster mesh (parallel/mesh.py ``cluster_mesh``) makes
+    the resident snapshot MESH-SHARDED (ISSUE 7): node tensors split
+    along the mesh's node axis (each device holds one shard of the
+    cluster — the combined HBM is the capacity), pod rows and the
+    gang/quota tables replicate, and every leaf carries the
+    ``NamedSharding`` that ``parallel.mesh.snapshot_shardings``
+    prescribes — the per-field builders here apply the same policy
+    through ``node_sharding``/``replicated_sharding``, and
+    tests/test_mesh_resident.py asserts the two stay in lockstep
+    leaf-for-leaf (a field classified differently in the two places is
+    a test failure, not silent mis-sharding).  Warm
+    delta Syncs scatter SHARD-LOCALLY (solver/resident.py
+    ``_scatter_flat_sharded``): a delta for node *j* lands on the one
+    device owning *j*'s rows, no all-gather, no full re-upload — the
+    same O(changed) warm path, now over N chips.  A node bucket that
+    does not divide over the mesh falls back to single-chip placement
+    for that geometry (logged once); buckets are powers of two, so any
+    power-of-two device prefix always divides.
+    """
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh
+        self._mesh_skip_warned: set = set()
         self.node_alloc: Optional[np.ndarray] = None
         self.node_requested: Optional[np.ndarray] = None
         self.node_usage: Optional[np.ndarray] = None
@@ -546,10 +569,18 @@ class ResidentState:
         tensor_updates, derived = plan
         snap = self._snapshot
         nodes, pods, quotas = snap.nodes, snap.pods, snap.quotas
+        mesh = self.active_mesh()
 
         def updated(dev_arr, key, update):
             if update[0] == "delta":
-                return apply_flat_delta(dev_arr, update[1], update[2])
+                # node tensors scatter SHARD-LOCALLY on the mesh: only
+                # the device owning the touched rows writes (pod/quota
+                # tensors replicate, so their scatter runs everywhere —
+                # identical values, still donated in place)
+                return apply_flat_delta(
+                    dev_arr, update[1], update[2],
+                    mesh=mesh if key.startswith("node_") else None,
+                )
             return None  # full: rebuilt below from the committed mirror
 
         node_patch = {}
@@ -610,7 +641,7 @@ class ResidentState:
                 new = updated(getattr(quotas, field), key, tensor_updates[key])
                 if new is None:
                     arr = getattr(self, key)
-                    new = jnp.asarray(
+                    new = self._place_rep(
                         arr.astype(bool) if field == "limited" else arr
                     )
                 quota_patch[field] = new
@@ -664,10 +695,54 @@ class ResidentState:
         out[: a.shape[0]] = a
         return out
 
+    # -- mesh placement (ISSUE 7) --
+    def active_mesh(self):
+        """The cluster mesh for the CURRENT node bucket, or None (no
+        mesh configured, or the bucket does not divide over it — then
+        the snapshot stays single-chip for this geometry, logged once).
+        Buckets are powers of two, so power-of-two device prefixes
+        always divide."""
+        if self.mesh is None:
+            return None
+        nb = self.node_bucket
+        if nb and nb % self.mesh.size == 0:
+            return self.mesh
+        if nb and nb not in self._mesh_skip_warned:
+            self._mesh_skip_warned.add(nb)
+            logger.warning(
+                "node bucket %d does not divide over the %d-device "
+                "cluster mesh; resident snapshot stays single-chip for "
+                "this geometry",
+                nb, self.mesh.size,
+            )
+        return None
+
+    def _place_node(self, a):
+        """Place a node-major tensor: sharded along the cluster mesh's
+        node axis when mesh-resident, plain device array otherwise."""
+        m = self.active_mesh()
+        if m is None:
+            return jnp.asarray(a)
+        from koordinator_tpu.parallel.mesh import node_sharding
+
+        return jax.device_put(a, node_sharding(m, np.ndim(a)))
+
+    def _place_rep(self, a):
+        """Place a pod/gang/quota tensor: replicated over the cluster
+        mesh when mesh-resident (the wave certifier and the quota
+        admission recheck read them on every shard)."""
+        m = self.active_mesh()
+        if m is None:
+            return jnp.asarray(a)
+        from koordinator_tpu.parallel.mesh import replicated_sharding
+
+        return jax.device_put(a, replicated_sharding(m))
+
     # -- per-field device builders (shared by cold rebuild + warm patch;
     #    one implementation keeps the two paths bit-exact) --
     def _dev_padded2(self, key: str, rows: int) -> jnp.ndarray:
-        return jnp.asarray(
+        place = self._place_node if key.startswith("node_") else self._place_rep
+        return place(
             self._pad2(np.asarray(getattr(self, key), np.int64), rows)
         )
 
@@ -677,24 +752,24 @@ class ResidentState:
         fresh[:N] = (
             self.node_fresh if self.node_fresh is not None else np.ones(N, bool)
         )
-        return jnp.asarray(fresh)
+        return self._place_node(fresh)
 
     def _dev_agg_usage(self):
         if not _present(self.node_agg):
             return None
-        return jnp.asarray(_pad_rows_to(self.node_agg, self.node_bucket))
+        return self._place_node(_pad_rows_to(self.node_agg, self.node_bucket))
 
     def _dev_agg_fresh(self):
         if not _present(self.node_agg_fresh):
             return None
-        return jnp.asarray(
+        return self._place_node(
             _pad_rows_to(self.node_agg_fresh, self.node_bucket).astype(bool)
         )
 
     def _dev_prod_usage(self):
         if not _present(self.node_prod):
             return None
-        return jnp.asarray(
+        return self._place_node(
             _pad_rows_to(np.asarray(self.node_prod, np.int64), self.node_bucket)
         )
 
@@ -704,7 +779,7 @@ class ResidentState:
             if self.pod_estimated is not None
             else self.pod_requests
         )
-        return jnp.asarray(self._pad2(np.asarray(est, np.int64), self.pod_bucket))
+        return self._place_rep(self._pad2(np.asarray(est, np.int64), self.pod_bucket))
 
     def _dev_priority(self) -> jnp.ndarray:
         P = self.pod_requests.shape[0]
@@ -715,7 +790,7 @@ class ResidentState:
         )
         pprio = np.zeros(self.pod_bucket, np.int64)
         pprio[:P] = prio
-        return jnp.asarray(pprio)
+        return self._place_rep(pprio)
 
     def _dev_priority_class(self) -> jnp.ndarray:
         P = self.pod_requests.shape[0]
@@ -728,7 +803,7 @@ class ResidentState:
         # value bands (apis/extension/priority.go:84); padding is NONE —
         # zeros would mean PROD and wrongly put padded pods on the prod
         # filter/score path
-        return jnp.asarray(
+        return self._place_rep(
             _pc_column(self.pod_priority_class, prio, P, self.pod_bucket)
         )
 
@@ -739,7 +814,7 @@ class ResidentState:
         )
         pgang = np.full(self.pod_bucket, -1, np.int32)
         pgang[:P] = gang
-        return jnp.asarray(pgang)
+        return self._place_rep(pgang)
 
     def _dev_quota_id(self) -> jnp.ndarray:
         P = self.pod_requests.shape[0]
@@ -748,7 +823,7 @@ class ResidentState:
         )
         pquota = np.full(self.pod_bucket, -1, np.int32)
         pquota[:P] = quota
-        return jnp.asarray(pquota)
+        return self._place_rep(pquota)
 
     def _dev_gangs(self) -> GangTable:
         gmin = self.gang_min if self.gang_min is not None else np.zeros(0, np.int32)
@@ -758,7 +833,9 @@ class ResidentState:
         gm = np.zeros(G, np.int32)
         gm[: len(gmin)] = gmin
         return GangTable(
-            min_member=jnp.asarray(gm), valid=jnp.asarray(gvalid), names=()
+            min_member=self._place_rep(gm),
+            valid=self._place_rep(gvalid),
+            names=(),
         )
 
     def snapshot(self) -> ClusterSnapshot:
@@ -788,15 +865,15 @@ class ResidentState:
                 requested=(
                     self._dev_padded2("node_requested", nb)
                     if self.node_requested is not None
-                    else jnp.zeros((nb, R), jnp.int64)
+                    else self._place_node(np.zeros((nb, R), np.int64))
                 ),
                 usage=(
                     self._dev_padded2("node_usage", nb)
                     if self.node_usage is not None
-                    else jnp.zeros((nb, R), jnp.int64)
+                    else self._place_node(np.zeros((nb, R), np.int64))
                 ),
                 metric_fresh=self._dev_metric_fresh(),
-                valid=jnp.asarray(nvalid),
+                valid=self._place_node(nvalid),
                 agg_usage=self._dev_agg_usage(),
                 agg_fresh=self._dev_agg_fresh(),
                 prod_usage=self._dev_prod_usage(),
@@ -806,19 +883,19 @@ class ResidentState:
                 requests=self._dev_padded2("pod_requests", pb),
                 estimated=self._dev_estimated(),
                 priority_class=self._dev_priority_class(),
-                qos=jnp.zeros(pb, jnp.int32),
+                qos=self._place_rep(np.zeros(pb, np.int32)),
                 priority=self._dev_priority(),
                 gang_id=self._dev_gang_id(),
                 quota_id=self._dev_quota_id(),
-                valid=jnp.asarray(pvalid),
+                valid=self._place_rep(pvalid),
                 names=(),
             ),
             gangs=self._dev_gangs(),
             quotas=QuotaTable(
-                runtime=jnp.asarray(qrt),
-                used=jnp.asarray(quse),
-                limited=jnp.asarray(qlim),
-                valid=jnp.asarray(qvalid),
+                runtime=self._place_rep(qrt),
+                used=self._place_rep(quse),
+                limited=self._place_rep(qlim),
+                valid=self._place_rep(qvalid),
                 names=(),
             ),
         )

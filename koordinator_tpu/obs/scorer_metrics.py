@@ -29,6 +29,7 @@ koord_scorer_coalesce_requests_total   counter   —
 koord_scorer_coalesce_window_ms        gauge     —
 koord_scorer_coalesce_device_idle_ms   gauge     — (cumulative)
 koord_scorer_assign_memo_total         counter   result (hit|miss)
+koord_scorer_score_memo_total          counter   result (hit|miss)
 ====================================== ========= ==========================
 
 The ``koord_scorer_coalesce_*`` families observe the coalescing
@@ -43,7 +44,9 @@ device sat idle while work was queued (``_device_idle_ms``; the
 double-buffered pipeline exists to hold this near zero — watch its
 RATE, a flat line is a saturated pipeline).  ``assign_memo_total``
 counts Assign RPCs served from the (snapshot id, CycleConfig) result
-memo vs. those that ran a device cycle.
+memo vs. those that ran a device cycle; ``score_memo_total`` is the
+Score-side twin (ISSUE 7 satellite) — requests served as sliced
+prefixes of a memoized padded top-k readback vs. those that launched.
 
 The jit cache-miss counter is fed by
 ``analysis.retrace_guard.watch_cache_misses`` — the runtime companion of
@@ -79,6 +82,7 @@ COALESCE_REQUESTS = "koord_scorer_coalesce_requests_total"
 COALESCE_WINDOW = "koord_scorer_coalesce_window_ms"
 COALESCE_DEVICE_IDLE = "koord_scorer_coalesce_device_idle_ms"
 ASSIGN_MEMO = "koord_scorer_assign_memo_total"
+SCORE_MEMO = "koord_scorer_score_memo_total"
 
 # occupancy is a count-of-requests-per-launch, not a latency: its own
 # power-of-two buckets (the dispatcher caps batches at 16 by default;
@@ -134,6 +138,10 @@ _FAMILIES = (
     (ASSIGN_MEMO, "counter",
      "Assign RPCs served from the (snapshot, config) result memo (hit) "
      "vs. ran a device cycle (miss)"),
+    (SCORE_MEMO, "counter",
+     "Score requests served as sliced prefixes of the memoized "
+     "(snapshot, config, k-bucket) top-k readback (hit) vs. launched "
+     "a device batch (miss)"),
 )
 
 # per-family bucket overrides (histograms default to DEFAULT_BUCKETS_MS)
@@ -246,3 +254,6 @@ class ScorerMetrics:
 
     def count_assign_memo(self, result: str) -> None:
         self.registry.counter_add(ASSIGN_MEMO, 1, {"result": result})
+
+    def count_score_memo(self, result: str, n: int = 1) -> None:
+        self.registry.counter_add(SCORE_MEMO, int(n), {"result": result})

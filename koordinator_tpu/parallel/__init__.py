@@ -1,7 +1,15 @@
 from koordinator_tpu.parallel.mesh import (  # noqa: F401
+    CLUSTER_AXIS,
+    cluster_mesh,
     make_mesh,
+    node_sharding,
+    pow2_device_count,
+    replicated_sharding,
+    shard_cluster_snapshot,
+    shard_map_compat,
     shard_snapshot_for_scoring,
     shard_snapshot_for_assign,
+    snapshot_shardings,
 )
 from koordinator_tpu.parallel.shard_assign import (  # noqa: F401
     greedy_assign_sharded,

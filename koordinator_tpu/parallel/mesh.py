@@ -28,6 +28,122 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from koordinator_tpu.model.snapshot import ClusterSnapshot
 
+# the one mesh axis of the RESIDENT cluster: node rows spread over it,
+# pod rows and the gang/quota tables replicate (ISSUE 7).  Distinct from
+# make_mesh's 2-D scoring mesh: the resident snapshot's capacity axis is
+# nodes — that is the tensor that outgrows one chip's HBM first (the
+# 100k x 10k fp32 cost tensor is ~4 GB; the node tables scale with it).
+CLUSTER_AXIS = "nodes"
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """Version-compat shard_map: ``jax.shard_map`` (with its ``check_vma``
+    kwarg) graduated from ``jax.experimental.shard_map.shard_map`` (whose
+    equivalent kwarg is ``check_rep``); the installed jax may carry either.
+    Shared by parallel/shard_assign.py and solver/resident.py — the one
+    compat shim."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def cluster_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """The 1-D resident-snapshot mesh: every device holds one node-axis
+    shard of the cluster.  ``devices`` defaults to all visible devices;
+    pass a prefix (``jax.devices()[:k]``) to shard over fewer chips."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.asarray(devices), (CLUSTER_AXIS,))
+
+
+def pow2_device_count(n: int) -> int:
+    """Largest power of two <= ``n`` (>= 1).  Node buckets are powers of
+    two, so only a power-of-two mesh size is guaranteed to divide every
+    geometry — a 6-device cluster mesh would never activate (the
+    resident state falls back to single-chip placement on every
+    bucket); daemons round their device count down through this before
+    building the mesh."""
+    n = max(1, int(n))
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+def node_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """NamedSharding for a node-major tensor ([N], [N, R], [N, A, R]):
+    leading axis split over the cluster mesh, trailing axes whole."""
+    return NamedSharding(
+        mesh, P(CLUSTER_AXIS, *([None] * (ndim - 1)))
+    )
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def snapshot_shardings(snap: ClusterSnapshot, mesh: Mesh):
+    """A pytree of ``NamedSharding`` specs matching ``snap`` leaf-for-leaf:
+    node tensors sharded along the cluster axis, pod rows and the
+    gang/quota tables replicated.  ``jax.tree_util.tree_map`` over
+    ``(specs, snap)`` is how a complete snapshot lands mesh-resident
+    (:func:`shard_cluster_snapshot`, the embedded-API path);
+    bridge/state.py builds its resident leaves incrementally through
+    the same ``node_sharding``/``replicated_sharding`` policy, and
+    tests/test_mesh_resident.py asserts the two stay in lockstep —
+    this function is the one canonical statement of which leaf gets
+    which spec."""
+    node = lambda a: node_sharding(mesh, np.ndim(a))
+    rep = lambda a: replicated_sharding(mesh)
+    nodes = snap.nodes
+    return ClusterSnapshot(
+        nodes=dataclass_replace(
+            nodes,
+            allocatable=node(nodes.allocatable),
+            requested=node(nodes.requested),
+            usage=node(nodes.usage),
+            metric_fresh=node(nodes.metric_fresh),
+            valid=node(nodes.valid),
+            agg_usage=(
+                None if nodes.agg_usage is None else node(nodes.agg_usage)
+            ),
+            agg_fresh=(
+                None if nodes.agg_fresh is None else node(nodes.agg_fresh)
+            ),
+            prod_usage=(
+                None if nodes.prod_usage is None else node(nodes.prod_usage)
+            ),
+        ),
+        pods=jax.tree_util.tree_map(rep, snap.pods),
+        gangs=jax.tree_util.tree_map(rep, snap.gangs),
+        quotas=jax.tree_util.tree_map(rep, snap.quotas),
+    )
+
+
+def shard_cluster_snapshot(snap: ClusterSnapshot, mesh: Mesh) -> ClusterSnapshot:
+    """Place ``snap`` mesh-resident: one ``device_put`` per leaf with its
+    :func:`snapshot_shardings` spec.  The node bucket must divide evenly
+    over the mesh (buckets are powers of two — pick a power-of-two device
+    count, or a prefix)."""
+    n = snap.nodes.allocatable.shape[0]
+    if n % mesh.size:
+        raise ValueError(
+            f"node bucket {n} does not divide over {mesh.size} devices; "
+            "resize the mesh to a power-of-two prefix"
+        )
+    return jax.tree_util.tree_map(
+        lambda spec, leaf: jax.device_put(leaf, spec),
+        snapshot_shardings(snap, mesh),
+        snap,
+    )
+
 
 def _factor2(n: int):
     """Split n into (a, b) with a*b = n, as square as possible."""

@@ -59,33 +59,21 @@ from koordinator_tpu.solver.greedy import (
     step_feasible_scores,
 )
 
-# the packed-key encode/decode and the in-wave certification are the ONE
-# shared implementation (solver/wave.py) this path and the single-chip
-# wave_assign both consume — no copy-pasted math
+# the packed-key encode/decode, the cross-shard top-M merge and the
+# in-wave certification are the ONE shared implementation
+# (solver/wave.py) this path and the single-chip wave_assign both
+# consume — no copy-pasted math; the shard_map version-compat shim is
+# shared with the resident scatter (parallel/mesh.py)
+from koordinator_tpu.parallel.mesh import shard_map_compat as _shard_map
 from koordinator_tpu.solver.wave import (
     is_most_allocated,
+    merge_topm,
+    merge_topm_keys,
     pack_keys,
     decode_key,
     resolve_wave,
     score_feasible,
 )
-
-
-def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
-    """Version-compat shard_map: ``jax.shard_map`` (with its ``check_vma``
-    kwarg) graduated from ``jax.experimental.shard_map.shard_map`` (whose
-    equivalent kwarg is ``check_rep``); the installed jax may carry either."""
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(
-            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=check_vma,
-        )
-    from jax.experimental.shard_map import shard_map
-
-    return shard_map(
-        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-        check_rep=check_vma,
-    )
 
 
 def _pad_nodes_to(snap: ClusterSnapshot, multiple: int) -> ClusterSnapshot:
@@ -496,13 +484,10 @@ def _assign_waves(
             # the ONE collective of the round
             gathered = lax.all_gather(payload, ax)  # leading [S, ...]
 
-            def _flat(a):  # [S, W, M, ...] -> [W, S*M, ...]
-                a = jnp.moveaxis(a, 0, 1)
-                return a.reshape((W, -1) + a.shape[3:])
-
             if most_alloc:
-                # frozen per-pod global top-M keys (k_M certification bar)
-                cand_key, _ = lax.top_k(_flat(gathered["key"]), M)
+                # frozen per-pod global top-M keys (k_M certification
+                # bar), via the shared cross-shard merge
+                cand_key = merge_topm_keys(gathered["key"], M)
                 R_ = alloc.shape[1]
                 u_gid = gathered["u_gid"].reshape(-1)  # [U = S*W*M]
                 U = u_gid.shape[0]
@@ -528,17 +513,9 @@ def _assign_waves(
                     universe["okp"] = gathered["u_okp"].reshape(U)
                 cand = None
             else:
-                g = {k: _flat(v) for k, v in gathered.items()}
-                gkeys, gsel = lax.top_k(g["key"], M)  # [W, M] global candidates
-
-                def take(a):
-                    sel = gsel
-                    while sel.ndim < a.ndim:
-                        sel = sel[..., None]
-                    return jnp.take_along_axis(a, sel, axis=1)
-
-                cand = {k: take(v) for k, v in g.items() if k != "key"}
-                cand_key = gkeys
+                # the shared cross-shard top-M merge (solver/wave.py):
+                # global candidates + their state rows, [W, M]
+                cand_key, cand = merge_topm(gathered, M)
                 universe = None
 
             # the SHARED certification resolver (solver/wave.py): commit

@@ -55,8 +55,8 @@ class _LeaderGatedServicer(ScorerServicer):
     """Assign requires leadership; Score/Sync serve on any replica (they
     are read-only against the resident snapshot)."""
 
-    def __init__(self, cfg, is_leader, mesh=None, state_dir=None):
-        super().__init__(cfg, mesh=mesh, state_dir=state_dir)
+    def __init__(self, cfg, is_leader, **kwargs):
+        super().__init__(cfg, **kwargs)
         self._is_leader = is_leader
 
     def assign(self, req, ctx=None):
@@ -80,6 +80,9 @@ class SchedulerServer:
         enable_grpc: bool = True,
         shard: bool = False,
         state_dir: Optional[str] = None,
+        mesh_devices: Optional[str] = None,
+        pipeline_depth: Optional[int] = None,
+        coalesce_cap_ms: Optional[float] = None,
     ):
         # persistent compile cache under the daemon's state dir: a
         # restarted sidecar skips the multi-second (16.5s on TPU,
@@ -123,20 +126,70 @@ class SchedulerServer:
             identity or f"{socket.gethostname()}-{os.getpid()}",
         )
         mesh = None
-        if shard:
+        mesh_resident = False
+        if mesh_devices:
+            # MESH-RESIDENT serving (ISSUE 7): the snapshot itself lives
+            # sharded over the 1-D cluster mesh — node tensors split,
+            # pod/quota rows replicate, warm deltas scatter into the
+            # owning shard, Assign runs the round-based multi-chip cycle
+            import jax
+
+            from koordinator_tpu.parallel import (
+                cluster_mesh,
+                pow2_device_count,
+            )
+
+            devices = jax.devices()
+            if mesh_devices == "auto":
+                want = len(devices)
+            else:
+                try:
+                    want = int(mesh_devices)
+                except ValueError:
+                    raise ValueError(
+                        f"--mesh must be a device count or 'auto', got "
+                        f"{mesh_devices!r}"
+                    ) from None
+            # node buckets are powers of two: a non-power-of-two mesh
+            # would never divide any geometry, silently leaving the
+            # operator on single-chip capacity — round DOWN so the mesh
+            # always activates
+            n = pow2_device_count(min(max(1, want), len(devices)))
+            if n != want:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "--mesh %s rounded down to %d devices (largest "
+                    "power of two <= visible %d: node buckets are "
+                    "powers of two, so only a power-of-two mesh "
+                    "divides every geometry)",
+                    mesh_devices, n, len(devices),
+                )
+            mesh = cluster_mesh(devices[:n])
+            mesh_resident = True
+        elif shard:
             # serve the round-based sharded cycle over every visible
             # device (parallel/shard_assign.py; Assign replies
-            # path="shard", bit-identical with single-chip)
+            # path="shard", bit-identical with single-chip).  The
+            # snapshot stays single-chip-resident; --mesh supersedes
+            # this when the cluster outgrows one device's memory
             import jax
 
             from koordinator_tpu.parallel import make_mesh
 
             mesh = make_mesh(jax.devices())
+        servicer_kw = {}
+        if pipeline_depth is not None:
+            servicer_kw["pipeline_depth"] = int(pipeline_depth)
+        if coalesce_cap_ms is not None:
+            servicer_kw["coalesce_cap_ms"] = float(coalesce_cap_ms)
         self.servicer = _LeaderGatedServicer(
             cfg, lambda: self.elector.is_leader, mesh=mesh,
+            mesh_resident=mesh_resident,
             # flight-recorder dumps (obs/flight.py) land under
             # <state-dir>/flight on cycle error / demotion / SIGUSR1
             state_dir=state_dir,
+            **servicer_kw,
         )
         self.api = APIService()
         self.uds_path = uds_path
@@ -263,7 +316,39 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument(
         "--shard", action="store_true",
         help="serve the round-based multi-chip Assign over every visible "
-        "device (jax.sharding.Mesh; placements stay bit-identical)",
+        "device (jax.sharding.Mesh; placements stay bit-identical); the "
+        "snapshot stays single-chip-resident — see --mesh for true "
+        "capacity scaling",
+    )
+    ap.add_argument(
+        "--mesh", dest="mesh_devices",
+        default=os.environ.get("KOORD_MESH_DEVICES") or None,
+        help="serve the MESH-RESIDENT snapshot: shard the cluster's node "
+        "tensors over N devices ('auto' = all visible; the combined HBM "
+        "is the capacity), replicate pod/quota rows, scatter warm deltas "
+        "into the owning shard only; placements stay bit-identical to "
+        "single-chip (env: KOORD_MESH_DEVICES)",
+    )
+    ap.add_argument(
+        "--pipeline-depth", type=int,
+        default=(
+            int(os.environ["KOORD_PIPELINE_DEPTH"])
+            if os.environ.get("KOORD_PIPELINE_DEPTH") else None
+        ),
+        help="launched-but-unread device batches allowed in flight "
+        "(default 2 = double buffering; 1 = serial readbacks, the bench "
+        "baseline; env: KOORD_PIPELINE_DEPTH) — a TPU tuning knob, no "
+        "code edit needed (docs/PIPELINE.md)",
+    )
+    ap.add_argument(
+        "--coalesce-cap-ms", type=float,
+        default=(
+            float(os.environ["KOORD_COALESCE_CAP_MS"])
+            if os.environ.get("KOORD_COALESCE_CAP_MS") else None
+        ),
+        help="clamp of the adaptive gather window's straggler wait "
+        "(default 5.0 ms; env: KOORD_COALESCE_CAP_MS) — bounds the "
+        "latency tax a burst-gathering leader may pay (docs/PIPELINE.md)",
     )
     ap.add_argument(
         "--state-dir", default=None,
@@ -286,6 +371,9 @@ def main(argv=None) -> int:
         http_port=args.http_port,
         shard=args.shard,
         state_dir=args.state_dir,
+        mesh_devices=args.mesh_devices,
+        pipeline_depth=args.pipeline_depth,
+        coalesce_cap_ms=args.coalesce_cap_ms,
     ).start()
     try:
         threading.Event().wait()

@@ -44,7 +44,47 @@ def _scatter_flat(arr, idx, val):
     return flat.reshape(arr.shape)
 
 
-def apply_flat_delta(arr: "jax.Array", idx, val) -> "jax.Array":
+@partial(jax.jit, static_argnames=("mesh",), donate_argnums=(0,))
+def _scatter_flat_sharded(arr, idx, val, *, mesh):
+    """Shard-LOCAL scatter into a mesh-resident node tensor (ISSUE 7).
+
+    ``arr`` is sharded along its leading (node) axis over the cluster
+    mesh; ``idx``/``val`` replicate.  Each device rebases the global
+    flat indices against its own shard's flat offset and scatters only
+    the cells it owns — indices outside the shard (including the pad
+    slots, which target ``arr.size`` globally) rebase out of the local
+    range and are dropped.  NO collective runs: a delta for node *j*
+    lands on the one device holding *j*'s rows, every other shard's
+    program is a no-op scatter, and the donated pre-delta buffers alias
+    in place per shard exactly like the single-chip path.
+
+    Shardings are preserved (in_specs == out_specs), so the warm path
+    never silently regathers the snapshot; one compiled program per
+    (shape, dtype, bucket, mesh), same sticky-bucket economics as
+    ``_scatter_flat``.
+    """
+    from koordinator_tpu.parallel.mesh import CLUSTER_AXIS, shard_map_compat
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(CLUSTER_AXIS, *([None] * (arr.ndim - 1)))
+
+    def body(a, idx, val):
+        # contiguous leading-axis sharding: shard s owns the global flat
+        # range [s * a.size, (s + 1) * a.size)
+        start = jax.lax.axis_index(CLUSTER_AXIS).astype(idx.dtype) * a.size
+        loc = idx - start
+        owned = (loc >= 0) & (loc < a.size)
+        loc = jnp.where(owned, loc, a.size)  # not-mine -> dropped
+        flat = a.reshape(-1)
+        flat = flat.at[loc].set(val.astype(a.dtype), mode="drop")
+        return flat.reshape(a.shape)
+
+    return shard_map_compat(
+        body, mesh=mesh, in_specs=(spec, P(), P()), out_specs=spec
+    )(arr, idx, val)
+
+
+def apply_flat_delta(arr: "jax.Array", idx, val, mesh=None) -> "jax.Array":
     """Apply a sparse (flat-index, value) delta to a resident device array.
 
     ``idx``/``val`` are host arrays in the UNPADDED mirror's flat index
@@ -63,6 +103,10 @@ def apply_flat_delta(arr: "jax.Array", idx, val) -> "jax.Array":
     invalidates buffers no in-flight launch can still read back; the
     scatter itself is a non-blocking async launch, which is what lets
     the next Sync's decode overlap it (docs/PIPELINE.md).
+
+    ``mesh``: a cluster mesh (parallel/mesh.py) routes the scatter
+    through the shard-local program — ``arr`` must be node-sharded over
+    it; only the shard owning each index writes, nothing regathers.
     """
     idx = np.asarray(idx, np.int64)
     val = np.asarray(val, np.int64)
@@ -72,4 +116,8 @@ def apply_flat_delta(arr: "jax.Array", idx, val) -> "jax.Array":
         pad = bucket - len(idx)
         idx = np.concatenate([idx, np.full(pad, arr.size, np.int64)])
         val = np.concatenate([val, np.zeros(pad, np.int64)])
-    return _scatter_flat(arr, jnp.asarray(idx), jnp.asarray(val))
+    if mesh is not None and mesh.size > 1:
+        scatter, kw = _scatter_flat_sharded, {"mesh": mesh}
+    else:
+        scatter, kw = _scatter_flat, {}
+    return scatter(arr, jnp.asarray(idx), jnp.asarray(val), **kw)

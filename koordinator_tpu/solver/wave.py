@@ -121,6 +121,46 @@ def score_feasible(score):
     return score > SENTINEL_SCORE // 2
 
 
+def flatten_shards(a):
+    """Align an ``all_gather``'d per-shard candidate payload by wave
+    lane: ``[S, W, M, ...] -> [W, S*M, ...]``.  The flattened axis is
+    the pool the cross-shard top-M merge selects from."""
+    a = jnp.moveaxis(a, 0, 1)
+    return a.reshape((a.shape[0], -1) + a.shape[3:])
+
+
+def merge_topm_keys(gathered_key, top_m: int):
+    """Key-only cross-shard merge: the frozen global top-M packed keys
+    per wave pod (ONE ``lax.top_k`` over the flattened ``[W, S*M]``
+    pool).  The packed-key tie-break (highest score, lowest node index)
+    rides the key encoding itself, so this merge orders identically to
+    the scan path's ``pmax``/``argmax`` — the MostAllocated universe
+    certificate needs only the resulting ``k_M`` bar."""
+    cand_key, _ = lax.top_k(flatten_shards(gathered_key), top_m)
+    return cand_key
+
+
+def merge_topm(gathered: dict, top_m: int):
+    """The full cross-shard top-M merge for the k_M (LeastAllocated)
+    path: flatten every gathered row ``[S, W, M, ...] -> [W, S*M, ...]``,
+    select the global top-M by packed ``key``, and gather each winner's
+    state rows along.  Returns ``(cand_key i64[W, M], cand dict)`` in
+    exactly the shape :func:`resolve_wave` consumes — the one merge
+    collective's worth of data every shard reduces identically, keeping
+    the round bit-identical to the single-chip oracle."""
+    g = {k: flatten_shards(v) for k, v in gathered.items()}
+    gkeys, gsel = lax.top_k(g["key"], top_m)
+
+    def take(a):
+        sel = gsel
+        while sel.ndim < a.ndim:
+            sel = sel[..., None]
+        return jnp.take_along_axis(a, sel, axis=1)
+
+    cand = {k: take(v) for k, v in g.items() if k != "key"}
+    return gkeys, cand
+
+
 def resolve_wave(
     cand_key,  # i64[W, M] frozen global top-M keys per wave pod
     *,

@@ -412,6 +412,54 @@ class TestPipelineMechanics:
         t2.join(timeout=5.0)
         assert d.stats()["device_idle_ms"] >= 0.0
 
+    def test_memo_served_batch_does_not_open_idle_gap(self):
+        """A ``no_device`` batch (the Score memo's prefix assembly)
+        answers its callers without touching the device; once it drains
+        the queue, a long quiet stretch must NOT count as device idle
+        at the next real launch.  (The no-launch paths used to leave
+        the idle clock running — harmless while such batches were rare,
+        badly inflating once the memo made them common.)  An
+        executor-REJECTED batch served nobody and keeps the documented
+        idle-gap-stays-open semantics."""
+        now = [0.0]
+        mode = {"kind": "launch"}
+
+        def executor(batch):
+            if mode["kind"] == "memo":
+                def serve():
+                    for e in batch:
+                        e.reply = "memo"
+
+                serve.no_device = True
+                return serve
+            if mode["kind"] == "reject":
+                for e in batch:
+                    e.error = ValueError("stale")
+                return None
+            return lambda: None
+
+        d = CoalescingDispatcher(
+            executor, max_batch=4, clock=lambda: now[0]
+        )
+        d.submit("warm")  # real launch: warm-up, never counted
+        mode["kind"] = "memo"
+        d.submit("memo-served")  # no device work; queue drains
+        now[0] += 100.0  # a long quiet stretch with an empty queue
+        mode["kind"] = "launch"
+        d.submit("real")
+        assert d.stats()["device_idle_ms"] == 0.0
+        # the rejected path is unchanged: its callers' queued time still
+        # reads as device idle at the next launch
+        mode["kind"] = "reject"
+        try:
+            d.submit("stale")
+        except ValueError:
+            pass
+        now[0] += 5.0
+        mode["kind"] = "launch"
+        d.submit("real2")
+        assert d.stats()["device_idle_ms"] >= 5000.0
+
 
 class TestAdaptiveGatherWindow:
     def test_converges_on_the_interarrival_ewma(self):
@@ -825,6 +873,144 @@ class TestAssignMemo:
         assert all(o == ok[0] for o in ok)
         serial = sv.assign(pb2.AssignRequest(snapshot_id=sid))
         assert ok[0][0] == list(serial.assignment)
+
+
+class TestScoreMemo:
+    """ISSUE 7 satellite (ROADMAP item-1 follow-on): a Score storm
+    against an unchanged (snapshot id, CycleConfig, k-bucket) serves
+    sliced prefixes from ONE launch's memoized readback — invalidated
+    atomically on generation bump, hit/miss on its own counter family."""
+
+    def _memo_counts(self, sv):
+        reg = sv.telemetry.registry
+        return (
+            reg.get("koord_scorer_score_memo_total", {"result": "miss"})
+            or 0,
+            reg.get("koord_scorer_score_memo_total", {"result": "hit"})
+            or 0,
+        )
+
+    def test_repeat_scores_hit_and_slice_prefixes(self):
+        sv, _ = _servicer(seed=67)
+        sid = sv.snapshot_id()
+        first = _score_fields(sv.score(
+            pb2.ScoreRequest(snapshot_id=sid, top_k=3, flat=True)
+        ))
+        assert self._memo_counts(sv) == (1, 0)
+        # same k and a SMALLER k both serve from the one launch's
+        # padded readback; the smaller k is a strict prefix slice
+        again = _score_fields(sv.score(
+            pb2.ScoreRequest(snapshot_id=sid, top_k=3, flat=True)
+        ))
+        smaller = sv.score(
+            pb2.ScoreRequest(snapshot_id=sid, top_k=2, flat=True)
+        )
+        assert self._memo_counts(sv) == (1, 2)
+        assert again == first
+        # bit-identical with what a fresh memo-less launch answers
+        fresh, _ = _servicer(seed=67, score_memo=False)
+        want = fresh.score(pb2.ScoreRequest(
+            snapshot_id=fresh.snapshot_id(), top_k=2, flat=True
+        ))
+        assert _score_fields(smaller) == _score_fields(want)
+
+    def test_wider_k_misses_and_widens_the_bucket(self):
+        # a cluster big enough that the sticky k-buckets actually tier
+        # (node bucket 32 > the minimum bucket of 8): k=2 launches at
+        # kb=8, k=9 needs 16
+        rng = np.random.RandomState(69)
+        state = _random_state(rng, n_nodes=20, n_pods=12, with_quota=False)
+        sv = ScorerServicer()
+        sv.sync(_full_sync_request(state))
+        sid = sv.snapshot_id()
+        sv.score(pb2.ScoreRequest(snapshot_id=sid, top_k=2, flat=True))
+        kb = sv._score_memo.get(sid, sv.cfg)["kb"]
+        assert kb < sv.state.node_bucket
+        # a k beyond the memoized bucket must relaunch (a prefix of the
+        # narrow readback cannot serve it), then replace the entry
+        wide = sv.score(pb2.ScoreRequest(
+            snapshot_id=sid, top_k=kb + 1, flat=True
+        ))
+        assert self._memo_counts(sv) == (2, 0)
+        assert sv._score_memo.get(sid, sv.cfg)["kb"] > kb
+        # ... and the widened entry serves the original k as a prefix,
+        # bit-identical
+        narrow = sv.score(pb2.ScoreRequest(
+            snapshot_id=sid, top_k=2, flat=True
+        ))
+        assert self._memo_counts(sv) == (2, 1)
+        fresh = ScorerServicer(score_memo=False)
+        fresh.sync(_full_sync_request(state))
+        want = fresh.score(pb2.ScoreRequest(
+            snapshot_id=fresh.snapshot_id(), top_k=2, flat=True
+        ))
+        assert _score_fields(narrow) == _score_fields(want)
+        del wide
+
+    def test_generation_bump_invalidates_atomically(self):
+        sv, state = _servicer(seed=71)
+        sid = sv.snapshot_id()
+        sv.score(pb2.ScoreRequest(snapshot_id=sid, top_k=2, flat=True))
+        assert sv._score_memo.get(sid, sv.cfg) is not None
+        prev = state["node_usage"].copy()
+        state["node_usage"][0, 0] += 7
+        req = pb2.SyncRequest()
+        req.nodes.usage.CopyFrom(numpy_to_tensor(state["node_usage"], prev))
+        sv.sync(req)
+        # the memo died with the generation it certified
+        assert sv._score_memo.get(sid, sv.cfg) is None
+        new_sid = sv.snapshot_id()
+        sv.score(pb2.ScoreRequest(snapshot_id=new_sid, top_k=2, flat=True))
+        assert self._memo_counts(sv) == (2, 0)
+        assert sv._score_memo.get(new_sid, sv.cfg) is not None
+
+    def test_concurrent_storm_shares_one_launch(self):
+        sv, _ = _servicer(seed=73, coalesce_window_ms=50.0)
+        sid = sv.snapshot_id()
+        # prime the memo, then storm: every storm request must be a hit
+        want = _score_fields(sv.score(
+            pb2.ScoreRequest(snapshot_id=sid, top_k=3, flat=True)
+        ))
+        n = 8
+        results = [None] * n
+        barrier = threading.Barrier(n)
+
+        def worker(i):
+            barrier.wait()
+            results[i] = _score_fields(sv.score(pb2.ScoreRequest(
+                snapshot_id=sid, top_k=(i % 3) + 1, flat=True
+            )))
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        miss, hit = self._memo_counts(sv)
+        assert miss == 1 and hit == n
+        # k=3 callers answer exactly the primed reply; smaller ks are
+        # its prefixes (checked against fresh memo-less launches)
+        fresh, _ = _servicer(seed=73, score_memo=False)
+        for i, got in enumerate(results):
+            k = (i % 3) + 1
+            if k == 3:
+                assert got == want
+            else:
+                ref = fresh.score(pb2.ScoreRequest(
+                    snapshot_id=fresh.snapshot_id(), top_k=k, flat=True
+                ))
+                assert got == _score_fields(ref)
+
+    def test_disabled_memo_always_launches(self):
+        sv, _ = _servicer(seed=79, score_memo=False)
+        sid = sv.snapshot_id()
+        for _ in range(3):
+            sv.score(pb2.ScoreRequest(snapshot_id=sid, top_k=2, flat=True))
+        assert sv._score_memo is None
+        assert self._memo_counts(sv) == (0, 0)
+        assert sv.dispatch.stats()["batches"] == 3
 
 
 class TestDonationSafetyInFlight:

@@ -282,6 +282,108 @@ class TestRetraceHazard:
         batched = jax.jit(_inner, static_argnames=("wave",))
         """) == []
 
+    def test_traced_mesh_knobs_caught(self):
+        """ISSUE 7: a jit boundary taking mesh/device-count/shard-width
+        traced re-specializes the partitioned program per value — the
+        same silent retrace class as the wave knobs; decorator and
+        call-form spellings both caught."""
+        got = lint("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def sharded_cycle(snapshot, cfg, mesh):
+            return snapshot
+
+        def _inner(arr, n_shards):
+            return arr
+
+        scatter = jax.jit(_inner)
+        """)
+        msgs = [(v.rule, v.message) for v in got]
+        assert len(msgs) == 2
+        assert all(r == "retrace-hazard" for r, _ in msgs)
+        assert sum("'mesh'" in m for _, m in msgs) == 1
+        assert sum("'n_shards'" in m for _, m in msgs) == 1
+        assert all("static_argnames" in m for _, m in msgs)
+
+    def test_static_mesh_knobs_are_clean(self):
+        assert lint("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg", "mesh"))
+        def sharded_cycle(snapshot, cfg, mesh):
+            return snapshot
+
+        def _inner(arr, n_shards):
+            return arr
+
+        scatter = jax.jit(_inner, static_argnames=("n_shards",))
+        """) == []
+
+    def test_mesh_knob_in_shard_map_body_caught(self):
+        """A shard_map body taking a mesh knob as a PARAMETER receives
+        it as a traced per-shard operand; the mesh belongs in the
+        shard_map(..., mesh=) binding or the closure."""
+        got = lint("""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def run(arr, mesh):
+            def body(a, num_shards):
+                return a * num_shards
+
+            return jax.shard_map(
+                body, mesh=mesh, in_specs=(P("nodes"), P()),
+                out_specs=P("nodes"),
+            )(arr, mesh.size)
+        """)
+        assert len(got) == 1
+        assert got[0].rule == "retrace-hazard"
+        assert "'num_shards'" in got[0].message
+        assert "shard_map" in got[0].message
+
+    def test_shard_map_body_without_mesh_knobs_is_clean(self):
+        assert lint("""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def run(arr, mesh):
+            def body(a, deltas):
+                return a + deltas
+
+            return jax.shard_map(
+                body, mesh=mesh, in_specs=(P("nodes"), P()),
+                out_specs=P("nodes"),
+            )(arr, arr)
+        """) == []
+
+    def test_shard_map_body_resolution_is_lexically_scoped(self):
+        """Two same-named nested defs in different functions must not
+        cross-resolve: the clean shard_map body in run() resolves to
+        run's own `body`, never to the unrelated `body(a, num_shards)`
+        elsewhere in the file (a file-wide name table collided here)."""
+        assert lint("""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def run(arr, mesh):
+            def body(a, deltas):
+                return a + deltas
+
+            return jax.shard_map(
+                body, mesh=mesh, in_specs=(P("nodes"), P()),
+                out_specs=P("nodes"),
+            )(arr, arr)
+
+        def unrelated():
+            def body(a, num_shards):
+                return a * num_shards
+
+            return body
+        """) == []
+
     def test_namey_pytree_metadata(self):
         got = lint("""
         import dataclasses

@@ -399,13 +399,27 @@ class TestServicerTelemetry:
         assert "sync_decode" in names
         assert "score_dispatch" in names and "score_readback" in names
         assert "dispatch" in names and "readback" in names
-        # a Score with NO pending cycle commits its own record
+        # a Score with NO pending cycle commits its own record — first
+        # a LAUNCHED one (memo invalidated so the batch really runs)...
+        sv._score_memo.invalidate()
         sv.score(pb2.ScoreRequest(
             snapshot_id=reply.snapshot_id, top_k=4, flat=True
         ))
         records = sv.telemetry.flight.snapshot()
         assert len(records) == 2
         assert records[-1]["notes"]["path"] == "score"
+        # ... then a memo-served one (ISSUE 7): still its own record,
+        # labeled path="memo" with the memo_hit note, so prefix slices
+        # never masquerade as device cycles
+        sv.score(pb2.ScoreRequest(
+            snapshot_id=reply.snapshot_id, top_k=4, flat=True
+        ))
+        records = sv.telemetry.flight.snapshot()
+        assert len(records) == 3
+        assert records[-1]["notes"]["path"] == "memo"
+        assert records[-1]["notes"]["memo_hit"] is True
+        # the memo record still says which snapshot it certified
+        assert records[-1]["snapshot_id"] == reply.snapshot_id
 
     def test_concurrent_assigns_get_exact_records(self, tmp_path):
         """ISSUE 6 correlation fix #1: each Assign RPC records on its

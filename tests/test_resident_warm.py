@@ -588,6 +588,56 @@ class TestRetraceBudget:
             sv.telemetry.flight.document("test")
         ) == []
 
+    def test_warm_mesh_sharded_stream_is_retrace_free(self):
+        """ISSUE 7 acceptance: the MESH-SHARDED resident snapshot keeps
+        the zero-retrace invariant per shard.  A warm delta-Sync /
+        Score / shard-Assign stream against a snapshot sharded over all
+        8 forced-host devices must hit zero jit cache misses after one
+        warm-up cycle — the shard-local scatter compiles once per
+        (shape, bucket, mesh), the cross-shard top-M merge rides the
+        static (cfg, mesh, wave, top_m) key, and the in/out sharding
+        match means no hidden resharding programs are minted."""
+        import jax
+
+        from koordinator_tpu.analysis import retrace_guard
+        from koordinator_tpu.parallel import cluster_mesh
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 (virtual) devices")
+        rng = np.random.RandomState(37)
+        state = _random_state(rng, n_nodes=5, n_pods=12, with_quota=False)
+        sv = ScorerServicer(
+            mesh=cluster_mesh(jax.devices()), mesh_resident=True
+        )
+        sv.sync(_full_sync_request(state))
+        snap = sv.state.snapshot()
+        # really sharded: node rows split over all 8 devices
+        assert len(snap.nodes.allocatable.sharding.device_set) == 8
+        # warm-up compiles: sharded scatter, score/top_k, shard cycle
+        sv.score(pb2.ScoreRequest(
+            snapshot_id=sv.snapshot_id(), top_k=3, flat=True
+        ))
+        first = self._warm_step(sv, state)
+        assert first.path == "shard"
+        with retrace_guard(budget=0) as counter:
+            for _ in range(4):
+                prev = state["node_usage"].copy()
+                state["node_usage"][0, 1] += 1
+                req = pb2.SyncRequest()
+                req.nodes.usage.CopyFrom(
+                    numpy_to_tensor(state["node_usage"], prev)
+                )
+                sv.sync(req)
+                assert sv.state.last_sync_path == "warm"
+                sv.score(pb2.ScoreRequest(
+                    snapshot_id=sv.snapshot_id(), top_k=3, flat=True
+                ))
+                reply = sv.assign(
+                    pb2.AssignRequest(snapshot_id=sv.snapshot_id())
+                )
+        assert counter.traces == 0 and counter.compiles == 0
+        assert reply.path == "shard"
+
     def test_warm_stream_with_coalesced_score_is_retrace_free(self):
         """ISSUE 5 acceptance: the coalescing dispatch engine is always
         on in the servicer, and a warm delta-Sync/Score/Assign stream
