@@ -250,6 +250,24 @@ def _validate_artifact(line: Optional[str]) -> list:
     _finite_nonneg("shard_sync_ms")
     _finite_nonneg("mesh_assign_ms")
     _finite_nonneg("mesh_speedup")
+    # replicated-serving-tier probe fields (ISSUE 8): the tier-vs-one-
+    # daemon read scaling, the follower lag, and the overload shed rate
+    # the acceptance tracks — malformed ones must not be archived
+    rc = doc.get("replica_count")
+    if rc is not None and (
+        isinstance(rc, bool) or not isinstance(rc, int) or rc < 1
+    ):
+        problems.append("'replica_count' must be an int >= 1")
+    _finite_nonneg("replica_lag_ms")
+    _finite_nonneg("replica_read_speedup")
+    sr = doc.get("shed_rate")
+    if sr is not None and (
+        isinstance(sr, bool)
+        or not isinstance(sr, (int, float))
+        or sr != sr
+        or not 0.0 <= sr <= 1.0
+    ):
+        problems.append("'shed_rate' must be null or a number in [0, 1]")
     # per-stage span summary (ISSUE 4): stage name -> milliseconds, or
     # null for a stage that measured nothing (a failed best-effort leg
     # must stay VISIBLE as null, never invented) — so BENCH_*.json
@@ -647,7 +665,12 @@ def _score_storm(sock_path, snapshot_id, clients=8, per_client=3, top_k=32,
     cost pollutes the comparison).  Returns ``(wall_s, sorted
     per-request latencies ms, reply digest set, errors)`` — the digest
     set proves the demultiplexed coalesced replies are byte-identical
-    to the serialized server's for the same snapshot."""
+    to the serialized server's for the same snapshot.
+
+    The replica tier's M x N storms (ISSUE 8) do NOT drive this with a
+    socket list from one process — a single bench-process GIL would
+    pace the arrivals; ``--replica-storm`` runs one of these per
+    replica instead (``replica_storm``)."""
     import hashlib
     import socket
     import struct
@@ -726,6 +749,78 @@ def _score_storm(sock_path, snapshot_id, clients=8, per_client=3, top_k=32,
         t.join(timeout=600)
     wall_s = time.perf_counter() - t0
     return wall_s, sorted(lats), digests, errors
+
+
+def _shed_storm(sock_path, snapshot_id, clients=32, top_k=32):
+    """Overload burst against an admission-gated daemon (ISSUE 8): each
+    worker fires exactly ONE flat top-k Score from behind a barrier, so
+    the gate sees the whole burst at once.  Returns ``(served digest
+    set, shed count, other error list, max shed-reply latency ms)`` —
+    served replies prove in-flight work completed untouched, the shed
+    latency proves rejections are fast (bounded), never queued."""
+    import hashlib
+    import socket
+    import struct
+
+    from koordinator_tpu.bridge.codegen import pb2
+    from koordinator_tpu.bridge.udsserver import METHOD_SCORE
+
+    body = pb2.ScoreRequest(
+        snapshot_id=snapshot_id, top_k=top_k, flat=True
+    ).SerializeToString()
+    digests, errors = set(), []
+    shed = 0
+    shed_ms = []
+    lock = threading.Lock()
+    released = threading.Barrier(clients + 1)
+
+    def worker():
+        nonlocal shed
+        try:
+            conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            conn.connect(sock_path)
+            released.wait()
+            t0 = time.perf_counter()
+            conn.sendall(struct.pack(">BI", METHOD_SCORE, len(body)) + body)
+            status, ln = struct.unpack(">BI", _recv_exact(conn, 5))
+            out = _recv_exact(conn, ln)
+            ms = _ms(t0)
+            conn.close()
+            if status == 0:
+                flat = pb2.ScoreReply.FromString(out).flat
+                digest = hashlib.sha256(
+                    flat.pod_index + flat.counts + flat.node_index
+                    + flat.score
+                ).hexdigest()
+                with lock:
+                    digests.add(digest)
+            elif b"RESOURCE_EXHAUSTED" in out:
+                with lock:
+                    shed += 1
+                    shed_ms.append(ms)
+            else:
+                with lock:
+                    errors.append(out[:200])
+        except Exception as exc:  # noqa: BLE001  (collected, asserted by caller)
+            with lock:
+                errors.append(repr(exc))
+            try:
+                released.abort()
+            except threading.BrokenBarrierError:
+                pass
+
+    threads = [
+        threading.Thread(target=worker, daemon=True) for _ in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        released.wait()
+    except threading.BrokenBarrierError:
+        pass
+    for t in threads:
+        t.join(timeout=600)
+    return digests, shed, errors, (max(shed_ms) if shed_ms else 0.0)
 
 
 def _extrapolate_serial(wall_s: float, measured: int, total: int) -> float:
@@ -1676,6 +1771,386 @@ def child_config(platform: str, config: str) -> None:
         )
         return
 
+    if config == "replica":
+        # ISSUE 8 scale point: the REPLICATED SERVING TIER — one leader
+        # daemon streaming committed Syncs to M follower daemons (real
+        # subprocesses: real per-replica jax runtimes, the scaling the
+        # tier exists to buy), M x N clients storming the followers,
+        # digest-identical to the single-daemon oracle, plus the
+        # admission-gate overload leg (shed_rate).  CPU rounds measure
+        # process-parallel read scaling of the same launches.
+        import subprocess as sp
+        import tempfile
+
+        from koordinator_tpu.bridge.codegen import pb2
+        from koordinator_tpu.bridge.server import ScorerServicer
+        from koordinator_tpu.bridge.state import numpy_to_tensor
+        from koordinator_tpu.bridge.udsserver import RawUdsServer
+        from koordinator_tpu.harness.golden import build_sync_request
+        from koordinator_tpu.replication.leader import ReplicationPublisher
+
+        # Scale: the replica tier exists to multiply READ throughput,
+        # and the quantity it multiplies is per-daemon serving capacity
+        # (dispatch, demux, reply assembly — the Python the GIL
+        # serializes) plus whatever device time a launch costs.  The
+        # default scale keeps the per-launch tensor small enough that
+        # one daemon's serving loop — not this host's core count — is
+        # the oracle's bottleneck, which is exactly the regime the
+        # tier targets (on real deployments each replica owns its own
+        # chip, so launch compute scales with the tier as well).
+        r_pods = int(os.environ.get("KOORD_BENCH_REPLICA_PODS", "256"))
+        r_nodes = int(os.environ.get("KOORD_BENCH_REPLICA_NODES", "64"))
+        # optional gather-cap override applied to EVERY daemon (leader
+        # and followers alike — same knob, both legs, so the legs
+        # differ only in how many daemons serve them); empty = the
+        # daemon's default adaptive window
+        r_cap_env = os.environ.get("KOORD_BENCH_REPLICA_CAP_MS", "")
+        r_cap_ms = float(r_cap_env) if r_cap_env else None
+        followers_n = int(
+            os.environ.get("KOORD_BENCH_REPLICA_FOLLOWERS", "3")
+        )
+        clients_per = int(
+            os.environ.get("KOORD_BENCH_REPLICA_CLIENTS", "16")
+        )
+        reps = int(os.environ.get("KOORD_BENCH_REPLICA_REPS", "3"))
+        total_clients = followers_n * clients_per
+        nodes, pods_l, gangs, quotas = generators.quota_colocation(
+            pods=r_pods, nodes=r_nodes
+        )
+        req, _ = build_sync_request(nodes, pods_l, gangs, quotas)
+        payload = req.SerializeToString()
+        phase(
+            "scale", pods=r_pods, nodes=r_nodes,
+            followers=followers_n, clients=total_clients, reps=reps,
+        )
+        with tempfile.TemporaryDirectory() as tmp:
+            # one shared persistent compile cache: the leader compiles,
+            # the follower processes deserialize instead of recompiling
+            cache_dir = os.path.join(tmp, "xla-cache")
+            koordinator_tpu.configure_compilation_cache(cache_dir)
+            leader_sock = os.path.join(tmp, "leader.sock")
+            repl_sock = os.path.join(tmp, "leader.repl")
+            leader_sv = ScorerServicer(
+                score_memo=False,
+                **({} if r_cap_ms is None
+                   else {"coalesce_cap_ms": r_cap_ms}),
+            )
+            leader_srv = RawUdsServer(leader_sock, servicer=leader_sv)
+            leader_srv.start()
+            pub = ReplicationPublisher(leader_sv, repl_sock)
+            pub.attach().start()
+            procs = []
+            try:
+                sid = leader_sv.sync(req).snapshot_id
+                phase("sync", snapshot_id=sid, bytes=len(payload))
+
+                env = dict(os.environ, KOORD_BENCH_XLA_CACHE=cache_dir)
+
+                def run_storm(socks, label):
+                    """M CLIENT PROCESSES of N workers each (real
+                    clients: a single bench-process GIL would pace the
+                    arrivals and starve every coalescer it storms),
+                    identical for both legs — the only variable is
+                    which daemon(s) the sockets name.  Workers warm up,
+                    signal STORM_READY, and fire together on GO; the
+                    wall is the slowest process's storm wall."""
+                    storm_procs = []
+                    for sock in socks:
+                        storm_procs.append(sp.Popen(
+                            [
+                                sys.executable,
+                                os.path.abspath(__file__),
+                                "--replica-storm",
+                                "--platform", platform,
+                                "--storm-sock", sock,
+                                "--storm-clients", str(clients_per),
+                                "--storm-reps", str(reps),
+                                "--storm-snapshot", sid,
+                            ],
+                            env=env, stdin=sp.PIPE, stdout=sp.PIPE,
+                            text=True,
+                            cwd=os.path.dirname(
+                                os.path.abspath(__file__)
+                            ),
+                        ))
+                    try:
+                        for p in storm_procs:
+                            line = p.stdout.readline()
+                            while line and line.strip() != "STORM_READY":
+                                line = p.stdout.readline()
+                            assert line, (
+                                f"{label} storm worker died before READY"
+                            )
+                        for p in storm_procs:
+                            p.stdin.write("GO\n")
+                            p.stdin.flush()
+                        results = []
+                        for p in storm_procs:
+                            out = p.stdout.readline()
+                            assert out, f"{label} storm worker died"
+                            results.append(json.loads(out))
+                    finally:
+                        for p in storm_procs:
+                            try:
+                                p.stdin.close()
+                            except OSError:
+                                pass
+                            try:
+                                p.wait(timeout=60)
+                            except sp.TimeoutExpired:
+                                p.kill()
+                    errs = sum((r["errors"] for r in results), [])
+                    digs = set()
+                    for r in results:
+                        digs.update(r["digests"])
+                    return max(r["storm_wall_s"] for r in results), \
+                        digs, errs
+
+                # follower daemons: separate PROCESSES subscribed to
+                # the leader's replication socket (stdout swallowed —
+                # only the bench child may print artifact lines)
+                follower_socks, status_files = [], []
+                if r_cap_ms is not None:
+                    env["KOORD_COALESCE_CAP_MS"] = str(r_cap_ms)
+                for i in range(followers_n):
+                    fsock = os.path.join(tmp, f"f{i}.sock")
+                    sfile = os.path.join(tmp, f"f{i}.status.json")
+                    follower_socks.append(fsock)
+                    status_files.append(sfile)
+                    procs.append(sp.Popen(
+                        [
+                            sys.executable, os.path.abspath(__file__),
+                            "--replica-follower",
+                            "--platform", platform,
+                            "--follower-sock", fsock,
+                            "--replicate-from", repl_sock,
+                            "--status-file", sfile,
+                        ],
+                        env=env, stdout=sp.DEVNULL,
+                        cwd=os.path.dirname(os.path.abspath(__file__)),
+                    ))
+
+                def follower_status(i):
+                    try:
+                        with open(status_files[i]) as fh:
+                            return json.load(fh)
+                    except (OSError, ValueError):
+                        return {}
+
+                def caught_up(want_sid):
+                    return all(
+                        follower_status(i).get("snapshot_id") == want_sid
+                        for i in range(followers_n)
+                    )
+
+                def wait_caught_up(want_sid, timeout_s):
+                    deadline = time.monotonic() + timeout_s
+                    while time.monotonic() < deadline:
+                        if caught_up(want_sid):
+                            return True
+                        for p in procs:
+                            assert p.poll() is None, (
+                                "follower process died before catch-up"
+                            )
+                        time.sleep(0.1)
+                    return caught_up(want_sid)
+
+                assert wait_caught_up(sid, float(
+                    os.environ.get("KOORD_BENCH_REPLICA_WAIT", "240")
+                )), "followers failed to catch up with the leader"
+                phase("followers_ready", followers=followers_n)
+
+                # single-daemon ORACLE: all M x N clients (M client
+                # processes) on the one leader — the deployment the
+                # tier replaces
+                wall_single, dig_single, errs = run_storm(
+                    [leader_sock] * followers_n, "oracle"
+                )
+                assert not errs, f"oracle storm errors: {errs}"
+                assert len(dig_single) == 1
+                phase(
+                    "oracle_storm",
+                    wall_ms=round(wall_single * 1000.0, 1),
+                    clients=total_clients,
+                )
+
+                # REPLICA TIER storm: the SAME M x N clients, process
+                # i's N workers on follower i
+                wall_tier, dig_tier, errs = run_storm(
+                    follower_socks, "tier"
+                )
+                assert not errs, f"tier storm errors: {errs}"
+                # the acceptance bit: every follower reply is
+                # byte-identical to the single-daemon oracle's
+                assert dig_tier == dig_single, (
+                    "replica tier replies diverged from the "
+                    "single-daemon oracle"
+                )
+                speedup = (
+                    wall_single / wall_tier if wall_tier > 0 else None
+                )
+                phase(
+                    "tier_storm",
+                    wall_ms=round(wall_tier * 1000.0, 1),
+                    speedup=(
+                        round(speedup, 3) if speedup is not None
+                        else None
+                    ),
+                )
+
+                # warm delta frames -> replication lag: three sparse
+                # usage deltas ride the stream at wire size; the lag
+                # gauge is commit-to-apply wall time on the follower
+                prev = np.asarray(
+                    [res.resource_vector(n.get("usage", {}))
+                     for n in nodes],
+                    dtype=np.int64,
+                )
+                delta_bytes = 0
+                for rep in range(3):
+                    cur = prev.copy()
+                    cur[:3, 0] += 100 + rep
+                    warm = pb2.SyncRequest()
+                    warm.nodes.usage.CopyFrom(numpy_to_tensor(cur, prev))
+                    delta_bytes = len(warm.SerializeToString())
+                    sid = leader_sv.sync(warm).snapshot_id
+                    prev = cur
+                assert wait_caught_up(sid, 60.0), (
+                    "followers failed to apply the warm delta frames"
+                )
+                lags = [
+                    follower_status(i).get("lag_ms")
+                    for i in range(followers_n)
+                ]
+                lags = [
+                    float(l) for l in lags if isinstance(l, (int, float))
+                ]
+                replica_lag_ms = max(lags) if lags else None
+                phase(
+                    "replica_lag",
+                    lag_ms=(
+                        round(replica_lag_ms, 2)
+                        if replica_lag_ms is not None else None
+                    ),
+                    delta_frame_bytes=delta_bytes,
+                )
+            finally:
+                for p in procs:
+                    p.terminate()
+                for p in procs:
+                    try:
+                        p.wait(timeout=10)
+                    except sp.TimeoutExpired:
+                        p.kill()
+                pub.stop()
+                leader_srv.stop()
+
+            # ADMISSION overload leg: a gated daemon under a one-shot
+            # burst far past --max-inflight — excess sheds fast with
+            # RESOURCE_EXHAUSTED while admitted work completes and
+            # stays byte-identical to the oracle
+            shed_clients = int(
+                os.environ.get("KOORD_BENCH_SHED_CLIENTS", "32")
+            )
+            max_inflight = int(
+                os.environ.get("KOORD_BENCH_SHED_INFLIGHT", "2")
+            )
+            gated_sv = ScorerServicer(
+                score_memo=False, max_inflight=max_inflight
+            )
+            gated_srv = RawUdsServer(
+                os.path.join(tmp, "gated.sock"), servicer=gated_sv
+            )
+            gated_srv.start()
+            try:
+                gsid = gated_sv.sync(
+                    pb2.SyncRequest.FromString(payload)
+                ).snapshot_id
+                # one untimed call: compile + cold snapshot build must
+                # not ride the overload measurement
+                gated_sv.score(pb2.ScoreRequest(
+                    snapshot_id=gsid, top_k=32, flat=True
+                ))
+                served, shed, other, max_shed_ms = _shed_storm(
+                    gated_srv.path, gsid, clients=shed_clients
+                )
+                assert not other, f"shed storm errors: {other}"
+                assert shed > 0, (
+                    "a burst far past --max-inflight must shed"
+                )
+                assert served, "admitted work must complete untouched"
+                assert served <= dig_single, (
+                    "served replies diverged under overload"
+                )
+                shed_rate = shed / float(shed_clients)
+                phase(
+                    "shed",
+                    shed=shed,
+                    clients=shed_clients,
+                    max_inflight=max_inflight,
+                    shed_rate=round(shed_rate, 3),
+                    max_shed_reply_ms=round(max_shed_ms, 2),
+                )
+            finally:
+                gated_srv.stop()
+        # the CPU caveat, stated in the artifact like the mesh config's
+        # (mesh_speedup < 1 on the host backend is expected and
+        # documented): every replica daemon AND every client process
+        # shares this host's cores, so a box with fewer than
+        # ~(followers + 1) cores physically cannot show the tier's
+        # read scaling — the single-daemon oracle already saturates
+        # the same silicon the followers would use.  On deployments
+        # the tier targets, each replica owns its own host/chip.
+        cpu_count = os.cpu_count() or 1
+        note = None
+        if backend == "cpu" and cpu_count < followers_n + 1:
+            note = (
+                f"host has {cpu_count} cores for {followers_n} replica "
+                "processes + clients: replica_read_speedup is "
+                "core-starved here; the tier's scaling needs one "
+                "host/chip per replica (see docs/REPLICATION.md)"
+            )
+        print(
+            json.dumps(
+                {
+                    "metric": "replica_tier_score_wall_ms",
+                    # the headline: the M x N-client storm wall on the
+                    # follower tier (the single-daemon oracle wall and
+                    # the ratio ride alongside)
+                    "value": round(wall_tier * 1000.0, 2),
+                    "unit": "ms",
+                    "backend": backend,
+                    "pods": r_pods,
+                    "nodes": r_nodes,
+                    "cpu_count": cpu_count,
+                    **({} if note is None else {"note": note}),
+                    "concurrency": total_clients,
+                    "replica_count": followers_n,
+                    "single_wall_ms": round(wall_single * 1000.0, 2),
+                    "replica_read_speedup": (
+                        round(speedup, 3) if speedup is not None else None
+                    ),
+                    "replica_lag_ms": (
+                        round(replica_lag_ms, 2)
+                        if replica_lag_ms is not None else None
+                    ),
+                    "shed_rate": round(shed_rate, 3),
+                    "max_shed_reply_ms": round(max_shed_ms, 2),
+                    "delta_frame_bytes": delta_bytes,
+                    "spans": {
+                        "oracle_storm": round(wall_single * 1000.0, 2),
+                        "tier_storm": round(wall_tier * 1000.0, 2),
+                        "replica_lag": (
+                            round(replica_lag_ms, 2)
+                            if replica_lag_ms is not None else None
+                        ),
+                    },
+                }
+            ),
+            flush=True,
+        )
+        return
+
     if config == "rebalance":
         # BASELINE config #5: LowNodeLoad Balance tick over the same
         # 10k x 2k cluster, pods placed by the scheduling cycle
@@ -1801,6 +2276,98 @@ def _spawn(flag, platform, env_extra, timeout, config=None):
         False,
         None,
         f"{flag} rc={proc.returncode}: {tail[-1] if tail else 'no stderr'}",
+    )
+
+
+def replica_follower(platform: str, sock: str, replicate_from: str,
+                     status_file: str) -> None:
+    """Follower worker for ``--config replica`` (ISSUE 8): one READ
+    REPLICA daemon in its own process — FollowerServicer on a raw-UDS
+    socket, subscribed to the leader's replication socket, publishing
+    its chain position to ``status_file`` after every applied frame so
+    the bench can wait for catch-up and read the lag without an RPC.
+    Exits when its parent (the bench child) disappears, so a
+    deadline-killed bench never leaks follower processes."""
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import koordinator_tpu
+
+    cache = os.environ.get("KOORD_BENCH_XLA_CACHE")
+    if cache:
+        koordinator_tpu.configure_compilation_cache(cache)
+    from koordinator_tpu.bridge.udsserver import RawUdsServer
+    from koordinator_tpu.replication.follower import (
+        FollowerServicer,
+        ReplicaApplier,
+        ReplicationSubscriber,
+    )
+
+    kw = {}
+    if os.environ.get("KOORD_COALESCE_CAP_MS"):
+        kw["coalesce_cap_ms"] = float(os.environ["KOORD_COALESCE_CAP_MS"])
+    sv = FollowerServicer(score_memo=False, leader=replicate_from, **kw)
+    applier = ReplicaApplier(sv)
+
+    def on_frame(result, frame):
+        try:
+            tmp_path = status_file + ".tmp"
+            with open(tmp_path, "w") as fh:
+                json.dump(
+                    {
+                        "snapshot_id": sv.snapshot_id(),
+                        "lag_ms": applier.last_lag_ms,
+                        "applied": applier.applied,
+                        "resyncs": applier.resyncs,
+                    },
+                    fh,
+                )
+            os.replace(tmp_path, status_file)
+        except OSError:
+            pass  # status is observability; the replica keeps serving
+
+    server = RawUdsServer(sock, servicer=sv).start()
+    sub = ReplicationSubscriber(
+        replicate_from, applier, on_frame=on_frame
+    ).start()
+    ppid = os.getppid()
+    try:
+        while os.getppid() == ppid:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        sub.stop()
+        server.stop()
+
+
+def replica_storm(sock: str, snapshot_id: str, clients: int,
+                  reps: int) -> None:
+    """Client-storm worker for ``--config replica`` (ISSUE 8): N
+    worker threads against ONE daemon socket, with the warm-up/GO
+    handshake on stdio so M such processes release their storms
+    together.  A separate process per replica's clients because a
+    single bench-process GIL would pace all M x N arrivals and starve
+    the very coalescers the storm measures."""
+    def on_start():
+        # _score_storm calls this after every warm-up completed and
+        # strictly before any timed request can fire
+        print("STORM_READY", flush=True)
+        sys.stdin.readline()  # GO
+
+    wall, _lats, digests, errors = _score_storm(
+        sock, snapshot_id, clients, reps, on_start=on_start
+    )
+    print(
+        json.dumps(
+            {
+                "storm_wall_s": wall,
+                "digests": sorted(digests),
+                "errors": [str(e) for e in errors],
+            }
+        ),
+        flush=True,
     )
 
 
@@ -1993,13 +2560,42 @@ def main() -> int:
         default=None,
         choices=[
             "spark", "loadaware", "gang", "extras", "rebalance", "smoke",
-            "bridge", "mesh",
+            "bridge", "mesh", "replica",
         ],
         help="measure a secondary BASELINE config instead of the headline "
         "10k x 2k quota_colocation cycle (driver contract: no args prints "
         "exactly the one headline JSON line)",
     )
+    ap.add_argument(
+        "--replica-follower", action="store_true",
+        help="internal: run one read-replica daemon for --config "
+        "replica (spawned by the bench child, never by the driver)",
+    )
+    ap.add_argument("--follower-sock", default=None)
+    ap.add_argument("--replicate-from", default=None)
+    ap.add_argument("--status-file", default=None)
+    ap.add_argument(
+        "--replica-storm", action="store_true",
+        help="internal: one replica's client storm for --config "
+        "replica (spawned by the bench child, never by the driver)",
+    )
+    ap.add_argument("--storm-sock", default=None)
+    ap.add_argument("--storm-clients", type=int, default=16)
+    ap.add_argument("--storm-reps", type=int, default=3)
+    ap.add_argument("--storm-snapshot", default=None)
     args = ap.parse_args()
+    if args.replica_follower:
+        replica_follower(
+            args.platform, args.follower_sock, args.replicate_from,
+            args.status_file,
+        )
+        return 0
+    if args.replica_storm:
+        replica_storm(
+            args.storm_sock, args.storm_snapshot, args.storm_clients,
+            args.storm_reps,
+        )
+        return 0
     if args.probe:
         probe(args.platform)
         return 0

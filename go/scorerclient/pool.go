@@ -101,6 +101,15 @@ func (p *Pool) Sync(req *SyncRequest) (*SyncReply, error) {
 	return reply, nil
 }
 
+// SetSnapshotID fans an externally-acknowledged id to every slot — the
+// ReplicaSet uses it to pin follower pools to the id the LEADER's Sync
+// certified (replica.go; followers never see the Sync themselves).
+func (p *Pool) SetSnapshotID(id string) {
+	for _, c := range p.clients {
+		c.setSnapshotID(id)
+	}
+}
+
 // ScoreFlat runs on the next round-robin connection.
 func (p *Pool) ScoreFlat(topK int64) (*ScoreReply, error) {
 	return p.Get().ScoreFlat(topK)

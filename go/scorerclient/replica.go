@@ -1,0 +1,251 @@
+package scorerclient
+
+// Replicated serving tier (ISSUE 8), Go side.
+//
+// Two halves:
+//
+//  1. The replication frame header mirror.  The leader daemon streams
+//     committed Syncs to followers as framed, already-encoded
+//     SyncRequest bytes (koordinator_tpu/replication/codec.py is the
+//     layout's home; bridge/wirecheck.py carries the independent
+//     Python mirror).  The constants and the field table here restate
+//     that layout so Go tooling can read the stream — and so
+//     koordlint's wire-contract rule can statically diff all three
+//     statements of the header (names, order, widths, magic, version):
+//     a one-sided framing edit fails lint, not a follower.
+//
+//  2. ReplicaSet — replica-aware dialing for the scheduler plugin:
+//     Sync goes to the LEADER (the tier's one writer; delta frames are
+//     order-sensitive), Score fans out ROUND-ROBIN over the follower
+//     pools (the read path the tier exists to scale), Assign stays on
+//     the leader.  A follower that has not yet applied the generation
+//     a Score names answers FAILED_PRECONDITION ("not resident"); the
+//     ReplicaSet retries that one call on the leader instead of
+//     failing the cycle — replication lag shows as a leader fallback,
+//     never as a scheduling error.
+
+import (
+	"encoding/binary"
+	"fmt"
+	"strconv"
+	"strings"
+	"sync/atomic"
+)
+
+// Replication frame header constants (big-endian, like the raw-UDS
+// scorer framing).  Keep in lockstep with replication/codec.py — the
+// wire-contract lint enforces it.
+const (
+	ReplicaFrameMagic   = 0x4B52504C // "KRPL"
+	ReplicaFrameVersion = 1
+	ReplicaKindDelta    = 1 // sequence frame: apply onto generation-1
+	ReplicaKindFull     = 2 // reset frame: replace all resident state
+	ReplicaHeaderLen    = 34
+	// MaxReplicaFrame mirrors the transport's 64 MiB frame cap.
+	MaxReplicaFrame = 64 << 20
+)
+
+// replicaFrameFields states the header layout — (name, byte width) in
+// emit order.  Parsed statically by koordlint wire-contract and diffed
+// against the two Python tables; ParseReplicaFrameHeader below walks
+// the same table so the Go decode cannot drift from the Go statement.
+var replicaFrameFields = []struct {
+	Name  string
+	Width int
+}{
+	{"magic", 4},
+	{"version", 1},
+	{"kind", 1},
+	{"epoch", 8},
+	{"generation", 8},
+	{"stamp_us", 8},
+	{"payload_len", 4},
+}
+
+// ReplicaFrameHeader is one decoded replication frame header; the
+// payload (PayloadLen bytes of SyncRequest wire) follows on the stream.
+type ReplicaFrameHeader struct {
+	Kind       int
+	Epoch      string
+	Generation uint64
+	StampUS    uint64
+	PayloadLen uint32
+}
+
+// ParseReplicaFrameHeader decodes the fixed 34-byte header, rejecting
+// anything malformed — the follower contract is that every malformed
+// frame is a detected discontinuity (full resync), never applied.
+func ParseReplicaFrameHeader(b []byte) (*ReplicaFrameHeader, error) {
+	if len(b) != ReplicaHeaderLen {
+		return nil, fmt.Errorf("replica frame header is %d bytes, want %d", len(b), ReplicaHeaderLen)
+	}
+	h := &ReplicaFrameHeader{}
+	i := 0
+	for _, f := range replicaFrameFields {
+		raw := b[i : i+f.Width]
+		i += f.Width
+		switch f.Name {
+		case "magic":
+			if m := binary.BigEndian.Uint32(raw); m != ReplicaFrameMagic {
+				return nil, fmt.Errorf("bad replica frame magic %#x", m)
+			}
+		case "version":
+			if raw[0] != ReplicaFrameVersion {
+				return nil, fmt.Errorf("bad replica frame version %d", raw[0])
+			}
+		case "kind":
+			h.Kind = int(raw[0])
+			if h.Kind != ReplicaKindDelta && h.Kind != ReplicaKindFull {
+				return nil, fmt.Errorf("bad replica frame kind %d", h.Kind)
+			}
+		case "epoch":
+			h.Epoch = string(raw)
+		case "generation":
+			h.Generation = binary.BigEndian.Uint64(raw)
+		case "stamp_us":
+			h.StampUS = binary.BigEndian.Uint64(raw)
+		case "payload_len":
+			h.PayloadLen = binary.BigEndian.Uint32(raw)
+			if h.PayloadLen > MaxReplicaFrame {
+				return nil, fmt.Errorf("replica frame payload %d over cap", h.PayloadLen)
+			}
+		}
+	}
+	return h, nil
+}
+
+// IsResourceExhausted reports whether an error is the admission gate's
+// load-shed reply (replication/admission.py): the daemon refused the
+// request before queueing it, and the caller should back off
+// RetryAfterMS and retry — or route to another replica.
+func IsResourceExhausted(err error) bool {
+	return err != nil && strings.Contains(err.Error(), "RESOURCE_EXHAUSTED")
+}
+
+// RetryAfterMS extracts the shed reply's retry-after hint
+// ("retry_after_ms=<n>"); 0 when absent.
+func RetryAfterMS(err error) int64 {
+	if err == nil {
+		return 0
+	}
+	msg := err.Error()
+	i := strings.Index(msg, "retry_after_ms=")
+	if i < 0 {
+		return 0
+	}
+	rest := msg[i+len("retry_after_ms="):]
+	j := 0
+	for j < len(rest) && rest[j] >= '0' && rest[j] <= '9' {
+		j++
+	}
+	ms, err2 := strconv.ParseInt(rest[:j], 10, 64)
+	if err2 != nil {
+		return 0
+	}
+	return ms
+}
+
+// isStaleSnapshot matches the daemon's FAILED_PRECONDITION "snapshot
+// ... is not resident" rejection — on a follower this means the
+// replica has not applied that generation yet (replication lag).
+func isStaleSnapshot(err error) bool {
+	return err != nil && strings.Contains(err.Error(), "is not resident")
+}
+
+// ReplicaSet routes calls across a replicated serving tier: one leader
+// pool (the writer) and N follower pools (the read tier).
+type ReplicaSet struct {
+	leader    *Pool
+	followers []*Pool
+	rr        atomic.Uint64
+}
+
+// DialReplicaSet connects a pool of size conns to the leader socket
+// and one to each follower socket.  Any dial failure closes everything
+// already opened — a silently half-dialed tier would skew the read
+// fan-out it exists to provide.
+func DialReplicaSet(leaderSocket string, followerSockets []string, size int) (*ReplicaSet, error) {
+	leader, err := DialPool(leaderSocket, size)
+	if err != nil {
+		return nil, fmt.Errorf("replica set leader dial: %w", err)
+	}
+	rs := &ReplicaSet{leader: leader}
+	for i, path := range followerSockets {
+		p, err := DialPool(path, size)
+		if err != nil {
+			rs.Close()
+			return nil, fmt.Errorf("replica set follower %d/%d dial: %w", i+1, len(followerSockets), err)
+		}
+		rs.followers = append(rs.followers, p)
+	}
+	return rs, nil
+}
+
+// NewReplicaSet wraps pre-built pools (test seam; mirrors NewPool).
+// The leader is required; zero followers degrades every call to the
+// leader, which is exactly the single-daemon deployment.
+func NewReplicaSet(leader *Pool, followers ...*Pool) *ReplicaSet {
+	if leader == nil {
+		panic("scorerclient: NewReplicaSet requires a leader pool")
+	}
+	return &ReplicaSet{leader: leader, followers: followers}
+}
+
+// Followers reports the follower pool count.
+func (r *ReplicaSet) Followers() int { return len(r.followers) }
+
+// Close closes every pool, keeping the first error.
+func (r *ReplicaSet) Close() error {
+	first := r.leader.Close()
+	for _, p := range r.followers {
+		if err := p.Close(); err != nil && first == nil {
+			first = err
+		}
+	}
+	return first
+}
+
+// Sync ships the snapshot to the LEADER and fans the acknowledged
+// SnapshotID out to every pool — leader and followers — so a Score on
+// any replica names the snapshot this Sync certified (the follower
+// serves it as soon as the replication frame lands; until then it
+// answers "not resident" and ScoreFlat falls back to the leader).
+func (r *ReplicaSet) Sync(req *SyncRequest) (*SyncReply, error) {
+	reply, err := r.leader.Sync(req)
+	if err != nil {
+		return nil, err
+	}
+	for _, p := range r.followers {
+		p.SetSnapshotID(reply.SnapshotID)
+	}
+	return reply, nil
+}
+
+// next picks the follower pool for this call round-robin.
+func (r *ReplicaSet) next() *Pool {
+	return r.followers[r.rr.Add(1)%uint64(len(r.followers))]
+}
+
+// ScoreFlat runs on the next follower round-robin; a follower still
+// catching up (stale-snapshot rejection) falls back to the leader for
+// this one call.  With no followers the leader serves directly.
+func (r *ReplicaSet) ScoreFlat(topK int64) (*ScoreReply, error) {
+	if len(r.followers) == 0 {
+		return r.leader.ScoreFlat(topK)
+	}
+	reply, err := r.next().ScoreFlat(topK)
+	if err != nil && isStaleSnapshot(err) {
+		return r.leader.ScoreFlat(topK)
+	}
+	return reply, err
+}
+
+// Assign runs the full cycle on the LEADER: placement is the write-
+// adjacent half of the scheduler loop, and the leader's snapshot is
+// by definition never behind.
+func (r *ReplicaSet) Assign() (*AssignReply, error) { return r.leader.Assign() }
+
+// AssignCycle runs on the leader under an explicit correlation id.
+func (r *ReplicaSet) AssignCycle(cycleID string) (*AssignReply, error) {
+	return r.leader.AssignCycle(cycleID)
+}

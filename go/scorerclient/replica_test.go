@@ -1,0 +1,172 @@
+package scorerclient
+
+import (
+	"encoding/binary"
+	"errors"
+	"testing"
+)
+
+func buildFrameHeader(magic uint32, version, kind byte, epoch string,
+	gen, stamp uint64, payloadLen uint32) []byte {
+	b := make([]byte, ReplicaHeaderLen)
+	binary.BigEndian.PutUint32(b[0:4], magic)
+	b[4] = version
+	b[5] = kind
+	copy(b[6:14], epoch)
+	binary.BigEndian.PutUint64(b[14:22], gen)
+	binary.BigEndian.PutUint64(b[22:30], stamp)
+	binary.BigEndian.PutUint32(b[30:34], payloadLen)
+	return b
+}
+
+func TestParseReplicaFrameHeaderRoundTrip(t *testing.T) {
+	raw := buildFrameHeader(ReplicaFrameMagic, ReplicaFrameVersion,
+		ReplicaKindDelta, "abcdef01", 42, 123456, 7)
+	if len(raw) != ReplicaHeaderLen {
+		t.Fatalf("built header is %d bytes, want %d", len(raw), ReplicaHeaderLen)
+	}
+	h, err := ParseReplicaFrameHeader(raw)
+	if err != nil {
+		t.Fatal(err)
+	}
+	if h.Kind != ReplicaKindDelta || h.Epoch != "abcdef01" ||
+		h.Generation != 42 || h.StampUS != 123456 || h.PayloadLen != 7 {
+		t.Fatalf("decoded %+v", h)
+	}
+}
+
+func TestParseReplicaFrameHeaderRejectsMalformed(t *testing.T) {
+	cases := []struct {
+		name string
+		raw  []byte
+	}{
+		{"bad magic", buildFrameHeader(0xdeadbeef, ReplicaFrameVersion,
+			ReplicaKindDelta, "abcdef01", 1, 0, 0)},
+		{"bad version", buildFrameHeader(ReplicaFrameMagic, 9,
+			ReplicaKindDelta, "abcdef01", 1, 0, 0)},
+		{"bad kind", buildFrameHeader(ReplicaFrameMagic,
+			ReplicaFrameVersion, 7, "abcdef01", 1, 0, 0)},
+		{"oversized payload", buildFrameHeader(ReplicaFrameMagic,
+			ReplicaFrameVersion, ReplicaKindFull, "abcdef01", 1, 0,
+			MaxReplicaFrame+1)},
+		{"truncated", buildFrameHeader(ReplicaFrameMagic,
+			ReplicaFrameVersion, ReplicaKindDelta, "abcdef01", 1, 0,
+			0)[:10]},
+	}
+	for _, tc := range cases {
+		if _, err := ParseReplicaFrameHeader(tc.raw); err == nil {
+			t.Fatalf("%s: malformed header parsed without error", tc.name)
+		}
+	}
+}
+
+func TestResourceExhaustedHelpers(t *testing.T) {
+	err := errors.New("scorer error: RESOURCE_EXHAUSTED: score shed at queue depth 64/64; retry_after_ms=125")
+	if !IsResourceExhausted(err) {
+		t.Fatal("shed reply not recognized")
+	}
+	if ms := RetryAfterMS(err); ms != 125 {
+		t.Fatalf("RetryAfterMS = %d, want 125", ms)
+	}
+	if IsResourceExhausted(errors.New("snapshot 's1-2' is not resident")) {
+		t.Fatal("stale-snapshot error misread as a shed")
+	}
+	if RetryAfterMS(errors.New("no hint here")) != 0 {
+		t.Fatal("missing hint must parse as 0")
+	}
+	if IsResourceExhausted(nil) || RetryAfterMS(nil) != 0 {
+		t.Fatal("nil error must be a no-op")
+	}
+}
+
+func TestNewReplicaSetRequiresLeader(t *testing.T) {
+	defer func() {
+		if recover() == nil {
+			t.Fatal("NewReplicaSet(nil) must panic at construction")
+		}
+	}()
+	NewReplicaSet(nil)
+}
+
+// Sync goes to the LEADER pool only, and the acked SnapshotID fans out
+// to every follower pool's every slot — a Score on any replica
+// afterwards names the snapshot the leader certified.
+func TestReplicaSetSyncFansIDToFollowerPools(t *testing.T) {
+	e := loadExpected(t)
+	leaderClients, leaderServers := pipeClients(t, 2)
+	go fakeServer(t, leaderServers[0], [][3][]byte{
+		{{MethodSync}, load(t, "sync_request.bin"), load(t, "sync_reply.bin")},
+	})
+	f1, _ := pipeClients(t, 2)
+	f2, _ := pipeClients(t, 2)
+	rs := NewReplicaSet(NewPool(leaderClients...), NewPool(f1...), NewPool(f2...))
+	if rs.Followers() != 2 {
+		t.Fatalf("Followers() = %d, want 2", rs.Followers())
+	}
+	reply, err := rs.Sync(buildSyncRequest(e))
+	if err != nil {
+		t.Fatal(err)
+	}
+	for i, c := range append(append([]*Client{}, f1...), f2...) {
+		if got := c.snapshotID(); got != reply.SnapshotID {
+			t.Fatalf("follower slot %d id %q, want %q", i, got, reply.SnapshotID)
+		}
+	}
+}
+
+// A follower that has not applied the generation yet answers the
+// stale-snapshot rejection; the ReplicaSet must serve that one call
+// from the leader instead of failing the cycle.
+func TestReplicaSetScoreFallsBackToLeaderOnStaleFollower(t *testing.T) {
+	e := loadExpected(t)
+	leaderClients, leaderServers := pipeClients(t, 1)
+	go fakeServer(t, leaderServers[0], [][3][]byte{
+		{{MethodScore}, load(t, "score_request.bin"), load(t, "score_reply.bin")},
+	})
+	followerClients, followerServers := pipeClients(t, 1)
+	// the follower rejects with the daemon's stale-snapshot message
+	go func() {
+		conn := followerServers[0]
+		hdr := make([]byte, 5)
+		if _, err := readFull(conn, hdr); err != nil {
+			return
+		}
+		length := binary.BigEndian.Uint32(hdr[1:5])
+		body := make([]byte, length)
+		if _, err := readFull(conn, body); err != nil {
+			return
+		}
+		msg := []byte("snapshot 's1-9' is not resident (current s1-2)")
+		out := make([]byte, 5+len(msg))
+		out[0] = 1 // status: error
+		binary.BigEndian.PutUint32(out[1:5], uint32(len(msg)))
+		copy(out[5:], msg)
+		if _, err := conn.Write(out); err != nil {
+			return
+		}
+	}()
+	leader := NewPool(leaderClients...)
+	follower := NewPool(followerClients...)
+	// the ids the leader's Sync acked, as ReplicaSet.Sync would fan out
+	leader.SetSnapshotID(e.SyncReply.SnapshotID)
+	follower.SetSnapshotID(e.SyncReply.SnapshotID)
+	rs := NewReplicaSet(leader, follower)
+	reply, err := rs.ScoreFlat(e.TopK)
+	if err != nil {
+		t.Fatalf("stale follower must fall back to the leader: %v", err)
+	}
+	if !reply.HasFlat {
+		t.Fatal("leader fallback reply lost the flat layout")
+	}
+}
+
+func TestPoolSetSnapshotIDFansToEverySlot(t *testing.T) {
+	clients, _ := pipeClients(t, 3)
+	p := NewPool(clients...)
+	p.SetSnapshotID("sfeed0000-9")
+	for i, c := range clients {
+		if got := c.snapshotID(); got != "sfeed0000-9" {
+			t.Fatalf("slot %d id %q after SetSnapshotID", i, got)
+		}
+	}
+}

@@ -385,6 +385,169 @@ def check_delta_constants(
     return out
 
 
+# ---- replication stream framing (ISSUE 8) ----
+# Three statements of the leader->follower frame header must agree:
+# replication/codec.py FRAME_FIELDS (the layout's home),
+# bridge/wirecheck.py REPLICA_FRAME_FIELDS (the independent runtime
+# mirror), and go/scorerclient/replica.go replicaFrameFields.  Same
+# treatment as scorer.proto vs wire.go: field names, emit order, byte
+# widths, and the magic/version constants, diffed statically so a
+# one-sided framing edit fails lint before any frame is built.
+
+_GO_FRAME_ENTRY = re.compile(r'\{"(\w+)",\s*(\d+)\}')
+_GO_FRAME_CONST = re.compile(
+    r"(ReplicaFrameMagic|ReplicaFrameVersion|ReplicaHeaderLen)\s*=\s*"
+    r"(0[xX][0-9a-fA-F]+|\d+)"
+)
+
+
+def _parse_py_frame_table(text: str, table_name: str, consts: Tuple[str, ...]):
+    """(fields, constants, line-of-table) from a Python source: the
+    ``table_name`` tuple-of-(name, width) assignment plus the named
+    integer constants, via AST."""
+    fields: List[Tuple[str, int]] = []
+    values: Dict[str, int] = {}
+    line = 0
+    tree = ast.parse(text)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        if target.id == table_name and isinstance(
+            node.value, (ast.Tuple, ast.List)
+        ):
+            line = node.lineno
+            for elt in node.value.elts:
+                if (
+                    isinstance(elt, (ast.Tuple, ast.List))
+                    and len(elt.elts) == 2
+                    and isinstance(elt.elts[0], ast.Constant)
+                    and isinstance(elt.elts[1], ast.Constant)
+                ):
+                    fields.append(
+                        (str(elt.elts[0].value), int(elt.elts[1].value))
+                    )
+        elif target.id in consts and isinstance(node.value, ast.Constant):
+            if isinstance(node.value.value, int):
+                values[target.id] = int(node.value.value)
+    return fields, values, line
+
+
+def _parse_go_frame_table(text: str):
+    """(fields, constants, line-of-table) from replica.go: the
+    replicaFrameFields literal entries in order plus the frame
+    constants."""
+    fields: List[Tuple[str, int]] = []
+    line = 0
+    in_table = False
+    for lineno, src in enumerate(text.splitlines(), start=1):
+        if "replicaFrameFields" in src and "=" in src:
+            in_table = True
+            line = lineno
+            continue
+        if in_table:
+            m = _GO_FRAME_ENTRY.search(src)
+            if m:
+                fields.append((m.group(1), int(m.group(2))))
+            elif src.strip() == "}":
+                in_table = False
+    consts = {
+        m.group(1): int(m.group(2), 0)
+        for m in _GO_FRAME_CONST.finditer(text)
+    }
+    return fields, consts, line
+
+
+def check_replication_framing(
+    codec_text: str,
+    wirecheck_text: str,
+    replica_go_text: str,
+    go_path: str = "go/scorerclient/replica.go",
+) -> List[Violation]:
+    out: List[Violation] = []
+    spec, spec_consts, _ = _parse_py_frame_table(
+        codec_text, "FRAME_FIELDS", ("MAGIC", "VERSION")
+    )
+    mirror, mirror_consts, mirror_line = _parse_py_frame_table(
+        wirecheck_text, "REPLICA_FRAME_FIELDS",
+        ("REPLICA_MAGIC", "REPLICA_VERSION"),
+    )
+    go_fields, go_consts, go_line = _parse_go_frame_table(replica_go_text)
+    if not spec:
+        out.append(Violation(
+            RULE, "koordinator_tpu/replication/codec.py", 0,
+            "FRAME_FIELDS table not found: the replication frame "
+            "layout has lost its one canonical Python statement",
+        ))
+        return out
+
+    def diff_table(got, got_path, got_line, label):
+        if got != spec:
+            out.append(Violation(
+                RULE, got_path, got_line,
+                f"{label} frame table {got} disagrees with "
+                f"replication/codec.py FRAME_FIELDS {spec}: a follower "
+                "decoding with one layout while the leader emits the "
+                "other tears every frame on the stream",
+            ))
+
+    if not mirror:
+        out.append(Violation(
+            RULE, "koordinator_tpu/bridge/wirecheck.py", 0,
+            "REPLICA_FRAME_FIELDS mirror table not found in "
+            "wirecheck.py (the independent second implementation)",
+        ))
+    else:
+        diff_table(mirror, "koordinator_tpu/bridge/wirecheck.py",
+                   mirror_line, "wirecheck.py REPLICA_FRAME_FIELDS")
+    if not go_fields:
+        out.append(Violation(
+            RULE, go_path, 0,
+            "replicaFrameFields table not found in replica.go",
+        ))
+    else:
+        diff_table(go_fields, go_path, go_line,
+                   "replica.go replicaFrameFields")
+    # constants: magic + version must agree on all three sides, and the
+    # Go header-length constant must equal the table's width sum
+    pairs = (
+        ("MAGIC", spec_consts.get("MAGIC"),
+         mirror_consts.get("REPLICA_MAGIC"),
+         go_consts.get("ReplicaFrameMagic")),
+        ("VERSION", spec_consts.get("VERSION"),
+         mirror_consts.get("REPLICA_VERSION"),
+         go_consts.get("ReplicaFrameVersion")),
+    )
+    for name, spec_v, mirror_v, go_v in pairs:
+        if mirror_v is not None and mirror_v != spec_v:
+            out.append(Violation(
+                RULE, "koordinator_tpu/bridge/wirecheck.py", 0,
+                f"replica frame {name}: wirecheck.py says "
+                f"{mirror_v:#x} but codec.py says {spec_v:#x}"
+                if spec_v is not None else
+                f"replica frame {name} missing from codec.py",
+            ))
+        if go_v is not None and go_v != spec_v:
+            out.append(Violation(
+                RULE, go_path, 0,
+                f"replica frame {name}: replica.go says {go_v:#x} "
+                f"but codec.py says "
+                f"{spec_v:#x}" if spec_v is not None else
+                f"replica frame {name} missing from codec.py",
+            ))
+    want_len = sum(w for _, w in spec)
+    go_len = go_consts.get("ReplicaHeaderLen")
+    if go_len is not None and go_len != want_len:
+        out.append(Violation(
+            RULE, go_path, 0,
+            f"ReplicaHeaderLen={go_len} but the frame table sums to "
+            f"{want_len}: the Go reader would mis-frame every stream",
+        ))
+    return out
+
+
 def check_pb2_descriptor(
     proto_text: str, pb2_module=None
 ) -> List[Violation]:
@@ -450,6 +613,11 @@ def check_repo(root: str) -> List[Violation]:
     state = read("koordinator_tpu", "bridge", "state.py")
     if delta is not None and state is not None:
         out.extend(check_delta_constants(delta, state))
+    codec = read("koordinator_tpu", "replication", "codec.py")
+    wcheck = read("koordinator_tpu", "bridge", "wirecheck.py")
+    replica = read("go", "scorerclient", "replica.go")
+    if codec is not None and wcheck is not None and replica is not None:
+        out.extend(check_replication_framing(codec, wcheck, replica))
     try:
         out.extend(check_pb2_descriptor(proto))
     except ImportError:  # no protobuf runtime: the static diff still ran

@@ -65,7 +65,8 @@ class _ChannelPool:
 
 
 class ScorerClient:
-    def __init__(self, target: str, channels: int = 1):
+    def __init__(self, target: str, channels: int = 1,
+                 followers: Sequence[str] = ()):
         """``target``: "unix:///path.sock" or host:port.
 
         ``channels``: size of the connection pool Score/Assign calls
@@ -75,7 +76,17 @@ class ScorerClient:
         coalescer concurrently instead of serializing on one HTTP/2
         connection.  Sync stays PINNED to the first channel: delta
         frames are order-sensitive against the acked baseline, and one
-        connection preserves their wire order for free."""
+        connection preserves their wire order for free.
+
+        ``followers`` (ISSUE 8, the replicated serving tier): targets
+        of read-replica daemons.  Sync keeps going to ``target`` (the
+        LEADER — the tier's one writer), Score round-robins over the
+        followers, and a follower still catching up (its
+        FAILED_PRECONDITION means "that generation has not replicated
+        here yet", not "your baseline is wrong") falls back to the
+        leader for that one call — replication lag degrades to leader
+        reads, never to a failed cycle or a spurious full re-sync.
+        Assign stays on the leader, whose snapshot is never behind."""
         self._pool = _ChannelPool(target, channels)
         self._channel = self._pool.channels[0]  # Sync's pinned channel
 
@@ -93,6 +104,13 @@ class ScorerClient:
         self._assigns = [
             unary(ch, "Assign", pb2.AssignReply)
             for ch in self._pool.channels
+        ]
+        self._follower_pools = [
+            _ChannelPool(t, 1) for t in followers
+        ]
+        self._follower_scores = [
+            unary(p.channels[0], "Score", pb2.ScoreReply)
+            for p in self._follower_pools
         ]
         self._rr = itertools.count()
         self._rr_lock = threading.Lock()
@@ -114,10 +132,36 @@ class ScorerClient:
 
     def close(self) -> None:
         self._pool.close()
+        for p in self._follower_pools:
+            p.close()
 
     def _slot(self) -> int:
         with self._rr_lock:
             return next(self._rr) % len(self._scores)
+
+    def _score_stub(self):
+        """Score's routing: round-robin over the follower replicas when
+        configured, else over the leader's own channel pool.  Returns
+        ``(stub, is_follower)``."""
+        if self._follower_scores:
+            with self._rr_lock:
+                i = next(self._rr) % len(self._follower_scores)
+            return self._follower_scores[i], True
+        return self._scores[self._slot()], False
+
+    def _call_score(self, request):
+        stub, on_follower = self._score_stub()
+        if on_follower:
+            try:
+                return stub(request)
+            except grpc.RpcError as e:
+                if e.code() != grpc.StatusCode.FAILED_PRECONDITION:
+                    raise
+                # the follower has not applied this generation yet
+                # (replication lag) — the LEADER certified the id, so
+                # the baseline is fine: serve this call there instead
+                # of invalidating anything
+        return self._call(self._scores[self._slot()], request)
 
     def _invalidate(self) -> None:
         with self._baseline_lock:
@@ -290,8 +334,7 @@ class ScorerClient:
             raise
 
     def score(self, top_k: int = 0) -> List[List[Tuple[int, int]]]:
-        reply = self._call(
-            self._scores[self._slot()],
+        reply = self._call_score(
             pb2.ScoreRequest(snapshot_id=self.snapshot_id or "", top_k=top_k),
         )
         return [
@@ -305,8 +348,7 @@ class ScorerClient:
         arrays decoded straight from the packed reply bytes — the O(1)
         assembly path on both ends (round-3 review #8).  Entry group g
         (pod pod_index[g]) covers counts[g] consecutive entries."""
-        reply = self._call(
-            self._scores[self._slot()],
+        reply = self._call_score(
             pb2.ScoreRequest(
                 snapshot_id=self.snapshot_id or "", top_k=top_k, flat=True
             ),

@@ -400,6 +400,13 @@ class CoalescingDispatcher:
             with self._cond:
                 self._cond.notify_all()
 
+    def queue_depth(self) -> int:
+        """Requests queued plus batches in flight — the depth the
+        admission gate (ISSUE 8, replication/admission.py) bounds from
+        upstream.  Cheap enough for a per-scrape gauge."""
+        with self._cond:
+            return len(self._queue) + self._inflight
+
     def stats(self) -> dict:
         with self._cond:
             return {

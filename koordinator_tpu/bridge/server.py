@@ -69,6 +69,10 @@ from koordinator_tpu.bridge.state import ResidentState
 from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG
 from koordinator_tpu.model.snapshot import pad_bucket
 from koordinator_tpu.obs import CycleTelemetry
+from koordinator_tpu.replication.admission import (
+    AdmissionGate,
+    ResourceExhausted,
+)
 from koordinator_tpu.solver import run_cycle, score_cycle
 
 
@@ -107,6 +111,7 @@ class ScorerServicer:
         mesh_resident: bool = False,
         coalesce_cap_ms: Optional[float] = None,
         score_memo: bool = True,
+        max_inflight: int = 0,
     ):
         """``mesh``: a ``jax.sharding.Mesh`` turns the ASSIGN RPC into
         the round-based multi-chip cycle (parallel/shard_assign.py
@@ -149,6 +154,14 @@ class ScorerServicer:
         this servicer's epoch so cycle ids ("c<epoch>-<seq>") correlate
         with snapshot ids ("s<epoch>-<gen>").
 
+        ``max_inflight`` (ISSUE 8 admission control): read RPCs
+        (Score/Assign) admitted-but-unfinished at once before new ones
+        are shed with RESOURCE_EXHAUSTED + a retry-after hint
+        (replication/admission.py; daemon flag ``--max-inflight`` /
+        ``KOORD_MAX_INFLIGHT``).  0 (the default) disables the gate.
+        Sync is never shed — the one-writer path must not degrade
+        under a read storm.
+
         ``coalesce_max_batch``: Score requests sharing one device launch
         at most (1 = the pre-coalescing serialized behavior, the bench
         baseline).  ``coalesce_window_ms``: ``None`` (the default)
@@ -186,6 +199,14 @@ class ScorerServicer:
         self._assign_memo = {}
         # Score top-k prefix memo (same invalidation; None = disabled)
         self._score_memo = ScoreMemo() if score_memo else None
+        # admission gate in front of the dispatch queue (ISSUE 8):
+        # Score/Assign reserve a slot before touching the coalescer,
+        # overload sheds fast instead of queueing without bound
+        self.admission = AdmissionGate(max_inflight)
+        # replication seam (ISSUE 8): the leader's publisher sets this
+        # to stream every committed Sync to the follower tier; called
+        # under _sync_lock, so frames publish in generation order
+        self.replication_hook = None
         self.dispatch = CoalescingDispatcher(
             self._score_launch_batch,
             max_batch=coalesce_max_batch,
@@ -229,7 +250,8 @@ class ScorerServicer:
             raise exc
 
     # -- RPC bodies (request -> reply functions) --
-    def sync(self, req: "pb2.SyncRequest", ctx=None) -> "pb2.SyncReply":
+    def sync(self, req: "pb2.SyncRequest", ctx=None,
+             wire_bytes: Optional[bytes] = None) -> "pb2.SyncReply":
         # Phase 1 under _sync_lock only: the protobuf->numpy decode +
         # validation runs while the device may still be scattering the
         # PREVIOUS sync's deltas (async dispatch) and while coalesced
@@ -315,18 +337,168 @@ class ScorerServicer:
                 plan_cell[0] = self.state.plan_commit(staged)
                 return self.state.commit_donates(staged, plan=plan_cell[0])
 
-            return self.dispatch.run_exclusive(commit, drain=_decide_drain)
+            reply = self.dispatch.run_exclusive(commit, drain=_decide_drain)
+            # replication (ISSUE 8): stream the committed frame to the
+            # follower tier — still under _sync_lock, so publishes are
+            # strictly generation-ordered; the publisher's per-follower
+            # queues are non-blocking, so a slow follower can never
+            # stall the one writer path
+            # ``wire_bytes`` is the CLIENT's original frame when the
+            # transport had it in hand (the raw-UDS server always
+            # does): the publisher streams those bytes verbatim — no
+            # re-encode on the one writer path.  A transport that only
+            # has the decoded message (gRPC) passes None and the
+            # publisher re-serializes, which is byte-identical (same
+            # runtime both ends).
+            hook = self.replication_hook
+            if hook is not None:
+                try:
+                    hook(req, reply.snapshot_id, wire_bytes)
+                except Exception:  # koordlint: disable=broad-except(the Sync IS committed — a publisher fault must not fail the client's acked write; followers detect the gap and resync)
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "replication publish failed for %s",
+                        reply.snapshot_id,
+                    )
+            return reply
+
+    # -- replication seam (ISSUE 8; koordinator_tpu/replication/) --
+    def export_replication_snapshot(self):
+        """``(epoch, generation, payload)`` of the current resident
+        state: the kind=full frame a new or resyncing subscriber
+        receives.  ``payload`` is the full-state SyncRequest bytes
+        (empty before the first Sync — the follower resets to the
+        empty state at this generation).  Consistent under
+        ``_state_lock``: mirrors and the generation move together at
+        commit, so the pair read here is exactly one committed Sync's
+        outcome."""
+        with self._state_lock:
+            epoch, gen = self._epoch, self._generation
+            req = self.state.export_sync_request()
+        return epoch, gen, (b"" if req is None else req.SerializeToString())
+
+    def apply_replica_frame(self, frame) -> dict:
+        """Apply one replication frame (replication/codec.py Frame) and
+        adopt the LEADER's ``(epoch, generation)`` — the follower's
+        snapshot ids mirror the leader's exactly, so a client holding
+        the leader's Sync ack can Score against any caught-up follower.
+        Continuity (gap/epoch fencing) is the caller's job
+        (replication/follower.py ReplicaApplier); this method only
+        applies:
+
+        * a sequence (kind=delta) frame runs the SAME two-phase
+          stage/commit seam a client Sync does — delta scatters, warm
+          residency, donation barrier and all — so the warm follower
+          apply path is the warm leader path, byte for byte;
+        * a reset (kind=full) frame swaps in a FRESH ResidentState and
+          applies the payload as a first Sync (the one-shot full
+          resync).  The swap never donates buffers out of the old
+          snapshot, so in-flight read batches keep their references
+          and the pipeline keeps flowing (``drain=False``).
+
+        A frame that fails validation raises WITHOUT mutating anything
+        (stage-then-commit): the follower keeps serving its last good
+        snapshot — never a torn one — and resyncs."""
+        from koordinator_tpu.replication import codec
+
+        payload = frame.payload
+        # an empty payload means two different things by kind: a FULL
+        # frame with no bytes resets to the empty pre-first-Sync state
+        # (req=None), while a DELTA frame with no bytes is a real
+        # no-change client Sync (pb2.SyncRequest() serializes to b"")
+        # that must APPLY — forcing a resync for it would replay the
+        # full state export on every quiet-cluster Sync
+        if frame.kind == codec.KIND_FULL:
+            req = pb2.SyncRequest.FromString(payload) if payload else None
+        else:
+            req = pb2.SyncRequest.FromString(payload)
+        with self._sync_lock:
+            if frame.kind == codec.KIND_FULL:
+                fresh = ResidentState(mesh=self.state.mesh)
+                staged = None if req is None else fresh.stage_sync(req)
+
+                def commit_full() -> dict:
+                    with self._state_lock:
+                        self.state = fresh
+                        info = (
+                            {"path": "cold", "delta_tensors": 0,
+                             "full_tensors": 0}
+                            if staged is None
+                            else fresh.commit_sync(staged)
+                        )
+                        self._adopt_replica_locked(frame, info)
+                        return info
+
+                return self.dispatch.run_exclusive(
+                    commit_full, drain=False
+                )
+
+            staged = self.state.stage_sync(req)
+            plan_cell = [None]
+
+            def commit_seq() -> dict:
+                with self._state_lock:
+                    info = self.state.commit_sync(
+                        staged, plan=plan_cell[0]
+                    )
+                    self._adopt_replica_locked(frame, info)
+                    return info
+
+            def _decide_drain() -> bool:
+                plan_cell[0] = self.state.plan_commit(staged)
+                return self.state.commit_donates(staged, plan=plan_cell[0])
+
+            return self.dispatch.run_exclusive(
+                commit_seq, drain=_decide_drain
+            )
+
+    def _adopt_replica_locked(self, frame, info) -> None:
+        """Adopt the leader's snapshot id after a replica apply
+        (``_state_lock`` held): generation AND epoch move to the
+        frame's, and the memos die exactly as on a client Sync — they
+        certified the previous generation."""
+        self._epoch = frame.epoch
+        self._generation = frame.generation
+        self._assign_memo.clear()
+        if self._score_memo is not None:
+            self._score_memo.invalidate()
+        # same backlog valve as a client Sync: a follower applying an
+        # endless frame stream with no Assign to correlate must commit
+        # span backlog instead of growing one immortal pending cycle
+        self.telemetry.flush_backlog()
+        self.telemetry.record_sync(
+            info,
+            snapshot_id=self.snapshot_id(),
+            epoch=frame.epoch,
+            generation=frame.generation,
+        )
 
     def score(self, req: "pb2.ScoreRequest", ctx=None) -> "pb2.ScoreReply":
-        # the coalescer runs the batch in whichever caller leads; this
-        # caller's slot carries its reply or its error back here
+        # admission first (ISSUE 8): the gate sheds BEFORE the request
+        # can deepen the dispatch queue, so a read storm past the
+        # configured depth degrades to fast RESOURCE_EXHAUSTED replies
+        # while everything already admitted completes untouched
         try:
-            entry = self.dispatch.submit(req)
-        except SnapshotNotResident as exc:
+            gate = self.admission.admit("score")
+            gate.__enter__()
+        except ResourceExhausted as exc:
+            self.telemetry.metrics.count_shed("score")
             if ctx is not None:
-                ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(exc))
+                ctx.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
             raise
-        return entry.reply
+        try:
+            # the coalescer runs the batch in whichever caller leads;
+            # this caller's slot carries its reply or its error back
+            try:
+                entry = self.dispatch.submit(req)
+            except SnapshotNotResident as exc:
+                if ctx is not None:
+                    ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(exc))
+                raise
+            return entry.reply
+        finally:
+            gate.__exit__(None, None, None)
 
     # -- coalesced Score execution: launch phase (leader thread, launch
     #    lock held) returning the readback closure the dispatcher runs
@@ -629,16 +801,34 @@ class ScorerServicer:
                 )
 
     def assign(self, req: "pb2.AssignRequest", ctx=None) -> "pb2.AssignReply":
-        # bounded retry: a waiter that inherited an OWNER's failure
-        # re-runs the memo protocol (the failed entry was removed, so
-        # one waiter promotes to owner); the last attempt bypasses the
-        # memo entirely and computes its own cycle, so a pathologically
-        # failing owner can never starve its waiters
-        for attempt in range(3):
-            outcome = self._assign_once(req, ctx, bypass_memo=attempt == 2)
-            if outcome is not None:
-                return outcome
-        raise RuntimeError("unreachable: memo-bypass attempt returned None")
+        # same admission gate as Score (ISSUE 8): Assign is read
+        # traffic against the resident snapshot, so it sheds with the
+        # same RESOURCE_EXHAUSTED-before-the-queue-drowns contract
+        try:
+            gate = self.admission.admit("assign")
+            gate.__enter__()
+        except ResourceExhausted as exc:
+            self.telemetry.metrics.count_shed("assign")
+            if ctx is not None:
+                ctx.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
+            raise
+        try:
+            # bounded retry: a waiter that inherited an OWNER's failure
+            # re-runs the memo protocol (the failed entry was removed,
+            # so one waiter promotes to owner); the last attempt
+            # bypasses the memo entirely and computes its own cycle, so
+            # a pathologically failing owner can never starve waiters
+            for attempt in range(3):
+                outcome = self._assign_once(
+                    req, ctx, bypass_memo=attempt == 2
+                )
+                if outcome is not None:
+                    return outcome
+            raise RuntimeError(
+                "unreachable: memo-bypass attempt returned None"
+            )
+        finally:
+            gate.__exit__(None, None, None)
 
     def _assign_once(
         self, req: "pb2.AssignRequest", ctx, bypass_memo: bool = False

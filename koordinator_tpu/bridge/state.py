@@ -379,6 +379,55 @@ class ResidentState:
             "full_tensors": kinds.count("full"),
         }
 
+    def export_sync_request(self) -> Optional["pb2.SyncRequest"]:
+        """Full-state ``SyncRequest`` rebuilt from the host mirrors —
+        the replication tier's one-shot full-resync payload (ISSUE 8):
+        a follower that applies this onto a FRESH ResidentState ends
+        with mirrors byte-identical to this one's (the same wire
+        decode both sides; tests/test_replication.py asserts the
+        round trip leaf-for-leaf).  Explicit buckets ride along so the
+        follower pads — and compiles — the very same geometry.  Returns
+        None before the first Sync (nothing to replicate yet; the
+        follower resets to the empty pre-first-Sync state instead)."""
+        if self.node_alloc is None or self.pod_requests is None:
+            return None
+        req = pb2.SyncRequest(
+            node_bucket=self.node_bucket, pod_bucket=self.pod_bucket
+        )
+        for target, arr in (
+            (req.nodes.allocatable, self.node_alloc),
+            (req.nodes.requested, self.node_requested),
+            (req.nodes.usage, self.node_usage),
+            (req.nodes.agg_usage, self.node_agg),
+            (req.nodes.agg_fresh, self.node_agg_fresh),
+            (req.nodes.prod_usage, self.node_prod),
+            (req.pods.requests, self.pod_requests),
+            (req.pods.estimated, self.pod_estimated),
+            (req.quotas.runtime, self.quota_runtime),
+            (req.quotas.used, self.quota_used),
+            (req.quotas.limited, self.quota_limited),
+        ):
+            if _present(arr):
+                # prev=None: always the full payload, never a delta —
+                # the receiver has no baseline by definition
+                target.CopyFrom(numpy_to_tensor(np.asarray(arr, np.int64)))
+        if self.node_names:
+            req.nodes.names.extend(self.node_names)
+        if self.node_fresh is not None and len(self.node_fresh):
+            req.nodes.metric_fresh.extend(bool(b) for b in self.node_fresh)
+        if self.pod_names:
+            req.pods.names.extend(self.pod_names)
+        for target, arr in (
+            (req.pods.priority, self.pod_priority),
+            (req.pods.priority_class, self.pod_priority_class),
+            (req.pods.gang_id, self.pod_gang),
+            (req.pods.quota_id, self.pod_quota),
+            (req.gangs.min_member, self.gang_min),
+        ):
+            if arr is not None and len(arr):
+                target.extend(int(v) for v in arr)
+        return req
+
     def _decode_sync(self, reqmsg: "pb2.SyncRequest"):
         """The pure decode/validate half of apply_sync: returns the
         staged mirror values and per-tensor wire info without mutating

@@ -229,7 +229,14 @@ class RawUdsServer:
                 req_cls, fn = entry
                 try:
                     req = req_cls.FromString(payload)
-                    reply = fn(req, None)
+                    if method == METHOD_SYNC:
+                        # hand the servicer the client's ORIGINAL frame
+                        # bytes: the replication publisher streams them
+                        # verbatim instead of re-encoding the decoded
+                        # message on the one writer path (ISSUE 8)
+                        reply = fn(req, None, wire_bytes=payload)
+                    else:
+                        reply = fn(req, None)
                     size = reply.ByteSize()
                     if size > _MAX_FRAME:
                         # every client enforces the same cap on replies; a

@@ -30,6 +30,12 @@ koord_scorer_coalesce_window_ms        gauge     —
 koord_scorer_coalesce_device_idle_ms   gauge     — (cumulative)
 koord_scorer_assign_memo_total         counter   result (hit|miss)
 koord_scorer_score_memo_total          counter   result (hit|miss)
+koord_scorer_shed_total                counter   method (score|assign)
+koord_scorer_replica_role              gauge     role (leader|follower)
+koord_scorer_replica_frames_total      counter   result (applied|stale|resync|error)
+koord_scorer_replica_lag_ms            gauge     —
+koord_scorer_replica_resyncs_total     counter   reason (gap|epoch|decode|apply|connect)
+koord_scorer_replica_followers         gauge     — (leader: live subscribers)
 ====================================== ========= ==========================
 
 The ``koord_scorer_coalesce_*`` families observe the coalescing
@@ -47,6 +53,21 @@ counts Assign RPCs served from the (snapshot id, CycleConfig) result
 memo vs. those that ran a device cycle; ``score_memo_total`` is the
 Score-side twin (ISSUE 7 satellite) — requests served as sliced
 prefixes of a memoized padded top-k readback vs. those that launched.
+
+The ``koord_scorer_shed_total`` and ``koord_scorer_replica_*`` families
+observe the replicated serving tier (ISSUE 8).  ``shed_total`` counts
+read RPCs the admission gate refused with RESOURCE_EXHAUSTED (its RATE
+under load is the overload signal; zero under the configured depth).
+On a follower, ``replica_frames_total`` partitions every replication
+frame by outcome (``applied`` extends the chain; ``stale`` is a
+duplicate/late frame a reordering transport re-delivered — dropped,
+not applied; ``resync`` detected a discontinuity; ``error`` failed
+frame decode), ``replica_lag_ms`` is the last applied frame's
+commit-to-apply wall delay against the leader's stamp, and
+``replica_resyncs_total`` says WHY each one-shot full resync ran — a
+growing ``gap`` rate means the transport (or a slow follower's dropped
+subscription) is lossy.  On the leader, ``replica_followers`` gauges
+live subscriptions.
 
 The jit cache-miss counter is fed by
 ``analysis.retrace_guard.watch_cache_misses`` — the runtime companion of
@@ -83,6 +104,12 @@ COALESCE_WINDOW = "koord_scorer_coalesce_window_ms"
 COALESCE_DEVICE_IDLE = "koord_scorer_coalesce_device_idle_ms"
 ASSIGN_MEMO = "koord_scorer_assign_memo_total"
 SCORE_MEMO = "koord_scorer_score_memo_total"
+SHED_TOTAL = "koord_scorer_shed_total"
+REPLICA_ROLE = "koord_scorer_replica_role"
+REPLICA_FRAMES = "koord_scorer_replica_frames_total"
+REPLICA_LAG = "koord_scorer_replica_lag_ms"
+REPLICA_RESYNCS = "koord_scorer_replica_resyncs_total"
+REPLICA_FOLLOWERS = "koord_scorer_replica_followers"
 
 # occupancy is a count-of-requests-per-launch, not a latency: its own
 # power-of-two buckets (the dispatcher caps batches at 16 by default;
@@ -142,6 +169,25 @@ _FAMILIES = (
      "Score requests served as sliced prefixes of the memoized "
      "(snapshot, config, k-bucket) top-k readback (hit) vs. launched "
      "a device batch (miss)"),
+    (SHED_TOTAL, "counter",
+     "read RPCs the admission gate refused with RESOURCE_EXHAUSTED "
+     "(queue depth at --max-inflight), by method; in-flight work "
+     "completes untouched"),
+    (REPLICA_ROLE, "gauge",
+     "replication role of this daemon as a label (leader|follower); "
+     "value is always 1"),
+    (REPLICA_FRAMES, "counter",
+     "replication frames by outcome on a follower: applied extends "
+     "the s<epoch>-<gen> chain, stale was a duplicate/late redelivery "
+     "(dropped), resync detected a discontinuity, error failed decode"),
+    (REPLICA_LAG, "gauge",
+     "commit-to-apply wall delay of the last applied replication "
+     "frame against the leader's stamp"),
+    (REPLICA_RESYNCS, "counter",
+     "one-shot full resyncs a follower performed, by trigger "
+     "(gap|epoch|decode|apply|connect)"),
+    (REPLICA_FOLLOWERS, "gauge",
+     "live replication subscriptions on the leader"),
 )
 
 # per-family bucket overrides (histograms default to DEFAULT_BUCKETS_MS)
@@ -257,3 +303,22 @@ class ScorerMetrics:
 
     def count_score_memo(self, result: str, n: int = 1) -> None:
         self.registry.counter_add(SCORE_MEMO, int(n), {"result": result})
+
+    # -- replicated serving tier (ISSUE 8) --
+    def count_shed(self, method: str) -> None:
+        self.registry.counter_add(SHED_TOTAL, 1, {"method": method})
+
+    def set_replica_role(self, role: str) -> None:
+        self.registry.gauge_set(REPLICA_ROLE, 1, {"role": role})
+
+    def count_replica_frame(self, result: str) -> None:
+        self.registry.counter_add(REPLICA_FRAMES, 1, {"result": result})
+
+    def set_replica_lag(self, lag_ms: float) -> None:
+        self.registry.gauge_set(REPLICA_LAG, float(lag_ms))
+
+    def count_replica_resync(self, reason: str) -> None:
+        self.registry.counter_add(REPLICA_RESYNCS, 1, {"reason": reason})
+
+    def set_replica_followers(self, n: int) -> None:
+        self.registry.gauge_set(REPLICA_FOLLOWERS, int(n))

@@ -1,0 +1,48 @@
+"""Replicated serving tier for the bridge daemon (ISSUE 8).
+
+One **leader** daemon applies client Syncs to its device-resident
+snapshot and streams the already-encoded delta frames to N **follower**
+daemons; each follower maintains its own device-resident copy (the same
+``bridge/state.py`` stage/commit + ``solver/resident.py`` scatter
+machinery) and serves Score/Assign read traffic locally — the paper's
+one-writer/many-readers split made horizontal.  The ``s<epoch>-<gen>``
+snapshot id chain is the fencing token: a follower applies only frames
+that extend its exact chain, and any discontinuity (gap, epoch bump,
+failed validation, truncated frame) triggers the documented one-shot
+full resync — never a torn snapshot.
+
+Modules:
+
+* ``codec``      — the frame layout (the one Python statement of the
+  header fields; mirrored independently by bridge/wirecheck.py and
+  go/scorerclient/replica.go, all three diffed by koordlint's
+  wire-contract rule).
+* ``admission``  — queue-depth admission control + load shedding
+  (``--max-inflight`` / KOORD_MAX_INFLIGHT; RESOURCE_EXHAUSTED with a
+  retry-after hint before the dispatch queue drowns).
+* ``leader``     — ReplicationPublisher: per-follower bounded queues
+  over a unix socket; the writer path never blocks on a reader.
+* ``follower``   — ReplicaApplier (continuity core) +
+  ReplicationSubscriber (reconnect = full resync) + FollowerServicer
+  (refuses client Syncs).
+
+``leader``/``follower`` import the bridge server and are therefore NOT
+imported eagerly here (bridge/server.py imports ``admission`` — eager
+re-export would cycle); import them explicitly.
+
+docs/REPLICATION.md has the stream protocol, the fencing rules, the
+shed policy and a failover walkthrough.
+"""
+
+from koordinator_tpu.replication.admission import (  # noqa: F401
+    AdmissionGate,
+    ResourceExhausted,
+)
+from koordinator_tpu.replication.codec import (  # noqa: F401
+    Frame,
+    FrameError,
+    KIND_DELTA,
+    KIND_FULL,
+    decode_frame,
+    encode_frame,
+)

@@ -1,0 +1,160 @@
+"""Admission control + load shedding for the bridge daemon (ISSUE 8).
+
+Overload on the old daemon degraded as latency collapse: every Score
+past the coalescer's throughput queued without bound, so p99 grew with
+the backlog and EVERY caller — including the ones the daemon could have
+served on time — missed its deadline.  The gate here sits IN FRONT of
+the dispatch queue and converts overload into fast, bounded rejections
+instead: once more than ``max_inflight`` read RPCs are admitted-but-
+unfinished, new ones fail immediately with :class:`ResourceExhausted`
+carrying a retry-after hint (one observed service period), which the
+transports map to gRPC ``RESOURCE_EXHAUSTED`` / a tagged raw-UDS error
+frame.  In-flight work is untouched — the gate never cancels, it only
+refuses to deepen the queue.
+
+The depth the gate counts is exactly the dispatch queue's upstream
+population (admitted Score/Assign RPCs that have not finished), which
+bounds the coalescer's gather queue plus everything in execution.  Sync
+is deliberately NEVER shed: the paper's one-writer design means the
+write path must stay live for the whole tier — followers replicate
+from it — while read storms are the thing to shed.
+
+``max_inflight=0`` (the default) disables the gate entirely; the
+daemon flag is ``--max-inflight`` / ``KOORD_MAX_INFLIGHT``.  Sheds
+count on the ``koord_scorer_shed_total{method}`` family.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class ResourceExhausted(Exception):
+    """The admission gate refused a request: the dispatch queue is at
+    its configured depth.  ``retry_after_ms`` is the server's hint —
+    one observed service period, i.e. when a slot plausibly frees.
+    Transports map this to gRPC RESOURCE_EXHAUSTED; the message itself
+    carries the machine-parsable ``retry_after_ms=<n>`` token the Go
+    client's ``IsResourceExhausted``/``RetryAfterMS`` helpers read."""
+
+    def __init__(self, method: str, depth: int, limit: int,
+                 retry_after_ms: float):
+        self.method = method
+        self.depth = depth
+        self.limit = limit
+        self.retry_after_ms = float(retry_after_ms)
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: {method} shed at queue depth "
+            f"{depth}/{limit}; retry_after_ms={self.retry_after_ms:.0f}"
+        )
+
+
+class AdmissionGate:
+    """Queue-depth gate with a service-time EWMA for the retry hint.
+
+    ``admit(method)`` returns a context manager; entering it either
+    reserves a slot or raises :class:`ResourceExhausted` *immediately*
+    (the bounded-deadline property: a shed response never waits on the
+    device).  Exiting releases the slot and feeds the EWMA with the
+    observed service time, so the retry-after hint tracks the actual
+    per-request cost under the current load, not a config constant.
+
+    Thread contract: everything under one small lock; no blocking calls
+    inside it (the gate is on the RPC fast path of every Score)."""
+
+    # hint floor/ceiling: a sub-ms hint makes clients busy-spin, a
+    # multi-minute one (first request after an idle stretch measuring a
+    # cold compile) parks them past any realistic drain
+    _MIN_HINT_MS = 1.0
+    _MAX_HINT_MS = 30_000.0
+
+    def __init__(self, max_inflight: int = 0, alpha: float = 0.2,
+                 clock=None):
+        self.max_inflight = max(0, int(max_inflight))
+        self.alpha = float(alpha)
+        self._clock = clock or time.perf_counter
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._ewma_ms: Optional[float] = None
+        # lifetime stats (bench + /metrics feed)
+        self.admitted = 0
+        self.shed = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_inflight > 0
+
+    def depth(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def retry_after_ms(self) -> float:
+        """One observed service period, clamped (the hint a shed reply
+        carries)."""
+        with self._lock:
+            return self._hint_locked()
+
+    def _hint_locked(self) -> float:
+        ewma = self._ewma_ms if self._ewma_ms is not None else 50.0
+        return min(self._MAX_HINT_MS, max(self._MIN_HINT_MS, ewma))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "inflight": self._inflight,
+                "max_inflight": self.max_inflight,
+                "admitted": self.admitted,
+                "shed": self.shed,
+                "ewma_service_ms": self._ewma_ms,
+            }
+
+    def admit(self, method: str) -> "_Admission":
+        return _Admission(self, method)
+
+    # -- slot accounting (called by _Admission) --
+    def _enter(self, method: str) -> float:
+        with self._lock:
+            if self.enabled and self._inflight >= self.max_inflight:
+                self.shed += 1
+                raise ResourceExhausted(
+                    method, self._inflight, self.max_inflight,
+                    self._hint_locked(),
+                )
+            self._inflight += 1
+            self.admitted += 1
+        return self._clock()
+
+    def _exit(self, entered_at: float) -> None:
+        served_ms = (self._clock() - entered_at) * 1000.0
+        with self._lock:
+            self._inflight -= 1
+            if self._ewma_ms is None:
+                self._ewma_ms = served_ms
+            else:
+                self._ewma_ms = (
+                    self.alpha * served_ms
+                    + (1.0 - self.alpha) * self._ewma_ms
+                )
+
+
+class _Admission:
+    """One RPC's pass through the gate (context manager)."""
+
+    __slots__ = ("_gate", "_method", "_entered_at")
+
+    def __init__(self, gate: AdmissionGate, method: str):
+        self._gate = gate
+        self._method = method
+        self._entered_at: Optional[float] = None
+
+    def __enter__(self) -> "_Admission":
+        self._entered_at = self._gate._enter(self._method)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._entered_at is not None:
+            self._gate._exit(self._entered_at)
+            self._entered_at = None
+        return False

@@ -1,0 +1,263 @@
+"""Follower side of the replicated serving tier (ISSUE 8).
+
+A follower daemon maintains its own device-resident snapshot copy by
+applying the leader's replication frames through the very same
+``bridge/state.py`` stage/commit seam (delta scatters, warm residency,
+donation barrier) a client Sync uses, and serves Score/Assign read
+traffic locally.  Three pieces:
+
+* :class:`ReplicaApplier` — the transport-independent continuity core.
+  Every frame is judged against the ``s<epoch>-<gen>`` chain the
+  follower is on; only a frame that EXTENDS it applies.  Anything else
+  is a classified discontinuity: ``gap`` (dropped frame), ``epoch``
+  (leader restart/failover), ``apply`` (payload failed validation —
+  state untouched, the stage-then-commit atomicity), and duplicates
+  from a reordering transport are dropped as ``stale``.  The fuzz in
+  tests/test_replication.py drives this against a lossy/reordering
+  channel with byte-parity asserted follower-vs-leader after every
+  commit.
+* :class:`ReplicationSubscriber` — the UDS transport: dial the
+  leader's ``.repl`` socket, stream frames into the applier, and on
+  ANY discontinuity (including a truncated or malformed frame) drop
+  the connection and redial — the leader opens every subscription with
+  a full-state frame, so reconnect IS the one-shot full resync.
+* :class:`FollowerServicer` — a ScorerServicer that refuses client
+  Syncs (the tier has ONE writer; Sync goes to the leader) while
+  serving Score/Assign exactly like the leader, snapshot ids included.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from typing import Optional
+
+import grpc
+
+from koordinator_tpu.bridge.server import ScorerServicer
+from koordinator_tpu.replication import codec
+
+logger = logging.getLogger(__name__)
+
+APPLIED = "applied"
+STALE = "stale"
+RESYNC = "resync"
+
+
+class NotLeader(Exception):
+    """A client sent Sync to a follower replica.  Mapped to gRPC
+    FAILED_PRECONDITION / a raw-UDS error frame; the fix is config
+    (point Sync at the leader), so the message says where."""
+
+
+class ReplicaApplier:
+    """Continuity-checked frame application onto a follower servicer.
+
+    ``offer(frame)`` returns :data:`APPLIED`, :data:`STALE` (duplicate
+    or late redelivery — dropped) or :data:`RESYNC` (discontinuity
+    detected; the caller must fetch a full frame, which ``offer``
+    always accepts).  Not thread-safe by itself: one transport thread
+    feeds one applier (client Score/Assign traffic runs concurrently —
+    the servicer's own locks cover that side)."""
+
+    def __init__(self, servicer, clock=time.time):
+        self.servicer = servicer
+        self._clock = clock
+        self.applied = 0
+        self.resyncs = 0
+        self.last_lag_ms: Optional[float] = None
+        servicer.telemetry.metrics.set_replica_role("follower")
+
+    # -- current chain position --
+    def position(self):
+        """(epoch, generation) the follower is at.  Before the first
+        full frame this is the follower's own boot epoch, which no
+        leader frame can ever extend — exactly the "must resync first"
+        state a fresh follower should be in."""
+        from koordinator_tpu.bridge.client import parse_snapshot_id
+
+        return parse_snapshot_id(self.servicer.snapshot_id())
+
+    def offer(self, frame: "codec.Frame") -> str:
+        metrics = self.servicer.telemetry.metrics
+        if frame.kind == codec.KIND_FULL:
+            return self._apply(frame, metrics)
+        epoch, gen = self.position()
+        if frame.epoch != epoch:
+            return self._resync("epoch", metrics)
+        if frame.generation <= gen:
+            # duplicate / late redelivery on the SAME chain: the state
+            # already contains it; applying again would corrupt the
+            # delta baselines — drop, don't resync
+            metrics.count_replica_frame(STALE)
+            return STALE
+        if frame.generation != gen + 1:
+            return self._resync("gap", metrics)
+        return self._apply(frame, metrics)
+
+    def _apply(self, frame, metrics) -> str:
+        try:
+            self.servicer.apply_replica_frame(frame)
+        except Exception:  # koordlint: disable=broad-except(a bad frame must demote to the documented full resync, never crash the follower; state is untouched by stage-then-commit)
+            logger.exception(
+                "replica frame s%s-%d failed to apply; forcing full "
+                "resync (resident state untouched)",
+                frame.epoch, frame.generation,
+            )
+            return self._resync("apply", metrics)
+        self.applied += 1
+        lag_ms = max(0.0, self._clock() * 1e6 - frame.stamp_us) / 1000.0
+        self.last_lag_ms = lag_ms
+        metrics.count_replica_frame(APPLIED)
+        metrics.set_replica_lag(lag_ms)
+        return APPLIED
+
+    def _resync(self, reason: str, metrics) -> str:
+        self.resyncs += 1
+        metrics.count_replica_frame(RESYNC)
+        metrics.count_replica_resync(reason)
+        return RESYNC
+
+
+def _read_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    """Exactly ``n`` bytes or None on EOF/reset (any partial read is a
+    truncated frame — the caller treats it as a discontinuity)."""
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = conn.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class ReplicationSubscriber:
+    """Dial the leader's replication socket and pump frames into an
+    applier; reconnect (= full resync) on any discontinuity.
+
+    ``on_frame(result, frame)`` is an optional callback after every
+    offer — the bench's follower worker uses it to publish catch-up
+    status; tests use it to observe the stream."""
+
+    def __init__(
+        self,
+        path: str,
+        applier: ReplicaApplier,
+        reconnect_delay_s: float = 0.05,
+        on_frame=None,
+    ):
+        self.path = path
+        self.applier = applier
+        self.reconnect_delay_s = float(reconnect_delay_s)
+        self.on_frame = on_frame
+        self._stop = threading.Event()
+        self._conn_lock = threading.Lock()
+        self._conn: Optional[socket.socket] = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self.connects = 0
+
+    def start(self) -> "ReplicationSubscriber":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        # unblock a recv in flight: the pump thread would otherwise
+        # sit in the blocking read until the leader sends again
+        with self._conn_lock:
+            conn = self._conn
+        if conn is not None:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        self._thread.join(timeout=5)
+
+    # -- internals --
+    def _run(self) -> None:
+        metrics = self.applier.servicer.telemetry.metrics
+        while not self._stop.is_set():
+            conn = None
+            try:
+                conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                conn.connect(self.path)
+                with self._conn_lock:
+                    self._conn = conn
+                self.connects += 1
+                self._pump(conn, metrics)
+            except OSError:
+                pass  # leader down/mid-restart: retry below
+            finally:
+                with self._conn_lock:
+                    self._conn = None
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+            # every redial lands a fresh full frame — reconnect IS the
+            # resync; pace it so a dead leader is polls, not a spin
+            self._stop.wait(self.reconnect_delay_s)
+
+    def _pump(self, conn: socket.socket, metrics) -> None:
+        while not self._stop.is_set():
+            header = _read_exact(conn, codec.HEADER_LEN)
+            if header is None:
+                return  # EOF between frames, or leader dropped us
+            try:
+                partial, plen = codec.decode_header(header)
+                payload = b""
+                if plen:
+                    body = _read_exact(conn, plen)
+                    if body is None:
+                        # truncated mid-frame: a protocol violation,
+                        # not a clean close — count it, then resync by
+                        # reconnecting
+                        metrics.count_replica_frame("error")
+                        metrics.count_replica_resync("connect")
+                        return
+                    payload = body
+                frame = codec.decode_frame(header + payload)
+            except codec.FrameError as exc:
+                logger.warning(
+                    "malformed replication frame (%s); resyncing", exc
+                )
+                metrics.count_replica_frame("error")
+                metrics.count_replica_resync("decode")
+                return
+            result = self.applier.offer(frame)
+            if self.on_frame is not None:
+                try:
+                    self.on_frame(result, frame)
+                except Exception:  # koordlint: disable=broad-except(status callbacks are observability; they must not kill the stream)
+                    logger.exception("replication on_frame callback failed")
+            if result == RESYNC:
+                return  # reconnect -> leader reopens with a full frame
+
+
+class FollowerServicer(ScorerServicer):
+    """A read-replica servicer: serves Score/Assign exactly like the
+    leader (snapshot ids included — they ARE the leader's after the
+    first applied frame) but refuses client Syncs: the tier has one
+    writer, and a follower silently accepting a Sync would fork its
+    chain off the leader's and poison every delta baseline."""
+
+    def __init__(self, *args, leader: str = "", **kwargs):
+        super().__init__(*args, **kwargs)
+        self._leader_hint = leader
+
+    def sync(self, req, ctx=None, wire_bytes=None):
+        msg = (
+            "replica follower does not accept Sync: the tier has one "
+            "writer"
+            + (f" (sync against {self._leader_hint})"
+               if self._leader_hint else "")
+        )
+        if ctx is not None:
+            ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, msg)
+        raise NotLeader(msg)

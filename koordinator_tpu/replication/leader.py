@@ -1,0 +1,277 @@
+"""Leader side of the replicated serving tier (ISSUE 8).
+
+One daemon — the leader — applies client Syncs to its device-resident
+snapshot and streams every committed frame to N follower daemons over a
+unix socket, each follower maintaining its own device-resident copy and
+serving Score/Assign read traffic locally.  The paper's design already
+separates the one writer from many readers; this module is that split
+made horizontal.
+
+Protocol (replication/codec.py frames over a plain ``SOCK_STREAM``
+unix socket, one-directional leader -> follower):
+
+* every new subscription OPENS with a ``kind=full`` frame — the
+  leader's full-state export at its current ``(epoch, generation)`` —
+  so "resync" and "subscribe" are the same mechanism: a follower that
+  detects any discontinuity simply drops the connection and redials;
+* every committed Sync then streams as a ``kind=delta`` sequence frame
+  (the client's already-encoded SyncRequest bytes — a warm delta frame
+  replicates at its wire size, O(changed));
+* a follower that cannot keep up is DROPPED, not waited for: each
+  subscriber has a bounded frame queue drained by its own sender
+  thread, and overflow closes the connection (the follower redials and
+  full-resyncs).  The writer path never blocks on a reader — publish
+  is enqueue-only.
+
+Ordering: the servicer invokes ``replication_hook`` under its
+``_sync_lock``, so frames fan out in strict generation order; new
+subscriptions serialize against the fan-out under the publisher's own
+lock and read the export under the servicer's ``_state_lock``, which
+makes the opening full frame a committed-generation prefix of the
+delta stream that follows (a delta the full frame already contains
+arrives with ``generation <= current`` and is dropped as stale by the
+follower — never applied twice).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import socket
+import threading
+import time
+
+from koordinator_tpu.replication import codec
+
+logger = logging.getLogger(__name__)
+
+# frames a slow follower may have outstanding before it is dropped to
+# a full resync; bounds leader-side memory at ~queue * frame size
+DEFAULT_QUEUE_FRAMES = 64
+
+
+def _parse_sid(snapshot_id: str):
+    from koordinator_tpu.bridge.client import parse_snapshot_id
+
+    return parse_snapshot_id(snapshot_id)
+
+
+class _Subscriber:
+    """One follower connection: bounded queue + sender thread."""
+
+    def __init__(self, conn: socket.socket, max_frames: int, on_drop):
+        self.conn = conn
+        self.max_frames = max_frames
+        self._on_drop = on_drop
+        self._frames = collections.deque()
+        self._cond = threading.Condition()
+        self._dead = False
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+
+    def start(self) -> "_Subscriber":
+        self._thread.start()
+        return self
+
+    def enqueue(self, frame_bytes: bytes) -> None:
+        """Non-blocking: the publish path must never wait on a reader.
+        Overflow kills the subscription — the follower's reconnect
+        gets a fresh full frame, which is strictly more information
+        than the frames this queue would have held."""
+        overflow = False
+        with self._cond:
+            if self._dead:
+                return
+            if len(self._frames) >= self.max_frames:
+                overflow = True
+            else:
+                self._frames.append(frame_bytes)
+                self._cond.notify_all()
+        if overflow:
+            logger.warning(
+                "replication subscriber overflowed its %d-frame "
+                "queue; dropping it to a full resync",
+                self.max_frames,
+            )
+            self.close()
+
+    def close(self) -> None:
+        # the on_drop callback (publisher lock) runs with the condition
+        # RELEASED: the sender thread takes cond -> publisher-lock and
+        # the publish path publisher-lock -> cond, so calling out while
+        # holding the condition would close a lock-order cycle
+        if self._kill():
+            self._on_drop(self)
+
+    def _kill(self) -> bool:
+        """Transition to dead exactly once; True for the transitioning
+        caller (who then owns the on_drop notification)."""
+        with self._cond:
+            if self._dead:
+                return False
+            self._dead = True
+            self._frames.clear()
+            try:
+                self.conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self.conn.close()
+            except OSError:
+                pass
+            self._cond.notify_all()
+            return True
+
+    def _drain(self) -> None:
+        while True:
+            with self._cond:
+                while not self._frames and not self._dead:
+                    self._cond.wait()
+                if self._dead:
+                    return
+                frame = self._frames.popleft()
+            try:
+                self.conn.sendall(frame)
+            except OSError:
+                self.close()
+                return
+
+
+class ReplicationPublisher:
+    """Streams a leader servicer's committed Syncs to followers.
+
+    ``attach`` + ``start`` on the leader daemon; the scheduler server
+    binds it at ``<uds>.repl`` by default (scheduler/server.py)."""
+
+    def __init__(
+        self,
+        servicer,
+        path: str,
+        queue_frames: int = DEFAULT_QUEUE_FRAMES,
+        clock=time.time,
+    ):
+        self.servicer = servicer
+        self.path = path
+        self.queue_frames = max(1, int(queue_frames))
+        self._clock = clock
+        # RLock: an enqueue overflow inside the fan-out (lock held)
+        # drops the subscriber, and the drop re-enters to unregister
+        self._lock = threading.RLock()
+        self._subs = []
+        self._stop = threading.Event()
+        if os.path.exists(path):
+            os.unlink(path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(path)
+        self._sock.listen(16)
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        # lifetime stats (tests/bench)
+        self.published = 0
+        self.subscriptions = 0
+
+    # -- lifecycle --
+    def attach(self) -> "ReplicationPublisher":
+        """Hook the servicer's Sync commit path.  Separate from start()
+        so tests can attach without a socket."""
+        self.servicer.replication_hook = self.on_sync_committed
+        self.servicer.telemetry.metrics.set_replica_role("leader")
+        return self
+
+    def start(self) -> "ReplicationPublisher":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.servicer.replication_hook is self.on_sync_committed:
+            self.servicer.replication_hook = None
+        try:
+            self._sock.close()
+        finally:
+            with self._lock:
+                subs = list(self._subs)
+            for sub in subs:
+                sub.close()
+            if os.path.exists(self.path):
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
+
+    def follower_count(self) -> int:
+        with self._lock:
+            return len(self._subs)
+
+    # -- the servicer hook (runs under the servicer's _sync_lock) --
+    def on_sync_committed(self, req, snapshot_id: str,
+                          wire_bytes=None) -> None:
+        """``wire_bytes`` is the client's original frame when the
+        transport kept it (the raw-UDS path) — streamed verbatim, the
+        "already-encoded delta frames" economics; a None falls back to
+        re-serializing the decoded message (gRPC), byte-identical."""
+        epoch, gen = _parse_sid(snapshot_id)
+        payload = (
+            wire_bytes if wire_bytes is not None
+            else req.SerializeToString()
+        )
+        frame = codec.encode_frame(
+            codec.KIND_DELTA, epoch, gen,
+            int(self._clock() * 1e6), payload,
+        )
+        with self._lock:
+            self.published += 1
+            for sub in list(self._subs):
+                sub.enqueue(frame)
+
+    # -- subscription plumbing --
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed by stop()
+            try:
+                self._register(conn)
+            except Exception:  # koordlint: disable=broad-except(one bad subscription must not kill the accept loop for every other follower)
+                logger.exception("replication subscription failed")
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _register(self, conn: socket.socket) -> None:
+        """Under the publisher lock: export the current state as the
+        opening full frame, enqueue it, then admit the subscriber —
+        atomically against the fan-out, so no committed delta can slip
+        between the export and the subscription (the continuity
+        argument in the module docstring)."""
+        sub = _Subscriber(conn, self.queue_frames, self._drop)
+        with self._lock:
+            epoch, gen, payload = (
+                self.servicer.export_replication_snapshot()
+            )
+            full = codec.encode_frame(
+                codec.KIND_FULL, epoch, gen,
+                int(self._clock() * 1e6), payload,
+            )
+            sub.enqueue(full)
+            self._subs.append(sub)
+            self.subscriptions += 1
+            n = len(self._subs)
+        sub.start()
+        self.servicer.telemetry.metrics.set_replica_followers(n)
+
+    def _drop(self, sub: "_Subscriber") -> None:
+        # from the sender thread (no lock) or re-entrantly from an
+        # enqueue overflow during the fan-out (RLock)
+        with self._lock:
+            try:
+                self._subs.remove(sub)
+            except ValueError:
+                return
+            n = len(self._subs)
+        try:
+            self.servicer.telemetry.metrics.set_replica_followers(n)
+        except Exception:  # koordlint: disable=broad-except(gauge update on a dying connection must not mask the drop itself)
+            pass

@@ -83,6 +83,8 @@ class SchedulerServer:
         mesh_devices: Optional[str] = None,
         pipeline_depth: Optional[int] = None,
         coalesce_cap_ms: Optional[float] = None,
+        max_inflight: Optional[int] = None,
+        replicate_from: Optional[str] = None,
     ):
         # persistent compile cache under the daemon's state dir: a
         # restarted sidecar skips the multi-second (16.5s on TPU,
@@ -183,14 +185,39 @@ class SchedulerServer:
             servicer_kw["pipeline_depth"] = int(pipeline_depth)
         if coalesce_cap_ms is not None:
             servicer_kw["coalesce_cap_ms"] = float(coalesce_cap_ms)
-        self.servicer = _LeaderGatedServicer(
-            cfg, lambda: self.elector.is_leader, mesh=mesh,
-            mesh_resident=mesh_resident,
-            # flight-recorder dumps (obs/flight.py) land under
-            # <state-dir>/flight on cycle error / demotion / SIGUSR1
-            state_dir=state_dir,
-            **servicer_kw,
-        )
+        if max_inflight is not None:
+            servicer_kw["max_inflight"] = int(max_inflight)
+        # replication role (ISSUE 8, koordinator_tpu/replication/):
+        # --replicate-from makes this daemon a READ FOLLOWER — it
+        # subscribes to the named leader's replication socket, applies
+        # the streamed frames onto its own device-resident snapshot,
+        # serves Score/Assign locally and refuses client Syncs.  The
+        # default role is leader: every committed Sync streams out on
+        # <uds>.repl for any follower that dials it.
+        self.replicate_from = replicate_from
+        self.repl_path = uds_path + ".repl"
+        self._publisher = None
+        self._subscriber = None
+        self.applier = None
+        if replicate_from:
+            from koordinator_tpu.replication.follower import (
+                FollowerServicer,
+            )
+
+            self.servicer = FollowerServicer(
+                cfg, leader=replicate_from, mesh=mesh,
+                mesh_resident=mesh_resident, state_dir=state_dir,
+                **servicer_kw,
+            )
+        else:
+            self.servicer = _LeaderGatedServicer(
+                cfg, lambda: self.elector.is_leader, mesh=mesh,
+                mesh_resident=mesh_resident,
+                # flight-recorder dumps (obs/flight.py) land under
+                # <state-dir>/flight on cycle error/demotion/SIGUSR1
+                state_dir=state_dir,
+                **servicer_kw,
+            )
         self.api = APIService()
         self.uds_path = uds_path
         self.enable_grpc = enable_grpc
@@ -222,6 +249,8 @@ class SchedulerServer:
                             # landed on the resident device tensors
                             # ("warm") or dropped residency ("cold")
                             "last_sync_path": outer.servicer.state.last_sync_path,
+                            # replication tier visibility (ISSUE 8)
+                            "replica": outer.replica_health(),
                         },
                     )
                     return
@@ -269,6 +298,22 @@ class SchedulerServer:
     def http_port(self) -> int:
         return self._httpd.server_address[1]
 
+    def replica_health(self) -> dict:
+        """The /healthz replication block: role, and either follower
+        chain position + lag or the leader's live subscriber count."""
+        out = {
+            "role": "follower" if self.replicate_from else "leader",
+            "snapshot_id": self.servicer.snapshot_id(),
+            "shed": self.servicer.admission.stats()["shed"],
+        }
+        if self.applier is not None:
+            out["applied_frames"] = self.applier.applied
+            out["resyncs"] = self.applier.resyncs
+            out["lag_ms"] = self.applier.last_lag_ms
+        if self._publisher is not None:
+            out["followers"] = self._publisher.follower_count()
+        return out
+
     def start(self) -> "SchedulerServer":
         os.makedirs(os.path.dirname(self.uds_path) or ".", exist_ok=True)
         # operator seam: `kill -USR1 <pid>` dumps the last K cycles'
@@ -281,6 +326,24 @@ class SchedulerServer:
             self._grpc_server = make_server(servicer=self.servicer)
             self._grpc_server.add_insecure_port(f"unix://{self.uds_path}")
             self._grpc_server.start()
+        if self.replicate_from:
+            from koordinator_tpu.replication.follower import (
+                ReplicaApplier,
+                ReplicationSubscriber,
+            )
+
+            self.applier = ReplicaApplier(self.servicer)
+            self._subscriber = ReplicationSubscriber(
+                self.replicate_from, self.applier
+            ).start()
+        else:
+            from koordinator_tpu.replication.leader import (
+                ReplicationPublisher,
+            )
+
+            self._publisher = ReplicationPublisher(
+                self.servicer, self.repl_path
+            ).attach().start()
         self._http.start()
         self._elector_thread = threading.Thread(
             target=self.elector.run, daemon=True
@@ -292,6 +355,10 @@ class SchedulerServer:
         self.elector.stop()
         if self._elector_thread:
             self._elector_thread.join(timeout=5)
+        if self._subscriber:
+            self._subscriber.stop()
+        if self._publisher:
+            self._publisher.stop()
         if self._raw_server:
             self._raw_server.stop()
         if self._grpc_server:
@@ -351,6 +418,27 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "latency tax a burst-gathering leader may pay (docs/PIPELINE.md)",
     )
     ap.add_argument(
+        "--max-inflight", type=int,
+        default=(
+            int(os.environ["KOORD_MAX_INFLIGHT"])
+            if os.environ.get("KOORD_MAX_INFLIGHT") else None
+        ),
+        help="admission control (docs/REPLICATION.md): read RPCs "
+        "(Score/Assign) admitted-but-unfinished before new ones shed "
+        "with RESOURCE_EXHAUSTED + a retry-after hint; 0 = unlimited "
+        "(default; env: KOORD_MAX_INFLIGHT).  Sync is never shed",
+    )
+    ap.add_argument(
+        "--replicate-from", dest="replicate_from",
+        default=os.environ.get("KOORD_REPLICATE_FROM") or None,
+        help="run as a READ FOLLOWER of the leader daemon whose "
+        "replication socket is at this path (the leader serves it at "
+        "<uds>.repl): apply the streamed Sync frames onto a local "
+        "device-resident snapshot copy, serve Score/Assign locally, "
+        "refuse client Syncs (env: KOORD_REPLICATE_FROM; "
+        "docs/REPLICATION.md)",
+    )
+    ap.add_argument(
         "--state-dir", default=None,
         help="daemon state directory (default: $XDG_STATE_HOME/"
         "koord-scheduler, per-user); the persistent XLA compile cache "
@@ -374,6 +462,8 @@ def main(argv=None) -> int:
         mesh_devices=args.mesh_devices,
         pipeline_depth=args.pipeline_depth,
         coalesce_cap_ms=args.coalesce_cap_ms,
+        max_inflight=args.max_inflight,
+        replicate_from=args.replicate_from,
     ).start()
     try:
         threading.Event().wait()

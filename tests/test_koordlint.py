@@ -810,6 +810,10 @@ class TestWireContract:
             "wire": read("go", "scorerclient", "wire.go"),
             "delta": read("go", "scorerclient", "delta.go"),
             "state": read("koordinator_tpu", "bridge", "state.py"),
+            "codec": read("koordinator_tpu", "replication", "codec.py"),
+            "wirecheck": read("koordinator_tpu", "bridge",
+                              "wirecheck.py"),
+            "replica": read("go", "scorerclient", "replica.go"),
         }
 
     def test_head_is_clean(self, sources):
@@ -943,6 +947,87 @@ class TestWireContract:
         (root / "go" / "scorerclient" / "wire.go").write_text(tagged)
         got = run_repo(root=str(root), rules=["wire-contract"])
         assert not any("appendPackedInt64" in v.message for v in got)
+
+    # -- replication stream framing (ISSUE 8): the three statements of
+    #    the frame header must agree, and every seeded one-sided edit
+    #    must fail lint, scorer.proto-style --
+    def test_replication_framing_head_is_clean(self, sources):
+        assert wire_contract.check_replication_framing(
+            sources["codec"], sources["wirecheck"], sources["replica"]
+        ) == []
+
+    def test_replication_go_width_drift_caught(self, sources):
+        bad = sources["replica"].replace(
+            '{"generation", 8},', '{"generation", 4},'
+        )
+        assert bad != sources["replica"]
+        got = wire_contract.check_replication_framing(
+            sources["codec"], sources["wirecheck"], bad
+        )
+        assert any("replicaFrameFields" in v.message for v in got)
+
+    def test_replication_go_field_order_drift_caught(self, sources):
+        bad = sources["replica"].replace(
+            '{"epoch", 8},\n\t{"generation", 8},',
+            '{"generation", 8},\n\t{"epoch", 8},',
+        )
+        assert bad != sources["replica"]
+        got = wire_contract.check_replication_framing(
+            sources["codec"], sources["wirecheck"], bad
+        )
+        assert any("disagrees" in v.message for v in got)
+
+    def test_replication_magic_and_version_drift_caught(self, sources):
+        bad = sources["replica"].replace(
+            "ReplicaFrameMagic   = 0x4B52504C",
+            "ReplicaFrameMagic   = 0x4B52504D",
+        )
+        assert bad != sources["replica"]
+        got = wire_contract.check_replication_framing(
+            sources["codec"], sources["wirecheck"], bad
+        )
+        assert any("MAGIC" in v.message for v in got)
+        bad = sources["replica"].replace(
+            "ReplicaFrameVersion = 1", "ReplicaFrameVersion = 2"
+        )
+        got = wire_contract.check_replication_framing(
+            sources["codec"], sources["wirecheck"], bad
+        )
+        assert any("VERSION" in v.message for v in got)
+
+    def test_replication_header_len_drift_caught(self, sources):
+        bad = sources["replica"].replace(
+            "ReplicaHeaderLen    = 34", "ReplicaHeaderLen    = 30"
+        )
+        assert bad != sources["replica"]
+        got = wire_contract.check_replication_framing(
+            sources["codec"], sources["wirecheck"], bad
+        )
+        assert any("ReplicaHeaderLen" in v.message for v in got)
+
+    def test_replication_wirecheck_mirror_drift_caught(self, sources):
+        bad = sources["wirecheck"].replace(
+            '("stamp_us", 8),', '("stamp_us", 4),'
+        )
+        assert bad != sources["wirecheck"]
+        got = wire_contract.check_replication_framing(
+            sources["codec"], bad, sources["replica"]
+        )
+        assert any("REPLICA_FRAME_FIELDS" in v.message for v in got)
+
+    def test_replication_missing_tables_flagged(self, sources):
+        got = wire_contract.check_replication_framing(
+            "x = 1\n", sources["wirecheck"], sources["replica"]
+        )
+        assert any("FRAME_FIELDS" in v.message for v in got)
+        got = wire_contract.check_replication_framing(
+            sources["codec"], "x = 1\n", sources["replica"]
+        )
+        assert any("REPLICA_FRAME_FIELDS" in v.message for v in got)
+        got = wire_contract.check_replication_framing(
+            sources["codec"], sources["wirecheck"], "package x\n"
+        )
+        assert any("replicaFrameFields" in v.message for v in got)
 
     def test_stale_pb2_caught(self, sources):
         # a field added to the proto but absent from the emitted module

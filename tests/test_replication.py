@@ -1,0 +1,987 @@
+"""Replicated serving tier (ISSUE 8): frame codec, fencing, admission
+control, transport resync, and the follower-vs-leader byte-parity
+acceptance — including the lossy/reordering fuzz and the 3-follower
+interleaved storm with an injected dropped frame and a leader restart.
+"""
+
+import os
+import socket
+import struct
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.bridge import state as bridge_state
+from koordinator_tpu.bridge.client import parse_snapshot_id
+from koordinator_tpu.bridge.codegen import pb2
+from koordinator_tpu.bridge.server import ScorerServicer
+from koordinator_tpu.bridge.state import numpy_to_tensor
+from koordinator_tpu.bridge import wirecheck
+from koordinator_tpu.harness import generators
+from koordinator_tpu.harness.golden import build_sync_request
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.replication import codec
+from koordinator_tpu.replication.admission import (
+    AdmissionGate,
+    ResourceExhausted,
+)
+from koordinator_tpu.replication.follower import (
+    APPLIED,
+    FollowerServicer,
+    NotLeader,
+    RESYNC,
+    ReplicaApplier,
+    ReplicationSubscriber,
+    STALE,
+)
+from koordinator_tpu.replication.leader import ReplicationPublisher
+
+
+# ---- shared helpers ----
+
+def _tiny_sync(pods=48, nodes=12, seed=3):
+    nodes_l, pods_l, gangs, quotas = generators.quota_colocation(
+        seed=seed, pods=pods, nodes=nodes, tenants=2
+    )
+    req, _ = build_sync_request(nodes_l, pods_l, gangs, quotas)
+    return req, nodes_l
+
+
+_MIRROR_KEYS = bridge_state._DELTA_TENSORS + (
+    "node_fresh", "pod_priority", "pod_priority_class", "pod_gang",
+    "pod_quota", "gang_min",
+)
+
+
+def _assert_state_parity(leader_sv, follower_sv):
+    """Follower mirrors byte-identical to the leader's, plus the id."""
+    assert follower_sv.snapshot_id() == leader_sv.snapshot_id()
+    _assert_mirror_parity(leader_sv, follower_sv)
+
+
+def _assert_mirror_parity(leader_sv, follower_sv):
+    """Mirror-only parity (no snapshot-id claim): the oracle daemon in
+    the storm test mints its own epoch, so only the STATE must match."""
+    a, b = leader_sv.state, follower_sv.state
+    for key in _MIRROR_KEYS:
+        va, vb = getattr(a, key), getattr(b, key)
+        if va is None or vb is None:
+            assert va is None and vb is None, f"{key}: {va!r} vs {vb!r}"
+        else:
+            va, vb = np.asarray(va), np.asarray(vb)
+            assert va.dtype == vb.dtype, key
+            assert np.array_equal(va, vb), key
+    assert a.node_names == b.node_names
+    assert a.pod_names == b.pod_names
+    assert a.node_bucket == b.node_bucket
+    assert a.pod_bucket == b.pod_bucket
+
+
+def _flat_score_bytes(sv, sid, top_k=8):
+    reply = sv.score(pb2.ScoreRequest(snapshot_id=sid, top_k=top_k,
+                                      flat=True))
+    return reply.flat.SerializeToString()
+
+
+def _capture_frames(leader_sv, clock=lambda: 0):
+    """Attach a replication hook that records encoded Frames in order
+    (the in-process stand-in for the publisher's fan-out)."""
+    frames = []
+
+    def hook(req, snapshot_id, wire_bytes=None):
+        epoch, gen = parse_snapshot_id(snapshot_id)
+        frames.append(codec.Frame(
+            kind=codec.KIND_DELTA, epoch=epoch, generation=gen,
+            stamp_us=int(clock()),
+            payload=(
+                wire_bytes if wire_bytes is not None
+                else req.SerializeToString()
+            ),
+        ))
+
+    leader_sv.replication_hook = hook
+    return frames
+
+
+def _full_frame(leader_sv, stamp_us=0):
+    epoch, gen, payload = leader_sv.export_replication_snapshot()
+    return codec.Frame(kind=codec.KIND_FULL, epoch=epoch,
+                       generation=gen, stamp_us=stamp_us, payload=payload)
+
+
+def _warm_usage_frame(prev, bump):
+    cur = prev.copy()
+    cur.flat[bump % cur.size] += 1 + bump
+    warm = pb2.SyncRequest()
+    warm.nodes.usage.CopyFrom(numpy_to_tensor(cur, prev))
+    return warm, cur
+
+
+# ---- frame codec ----
+
+class TestFrameCodec:
+    def test_roundtrip_both_kinds(self):
+        for kind, payload in (
+            (codec.KIND_DELTA, b"\x01\x02\x03"),
+            (codec.KIND_FULL, b""),
+        ):
+            raw = codec.encode_frame(kind, "abcdef01", 7, 123_456, payload)
+            f = codec.decode_frame(raw)
+            assert (f.kind, f.epoch, f.generation, f.stamp_us,
+                    f.payload) == (kind, "abcdef01", 7, 123_456, payload)
+            assert f.snapshot_id == "sabcdef01-7"
+
+    def test_wirecheck_mirror_agrees_byte_for_byte(self):
+        """The independent wirecheck implementation and the codec must
+        produce and accept identical bytes — two implementations, one
+        contract (the scorer.proto treatment)."""
+        raw = codec.encode_frame(codec.KIND_DELTA, "0123abcd", 42,
+                                 9_999_999, b"payload!")
+        mirror = wirecheck.decode_replica_frame(raw)
+        assert mirror["kind"] == codec.KIND_DELTA
+        assert mirror["epoch"] == "0123abcd"
+        assert mirror["generation"] == 42
+        assert mirror["stamp_us"] == 9_999_999
+        assert mirror["payload"] == b"payload!"
+        assert wirecheck.encode_replica_frame(mirror) == raw
+        # and the reverse direction: wirecheck-encoded, codec-decoded
+        raw2 = wirecheck.encode_replica_frame(dict(
+            kind=codec.KIND_FULL, epoch="deadbeef", generation=3,
+            stamp_us=1, payload=b"xyz",
+        ))
+        f = codec.decode_frame(raw2)
+        assert (f.kind, f.epoch, f.generation, f.payload) == (
+            codec.KIND_FULL, "deadbeef", 3, b"xyz"
+        )
+
+    @pytest.mark.parametrize("mutate,err", [
+        (lambda b: b"\x00" + b[1:], "magic"),
+        (lambda b: b[:4] + b"\x09" + b[5:], "version"),
+        (lambda b: b[:5] + b"\x07" + b[6:], "kind"),
+        (lambda b: b[:10], "header"),
+        (lambda b: b[:-2], "truncated"),
+        (lambda b: b + b"\x00", "truncated"),
+    ])
+    def test_codec_layer_negatives(self, mutate, err):
+        """Every malformed shape is a raised FrameError at BOTH codec
+        implementations — never a silently mis-decoded frame."""
+        raw = codec.encode_frame(codec.KIND_DELTA, "abcdef01", 1, 0,
+                                 b"pp")
+        bad = mutate(raw)
+        with pytest.raises(codec.FrameError):
+            codec.decode_frame(bad)
+        with pytest.raises(ValueError):
+            wirecheck.decode_replica_frame(bad)
+
+    def test_oversized_payload_len_rejected(self):
+        raw = bytearray(codec.encode_frame(
+            codec.KIND_DELTA, "abcdef01", 1, 0, b""
+        ))
+        raw[30:34] = struct.pack(">I", codec.MAX_PAYLOAD + 1)
+        with pytest.raises(codec.FrameError):
+            codec.decode_frame(bytes(raw))
+        with pytest.raises(ValueError):
+            wirecheck.decode_replica_frame(bytes(raw))
+
+    def test_encode_rejects_bad_epoch_and_kind(self):
+        with pytest.raises(codec.FrameError):
+            codec.encode_frame(codec.KIND_DELTA, "short", 1, 0, b"")
+        with pytest.raises(codec.FrameError):
+            codec.encode_frame(9, "abcdef01", 1, 0, b"")
+        with pytest.raises(codec.FrameError):
+            codec.encode_frame(codec.KIND_DELTA, "abcdef01", -1, 0, b"")
+
+
+# ---- admission control ----
+
+class TestAdmission:
+    def test_disabled_by_default(self):
+        gate = AdmissionGate()
+        assert not gate.enabled
+        for _ in range(64):
+            with gate.admit("score"):
+                pass
+        assert gate.stats()["shed"] == 0
+
+    def test_sheds_over_depth_with_retry_hint(self):
+        gate = AdmissionGate(max_inflight=2)
+        a = gate.admit("score"); a.__enter__()
+        b = gate.admit("score"); b.__enter__()
+        with pytest.raises(ResourceExhausted) as ei:
+            gate.admit("score").__enter__()
+        exc = ei.value
+        assert exc.retry_after_ms >= 1.0
+        assert "retry_after_ms=" in str(exc)
+        assert "RESOURCE_EXHAUSTED" in str(exc)
+        assert gate.stats()["shed"] == 1
+        b.__exit__(None, None, None)
+        # a slot freed: admission resumes immediately
+        with gate.admit("score"):
+            pass
+        a.__exit__(None, None, None)
+        assert gate.depth() == 0
+
+    def test_retry_hint_tracks_service_ewma(self):
+        t = [0.0]
+        gate = AdmissionGate(max_inflight=1, clock=lambda: t[0])
+        adm = gate.admit("score")
+        adm.__enter__()
+        t[0] += 0.200  # a 200 ms request
+        adm.__exit__(None, None, None)
+        assert gate.retry_after_ms() == pytest.approx(200.0)
+        adm = gate.admit("score")
+        adm.__enter__()
+        t[0] += 0.100
+        adm.__exit__(None, None, None)
+        # EWMA (alpha=0.2): 0.2*100 + 0.8*200 = 180
+        assert gate.retry_after_ms() == pytest.approx(180.0)
+
+    def test_servicer_sheds_score_fast_and_never_sync(self):
+        req, _ = _tiny_sync()
+        sv = ScorerServicer(score_memo=False, max_inflight=1)
+        reply = sv.sync(req)
+        # saturate the gate from outside, exactly like a stuck RPC
+        held = sv.admission.admit("score")
+        held.__enter__()
+        try:
+            t0 = time.perf_counter()
+            with pytest.raises(ResourceExhausted):
+                sv.score(pb2.ScoreRequest(snapshot_id=reply.snapshot_id,
+                                          top_k=4, flat=True))
+            # bounded deadline: the shed never touches the device or
+            # the dispatch queue — it must return ~immediately
+            assert time.perf_counter() - t0 < 1.0
+            with pytest.raises(ResourceExhausted):
+                sv.assign(pb2.AssignRequest(
+                    snapshot_id=reply.snapshot_id
+                ))
+            # Sync is NEVER shed: the writer path stays live
+            warm = pb2.SyncRequest()
+            prev = np.frombuffer(
+                req.nodes.usage.data, "<i8"
+            ).reshape(tuple(req.nodes.usage.shape)).copy()
+            cur = prev.copy()
+            cur[0, 0] += 5
+            warm.nodes.usage.CopyFrom(numpy_to_tensor(cur, prev))
+            sv.sync(warm)
+        finally:
+            held.__exit__(None, None, None)
+        # the shed counter moved, and service resumed untouched
+        render = sv.telemetry.registry.render()
+        assert 'koord_scorer_shed_total{method="score"} 1' in render
+        assert 'koord_scorer_shed_total{method="assign"} 1' in render
+        out = sv.score(pb2.ScoreRequest(snapshot_id=sv.snapshot_id(),
+                                        top_k=4, flat=True))
+        assert out.flat.pod_index
+
+    def test_overload_storm_sheds_while_inflight_completes(self):
+        """The acceptance shape: with the gate saturated, excess Scores
+        get RESOURCE_EXHAUSTED within a bounded deadline while admitted
+        work completes untouched — and the survivors' replies are
+        byte-identical to an un-gated oracle's."""
+        req, _ = _tiny_sync()
+        sv = ScorerServicer(score_memo=False, max_inflight=2)
+        oracle = ScorerServicer(score_memo=False)
+        sid = sv.sync(req).snapshot_id
+        oracle_sid = oracle.sync(req).snapshot_id
+        want = _flat_score_bytes(oracle, oracle_sid)
+        results, errors = [], []
+        lock = threading.Lock()
+
+        def worker():
+            try:
+                out = _flat_score_bytes(sv, sid)
+                with lock:
+                    results.append(out)
+            except ResourceExhausted as exc:
+                with lock:
+                    errors.append(exc)
+
+        threads = [threading.Thread(target=worker) for _ in range(12)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+        assert len(results) + len(errors) == 12
+        assert results, "at least the admitted requests must serve"
+        for out in results:
+            assert out == want
+        for exc in errors:
+            assert exc.retry_after_ms >= 1.0
+        assert sv.admission.stats()["shed"] == len(errors)
+
+
+# ---- fencing: the replica apply path negatives (satellite) ----
+
+class TestReplicaFencing:
+    def _pair(self):
+        req, nodes_l = _tiny_sync()
+        leader = ScorerServicer(score_memo=False)
+        frames = _capture_frames(leader)
+        leader.sync(req)
+        follower = FollowerServicer(score_memo=False)
+        applier = ReplicaApplier(follower)
+        assert applier.offer(_full_frame(leader)) == APPLIED
+        _assert_state_parity(leader, follower)
+        prev = np.asarray(
+            [res.resource_vector(n.get("usage", {})) for n in nodes_l],
+            dtype=np.int64,
+        )
+        return leader, frames, follower, applier, prev
+
+    def test_in_order_stream_applies(self):
+        leader, frames, follower, applier, prev = self._pair()
+        for i in range(4):
+            warm, prev = _warm_usage_frame(prev, i)
+            leader.sync(warm)
+            assert applier.offer(frames[-1]) == APPLIED
+            _assert_state_parity(leader, follower)
+
+    def test_reordered_and_duplicate_frames_are_stale_not_applied(self):
+        leader, frames, follower, applier, prev = self._pair()
+        warm, prev = _warm_usage_frame(prev, 0)
+        leader.sync(warm)
+        seq = frames[-1]
+        assert applier.offer(seq) == APPLIED
+        sid = follower.snapshot_id()
+        # duplicate redelivery: dropped, chain position unmoved
+        assert applier.offer(seq) == STALE
+        assert follower.snapshot_id() == sid
+        _assert_state_parity(leader, follower)
+
+    def test_generation_gap_forces_full_resync(self):
+        leader, frames, follower, applier, prev = self._pair()
+        warm, prev = _warm_usage_frame(prev, 0)
+        leader.sync(warm)  # frame the follower will "lose"
+        warm, prev = _warm_usage_frame(prev, 1)
+        leader.sync(warm)
+        dropped, after = frames[-2], frames[-1]
+        before_sid = follower.snapshot_id()
+        assert applier.offer(after) == RESYNC  # gap detected
+        # not torn: the follower still serves its LAST GOOD snapshot
+        assert follower.snapshot_id() == before_sid
+        assert applier.offer(_full_frame(leader)) == APPLIED
+        _assert_state_parity(leader, follower)
+
+    def test_epoch_mismatch_forces_full_resync(self):
+        leader, frames, follower, applier, prev = self._pair()
+        warm, prev = _warm_usage_frame(prev, 0)
+        leader.sync(warm)
+        seq = frames[-1]
+        import dataclasses
+
+        foreign = dataclasses.replace(seq, epoch="ffffffff")
+        assert applier.offer(foreign) == RESYNC
+        assert applier.offer(seq) == APPLIED  # real frame still lands
+        _assert_state_parity(leader, follower)
+
+    def test_corrupt_payload_forces_resync_not_torn_state(self):
+        """A frame whose header chains correctly but whose payload fails
+        validation must leave the follower on its last good snapshot
+        (stage-then-commit atomicity) and demote to resync."""
+        leader, frames, follower, applier, prev = self._pair()
+        warm, prev = _warm_usage_frame(prev, 0)
+        leader.sync(warm)
+        seq = frames[-1]
+        import dataclasses
+
+        corrupt = dataclasses.replace(
+            seq, payload=b"\xff\xfe\xfd" + seq.payload[:7]
+        )
+        before_sid = follower.snapshot_id()
+        before = _flat_score_bytes(follower, before_sid)
+        assert applier.offer(corrupt) == RESYNC
+        assert follower.snapshot_id() == before_sid
+        assert _flat_score_bytes(follower, before_sid) == before
+        assert applier.offer(_full_frame(leader)) == APPLIED
+        _assert_state_parity(leader, follower)
+
+    def test_no_change_sync_replicates_as_empty_delta_frame(self):
+        """A client Sync that changed NOTHING serializes to zero bytes;
+        its frame must apply on the follower (generation keeps pace),
+        never classify as a discontinuity — a quiet cluster must not
+        full-resync every heartbeat."""
+        leader, frames, follower, applier, prev = self._pair()
+        leader.sync(pb2.SyncRequest())  # no-change frame, b"" payload
+        assert frames[-1].payload == b""
+        assert applier.offer(frames[-1]) == APPLIED
+        _assert_state_parity(leader, follower)
+
+    def test_fresh_follower_rejects_delta_before_first_full(self):
+        req, _ = _tiny_sync()
+        leader = ScorerServicer(score_memo=False)
+        frames = _capture_frames(leader)
+        leader.sync(req)
+        follower = FollowerServicer(score_memo=False)
+        applier = ReplicaApplier(follower)
+        # no full frame yet: the follower is on its own boot epoch,
+        # which no leader frame extends
+        assert applier.offer(frames[-1]) == RESYNC
+
+    def test_follower_refuses_client_sync(self):
+        req, _ = _tiny_sync()
+        follower = FollowerServicer(score_memo=False, leader="ldr.repl")
+        with pytest.raises(NotLeader) as ei:
+            follower.sync(req)
+        assert "one writer" in str(ei.value)
+        assert "ldr.repl" in str(ei.value)
+
+    def test_resync_reasons_counted(self):
+        leader, frames, follower, applier, prev = self._pair()
+        warm, prev = _warm_usage_frame(prev, 0)
+        leader.sync(warm)
+        import dataclasses
+
+        seq = frames[-1]
+        applier.offer(dataclasses.replace(seq, epoch="ffffffff"))
+        applier.offer(dataclasses.replace(seq, generation=seq.generation + 9))
+        render = follower.telemetry.registry.render()
+        assert 'koord_scorer_replica_resyncs_total{reason="epoch"} 1' in render
+        assert 'koord_scorer_replica_resyncs_total{reason="gap"} 1' in render
+        assert 'koord_scorer_replica_frames_total{result="applied"} 1' in render
+
+
+# ---- export round trip ----
+
+class TestExport:
+    def test_export_reproduces_mirrors_on_fresh_state(self):
+        req, _ = _tiny_sync()
+        leader = ScorerServicer(score_memo=False)
+        leader.sync(req)
+        follower = FollowerServicer(score_memo=False)
+        applier = ReplicaApplier(follower)
+        assert applier.offer(_full_frame(leader)) == APPLIED
+        _assert_state_parity(leader, follower)
+        # and the read replies match byte for byte
+        sid = leader.snapshot_id()
+        assert _flat_score_bytes(follower, sid) == _flat_score_bytes(
+            leader, sid
+        )
+        ra = leader.assign(pb2.AssignRequest(snapshot_id=sid))
+        rb = follower.assign(pb2.AssignRequest(snapshot_id=sid))
+        assert list(ra.assignment) == list(rb.assignment)
+        assert list(ra.status) == list(rb.status)
+
+    def test_export_before_first_sync_is_empty_reset(self):
+        leader = ScorerServicer(score_memo=False)
+        epoch, gen, payload = leader.export_replication_snapshot()
+        assert gen == 0 and payload == b""
+        follower = FollowerServicer(score_memo=False)
+        applier = ReplicaApplier(follower)
+        assert applier.offer(_full_frame(leader)) == APPLIED
+        assert follower.snapshot_id() == leader.snapshot_id()
+
+
+# ---- the lossy/reordering fuzz (tentpole acceptance) ----
+
+class _FuzzChannel:
+    """Injected lossy/reordering transport: every frame may be dropped,
+    duplicated, or delayed behind the next one."""
+
+    def __init__(self, rng):
+        self.rng = rng
+        self.delayed = []
+
+    def send(self, frame):
+        out = []
+        roll = self.rng.random()
+        if roll < 0.15:
+            pass  # dropped
+        elif roll < 0.30:
+            out += [frame, frame]  # duplicated
+        elif roll < 0.50:
+            self.delayed.append(frame)  # reordered behind the next
+        else:
+            out.append(frame)
+        if self.delayed and self.rng.random() < 0.6:
+            out.append(self.delayed.pop(0))
+        return out
+
+    def flush(self):
+        out, self.delayed = self.delayed, []
+        return out
+
+
+class TestLossyFuzzParity:
+    def test_byte_parity_after_every_commit(self):
+        """~30 warm/full/scalar Syncs through a lossy, reordering,
+        duplicating channel; after every leader commit the channel is
+        flushed and the follower must end byte-identical to the leader
+        — through the documented resync when the chain broke, and
+        through a mid-stream leader restart (epoch bump)."""
+        rng = np.random.default_rng(7)
+        req, nodes_l = _tiny_sync()
+        leader = ScorerServicer(score_memo=False)
+        frames = _capture_frames(leader)
+        follower = FollowerServicer(score_memo=False)
+        applier = ReplicaApplier(follower)
+        chan = _FuzzChannel(rng)
+        leader.sync(req)
+        prev = np.asarray(
+            [res.resource_vector(n.get("usage", {})) for n in nodes_l],
+            dtype=np.int64,
+        )
+        resyncs = 0
+        for step in range(30):
+            if step == 15:
+                # leader restart: a NEW servicer (fresh epoch) rebuilt
+                # from a full client sync — exactly the failover path
+                full_req = leader.state.export_sync_request()
+                leader = ScorerServicer(score_memo=False)
+                frames = _capture_frames(leader)
+                leader.sync(full_req)
+            elif step % 7 == 3:
+                # scalar-only churn (priority column)
+                scalar = pb2.SyncRequest()
+                P = leader.state.pod_requests.shape[0]
+                scalar.pods.priority.extend(
+                    int(v) for v in rng.integers(0, 9000, P)
+                )
+                leader.sync(scalar)
+            else:
+                warm, prev = _warm_usage_frame(prev, int(rng.integers(0, 64)))
+                leader.sync(warm)
+            # deliver whatever the lossy channel lets through
+            delivered = chan.send(frames[-1]) if frames else []
+            need_resync = False
+            for frame in delivered:
+                if applier.offer(frame) == RESYNC:
+                    need_resync = True
+            # after every commit: flush stragglers, then the follower
+            # either reached the leader's id or performs the documented
+            # one-shot full resync — and parity must hold either way
+            for frame in chan.flush():
+                if applier.offer(frame) == RESYNC:
+                    need_resync = True
+            if (need_resync
+                    or follower.snapshot_id() != leader.snapshot_id()):
+                resyncs += 1
+                assert applier.offer(_full_frame(leader)) == APPLIED
+            _assert_state_parity(leader, follower)
+            sid = leader.snapshot_id()
+            assert _flat_score_bytes(follower, sid) == _flat_score_bytes(
+                leader, sid
+            )
+        # the channel is lossy by construction: the resync path itself
+        # must have been exercised, not just the happy path
+        assert resyncs > 0
+        assert applier.applied > 0
+
+
+# ---- warm follower apply path holds zero retraces ----
+
+class TestFollowerRetrace:
+    def test_warm_follower_stream_is_retrace_free(self):
+        from koordinator_tpu.analysis import retrace_guard
+
+        req, nodes_l = _tiny_sync()
+        leader = ScorerServicer(score_memo=False)
+        frames = _capture_frames(leader)
+        leader.sync(req)
+        follower = FollowerServicer(score_memo=False)
+        applier = ReplicaApplier(follower)
+        assert applier.offer(_full_frame(leader)) == APPLIED
+        prev = np.asarray(
+            [res.resource_vector(n.get("usage", {})) for n in nodes_l],
+            dtype=np.int64,
+        )
+        sid = leader.snapshot_id()
+        # materialize device residency on BOTH sides (a delta can only
+        # land warm on an already-resident snapshot — the leader rule)
+        leader.score(pb2.ScoreRequest(snapshot_id=sid, top_k=4,
+                                      flat=True))
+        follower.score(pb2.ScoreRequest(snapshot_id=sid, top_k=4,
+                                        flat=True))
+
+        def warm_step(i):
+            nonlocal prev, sid
+            warm, prev = _warm_usage_frame(prev, i)
+            leader.sync(warm)
+            assert applier.offer(frames[-1]) == APPLIED
+            sid = follower.snapshot_id()
+            assert follower.state.last_sync_path == "warm"
+            follower.score(pb2.ScoreRequest(snapshot_id=sid, top_k=4,
+                                            flat=True))
+            follower.assign(pb2.AssignRequest(snapshot_id=sid))
+
+        # one warm-up rep compiles; the guarded stream must then hold
+        # ZERO jit cache misses — the replica apply path is the same
+        # donated delta scatter the leader's warm Sync runs
+        warm_step(0)
+        with retrace_guard(budget=0) as counter:
+            for i in range(1, 4):
+                warm_step(i)
+        assert counter.traces == 0 and counter.compiles == 0
+
+
+# ---- UDS transport: publisher/subscriber ----
+
+def _wait_until(predicate, timeout_s=20.0, interval_s=0.01):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+class TestUdsTransport:
+    def _tier(self, tmp):
+        req, nodes_l = _tiny_sync()
+        leader = ScorerServicer(score_memo=False)
+        pub = ReplicationPublisher(
+            leader, os.path.join(tmp, "leader.repl")
+        ).attach().start()
+        follower = FollowerServicer(score_memo=False)
+        applier = ReplicaApplier(follower)
+        sub = ReplicationSubscriber(pub.path, applier).start()
+        return req, nodes_l, leader, pub, follower, applier, sub
+
+    def test_subscribe_streams_full_then_deltas(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            req, nodes_l, leader, pub, follower, applier, sub = (
+                self._tier(tmp)
+            )
+            try:
+                leader.sync(req)
+                assert _wait_until(
+                    lambda: follower.snapshot_id() == leader.snapshot_id()
+                )
+                _assert_state_parity(leader, follower)
+                prev = np.asarray(
+                    [res.resource_vector(n.get("usage", {}))
+                     for n in nodes_l],
+                    dtype=np.int64,
+                )
+                for i in range(3):
+                    warm, prev = _warm_usage_frame(prev, i)
+                    leader.sync(warm)
+                assert _wait_until(
+                    lambda: follower.snapshot_id() == leader.snapshot_id()
+                )
+                _assert_state_parity(leader, follower)
+                assert applier.last_lag_ms is not None
+                assert pub.follower_count() == 1
+            finally:
+                sub.stop()
+                pub.stop()
+
+    def test_dropped_connection_reconnects_and_resyncs(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            req, nodes_l, leader, pub, follower, applier, sub = (
+                self._tier(tmp)
+            )
+            try:
+                leader.sync(req)
+                assert _wait_until(
+                    lambda: follower.snapshot_id() == leader.snapshot_id()
+                )
+                connects_before = sub.connects
+                # the leader drops the subscription (the slow-follower
+                # path); frames committed while down are MISSED
+                with pub._lock:
+                    subs = list(pub._subs)
+                for s in subs:
+                    s.close()
+                prev = np.asarray(
+                    [res.resource_vector(n.get("usage", {}))
+                     for n in nodes_l],
+                    dtype=np.int64,
+                )
+                warm, prev = _warm_usage_frame(prev, 5)
+                leader.sync(warm)
+                # reconnect lands a fresh full frame: parity restored
+                assert _wait_until(
+                    lambda: follower.snapshot_id() == leader.snapshot_id()
+                )
+                _assert_state_parity(leader, follower)
+                assert sub.connects > connects_before
+            finally:
+                sub.stop()
+                pub.stop()
+
+    def test_truncated_stream_forces_resync_not_crash(self):
+        """UDS-layer negative: a 'leader' that emits a truncated frame
+        mid-stream.  The follower counts it, reconnects, and converges
+        once a real leader serves the socket."""
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "leader.repl")
+            req, _ = _tiny_sync()
+            leader = ScorerServicer(score_memo=False)
+            leader.sync(req)
+            # fake leader: one valid header promising more bytes than
+            # it sends, then a hard close mid-payload
+            lsock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            lsock.bind(path)
+            lsock.listen(1)
+            served = threading.Event()
+
+            def fake_leader():
+                conn, _ = lsock.accept()
+                frame = codec.encode_frame(
+                    codec.KIND_FULL, "abcdef01", 1, 0, b"x" * 64
+                )
+                conn.sendall(frame[: codec.HEADER_LEN + 10])
+                conn.close()
+                served.set()
+
+            threading.Thread(target=fake_leader, daemon=True).start()
+            follower = FollowerServicer(score_memo=False)
+            applier = ReplicaApplier(follower)
+            sub = ReplicationSubscriber(path, applier).start()
+            try:
+                assert served.wait(timeout=20)
+                # swap in the real publisher on the same path: the
+                # follower's reconnect loop finds it and full-resyncs
+                _wait_until(lambda: sub.connects >= 1)
+                lsock.close()
+                os.unlink(path)
+                pub = ReplicationPublisher(leader, path).attach().start()
+                try:
+                    assert _wait_until(
+                        lambda: follower.snapshot_id()
+                        == leader.snapshot_id()
+                    )
+                    _assert_state_parity(leader, follower)
+                finally:
+                    pub.stop()
+                render = follower.telemetry.registry.render()
+                assert 'koord_scorer_replica_frames_total{result="error"}' \
+                    in render
+            finally:
+                sub.stop()
+
+    def test_overflowed_subscriber_is_dropped(self):
+        """Unit seam: a subscriber whose bounded queue overflows is
+        killed (the follower's reconnect is the resync); the publish
+        path never blocks."""
+        a, b = socket.socketpair()
+        dropped = []
+        from koordinator_tpu.replication.leader import _Subscriber
+
+        sub = _Subscriber(a, max_frames=2, on_drop=dropped.append)
+        try:
+            # no drain thread running: the queue only fills
+            sub.enqueue(b"1")
+            sub.enqueue(b"2")
+            assert not dropped
+            sub.enqueue(b"3")
+            assert dropped == [sub]
+            # dead: further enqueues are no-ops, not errors
+            sub.enqueue(b"4")
+        finally:
+            for s in (a, b):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
+# ---- 3-follower interleaved storm (acceptance criterion) ----
+
+class TestThreeFollowerStorm:
+    def test_tier_matches_single_daemon_oracle(self):
+        """3 followers under an interleaved Sync/Score/Assign storm end
+        byte-identical to the single-daemon oracle — across an
+        injected dropped frame (follower 1) and a leader restart
+        (epoch bump), with reads hammering the followers throughout."""
+        req, nodes_l = _tiny_sync(pods=32, nodes=8)
+        leader = ScorerServicer(score_memo=False)
+        frames = _capture_frames(leader)
+        oracle = ScorerServicer(score_memo=False)
+        followers = [FollowerServicer(score_memo=False) for _ in range(3)]
+        appliers = [ReplicaApplier(f) for f in followers]
+        leader.sync(req)
+        oracle.sync(req)
+        for applier in appliers:
+            assert applier.offer(_full_frame(leader)) == APPLIED
+
+        stop = threading.Event()
+        read_errors = []
+
+        def read_storm(i):
+            f = followers[i]
+            while not stop.is_set():
+                sid = f.snapshot_id()
+                try:
+                    f.score(pb2.ScoreRequest(snapshot_id=sid, top_k=4,
+                                             flat=True))
+                    f.assign(pb2.AssignRequest(snapshot_id=sid))
+                except Exception as exc:  # noqa: BLE001 (collected, asserted below)
+                    # a Sync landing between snapshot_id() and the call
+                    # is the ordinary displaced-mid-queue condition
+                    name = type(exc).__name__
+                    if "SnapshotNotResident" not in repr(exc) and \
+                            name != "SnapshotNotResident":
+                        read_errors.append(repr(exc))
+                        return
+
+        threads = [
+            threading.Thread(target=read_storm, args=(i,), daemon=True)
+            for i in range(3)
+        ]
+        for th in threads:
+            th.start()
+        try:
+            prev = np.asarray(
+                [res.resource_vector(n.get("usage", {}))
+                 for n in nodes_l],
+                dtype=np.int64,
+            )
+            for step in range(12):
+                if step == 6:
+                    # leader restart mid-storm: fresh epoch, state
+                    # rebuilt from a full sync (the failover walk)
+                    full_req = leader.state.export_sync_request()
+                    leader = ScorerServicer(score_memo=False)
+                    frames = _capture_frames(leader)
+                    leader.sync(full_req)
+                    oracle.sync(full_req)
+                else:
+                    warm, prev = _warm_usage_frame(prev, step * 3)
+                    leader.sync(warm)
+                    oracle.sync(warm)
+                frame = frames[-1]
+                for i, applier in enumerate(appliers):
+                    if i == 1 and step == 3:
+                        continue  # injected dropped frame
+                    if applier.offer(frame) == RESYNC:
+                        assert applier.offer(
+                            _full_frame(leader)
+                        ) == APPLIED
+            # drain: every follower must converge on the leader's id
+            for applier in appliers:
+                if (applier.servicer.snapshot_id()
+                        != leader.snapshot_id()):
+                    assert applier.offer(_full_frame(leader)) == APPLIED
+        finally:
+            stop.set()
+            for th in threads:
+                th.join(timeout=30)
+        assert not read_errors, read_errors
+        # END-STATE PARITY: every follower byte-identical to the
+        # single-daemon oracle (and to the leader), replies included
+        sid = leader.snapshot_id()
+        oracle_sid = oracle.snapshot_id()
+        want_score = _flat_score_bytes(oracle, oracle_sid)
+        want_assign = oracle.assign(
+            pb2.AssignRequest(snapshot_id=oracle_sid)
+        )
+        for follower in followers:
+            _assert_state_parity(leader, follower)
+            _assert_mirror_parity(oracle, follower)
+            assert _flat_score_bytes(follower, sid) == want_score
+            got = follower.assign(pb2.AssignRequest(snapshot_id=sid))
+            assert list(got.assignment) == list(want_assign.assignment)
+            assert list(got.status) == list(want_assign.status)
+        # follower 1 DID take the injected resync path
+        assert appliers[1].resyncs >= 1
+
+
+# ---- replica-aware Python client (gRPC) ----
+
+class TestReplicaAwareClient:
+    def test_score_routes_to_follower_with_leader_fallback(self):
+        from koordinator_tpu.bridge.client import ScorerClient
+        from koordinator_tpu.bridge.server import make_server
+
+        req, _ = _tiny_sync(pods=16, nodes=4)
+        leader_sv = ScorerServicer(score_memo=False)
+        follower_sv = FollowerServicer(score_memo=False)
+        applier = ReplicaApplier(follower_sv)
+        with tempfile.TemporaryDirectory() as tmp:
+            lsock = os.path.join(tmp, "l.sock")
+            fsock = os.path.join(tmp, "f.sock")
+            lsrv = make_server(servicer=leader_sv)
+            lsrv.add_insecure_port(f"unix://{lsock}")
+            lsrv.start()
+            fsrv = make_server(servicer=follower_sv)
+            fsrv.add_insecure_port(f"unix://{fsock}")
+            fsrv.start()
+            client = ScorerClient(
+                f"unix://{lsock}", followers=[f"unix://{fsock}"]
+            )
+            try:
+                client.sync(
+                    node_allocatable=np.frombuffer(
+                        req.nodes.allocatable.data, "<i8"
+                    ).reshape(tuple(req.nodes.allocatable.shape)),
+                    node_usage=np.frombuffer(
+                        req.nodes.usage.data, "<i8"
+                    ).reshape(tuple(req.nodes.usage.shape)),
+                    pod_requests=np.frombuffer(
+                        req.pods.requests.data, "<i8"
+                    ).reshape(tuple(req.pods.requests.shape)),
+                )
+                # follower NOT caught up: Score must fall back to the
+                # leader instead of failing — and must NOT invalidate
+                # the client's delta baseline (generation survives)
+                out = client.score_flat(top_k=4)
+                assert out[0].size
+                assert client._generation is not None
+                assert follower_sv.dispatch.stats()["requests"] == 0
+                # catch the follower up: the same call now serves from
+                # the replica
+                assert applier.offer(_full_frame(leader_sv)) == APPLIED
+                out2 = client.score_flat(top_k=4)
+                assert follower_sv.dispatch.stats()["requests"] == 1
+                for a, b in zip(out, out2):
+                    assert np.array_equal(a, b)
+            finally:
+                client.close()
+                lsrv.stop(0)
+                fsrv.stop(0)
+
+
+# ---- scheduler daemon integration ----
+
+class TestSchedulerServerRoles:
+    def test_leader_and_follower_daemons_end_to_end(self):
+        """A leader SchedulerServer publishes on <uds>.repl; a follower
+        SchedulerServer pointed at it serves the leader's snapshot and
+        refuses Sync."""
+        from koordinator_tpu.scheduler.server import SchedulerServer
+
+        with tempfile.TemporaryDirectory() as tmp:
+            leader_srv = SchedulerServer(
+                lease_path=os.path.join(tmp, "l.lease"),
+                uds_path=os.path.join(tmp, "l.sock"),
+                http_port=0,
+                enable_grpc=False,
+                state_dir=None,
+            ).start()
+            follower_srv = None
+            try:
+                follower_srv = SchedulerServer(
+                    lease_path=os.path.join(tmp, "f.lease"),
+                    uds_path=os.path.join(tmp, "f.sock"),
+                    http_port=0,
+                    enable_grpc=False,
+                    state_dir=None,
+                    replicate_from=leader_srv.repl_path,
+                    max_inflight=64,
+                ).start()
+                req, _ = _tiny_sync(pods=16, nodes=4)
+                leader_srv.servicer.sync(req)
+                assert _wait_until(
+                    lambda: follower_srv.servicer.snapshot_id()
+                    == leader_srv.servicer.snapshot_id()
+                )
+                sid = leader_srv.servicer.snapshot_id()
+                assert _flat_score_bytes(
+                    follower_srv.servicer, sid
+                ) == _flat_score_bytes(leader_srv.servicer, sid)
+                with pytest.raises(NotLeader):
+                    follower_srv.servicer.sync(req)
+                health = follower_srv.replica_health()
+                assert health["role"] == "follower"
+                assert health["applied_frames"] >= 1
+                assert leader_srv.replica_health()["role"] == "leader"
+                assert leader_srv.replica_health()["followers"] == 1
+            finally:
+                if follower_srv is not None:
+                    follower_srv.stop()
+                leader_srv.stop()
