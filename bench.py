@@ -239,6 +239,14 @@ def _validate_artifact(line: Optional[str]) -> list:
         isinstance(ss, bool) or not isinstance(ss, int) or ss < 1
     ):
         problems.append("'score_serial_sample' must be null or an int >= 1")
+    # incremental-score-engine probe fields (ISSUE 9): the warm Score
+    # cost (dirty-column rescore of the resident [P, N] tensor) vs the
+    # full-rescore oracle — the quantity this engine exists to cut, and
+    # the one warm-path timing the Assign side had but Score did not
+    _finite_nonneg("warm_score_ms")
+    _finite_nonneg("full_warm_score_ms")
+    _finite_nonneg("incr_score_speedup")
+    _finite_nonneg("incr_cols_rescored")
     # mesh-sharded snapshot probe fields (ISSUE 7): the per-shard Sync
     # cost and the mesh-vs-single-chip cycle numbers the acceptance
     # tracks — malformed ones must not be archived
@@ -342,7 +350,7 @@ def child(platform: str) -> None:
     spans = {
         "init": None, "rtt_floor": None, "snapshot": None,
         "lowering_probe": None, "compile": None, "steady": None,
-        "wave_compile": None, "wave": None,
+        "wave_compile": None, "wave": None, "incr_score": None,
         "cpu_native": None, "cpu_native_mt": None,
     }
 
@@ -470,6 +478,32 @@ def child(platform: str) -> None:
         # never a logged hiccup next to a published artifact
         assert wave_parity, "wave placements diverged from the per-pod cycle"
 
+    # incremental score engine (ISSUE 9) at headline scale: the warm
+    # Score cost through the dirty-column rescore vs the full-rescore
+    # oracle — same probe implementation as --config bridge (the parity
+    # assert rides inside it).  Best-effort: a failure publishes nulls.
+    warm_score_ms = full_warm_score_ms = None
+    incr_score_speedup = incr_cols_rescored = None
+    try:
+        from koordinator_tpu.harness.golden import build_sync_request
+
+        sync_req, _ = build_sync_request(nodes, pods, gangs, quotas)
+        (warm_score_ms, full_warm_score_ms,
+         incr_score_speedup, incr_cols_rescored) = (
+            _incr_score_probe(sync_req.SerializeToString())
+        )
+        del sync_req
+        spans["incr_score"] = round(warm_score_ms, 2)
+        phase(
+            "incr_score",
+            warm_score_ms=round(warm_score_ms, 2),
+            full_warm_score_ms=round(full_warm_score_ms, 2),
+            speedup=round(incr_score_speedup, 3),
+            cols=round(incr_cols_rescored, 1),
+        )
+    except Exception as exc:  # noqa: BLE001
+        phase("incr_score_failed", error=str(exc)[:200])
+
     # measured native CPU baseline (BASELINE.md): the sequential per-pod
     # C++ cycle (native/score_baseline.cpp) on the same snapshot — the
     # shape of the reference's Go Score hot loop, Go toolchain absent.
@@ -553,6 +587,21 @@ def child(platform: str) -> None:
                 ),
                 "wave_speedup": (
                     round(ms / wave_ms, 3) if wave_ms else None
+                ),
+                # incremental score engine (ISSUE 9): warm Score via
+                # dirty-column rescore vs full-rescore oracle, <=64
+                # dirty nodes; null = the probe failed / did not run
+                "warm_score_ms": (
+                    round(warm_score_ms, 2)
+                    if warm_score_ms is not None else None
+                ),
+                "incr_score_speedup": (
+                    round(incr_score_speedup, 3)
+                    if incr_score_speedup is not None else None
+                ),
+                "incr_cols_rescored": (
+                    round(incr_cols_rescored, 1)
+                    if incr_cols_rescored is not None else None
                 ),
                 # per-stage breakdown (ISSUE 4): null = the stage
                 # measured nothing (failed best-effort leg, or a stage
@@ -821,6 +870,96 @@ def _shed_storm(sock_path, snapshot_id, clients=32, top_k=32):
     for t in threads:
         t.join(timeout=600)
     return digests, shed, errors, (max(shed_ms) if shed_ms else 0.0)
+
+
+def _incr_score_probe(sync_payload, reps=3, dirty_nodes=64, top_k=32):
+    """ISSUE 9 probe: warm Score through the incremental engine vs the
+    full-rescore oracle — the ONE implementation behind both the bridge
+    and headline artifacts' ``warm_score_ms`` / ``incr_score_speedup``
+    / ``incr_cols_rescored`` fields.
+
+    Two in-process servicers replay the same stream (full Sync, one
+    untimed warm-up delta+Score per engine to compile the warm paths,
+    then ``reps`` x a <=``dirty_nodes``-row delta Sync followed by a
+    flat top-k Score), with the reply payload bytes asserted identical
+    per rep — the speedup is only publishable against a digest-equal
+    oracle.  The saving is arithmetic (O(P x d) vs O(P x N) rescoring,
+    both sides paying the same masked top_k), so unlike the mesh and
+    pipeline probes it is host-visible on CPU.
+
+    Returns (warm_score_ms, full_warm_score_ms, speedup, cols_mean).
+    """
+    import numpy as np
+
+    from koordinator_tpu.bridge.codegen import pb2
+    from koordinator_tpu.bridge.server import ScorerServicer
+    from koordinator_tpu.bridge.state import numpy_to_tensor
+
+    incr_sv = ScorerServicer(score_memo=False)
+    full_sv = ScorerServicer(score_memo=False, score_incr=False)
+    for sv in (incr_sv, full_sv):
+        sv.sync(pb2.SyncRequest.FromString(sync_payload))
+
+    def score(sv):
+        t0 = time.perf_counter()
+        reply = sv.score(pb2.ScoreRequest(
+            snapshot_id=sv.snapshot_id(), top_k=top_k, flat=True
+        ))
+        return reply.flat.SerializeToString(), _ms(t0)
+
+    # spread the dirty rows across the table (a delta touching one
+    # contiguous corner would understate gather/scatter cost), and cap
+    # them at an eighth of it so a scaled-down run stays under the
+    # engine's default 0.25 dirty-ratio gate (at the real 10k x 2k
+    # scale the cap is inert: 64 of 2000 nodes)
+    base = np.asarray(incr_sv.state.node_requested, np.int64).copy()
+    n_real = base.shape[0]
+    dirty_nodes = min(int(dirty_nodes), max(1, n_real // 8))
+    rows = np.unique(
+        (np.arange(dirty_nodes) * max(1, n_real // dirty_nodes)) % n_real
+    )
+
+    def delta(rep):
+        prev = base.copy()
+        base[rows, 0] += 1 + rep
+        warm = pb2.SyncRequest()
+        warm.nodes.requested.CopyFrom(numpy_to_tensor(base, prev))
+        raw = warm.SerializeToString()
+        for sv in (incr_sv, full_sv):
+            sv.sync(pb2.SyncRequest.FromString(raw))
+            assert sv.state.last_sync_path == "warm", (
+                "probe delta must land on the resident tensors"
+            )
+
+    # warm-up: the cold Score populates the residency and compiles the
+    # full path; one delta+Score compiles the dirty-bucket rescore
+    score(incr_sv)
+    score(full_sv)
+    delta(0)
+    score(incr_sv)
+    score(full_sv)
+    incr_times, full_times = [], []
+    for rep in range(1, reps + 1):
+        delta(rep)
+        d_incr, t_incr = score(incr_sv)
+        d_full, t_full = score(full_sv)
+        assert d_incr == d_full, (
+            "incremental Score diverged from the full-rescore oracle"
+        )
+        incr_times.append(t_incr)
+        full_times.append(t_full)
+    reg = incr_sv.telemetry.registry
+    launched = reg.get(
+        "koord_scorer_score_incr_total", {"result": "incr"}
+    ) or 0
+    assert launched >= reps, (
+        f"probe Scores fell back instead of rescoring incrementally "
+        f"({launched} incr launches)"
+    )
+    count, total = reg.get_histogram("koord_scorer_incr_cols", {})
+    cols_mean = (total / count) if count else 0.0
+    warm_ms, full_ms = min(incr_times), min(full_times)
+    return warm_ms, full_ms, full_ms / warm_ms, cols_mean
 
 
 def _extrapolate_serial(wall_s: float, measured: int, total: int) -> float:
@@ -1260,16 +1399,21 @@ def child_config(platform: str, config: str) -> None:
         payload = req.SerializeToString()
         with tempfile.TemporaryDirectory() as tmp:
             sock_path = os.path.join(tmp, "scorer.sock")
-            # Score memo OFF for every storm engine below: a storm
-            # against an unchanged snapshot would otherwise serve from
-            # the (snapshot, config, k-bucket) prefix memo after its
-            # first batch, and the probe is here to measure the
-            # DISPATCH engines, not the memo short-circuit (the memo
-            # has its own hit/miss counters and tests)
+            # Score memo AND incremental engine OFF for every storm
+            # engine below: a storm against an unchanged snapshot would
+            # otherwise serve from the (snapshot, config, k-bucket)
+            # prefix memo after its first batch — and with the
+            # incremental engine on, every post-first launch would
+            # reuse the resident score tensors with an empty dirty set
+            # (no scoring math at all).  The probe is here to measure
+            # the DISPATCH engines, not the short-circuits (the memo
+            # and the incremental engine have their own counters,
+            # tests, and the incr_score probe above).
             from koordinator_tpu.bridge.server import ScorerServicer
 
             server = RawUdsServer(
-                sock_path, servicer=ScorerServicer(score_memo=False)
+                sock_path,
+                servicer=ScorerServicer(score_memo=False, score_incr=False),
             )
             server.start()
             conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -1353,6 +1497,32 @@ def child_config(platform: str, config: str) -> None:
                     "delta frame should be ~100x below the full sync"
                 )
 
+                # incremental score engine probe (ISSUE 9): the WARM
+                # Score cost — until now only the Assign side had a
+                # warm-path timing (warm_assign_ms), while the O(P x N)
+                # rescore a warm Score pays went unmeasured.  Two
+                # in-process servicers (engine on vs score_incr=False
+                # oracle) replay the same <=64-dirty-node delta/Score
+                # stream, digest-identity asserted per rep.  Best
+                # effort: a probe failure publishes nulls, never kills
+                # the bridge artifact.
+                warm_score_ms = full_warm_score_ms = None
+                incr_score_speedup = incr_cols_rescored = None
+                try:
+                    (warm_score_ms, full_warm_score_ms,
+                     incr_score_speedup, incr_cols_rescored) = (
+                        _incr_score_probe(payload)
+                    )
+                    phase(
+                        "incr_score",
+                        warm_score_ms=round(warm_score_ms, 2),
+                        full_warm_score_ms=round(full_warm_score_ms, 2),
+                        speedup=round(incr_score_speedup, 3),
+                        cols=round(incr_cols_rescored, 1),
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    phase("incr_score_failed", error=str(exc)[:200])
+
                 # COLD cycles (the pre-PR price of EVERY Assign): drop
                 # the resident state so the next full Sync re-decodes
                 # everything and Assign pays the host re-encode + full
@@ -1426,6 +1596,7 @@ def child_config(platform: str, config: str) -> None:
                         coalesce_window_ms=0.0,
                         pipeline_depth=1,
                         score_memo=False,
+                        score_incr=False,
                     )
                     coal_server, coal_sock, coal_sid = storm_server(
                         "coalesce_d1",
@@ -1433,6 +1604,7 @@ def child_config(platform: str, config: str) -> None:
                         coalesce_window_ms=0.0,
                         pipeline_depth=1,
                         score_memo=False,
+                        score_incr=False,
                     )
                     # The serialized baseline processes strictly one
                     # request at a time (max_batch=1, depth=1), so its
@@ -1555,6 +1727,28 @@ def child_config(platform: str, config: str) -> None:
                     # Assign ran straight off the resident tensors
                     "warm_assign_ms": round(warm_ms, 2),
                     "warm_speedup": round(cold_ms / warm_ms, 3),
+                    # incremental score engine (ISSUE 9): the warm
+                    # SCORE cost — dirty-column rescore of the resident
+                    # [P, N] tensor vs the full-rescore oracle (digest-
+                    # identical by assertion), <=64 dirty nodes per
+                    # delta; null = the probe failed and measured
+                    # nothing
+                    "warm_score_ms": (
+                        round(warm_score_ms, 2)
+                        if warm_score_ms is not None else None
+                    ),
+                    "full_warm_score_ms": (
+                        round(full_warm_score_ms, 2)
+                        if full_warm_score_ms is not None else None
+                    ),
+                    "incr_score_speedup": (
+                        round(incr_score_speedup, 3)
+                        if incr_score_speedup is not None else None
+                    ),
+                    "incr_cols_rescored": (
+                        round(incr_cols_rescored, 1)
+                        if incr_cols_rescored is not None else None
+                    ),
                     "sync_ms": round(sync_ms, 1),
                     "sync_bytes": len(payload),
                     "delta_sync_ms": round(delta_sync_ms, 2),
@@ -1602,6 +1796,14 @@ def child_config(platform: str, config: str) -> None:
                         "warm_assign": round(warm_ms, 2),
                         "cold_assign": round(cold_ms, 2),
                         "score_top32": round(score_ms, 2),
+                        "warm_score_incr": (
+                            round(warm_score_ms, 2)
+                            if warm_score_ms is not None else None
+                        ),
+                        "warm_score_full": (
+                            round(full_warm_score_ms, 2)
+                            if full_warm_score_ms is not None else None
+                        ),
                         "score_storm_serial": round(wall_serial * 1000.0, 2),
                         "score_storm_depth1": round(wall_d1 * 1000.0, 2),
                         "score_storm_coalesced": round(wall_coal * 1000.0, 2),
@@ -1830,8 +2032,14 @@ def child_config(platform: str, config: str) -> None:
             koordinator_tpu.configure_compilation_cache(cache_dir)
             leader_sock = os.path.join(tmp, "leader.sock")
             repl_sock = os.path.join(tmp, "leader.repl")
+            # memo AND incremental engine off (the --config bridge storm
+            # rule): the replica storms fire Scores at one unchanged
+            # snapshot, and the engine's empty-dirty-set passthrough
+            # would skip the scoring math the tier's read scaling is
+            # supposed to amortize — replica_read_speedup must keep
+            # PR 8's meaning
             leader_sv = ScorerServicer(
-                score_memo=False,
+                score_memo=False, score_incr=False,
                 **({} if r_cap_ms is None
                    else {"coalesce_cap_ms": r_cap_ms}),
             )
@@ -2055,8 +2263,12 @@ def child_config(platform: str, config: str) -> None:
             max_inflight = int(
                 os.environ.get("KOORD_BENCH_SHED_INFLIGHT", "2")
             )
+            # incremental engine off too: the passthrough would collapse
+            # service time and a burst could drain below --max-inflight
+            # before it sheds, failing the shed>0 acceptance spuriously
             gated_sv = ScorerServicer(
-                score_memo=False, max_inflight=max_inflight
+                score_memo=False, score_incr=False,
+                max_inflight=max_inflight,
             )
             gated_srv = RawUdsServer(
                 os.path.join(tmp, "gated.sock"), servicer=gated_sv
@@ -2307,7 +2519,10 @@ def replica_follower(platform: str, sock: str, replicate_from: str,
     kw = {}
     if os.environ.get("KOORD_COALESCE_CAP_MS"):
         kw["coalesce_cap_ms"] = float(os.environ["KOORD_COALESCE_CAP_MS"])
-    sv = FollowerServicer(score_memo=False, leader=replicate_from, **kw)
+    # the follower serves the replica storm's reads: same storm rule —
+    # memo and incremental engine off so every Score pays real rescoring
+    sv = FollowerServicer(score_memo=False, score_incr=False,
+                          leader=replicate_from, **kw)
     applier = ReplicaApplier(sv)
 
     def on_frame(result, frame):

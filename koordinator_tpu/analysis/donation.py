@@ -14,12 +14,55 @@ on the call line itself, which counts)."""
 from __future__ import annotations
 
 import ast
+import dataclasses
 from typing import Iterator, List
 
 from koordinator_tpu.analysis import jitscope
 from koordinator_tpu.analysis.core import SourceFile, Violation
 
 RULE = "donation-safety"
+
+
+@dataclasses.dataclass(frozen=True)
+class _KnownDonor:
+    """A donating helper whose jit wrapper lives in ANOTHER module —
+    invisible to jitscope's module-local scan, so its donation contract
+    is declared here by (positional param order, donated param names).
+    ISSUE 9 extends the rule over the resident-score-tensor scatter
+    call sites this way: bridge/server.py donates the resident scores
+    buffer to solver/incremental.py's ``rescore_dirty`` exactly like
+    bridge/state.py donates snapshot buffers to ``apply_flat_delta``."""
+
+    positional: tuple
+    donated: frozenset
+
+    def positional_params(self):
+        return list(self.positional)
+
+    def donated_params(self):
+        return set(self.donated)
+
+
+# exported donating helpers by callable name; a call site in ANY scanned
+# module is checked against the donated-argument contract.  Names are
+# specific enough that a same-named unrelated local function is
+# implausible — and a module-LOCAL jit def of the same name wins (the
+# dict update order below).
+_KNOWN_DONORS = {
+    # solver/resident.py: donates the pre-delta resident buffer
+    "apply_flat_delta": _KnownDonor(
+        positional=("arr", "idx", "val", "mesh"),
+        donated=frozenset({"arr"}),
+    ),
+    # solver/incremental.py: donates the pre-rescore resident scores
+    # tensor (feasible is deliberately NOT donated — in-flight
+    # readbacks hold it; see the module docstring)
+    "rescore_dirty": _KnownDonor(
+        positional=("snapshot", "scores", "feasible", "node_rows",
+                    "pod_rows", "cfg", "mesh"),
+        donated=frozenset({"scores"}),
+    ),
+}
 
 
 def _scopes(tree: ast.AST) -> Iterator[ast.AST]:
@@ -30,9 +73,14 @@ def _scopes(tree: ast.AST) -> Iterator[ast.AST]:
 
 
 def check(source: SourceFile) -> List[Violation]:
-    donors = jitscope.donating_callables(source.tree)
-    if not donors:
-        return []
+    # the known cross-module donors apply everywhere EXCEPT where the
+    # module defines the name itself — a local def's declared donate
+    # args (possibly none) are the truth for its own module
+    donors = dict(_KNOWN_DONORS)
+    for node in ast.walk(source.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            donors.pop(node.name, None)
+    donors.update(jitscope.donating_callables(source.tree))
     out: List[Violation] = []
     for scope in _scopes(source.tree):
         # gather loads / stores of every name in this scope, by line.
@@ -60,7 +108,9 @@ def check(source: SourceFile) -> List[Violation]:
             if not isinstance(call.func, ast.Name):
                 continue
             spec = donors.get(call.func.id)
-            if spec is None or spec.func is None:
+            if spec is None:
+                continue
+            if isinstance(spec, jitscope.JitSpec) and spec.func is None:
                 continue
             pos = spec.positional_params()
             donated_idx = sorted(

@@ -201,6 +201,18 @@ _MESH_STATIC_PARAMS = (
     "n_shards", "num_shards", "shard_width",
 )
 
+# incremental-rescore knobs (ISSUE 9): a dirty COUNT at a jit boundary
+# is the same hazard shape — delta sizes vary per cycle, so a traced
+# n_dirty/dirty_width specializes the rescore per distinct count, one
+# silent retrace per delta size.  The count must never cross the
+# boundary at all: dirty indices ride bucket-PADDED index vectors whose
+# pad slots carry an out-of-range target dropped by mode="drop"
+# (solver/incremental.py), exactly the delta scatter's discipline.
+_DIRTY_STATIC_PARAMS = (
+    "n_dirty", "num_dirty", "dirty_count", "dirty_width",
+    "n_dirty_nodes", "n_dirty_pods",
+)
+
 
 def _traced_wave_knobs(source: SourceFile, spec: jitscope.JitSpec) -> List[Violation]:
     if spec.func is None:
@@ -238,6 +250,23 @@ def _traced_wave_knobs(source: SourceFile, spec: jitscope.JitSpec) -> List[Viola
                         "so every distinct value retraces the sharded "
                         "cycle silently; declare it in static_argnames "
                         "(it is configuration, like cfg)"
+                    ),
+                )
+            )
+        elif pname in _DIRTY_STATIC_PARAMS:
+            out.append(
+                Violation(
+                    rule=RULE,
+                    path=source.path,
+                    line=spec.line,
+                    message=(
+                        f"jit boundary {spec.name}() takes '{pname}' as a "
+                        "TRACED argument: delta sizes vary per cycle, so "
+                        "a traced dirty count retraces the rescore per "
+                        "distinct value; don't pass the count at all — "
+                        "pad the dirty-index vector to a power-of-two "
+                        "bucket with out-of-range slots mode=\"drop\" "
+                        "discards (solver/incremental.py)"
                     ),
                 )
             )

@@ -36,12 +36,24 @@ on (snapshot id, CycleConfig), invalidated atomically with every
 generation bump — one cycle runs, its certified result fans out, and
 the replies are bit-identical to serial execution (timing fields aside).
 
+Warm Scores run an ENGINE LADDER (ISSUE 9): the (snapshot id, config,
+k-bucket) prefix memo first (no device work at all), then the
+incremental column/row rescore of the device-resident [P, N]
+score/feasible tensors (only what the delta Syncs since the last
+launch dirtied — solver/incremental.py, bit-identical by
+construction), then the full ``score_cycle``.  The resident tensors
+advance with every generation bump instead of being discarded; cold
+Syncs, geometry changes, full-tensor re-uploads, a CycleConfig change
+or a dirty ratio past ``--score-incr-max-ratio`` fall back to the
+full rescore (docs/KERNEL.md "Incremental scoring" has the matrix).
+
 The wire contract is untouched: replies are byte-identical to the
 serialized daemon's, only the internal concurrency changed.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
@@ -52,8 +64,6 @@ import numpy as np
 
 import grpc
 import jax
-import jax.numpy as jnp
-from jax import lax
 
 from koordinator_tpu.bridge.codegen import SERVICE, pb2
 from koordinator_tpu.bridge.coalesce import (
@@ -73,7 +83,12 @@ from koordinator_tpu.replication.admission import (
     AdmissionGate,
     ResourceExhausted,
 )
-from koordinator_tpu.solver import run_cycle, score_cycle
+from koordinator_tpu.solver import (
+    masked_top_k,
+    run_cycle,
+    score_cycle,
+    score_upper_bound,
+)
 
 
 class _AssignMemo:
@@ -112,6 +127,8 @@ class ScorerServicer:
         coalesce_cap_ms: Optional[float] = None,
         score_memo: bool = True,
         max_inflight: int = 0,
+        score_incr: bool = True,
+        score_incr_max_ratio: Optional[float] = None,
     ):
         """``mesh``: a ``jax.sharding.Mesh`` turns the ASSIGN RPC into
         the round-based multi-chip cycle (parallel/shard_assign.py
@@ -141,6 +158,25 @@ class ScorerServicer:
         generation bump, the Assign-memo contract).  ``False`` disables
         it — the bench storms do, to keep measuring the dispatch
         engine itself.
+
+        ``score_incr`` (ISSUE 9, the tentpole): keep the [P, N]
+        score/feasible tensors device-resident across generations and
+        serve a warm Score by rescoring ONLY the columns/rows the
+        delta Syncs since the last launch dirtied
+        (solver/incremental.py; O(P x d) + O(d_p x N) instead of
+        O(P x N), bit-identical by the gather/scatter exactness
+        contract).  Engine order per Score batch: prefix memo ->
+        incremental -> full rescore.  ``False`` disables it — the
+        bench storms do, mirroring ``score_memo=False``, so they keep
+        measuring the dispatch engines rather than the short-circuit.
+
+        ``score_incr_max_ratio``: dirty-cost fraction
+        (``d_nodes/N + d_pods/P`` — the incremental arithmetic
+        relative to a full rescore) above which a Score falls back to
+        the full ``score_cycle`` (rescoring most of the tensor
+        incrementally costs MORE: two scatters plus worse fusion).
+        Default from ``KOORD_SCORE_INCR_MAX_RATIO``, else 0.25; daemon
+        flag ``--score-incr-max-ratio``.
 
         ``coalesce_cap_ms``: clamp of the adaptive gather window's
         straggler wait (AdaptiveGatherWindow cap_ms; default 5.0) —
@@ -199,6 +235,17 @@ class ScorerServicer:
         self._assign_memo = {}
         # Score top-k prefix memo (same invalidation; None = disabled)
         self._score_memo = ScoreMemo() if score_memo else None
+        # incremental score engine (ISSUE 9): resident [P, N] tensors
+        # advanced column-wise by warm deltas; the ratio gates when a
+        # mostly-dirty tensor should just full-rescore
+        self._score_incr = bool(score_incr)
+        if score_incr_max_ratio is None:
+            # `or`: an empty env value means unset (every KOORD_* knob's
+            # convention), not a float('') crash at daemon startup
+            score_incr_max_ratio = float(
+                os.environ.get("KOORD_SCORE_INCR_MAX_RATIO") or "0.25"
+            )
+        self._score_incr_max_ratio = float(score_incr_max_ratio)
         # admission gate in front of the dispatch queue (ISSUE 8):
         # Score/Assign reserve a slot before touching the coalescer,
         # overload sheds fast instead of queueing without bound
@@ -534,6 +581,7 @@ class ScorerServicer:
                 ]
                 if max(memo_ks) > memo["kb"]:
                     memo = None  # needs a wider launch; it will replace
+            incr = None
             if memo is None:
                 try:
                     snap = self.state.snapshot()
@@ -544,6 +592,16 @@ class ScorerServicer:
                     # Sync does)
                     self.telemetry.abort_cycle("score", exc)
                     raise
+                if self._score_incr:
+                    # incremental engine (ISSUE 9): the resident score
+                    # tensors, if any, with the dirt the warm commits
+                    # since their launch accumulated.  A CycleConfig
+                    # change invalidates them wholesale — the tensors
+                    # certify a different scoring program.
+                    incr = self.state.score_residency()
+                    if incr is not None and incr.cfg != self.cfg:
+                        self.state.drop_score_residency()
+                        incr = None
         if memo is not None:
             # the prefix assembly is pure host work: hand it back as a
             # no-device closure so it runs OFF the launch lock (like a
@@ -572,11 +630,58 @@ class ScorerServicer:
             # prefix of the padded result (lax.top_k sorts descending
             # with index tie-breaks, so prefixes are exact)
             k_launch = min(pad_bucket(max(ks)), N)
-            scores, feasible = score_cycle(snap, self.cfg)
-            masked = jnp.where(
-                feasible, scores, jnp.iinfo(jnp.int64).min
+            # engine ordering (ISSUE 9): memo (handled above) ->
+            # incremental column/row rescore of the resident tensors ->
+            # full score_cycle.  All three hand bit-identical tensors
+            # to the SAME masked top_k below.
+            scores = feasible = None
+            incr_result = None  # telemetry: incr | full | fallback
+            incr_cols = 0
+            if self._score_incr:
+                if incr is not None:
+                    ratio = (
+                        len(incr.dirty_nodes) / N + len(incr.dirty_pods) / P
+                    )
+                    if ratio <= self._score_incr_max_ratio:
+                        incr_cols = len(incr.dirty_nodes)
+                        try:
+                            scores, feasible = self._score_incremental(
+                                snap, incr
+                            )
+                            incr_result = "incr"
+                        except Exception:  # koordlint: disable=broad-except(owner failure on the incremental launch: the full rescore below is the documented fallback; the residency was dropped so the torn tensor can never serve)
+                            # the kernel may have consumed the donated
+                            # scores buffer mid-failure: the residency
+                            # is poison — drop it and full-rescore;
+                            # the reply this batch serves stays exact
+                            import logging
+
+                            logging.getLogger(__name__).exception(
+                                "incremental rescore failed; falling "
+                                "back to a full score_cycle"
+                            )
+                            self.state.drop_score_residency()
+                            incr_result = "fallback"
+                    else:
+                        # mostly-dirty: a full rescore is cheaper than
+                        # scattering most of the tensor incrementally
+                        incr_result = "fallback"
+                else:
+                    incr_result = "full"  # nothing resident to advance
+            if scores is None:
+                scores, feasible = score_cycle(snap, self.cfg)
+            if self._score_incr:
+                # the tensors this launch certifies become (or refresh)
+                # the residency; accumulated dirt clears with the store
+                self.state.store_score_result(self.cfg, scores, feasible)
+            # masked top-k via the packed-f64 fast path (solver/topk.py):
+            # bit-identical to lax.top_k on the masked i64 tensor, ~30x
+            # cheaper on CPU — the shared tail both engines pay, so the
+            # incremental saving is not buried under an integer sort
+            top_scores, top_idx = masked_top_k(
+                scores, feasible, k=k_launch,
+                hi=score_upper_bound(self.cfg),
             )
-            top_scores, top_idx = lax.top_k(masked, k_launch)
             # launch phase ends with the program ENQUEUED (async
             # dispatch); everything below blocks, so it lives in the
             # readback closure the dispatcher runs off the launch lock
@@ -638,10 +743,38 @@ class ScorerServicer:
             # after followers were notified, so telemetry never extends
             # the readback path either
             return lambda: self._score_telemetry(
-                assembled, sid, dispatch_s, readback_s, exec_ms, n_failed
+                assembled, sid, dispatch_s, readback_s, exec_ms, n_failed,
+                incr_result=incr_result, incr_cols=incr_cols,
             )
 
         return _readback
+
+    @launch_section
+    def _score_incremental(self, snap, res):
+        """Advance the resident score tensors through the accumulated
+        dirty columns/rows (solver/incremental.py ``rescore_dirty``) —
+        the warm Score engine.  Caller holds the launch lock (commits
+        that add dirt run under it too, so the sets cannot move) and
+        owns the fallback on failure.
+
+        The resident ``scores`` buffer is DONATED to the rescore: the
+        residency's references are cleared FIRST so a mid-kernel
+        failure can never leave a consumed buffer published — the
+        caller re-stores the advanced tensors on success and drops the
+        residency on failure.  With no dirt at all (a quota-only delta
+        stream, or a wider-k relaunch over an unchanged snapshot) the
+        tensors are already exact and pass through untouched — no
+        kernel launches."""
+        from koordinator_tpu.solver.incremental import rescore_dirty
+
+        if not res.dirty_nodes and not res.dirty_pods:
+            return res.scores, res.feasible
+        scores, feasible = res.scores, res.feasible
+        res.scores = res.feasible = None
+        return rescore_dirty(
+            snap, scores, feasible, res.dirty_nodes, res.dirty_pods,
+            self.cfg, mesh=self.state.active_mesh(),
+        )
 
     def _score_serve_memo(self, accepted, ks, memo, sid):
         """Serve a whole coalesced batch as sliced prefixes of the
@@ -748,7 +881,8 @@ class ScorerServicer:
         return reply
 
     def _score_telemetry(self, assembled, sid, dispatch_s, readback_s,
-                         exec_ms, n_failed=0):
+                         exec_ms, n_failed=0, incr_result=None,
+                         incr_cols=0):
         """Per-batch telemetry, sequenced under the state lock.  The
         pending-cycle contract is unchanged from the serial daemon: a
         pending cycle holds Sync stages awaiting the Assign that
@@ -774,6 +908,12 @@ class ScorerServicer:
                 tel.metrics.count_score_memo(
                     "miss", len(assembled) + n_failed
                 )
+            if incr_result is not None:
+                # one observation per LAUNCH (the engines are per-batch
+                # decisions, unlike the per-request memo counters)
+                tel.metrics.count_score_incr(incr_result)
+                if incr_result == "incr":
+                    tel.metrics.observe_incr_cols(incr_cols)
             if not assembled:
                 return
             tel.flush_backlog()

@@ -190,6 +190,45 @@ _DELTA_TENSORS = (
     "quota_limited",
 )
 
+# score-relevant tensors (ISSUE 9): which resident mirrors feed the
+# stateless score_cycle math — a delta to one of these dirties the
+# touched ROWS of the resident [P, N] score tensors.  Quota tensors are
+# deliberately absent: score_cycle reads no quota state (quota admission
+# lives in the sequential Assign step only), so a quota-only Sync leaves
+# the resident score tensors exactly valid — zero columns to rescore.
+_SCORE_NODE_TENSORS = ("node_alloc", "node_requested", "node_usage",
+                       "node_agg", "node_agg_fresh", "node_prod")
+_SCORE_POD_TENSORS = ("pod_requests", "pod_estimated")
+
+
+class ScoreResidency:
+    """The [P, N] score/feasible tensors as first-class device-resident
+    leaves (ISSUE 9), plus the dirty row/column sets accumulated since
+    the launch that certified them.
+
+    ``scores``/``feasible`` are the padded tensors the last Score launch
+    produced (node-axis-sharded ``P(None, "nodes")`` when mesh-resident);
+    every warm commit unions the rows it invalidated into
+    ``dirty_nodes``/``dirty_pods`` instead of discarding the tensors —
+    the generation advances, the derived result advances with it.  The
+    next Score recomputes only the dirty columns/rows
+    (solver/incremental.py ``rescore_dirty``) and the sets clear.
+
+    ``cfg`` is the CycleConfig the tensors were scored under: a config
+    change means a different scoring program, so the servicer drops the
+    residency rather than advance tensors it cannot certify.
+    """
+
+    __slots__ = ("cfg", "scores", "feasible", "dirty_nodes", "dirty_pods")
+
+    def __init__(self, cfg, scores, feasible):
+        self.cfg = cfg
+        self.scores = scores
+        self.feasible = feasible
+        self.dirty_nodes: set = set()
+        self.dirty_pods: set = set()
+
+
 # companions reset to defaults when a full tensor changes the node table
 # size (ADVICE r5: a stale differently-shaped column must not linger to
 # fail later at snapshot build).  node_requested/node_usage are included:
@@ -256,6 +295,10 @@ class ResidentState:
         self.node_bucket = 0
         self.pod_bucket = 0
         self._snapshot: Optional[ClusterSnapshot] = None
+        # resident [P, N] score/feasible tensors + accumulated dirt
+        # (ISSUE 9); populated by the servicer's Score launches via
+        # store_score_result, advanced by warm commits, dropped cold
+        self._score_res: Optional[ScoreResidency] = None
         self._i32_ok: Optional[bool] = None
         # observability: how the last Sync landed on the device
         # ("cold" = residency dropped, rebuild at next snapshot();
@@ -351,17 +394,24 @@ class ResidentState:
         if plan is _PLAN_UNSET:
             # device-update plan against the PRE-commit mirrors
             plan = self._warm_plan(staged, tinfo)
+        # dirty score rows/columns this commit invalidates (ISSUE 9) —
+        # computed against the PRE-commit mirrors, like the plan
+        score_dirty = (
+            self._score_dirty_rows(staged, plan) if plan is not None else None
+        )
         # atomic commit point: nothing above mutated self
         for key, value in staged.items():
             setattr(self, key, value)
         if plan is None:
             self._snapshot = None  # cold: rebuilt lazily at snapshot()
+            self._score_res = None  # geometry moved: nothing to advance
             self.last_sync_path = "cold"
         else:
             try:
                 with maybe_span(spans, "delta_scatter"):
                     self._snapshot = self._apply_warm(plan)
                 self.last_sync_path = "warm"
+                self._note_score_dirty(score_dirty)
             except Exception:
                 # a torn device update may have donated buffers out of the
                 # old snapshot: drop residency, the mirrors stay truthful
@@ -370,6 +420,7 @@ class ResidentState:
                     "warm device update failed; falling back to cold rebuild"
                 )
                 self._snapshot = None
+                self._score_res = None
                 self.last_sync_path = "cold"
         self._i32_ok = None
         kinds = [kind for kind, _, _ in tinfo.values()]
@@ -705,6 +756,125 @@ class ResidentState:
         return ClusterSnapshot(
             nodes=nodes, pods=pods, gangs=gangs, quotas=quotas
         )
+
+    # -- resident score tensors (ISSUE 9) --
+    def score_residency(self) -> Optional[ScoreResidency]:
+        """The resident [P, N] score/feasible tensors with their
+        accumulated dirt, or None (never scored, or residency dropped).
+        Callers serialize through the dispatch launch lock: commits
+        mutate the dirt under it (run_exclusive) and Score launches
+        read/advance under it."""
+        return self._score_res
+
+    def drop_score_residency(self) -> None:
+        self._score_res = None
+
+    def store_score_result(self, cfg, scores, feasible) -> None:
+        """Adopt the tensors a Score launch just certified: the
+        residency's dirt clears (the launch incorporated it) and the
+        tensors land in the canonical placement — node-axis-sharded
+        over the cluster mesh when mesh-resident
+        (parallel/mesh.py ``score_sharding``), so the next incremental
+        rescore partitions without any resharding program.  device_put
+        with an already-matching sharding is a no-op, which is exactly
+        the incremental path's case (the shard_map preserves specs)."""
+        mesh = self.active_mesh()
+        if mesh is not None:
+            from koordinator_tpu.parallel.mesh import score_sharding
+
+            spec = score_sharding(mesh)
+            scores = jax.device_put(scores, spec)
+            feasible = jax.device_put(feasible, spec)
+        self._score_res = ScoreResidency(cfg, scores, feasible)
+
+    def _note_score_dirty(self, score_dirty) -> None:
+        """Advance the score residency past a warm commit: union the
+        invalidated rows (None = attribution lost, e.g. a full-tensor
+        re-upload — the residency drops and the next Score full-
+        rescores)."""
+        res = self._score_res
+        if res is None:
+            return
+        if score_dirty is None:
+            self._score_res = None
+            return
+        dirty_nodes, dirty_pods = score_dirty
+        res.dirty_nodes |= dirty_nodes
+        res.dirty_pods |= dirty_pods
+
+    def _score_dirty_rows(self, staged, plan):
+        """(dirty node rows, dirty pod rows) a warm plan invalidates in
+        the resident score tensors, or None when row attribution is
+        lost (a full tensor rode the frame).  Runs BEFORE the mirror
+        commit — derived-column comparisons need the old values.
+
+        Row attribution per update kind:
+
+        * a sparse delta's flat indices divide by the mirror's trailing
+          row size — the same index space the device scatter targets;
+        * quota tensors contribute nothing (``_SCORE_NODE_TENSORS``
+          note: score_cycle never reads quota state);
+        * derived freshness (``node_fresh``) diffs old-vs-new per node
+          (None means the all-fresh default, the ``_dev_metric_fresh``
+          rule);
+        * priority/priority-class changes dirty the pods whose
+          EFFECTIVE class moved — the one column score_cycle reads
+          (``_pc_column``, the same derivation the device builder
+          uses); raw priority feeds scoring only through it.
+        """
+        tensor_updates, derived = plan
+        dirty_nodes: set = set()
+        dirty_pods: set = set()
+        for key, update in tensor_updates.items():
+            if key == "pod_estimated_from_requests":
+                continue  # rides pod_requests' indices, counted there
+            if key not in _SCORE_NODE_TENSORS and key not in _SCORE_POD_TENSORS:
+                continue
+            if update[0] != "delta":
+                return None  # full re-upload: which rows moved is unknown
+            base = np.asarray(getattr(self, key))
+            trailing = int(np.prod(base.shape[1:])) if base.ndim > 1 else 1
+            rows = dirty_nodes if key in _SCORE_NODE_TENSORS else dirty_pods
+            rows.update(
+                (np.asarray(update[1], np.int64) // trailing).tolist()
+            )
+        # gate on the plan's derived set: _warm_plan already diffed the
+        # scalar columns, so an unchanged list riding the frame costs
+        # nothing here (the effective-class derivation below is an O(P)
+        # Python loop — it must not run on every priority-carrying Sync
+        # while the launch lock holds back Score/Assign)
+        new_fresh = staged.get("node_fresh")
+        if "node_fresh" in derived and new_fresh is not None:
+            new_fresh = np.asarray(new_fresh, bool)
+            old_fresh = (
+                np.asarray(self.node_fresh, bool)
+                if self.node_fresh is not None
+                else np.ones(len(new_fresh), bool)
+            )
+            if len(old_fresh) == len(new_fresh):
+                dirty_nodes.update(
+                    np.flatnonzero(old_fresh != new_fresh).tolist()
+                )
+            else:
+                return None  # length moved without a resize: stay safe
+        if "pod_priority" in derived or "pod_priority_class" in derived:
+            P = self.pod_requests.shape[0]
+
+            def eff_class(explicit, priority):
+                prio = (
+                    np.asarray(priority)
+                    if priority is not None
+                    else np.zeros(P, np.int64)
+                )
+                return _pc_column(explicit, prio, P, P)
+
+            old_cls = eff_class(self.pod_priority_class, self.pod_priority)
+            new_cls = eff_class(
+                staged.get("pod_priority_class", self.pod_priority_class),
+                staged.get("pod_priority", self.pod_priority),
+            )
+            dirty_pods.update(np.flatnonzero(old_cls != new_cls).tolist())
+        return dirty_nodes, dirty_pods
 
     def i32_fits(self) -> bool:
         """Whether the resident tensors fit the Pallas kernel's i32

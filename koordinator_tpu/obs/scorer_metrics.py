@@ -30,6 +30,8 @@ koord_scorer_coalesce_window_ms        gauge     —
 koord_scorer_coalesce_device_idle_ms   gauge     — (cumulative)
 koord_scorer_assign_memo_total         counter   result (hit|miss)
 koord_scorer_score_memo_total          counter   result (hit|miss)
+koord_scorer_score_incr_total          counter   result (incr|full|fallback)
+koord_scorer_incr_cols                 histogram —
 koord_scorer_shed_total                counter   method (score|assign)
 koord_scorer_replica_role              gauge     role (leader|follower)
 koord_scorer_replica_frames_total      counter   result (applied|stale|resync|error)
@@ -104,6 +106,8 @@ COALESCE_WINDOW = "koord_scorer_coalesce_window_ms"
 COALESCE_DEVICE_IDLE = "koord_scorer_coalesce_device_idle_ms"
 ASSIGN_MEMO = "koord_scorer_assign_memo_total"
 SCORE_MEMO = "koord_scorer_score_memo_total"
+SCORE_INCR = "koord_scorer_score_incr_total"
+INCR_COLS = "koord_scorer_incr_cols"
 SHED_TOTAL = "koord_scorer_shed_total"
 REPLICA_ROLE = "koord_scorer_replica_role"
 REPLICA_FRAMES = "koord_scorer_replica_frames_total"
@@ -115,6 +119,13 @@ REPLICA_FOLLOWERS = "koord_scorer_replica_followers"
 # power-of-two buckets (the dispatcher caps batches at 16 by default;
 # 32/64 leave headroom for tuned deployments)
 _OCCUPANCY_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, float("inf"))
+
+# dirty-column counts per incremental Score launch: power-of-two-ish
+# buckets matching the delta scatter's pad buckets (0 = a row-only or
+# quota-only delta stream rescored no columns at all)
+_INCR_COLS_BUCKETS = (
+    1.0, 4.0, 16.0, 64.0, 256.0, 1024.0, 4096.0, float("inf"),
+)
 
 _FAMILIES = (
     (CYCLE_LATENCY, "histogram",
@@ -169,6 +180,15 @@ _FAMILIES = (
      "Score requests served as sliced prefixes of the memoized "
      "(snapshot, config, k-bucket) top-k readback (hit) vs. launched "
      "a device batch (miss)"),
+    (SCORE_INCR, "counter",
+     "Score launches by engine outcome: incr rescored only the dirty "
+     "columns/rows of the resident score tensor, full had no resident "
+     "tensor to advance (cold/first score), fallback had one but full-"
+     "rescored (dirty ratio past --score-incr-max-ratio, or an "
+     "incremental-launch failure)"),
+    (INCR_COLS, "histogram",
+     "dirty node columns recomputed per incremental Score launch "
+     "(O(P x d) of the O(P x N) a full rescore pays)"),
     (SHED_TOTAL, "counter",
      "read RPCs the admission gate refused with RESOURCE_EXHAUSTED "
      "(queue depth at --max-inflight), by method; in-flight work "
@@ -191,7 +211,10 @@ _FAMILIES = (
 )
 
 # per-family bucket overrides (histograms default to DEFAULT_BUCKETS_MS)
-_BUCKET_OVERRIDES = {COALESCE_OCCUPANCY: _OCCUPANCY_BUCKETS}
+_BUCKET_OVERRIDES = {
+    COALESCE_OCCUPANCY: _OCCUPANCY_BUCKETS,
+    INCR_COLS: _INCR_COLS_BUCKETS,
+}
 
 
 class ScorerMetrics:
@@ -303,6 +326,16 @@ class ScorerMetrics:
 
     def count_score_memo(self, result: str, n: int = 1) -> None:
         self.registry.counter_add(SCORE_MEMO, int(n), {"result": result})
+
+    # -- incremental score engine (ISSUE 9) --
+    def count_score_incr(self, result: str) -> None:
+        """One Score LAUNCH's engine outcome (incr|full|fallback) —
+        per launch, not per coalesced request: the engine decision is
+        batch-scoped."""
+        self.registry.counter_add(SCORE_INCR, 1, {"result": result})
+
+    def observe_incr_cols(self, cols: int) -> None:
+        self.registry.histogram_observe(INCR_COLS, float(cols))
 
     # -- replicated serving tier (ISSUE 8) --
     def count_shed(self, method: str) -> None:

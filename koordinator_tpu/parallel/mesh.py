@@ -89,6 +89,28 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def score_sharding(mesh: Mesh) -> NamedSharding:
+    """NamedSharding of the resident [P, N] score/feasible tensors
+    (ISSUE 9): the NODE axis (axis 1) splits over the cluster mesh —
+    column j lives on the device owning node j's snapshot rows, so the
+    incremental dirty-column rescore is shard-local exactly like the
+    delta scatter, and the persistent score tensor's HBM cost divides
+    by the mesh like the node tables it derives from."""
+    return NamedSharding(mesh, P(None, CLUSTER_AXIS))
+
+
+def snapshot_partition_specs(snap: ClusterSnapshot):
+    """A pytree of bare ``PartitionSpec``s matching ``snap``
+    leaf-for-leaf — the mesh-independent half of the placement policy
+    (:func:`snapshot_shardings` binds it to a mesh).  Consumed as
+    ``shard_map`` in/out specs by the incremental score engine
+    (solver/incremental.py), so the rescore partitions the snapshot
+    exactly as it is resident — no hidden resharding program."""
+    node = lambda a: P(CLUSTER_AXIS, *([None] * (np.ndim(a) - 1)))
+    rep = lambda a: P()
+    return _snapshot_spec_tree(snap, node, rep)
+
+
 def snapshot_shardings(snap: ClusterSnapshot, mesh: Mesh):
     """A pytree of ``NamedSharding`` specs matching ``snap`` leaf-for-leaf:
     node tensors sharded along the cluster axis, pod rows and the
@@ -98,10 +120,18 @@ def snapshot_shardings(snap: ClusterSnapshot, mesh: Mesh):
     bridge/state.py builds its resident leaves incrementally through
     the same ``node_sharding``/``replicated_sharding`` policy, and
     tests/test_mesh_resident.py asserts the two stay in lockstep —
-    this function is the one canonical statement of which leaf gets
-    which spec."""
+    this function (with :func:`snapshot_partition_specs`, the same
+    classification over bare ``PartitionSpec``s) is the one canonical
+    statement of which leaf gets which spec."""
     node = lambda a: node_sharding(mesh, np.ndim(a))
     rep = lambda a: replicated_sharding(mesh)
+    return _snapshot_spec_tree(snap, node, rep)
+
+
+def _snapshot_spec_tree(snap: ClusterSnapshot, node, rep):
+    """The per-leaf placement classification shared by
+    :func:`snapshot_shardings` and :func:`snapshot_partition_specs`:
+    ``node``/``rep`` map each array leaf to its spec."""
     nodes = snap.nodes
     return ClusterSnapshot(
         nodes=dataclass_replace(

@@ -85,6 +85,7 @@ class SchedulerServer:
         coalesce_cap_ms: Optional[float] = None,
         max_inflight: Optional[int] = None,
         replicate_from: Optional[str] = None,
+        score_incr_max_ratio: Optional[float] = None,
     ):
         # persistent compile cache under the daemon's state dir: a
         # restarted sidecar skips the multi-second (16.5s on TPU,
@@ -187,6 +188,8 @@ class SchedulerServer:
             servicer_kw["coalesce_cap_ms"] = float(coalesce_cap_ms)
         if max_inflight is not None:
             servicer_kw["max_inflight"] = int(max_inflight)
+        if score_incr_max_ratio is not None:
+            servicer_kw["score_incr_max_ratio"] = float(score_incr_max_ratio)
         # replication role (ISSUE 8, koordinator_tpu/replication/):
         # --replicate-from makes this daemon a READ FOLLOWER — it
         # subscribes to the named leader's replication socket, applies
@@ -439,6 +442,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "docs/REPLICATION.md)",
     )
     ap.add_argument(
+        "--score-incr-max-ratio", type=float,
+        dest="score_incr_max_ratio",
+        default=(
+            float(os.environ["KOORD_SCORE_INCR_MAX_RATIO"])
+            if os.environ.get("KOORD_SCORE_INCR_MAX_RATIO") else None
+        ),
+        help="incremental score engine's fallback gate (docs/KERNEL.md "
+        "\"Incremental scoring\"): dirty-cost fraction "
+        "(dirty_nodes/N + dirty_pods/P) above which a warm Score "
+        "full-rescores instead of advancing the resident [P, N] score "
+        "tensor column-wise (default 0.25; env: "
+        "KOORD_SCORE_INCR_MAX_RATIO)",
+    )
+    ap.add_argument(
         "--state-dir", default=None,
         help="daemon state directory (default: $XDG_STATE_HOME/"
         "koord-scheduler, per-user); the persistent XLA compile cache "
@@ -464,6 +481,7 @@ def main(argv=None) -> int:
         coalesce_cap_ms=args.coalesce_cap_ms,
         max_inflight=args.max_inflight,
         replicate_from=args.replicate_from,
+        score_incr_max_ratio=args.score_incr_max_ratio,
     ).start()
     try:
         threading.Event().wait()

@@ -3,8 +3,14 @@ from koordinator_tpu.solver.greedy import (  # noqa: F401
     STATUS_ASSIGNED,
     STATUS_UNSCHEDULABLE,
     STATUS_WAIT_GANG,
+    score_all,
     score_cycle,
     greedy_assign,
+)
+from koordinator_tpu.solver.incremental import rescore_dirty  # noqa: F401
+from koordinator_tpu.solver.topk import (  # noqa: F401
+    masked_top_k,
+    score_upper_bound,
 )
 from koordinator_tpu.solver.wave import wave_assign  # noqa: F401
 

@@ -188,13 +188,15 @@ def step_feasible_scores(
     return feasible, total
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def score_cycle(snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG):
-    """Stateless batch scoring: scores + feasibility for every (pod, node).
+def score_all(snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG):
+    """The scoring math of :func:`score_cycle`, un-jitted.
 
-    Equivalent to running the reference's Filter+Score for each pending pod
-    against the *initial* snapshot (no intra-batch Reserve effects).
-    Returns (scores i64[P, N], feasible bool[P, N]).
+    The ONE statement of the stateless Filter+Score semantics, shared by
+    the jitted full rescore (``score_cycle``) and the incremental
+    column/row rescore (solver/incremental.py, ISSUE 9) — every term is
+    cellwise in (pod row, node row), which is exactly what makes
+    "gather rows, score, scatter back" bit-identical to a full rescore,
+    and sharing the body is what keeps the two engines from drifting.
     """
     pods, nodes = snapshot.pods, snapshot.nodes
     feasible = fit_mask(
@@ -218,6 +220,17 @@ def score_cycle(snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONF
         pods.estimated,
     )
     return scores, feasible
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def score_cycle(snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG):
+    """Stateless batch scoring: scores + feasibility for every (pod, node).
+
+    Equivalent to running the reference's Filter+Score for each pending pod
+    against the *initial* snapshot (no intra-batch Reserve effects).
+    Returns (scores i64[P, N], feasible bool[P, N]).
+    """
+    return score_all(snapshot, cfg)
 
 
 @partial(jax.jit, static_argnames=("cfg",))

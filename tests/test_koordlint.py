@@ -171,6 +171,66 @@ class TestDonationSafety:
         )
         assert lint(src) == []
 
+    def test_known_cross_module_donor_rescore_dirty_caught(self):
+        # ISSUE 9: the resident-score-tensor scatter's jit wrapper lives
+        # in solver/incremental.py, invisible to the module-local scan —
+        # the known-donor table must still catch a read of the donated
+        # scores buffer at a cross-module call site
+        got = lint("""
+        from koordinator_tpu.solver.incremental import rescore_dirty
+
+        def advance(snap, scores, feasible, dirty, cfg):
+            out_s, out_f = rescore_dirty(snap, scores, feasible, dirty, set(), cfg)
+            stale = scores.sum()
+            return out_s, out_f, stale
+        """)
+        assert [(v.rule, v.line) for v in got] == [("donation-safety", 6)]
+        assert "donated to rescore_dirty()" in got[0].message
+
+    def test_known_donor_rebind_and_non_donated_args_clean(self):
+        # feasible is NOT donated (in-flight readbacks hold it): reading
+        # it after the call is fine, and the rebind idiom forgives scores
+        assert lint("""
+        from koordinator_tpu.solver.incremental import rescore_dirty
+        from koordinator_tpu.solver.resident import apply_flat_delta
+
+        def advance(snap, scores, feasible, dirty, cfg):
+            scores, feasible = rescore_dirty(
+                snap, scores, feasible, dirty, set(), cfg)
+            return scores, feasible.sum()
+
+        def scatter(buf, idx, val):
+            buf = apply_flat_delta(buf, idx, val)
+            return buf
+        """) == []
+
+    def test_known_donor_apply_flat_delta_caught(self):
+        got = lint("""
+        from koordinator_tpu.solver.resident import apply_flat_delta
+
+        def scatter(buf, idx, val):
+            out = apply_flat_delta(buf, idx, val)
+            return out, buf.sum()
+        """)
+        assert [(v.rule, v.line) for v in got] == [("donation-safety", 6)]
+
+    def test_local_def_overrides_known_donor(self):
+        # a module-LOCAL jitted def named rescore_dirty declares its own
+        # (empty) donation contract; the cross-module table must not
+        # impose the solver helper's on it
+        assert lint("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def rescore_dirty(snapshot, scores, feasible, a, b, cfg):
+            return scores, feasible
+
+        def advance(snap, scores, feasible, cfg):
+            out = rescore_dirty(snap, scores, feasible, 1, 2, cfg)
+            return out, scores.sum()
+        """) == []
+
 
 class TestRetraceHazard:
     def test_tracer_branch_in_jitted_fixture(self):
@@ -320,6 +380,48 @@ class TestRetraceHazard:
             return arr
 
         scatter = jax.jit(_inner, static_argnames=("n_shards",))
+        """) == []
+
+    def test_traced_dirty_knobs_caught(self):
+        """ISSUE 9: a jit boundary taking a dirty COUNT traced is the
+        same silent retrace class — delta sizes vary per cycle, so the
+        rescore would re-specialize per distinct count; the count must
+        ride a bucket-padded index vector instead.  Decorator and
+        call-form spellings both."""
+        got = lint("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def rescore(snapshot, scores, cfg, n_dirty):
+            return scores
+
+        def _inner(scores, dirty_width):
+            return scores
+
+        column_rescore = jax.jit(_inner)
+        """)
+        msgs = [(v.line, v.message) for v in got]
+        assert len(msgs) == 2, msgs
+        assert sum("'n_dirty'" in m for _, m in msgs) == 1
+        assert sum("'dirty_width'" in m for _, m in msgs) == 1
+        assert all("pad" in m for _, m in msgs)
+
+    def test_static_or_padded_dirty_params_are_clean(self):
+        # a padded index VECTOR (node_idx/pod_idx) carries no count at
+        # the boundary; an explicitly-static count is also accepted
+        assert lint("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def rescore(snapshot, scores, node_idx, pod_idx, cfg):
+            return scores
+
+        def _inner(scores, n_dirty):
+            return scores
+
+        sized = jax.jit(_inner, static_argnames=("n_dirty",))
         """) == []
 
     def test_mesh_knob_in_shard_map_body_caught(self):

@@ -619,6 +619,12 @@ class TestRetraceBudget:
         ))
         first = self._warm_step(sv, state)
         assert first.path == "shard"
+        # ... and the shard-local incremental rescore (ISSUE 9): the
+        # first WARM Score advances the resident score tensors through
+        # the dirty-column kernel, whose compile belongs to warm-up
+        sv.score(pb2.ScoreRequest(
+            snapshot_id=sv.snapshot_id(), top_k=3, flat=True
+        ))
         with retrace_guard(budget=0) as counter:
             for _ in range(4):
                 prev = state["node_usage"].copy()
@@ -652,12 +658,18 @@ class TestRetraceBudget:
         sv = ScorerServicer()
         sv.sync(_full_sync_request(state))
         sv.state.snapshot()
-        # warm-up: compiles the scatter, the cycle AND the score/top_k
-        # programs (two top_k values land in the same pad bucket)
+        # warm-up: compiles the scatter, the cycle, the score/top_k
+        # programs (two top_k values land in the same pad bucket) AND
+        # the incremental column rescore (ISSUE 9) — the first Score
+        # after a warm delta advances the resident score tensors
+        # through the dirty-column kernel
         sv.score(pb2.ScoreRequest(
             snapshot_id=sv.snapshot_id(), top_k=3, flat=True
         ))
         self._warm_step(sv, state)
+        sv.score(pb2.ScoreRequest(
+            snapshot_id=sv.snapshot_id(), top_k=2, flat=True
+        ))
         with retrace_guard(budget=0) as counter:
             for step in range(4):
                 prev = state["node_usage"].copy()
