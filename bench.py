@@ -276,6 +276,19 @@ def _validate_artifact(line: Optional[str]) -> list:
         or not 0.0 <= sr <= 1.0
     ):
         problems.append("'shed_rate' must be null or a number in [0, 1]")
+    # crash-tolerance probe fields (ISSUE 11): leader-SIGKILL recovery
+    # economics — both failover legs, the journal replay/append tax,
+    # and how many follower full-resyncs the storm cost
+    _finite_nonneg("failover_ms")
+    _finite_nonneg("warm_restart_ms")
+    _finite_nonneg("journal_replay_ms")
+    _finite_nonneg("journal_append_us")
+    for key in ("resyncs_during_failover", "reads_during_failover"):
+        v = doc.get(key)
+        if v is not None and (
+            isinstance(v, bool) or not isinstance(v, int) or v < 0
+        ):
+            problems.append(f"'{key}' must be null or an int >= 0")
     # per-stage span summary (ISSUE 4): stage name -> milliseconds, or
     # null for a stage that measured nothing (a failed best-effort leg
     # must stay VISIBLE as null, never invented) — so BENCH_*.json
@@ -2363,6 +2376,351 @@ def child_config(platform: str, config: str) -> None:
         )
         return
 
+    if config == "failover":
+        # ISSUE 11: crash-tolerant serving tier.  Kill the leader
+        # subprocess with SIGKILL mid-read-storm and recover it BOTH
+        # documented ways — (A) journal warm-restart onto the SAME
+        # s<epoch>-<gen> chain, (B) follower promotion via SIGUSR2 —
+        # publishing the recovery economics: failover_ms,
+        # journal_replay_ms, journal_append_us, and how many follower
+        # full-resyncs the whole storm cost (0 is the journal's win).
+        import signal as _signal
+        import socket as _socket
+        import struct as _struct
+        import subprocess as sp
+        import tempfile
+
+        from koordinator_tpu.bridge.client import parse_snapshot_id
+        from koordinator_tpu.bridge.codegen import pb2
+        from koordinator_tpu.bridge.state import numpy_to_tensor
+        from koordinator_tpu.bridge.udsserver import (
+            METHOD_SCORE,
+            METHOD_SYNC,
+        )
+        from koordinator_tpu.harness.golden import build_sync_request
+
+        f_pods = int(os.environ.get("KOORD_BENCH_FAILOVER_PODS", "256"))
+        f_nodes = int(os.environ.get("KOORD_BENCH_FAILOVER_NODES", "64"))
+        f_deltas = int(
+            os.environ.get("KOORD_BENCH_FAILOVER_DELTAS", "8")
+        )
+        wait_s = float(
+            os.environ.get("KOORD_BENCH_FAILOVER_WAIT", "240")
+        )
+        nodes, pods_l, gangs, quotas = generators.quota_colocation(
+            pods=f_pods, nodes=f_nodes
+        )
+        req, _ = build_sync_request(nodes, pods_l, gangs, quotas)
+        payload = req.SerializeToString()
+        phase("scale", pods=f_pods, nodes=f_nodes, deltas=f_deltas)
+        with tempfile.TemporaryDirectory() as tmp:
+            cache_dir = os.path.join(tmp, "xla-cache")
+            koordinator_tpu.configure_compilation_cache(cache_dir)
+            state_dir = os.path.join(tmp, "leader-state")
+            leader_sock = os.path.join(tmp, "leader.sock")
+            leader_repl = os.path.join(tmp, "leader.repl")
+            lstatus = os.path.join(tmp, "leader.status.json")
+            fsock = os.path.join(tmp, "f0.sock")
+            frepl = os.path.join(tmp, "f0.repl")
+            fstatus = os.path.join(tmp, "f0.status.json")
+            fstate = os.path.join(tmp, "f0-state")
+            env = dict(os.environ, KOORD_BENCH_XLA_CACHE=cache_dir)
+
+            def read_status(path):
+                try:
+                    with open(path) as fh:
+                        return json.load(fh)
+                except (OSError, ValueError):
+                    return {}
+
+            def wait_status(path, pred, timeout_s, what):
+                deadline = time.monotonic() + timeout_s
+                while time.monotonic() < deadline:
+                    if pred(read_status(path)):
+                        return read_status(path)
+                    time.sleep(0.05)
+                st = read_status(path)
+                assert pred(st), f"timed out waiting for {what}: {st}"
+                return st
+
+            def spawn_leader():
+                # the PREVIOUS leader's status file must not satisfy a
+                # wait meant for the new one (the socket would not be
+                # bound yet): the status a wait sees must come from the
+                # process it waits for
+                try:
+                    os.unlink(lstatus)
+                except OSError:
+                    pass
+                return sp.Popen(
+                    [
+                        sys.executable, os.path.abspath(__file__),
+                        "--failover-leader",
+                        "--platform", platform,
+                        "--leader-sock", leader_sock,
+                        "--leader-repl", leader_repl,
+                        "--leader-state-dir", state_dir,
+                        "--status-file", lstatus,
+                    ],
+                    env=env, stdout=sp.DEVNULL,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                )
+
+            def raw_call(sock_path, method, body, timeout=60.0):
+                conn = _socket.socket(
+                    _socket.AF_UNIX, _socket.SOCK_STREAM
+                )
+                conn.settimeout(timeout)
+                try:
+                    conn.connect(sock_path)
+                    conn.sendall(
+                        _struct.pack(">BI", method, len(body)) + body
+                    )
+                    hdr = b""
+                    while len(hdr) < 5:
+                        chunk = conn.recv(5 - len(hdr))
+                        if not chunk:
+                            raise ConnectionError("closed mid-reply")
+                        hdr += chunk
+                    status, ln = _struct.unpack(">BI", hdr)
+                    out = b""
+                    while len(out) < ln:
+                        chunk = conn.recv(ln - len(out))
+                        if not chunk:
+                            raise ConnectionError("closed mid-reply")
+                        out += chunk
+                    return status, out
+                finally:
+                    conn.close()
+
+            def raw_sync(sock_path, body):
+                status, out = raw_call(sock_path, METHOD_SYNC, body)
+                assert status == 0, out[:200]
+                return pb2.SyncReply.FromString(out)
+
+            leader = spawn_leader()
+            procs = [leader]
+            storm_stop = threading.Event()
+            storm_threads = []
+            reads_lock = threading.Lock()
+            reads = {"ok": 0, "err": 0, "ok_during_failover": 0}
+            in_failover = threading.Event()
+            try:
+                wait_status(
+                    lstatus, lambda s: s.get("snapshot_id"), wait_s,
+                    "leader boot",
+                )
+                sid = raw_sync(leader_sock, payload).snapshot_id
+                phase("sync", snapshot_id=sid, bytes=len(payload))
+                procs.append(sp.Popen(
+                    [
+                        sys.executable, os.path.abspath(__file__),
+                        "--replica-follower",
+                        "--platform", platform,
+                        "--follower-sock", fsock,
+                        "--replicate-from", leader_repl,
+                        "--status-file", fstatus,
+                        "--promote-repl", frepl,
+                        "--promote-state-dir", fstate,
+                    ],
+                    env=env, stdout=sp.DEVNULL,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                ))
+                follower = procs[-1]
+                wait_status(
+                    fstatus,
+                    lambda s: s.get("snapshot_id") == sid,
+                    wait_s, "follower catch-up",
+                )
+
+                # journal append tax: warm deltas riding the journal
+                prev = np.asarray(
+                    [res.resource_vector(n.get("usage", {}))
+                     for n in nodes],
+                    dtype=np.int64,
+                )
+
+                def warm_delta(bump):
+                    nonlocal prev
+                    cur = prev.copy()
+                    cur[bump % cur.shape[0], 0] += 1 + bump
+                    warm = pb2.SyncRequest()
+                    warm.nodes.usage.CopyFrom(
+                        numpy_to_tensor(cur, prev)
+                    )
+                    prev = cur
+                    return warm.SerializeToString()
+
+                for i in range(f_deltas):
+                    sid = raw_sync(leader_sock, warm_delta(i)).snapshot_id
+                wait_status(
+                    fstatus, lambda s: s.get("snapshot_id") == sid,
+                    wait_s, "follower delta catch-up",
+                )
+                # the leader's status loop ticks at 10 Hz; wait for it
+                # to have SEEN every append before sampling the stats
+                lstat = wait_status(
+                    lstatus,
+                    lambda s: (s.get("appends") or 0) >= f_deltas + 1,
+                    wait_s, "leader journal append stats",
+                )
+                journal_append_us = lstat.get("last_append_us")
+                phase(
+                    "journal_appends",
+                    appends=lstat.get("appends"),
+                    last_append_us=journal_append_us,
+                )
+
+                # background read storm on the FOLLOWER: reads must
+                # stay up while the leader dies, twice
+                def storm():
+                    while not storm_stop.is_set():
+                        cur = read_status(fstatus).get("snapshot_id")
+                        if not cur:
+                            time.sleep(0.01)  # koordlint: disable=bare-retry(status-file poll pacing the load generator, not a retry)
+                            continue
+                        body = pb2.ScoreRequest(
+                            snapshot_id=cur, top_k=8, flat=True
+                        ).SerializeToString()
+                        try:
+                            status, out = raw_call(
+                                fsock, METHOD_SCORE, body, timeout=30.0
+                            )
+                        except OSError:
+                            status = 1
+                        with reads_lock:
+                            if status == 0:
+                                reads["ok"] += 1
+                                if in_failover.is_set():
+                                    reads["ok_during_failover"] += 1
+                            else:
+                                reads["err"] += 1
+                        time.sleep(0.005)  # koordlint: disable=bare-retry(fixed request pacing of the availability storm — errors are COUNTED, not retried)
+
+                storm_threads = [
+                    threading.Thread(target=storm, daemon=True)
+                    for _ in range(4)
+                ]
+                for t in storm_threads:
+                    t.start()
+                resyncs_before = int(
+                    read_status(fstatus).get("resyncs") or 0
+                )
+
+                # -- LEG A: SIGKILL -> journal warm-restart --
+                in_failover.set()
+                t_kill = time.perf_counter()
+                leader.kill()
+                leader.wait()
+                leader = spawn_leader()
+                procs.append(leader)
+                lstat = wait_status(
+                    lstatus,
+                    lambda s: s.get("snapshot_id") == sid,
+                    wait_s, "journal warm-restart onto the same chain",
+                )
+                journal_replay_ms = lstat.get("replay_ms")
+                old_epoch, _old_gen = parse_snapshot_id(sid)
+                reply = raw_sync(leader_sock, warm_delta(100))
+                warm_restart_ms = (time.perf_counter() - t_kill) * 1000.0
+                new_epoch, _new_gen = parse_snapshot_id(
+                    reply.snapshot_id
+                )
+                assert new_epoch == old_epoch, (
+                    "warm restart must resume the SAME epoch chain"
+                )
+                sid = reply.snapshot_id
+                in_failover.clear()
+                wait_status(
+                    fstatus, lambda s: s.get("snapshot_id") == sid,
+                    wait_s, "follower resume after warm restart",
+                )
+                resyncs_after_a = int(
+                    read_status(fstatus).get("resyncs") or 0
+                )
+                phase(
+                    "warm_restart",
+                    warm_restart_ms=round(warm_restart_ms, 1),
+                    journal_replay_ms=journal_replay_ms,
+                    replayed_frames=lstat.get("replayed_frames"),
+                    follower_resyncs=resyncs_after_a - resyncs_before,
+                )
+
+                # -- LEG B: SIGKILL -> follower promotion (SIGUSR2) --
+                in_failover.set()
+                t_kill = time.perf_counter()
+                leader.kill()
+                leader.wait()
+                os.kill(follower.pid, _signal.SIGUSR2)
+                fstat = wait_status(
+                    fstatus, lambda s: s.get("promoted"), wait_s,
+                    "follower promotion",
+                )
+                reply = raw_sync(fsock, warm_delta(200))
+                failover_ms = (time.perf_counter() - t_kill) * 1000.0
+                promoted_sid = reply.snapshot_id
+                assert parse_snapshot_id(promoted_sid)[0] != old_epoch, (
+                    "promotion must bump the epoch"
+                )
+                in_failover.clear()
+                phase(
+                    "promotion",
+                    failover_ms=round(failover_ms, 1),
+                    promoted_sid=promoted_sid,
+                )
+                storm_stop.set()
+                for t in storm_threads:
+                    t.join(timeout=30)
+                resyncs_during_failover = int(
+                    read_status(fstatus).get("resyncs") or 0
+                ) - resyncs_before
+                assert reads["ok_during_failover"] > 0, (
+                    "reads must stay up while the leader is down"
+                )
+            finally:
+                storm_stop.set()
+                for p in procs:
+                    try:
+                        p.kill()
+                    except OSError:
+                        pass
+                for p in procs:
+                    try:
+                        p.wait(timeout=10)
+                    except sp.TimeoutExpired:
+                        pass
+        print(
+            json.dumps(
+                {
+                    # the headline: leader-SIGKILL -> promoted follower
+                    # ACKING WRITES again (the availability gap writes
+                    # see; reads never stopped — asserted above)
+                    "metric": "failover_promote_ms",
+                    "value": round(failover_ms, 2),
+                    "unit": "ms",
+                    "backend": backend,
+                    "pods": f_pods,
+                    "nodes": f_nodes,
+                    "cpu_count": os.cpu_count() or 1,
+                    "failover_ms": round(failover_ms, 2),
+                    "warm_restart_ms": round(warm_restart_ms, 2),
+                    "journal_replay_ms": journal_replay_ms,
+                    "journal_append_us": journal_append_us,
+                    "resyncs_during_failover": resyncs_during_failover,
+                    "reads_during_failover": (
+                        reads["ok_during_failover"]
+                    ),
+                    "spans": {
+                        "warm_restart": round(warm_restart_ms, 2),
+                        "promotion": round(failover_ms, 2),
+                        "journal_replay": journal_replay_ms,
+                    },
+                }
+            ),
+            flush=True,
+        )
+        return
+
     if config == "rebalance":
         # BASELINE config #5: LowNodeLoad Balance tick over the same
         # 10k x 2k cluster, pods placed by the scheduling cycle
@@ -2491,15 +2849,89 @@ def _spawn(flag, platform, env_extra, timeout, config=None):
     )
 
 
+def failover_leader(platform: str, sock: str, repl: str,
+                    state_dir: str, status_file: str) -> None:
+    """Leader worker for ``--config failover`` (ISSUE 11): one WRITER
+    daemon in its own process — ScorerServicer on a raw-UDS socket,
+    durable frame journal under ``state_dir`` replayed on boot (the
+    warm-restart leg re-spawns this very worker against the same
+    state dir), replication publisher serving journal-backed resume.
+    Publishes boot/replay/journal stats to ``status_file`` so the
+    bench can assert the same-chain resume and read the append tax
+    without an RPC.  Exits when its parent disappears — a
+    deadline-killed bench leaks nothing."""
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import koordinator_tpu
+
+    cache = os.environ.get("KOORD_BENCH_XLA_CACHE")
+    if cache:
+        koordinator_tpu.configure_compilation_cache(cache)
+    from koordinator_tpu.bridge.server import ScorerServicer
+    from koordinator_tpu.bridge.udsserver import RawUdsServer
+    from koordinator_tpu.replication.journal import FrameJournal
+    from koordinator_tpu.replication.leader import ReplicationPublisher
+
+    sv = ScorerServicer(score_memo=False, score_incr=False)
+    os.makedirs(state_dir, exist_ok=True)
+    journal = FrameJournal(os.path.join(state_dir, "journal.krj"))
+    replay = journal.recover(sv)
+    journal.attach(sv)
+    server = RawUdsServer(sock, servicer=sv).start()
+    pub = ReplicationPublisher(sv, repl, journal=journal).attach().start()
+
+    def write_status():
+        try:
+            st = journal.stats()
+            tmp_path = status_file + ".tmp"
+            with open(tmp_path, "w") as fh:
+                json.dump(
+                    {
+                        "snapshot_id": sv.snapshot_id(),
+                        "replay_ms": replay["replay_ms"],
+                        "replayed_frames": replay["replayed_frames"],
+                        "truncated": replay["truncated"],
+                        "appends": st["appends"],
+                        "last_append_us": st["last_append_us"],
+                        "journal_bytes": st["bytes"],
+                    },
+                    fh,
+                )
+            os.replace(tmp_path, status_file)
+        except OSError:
+            pass  # status is observability; the leader keeps serving
+
+    ppid = os.getppid()
+    try:
+        while os.getppid() == ppid:
+            write_status()
+            time.sleep(0.1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        pub.stop()
+        server.stop()
+        journal.close()
+
+
 def replica_follower(platform: str, sock: str, replicate_from: str,
-                     status_file: str) -> None:
+                     status_file: str, promote_repl=None,
+                     promote_state_dir=None) -> None:
     """Follower worker for ``--config replica`` (ISSUE 8): one READ
     REPLICA daemon in its own process — FollowerServicer on a raw-UDS
     socket, subscribed to the leader's replication socket, publishing
     its chain position to ``status_file`` after every applied frame so
     the bench can wait for catch-up and read the lag without an RPC.
     Exits when its parent (the bench child) disappears, so a
-    deadline-killed bench never leaks follower processes."""
+    deadline-killed bench never leaks follower processes.
+
+    ``--config failover`` (ISSUE 11) reuses this worker with
+    ``promote_repl``/``promote_state_dir`` set: on SIGUSR2 the replica
+    PROMOTES — subscription stopped, epoch bumped, its own journal
+    seeded and publisher started on ``promote_repl`` — and the status
+    file flips ``promoted`` with the new chain id."""
     import jax
 
     if platform == "cpu":
@@ -2524,8 +2956,9 @@ def replica_follower(platform: str, sock: str, replicate_from: str,
     sv = FollowerServicer(score_memo=False, score_incr=False,
                           leader=replicate_from, **kw)
     applier = ReplicaApplier(sv)
+    promoted = {"flag": False, "sid": None}
 
-    def on_frame(result, frame):
+    def write_status():
         try:
             tmp_path = status_file + ".tmp"
             with open(tmp_path, "w") as fh:
@@ -2535,6 +2968,7 @@ def replica_follower(platform: str, sock: str, replicate_from: str,
                         "lag_ms": applier.last_lag_ms,
                         "applied": applier.applied,
                         "resyncs": applier.resyncs,
+                        "promoted": promoted["flag"],
                     },
                     fh,
                 )
@@ -2542,18 +2976,62 @@ def replica_follower(platform: str, sock: str, replicate_from: str,
         except OSError:
             pass  # status is observability; the replica keeps serving
 
+    def on_frame(result, frame):
+        write_status()
+
     server = RawUdsServer(sock, servicer=sv).start()
     sub = ReplicationSubscriber(
         replicate_from, applier, on_frame=on_frame
     ).start()
+    pub = None
+    journal = None
+    promote_evt = threading.Event()
+    if promote_repl:
+        import signal as _signal
+
+        _signal.signal(
+            _signal.SIGUSR2, lambda signum, frame: promote_evt.set()
+        )
     ppid = os.getppid()
     try:
         while os.getppid() == ppid:
-            time.sleep(0.5)
+            if promote_evt.is_set() and not promoted["flag"]:
+                # the failover-config promote path: subscription down,
+                # epoch bumped, own journal + publisher up
+                sub.stop()
+                promoted["sid"] = sv.promote()
+                if promote_state_dir:
+                    from koordinator_tpu.replication.journal import (
+                        FrameJournal,
+                    )
+
+                    os.makedirs(promote_state_dir, exist_ok=True)
+                    journal = FrameJournal(
+                        os.path.join(promote_state_dir, "journal.krj")
+                    )
+                    epoch, gen, payload = (
+                        sv.export_replication_snapshot()
+                    )
+                    journal.write_base(epoch, gen, payload)
+                    journal.attach(sv)
+                from koordinator_tpu.replication.leader import (
+                    ReplicationPublisher,
+                )
+
+                pub = ReplicationPublisher(
+                    sv, promote_repl, journal=journal
+                ).attach().start()
+                promoted["flag"] = True
+            write_status()
+            time.sleep(0.1)
     except KeyboardInterrupt:
         pass
     finally:
         sub.stop()
+        if pub is not None:
+            pub.stop()
+        if journal is not None:
+            journal.close()
         server.stop()
 
 
@@ -2775,7 +3253,7 @@ def main() -> int:
         default=None,
         choices=[
             "spark", "loadaware", "gang", "extras", "rebalance", "smoke",
-            "bridge", "mesh", "replica",
+            "bridge", "mesh", "replica", "failover",
         ],
         help="measure a secondary BASELINE config instead of the headline "
         "10k x 2k quota_colocation cycle (driver contract: no args prints "
@@ -2789,6 +3267,16 @@ def main() -> int:
     ap.add_argument("--follower-sock", default=None)
     ap.add_argument("--replicate-from", default=None)
     ap.add_argument("--status-file", default=None)
+    ap.add_argument("--promote-repl", default=None)
+    ap.add_argument("--promote-state-dir", default=None)
+    ap.add_argument(
+        "--failover-leader", action="store_true",
+        help="internal: run the journaled leader daemon for --config "
+        "failover (spawned by the bench child, never by the driver)",
+    )
+    ap.add_argument("--leader-sock", default=None)
+    ap.add_argument("--leader-repl", default=None)
+    ap.add_argument("--leader-state-dir", default=None)
     ap.add_argument(
         "--replica-storm", action="store_true",
         help="internal: one replica's client storm for --config "
@@ -2799,10 +3287,18 @@ def main() -> int:
     ap.add_argument("--storm-reps", type=int, default=3)
     ap.add_argument("--storm-snapshot", default=None)
     args = ap.parse_args()
+    if args.failover_leader:
+        failover_leader(
+            args.platform, args.leader_sock, args.leader_repl,
+            args.leader_state_dir, args.status_file,
+        )
+        return 0
     if args.replica_follower:
         replica_follower(
             args.platform, args.follower_sock, args.replicate_from,
             args.status_file,
+            promote_repl=args.promote_repl,
+            promote_state_dir=args.promote_state_dir,
         )
         return 0
     if args.replica_storm:

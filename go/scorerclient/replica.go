@@ -29,7 +29,9 @@ import (
 	"fmt"
 	"strconv"
 	"strings"
+	"sync"
 	"sync/atomic"
+	"time"
 )
 
 // Replication frame header constants (big-endian, like the raw-UDS
@@ -40,6 +42,7 @@ const (
 	ReplicaFrameVersion = 1
 	ReplicaKindDelta    = 1 // sequence frame: apply onto generation-1
 	ReplicaKindFull     = 2 // reset frame: replace all resident state
+	ReplicaKindHello    = 3 // follower->leader resume offer (position)
 	ReplicaHeaderLen    = 34
 	// MaxReplicaFrame mirrors the transport's 64 MiB frame cap.
 	MaxReplicaFrame = 64 << 20
@@ -95,7 +98,7 @@ func ParseReplicaFrameHeader(b []byte) (*ReplicaFrameHeader, error) {
 			}
 		case "kind":
 			h.Kind = int(raw[0])
-			if h.Kind != ReplicaKindDelta && h.Kind != ReplicaKindFull {
+			if h.Kind != ReplicaKindDelta && h.Kind != ReplicaKindFull && h.Kind != ReplicaKindHello {
 				return nil, fmt.Errorf("bad replica frame kind %d", h.Kind)
 			}
 		case "epoch":
@@ -152,12 +155,44 @@ func isStaleSnapshot(err error) bool {
 	return err != nil && strings.Contains(err.Error(), "is not resident")
 }
 
+// IsNotLeader matches the follower daemon's Sync refusal ("the tier
+// has one writer") — a failover PROBE result, not a failure: the
+// promoted leader is some other replica, keep looking (ISSUE 11).
+func IsNotLeader(err error) bool {
+	return err != nil && strings.Contains(err.Error(), "one writer")
+}
+
+// isTransport reports whether an error is a channel-level failure (a
+// dead socket, a reset) rather than a server answer: the raw framing
+// wraps every server-sent error frame in "scorer error: ...", so
+// anything WITHOUT that prefix never carried a server's decision and
+// is safe to retry through the backoff policy.
+func isTransport(err error) bool {
+	return err != nil && !strings.Contains(err.Error(), "scorer error:")
+}
+
 // ReplicaSet routes calls across a replicated serving tier: one leader
 // pool (the writer) and N follower pools (the read tier).
+//
+// Failover (ISSUE 11): Sync/Assign track the ACTIVE WRITER — on a
+// transport error or a "one writer" refusal the call probes the other
+// replicas under the shared Backoff policy, redialing dead pools when
+// their socket paths are known (DialReplicaSet), and sticks to
+// whichever replica accepted the write (a follower promoted via
+// SIGUSR2/admin RPC).  Reads keep their follower round-robin; the lag
+// fallback follows the active writer, not the configured leader.
 type ReplicaSet struct {
+	mu        sync.Mutex
 	leader    *Pool
 	followers []*Pool
-	rr        atomic.Uint64
+	// dial info for failover redials; empty when built from NewReplicaSet
+	leaderSocket    string
+	followerSockets []string
+	size            int
+	// active writer: -1 = the configured leader, >=0 = follower index
+	active  int
+	backoff Backoff
+	rr      atomic.Uint64
 }
 
 // DialReplicaSet connects a pool of size conns to the leader socket
@@ -169,7 +204,14 @@ func DialReplicaSet(leaderSocket string, followerSockets []string, size int) (*R
 	if err != nil {
 		return nil, fmt.Errorf("replica set leader dial: %w", err)
 	}
-	rs := &ReplicaSet{leader: leader}
+	rs := &ReplicaSet{
+		leader:          leader,
+		leaderSocket:    leaderSocket,
+		followerSockets: append([]string(nil), followerSockets...),
+		size:            size,
+		active:          -1,
+		backoff:         DefaultBackoff(),
+	}
 	for i, path := range followerSockets {
 		p, err := DialPool(path, size)
 		if err != nil {
@@ -183,12 +225,105 @@ func DialReplicaSet(leaderSocket string, followerSockets []string, size int) (*R
 
 // NewReplicaSet wraps pre-built pools (test seam; mirrors NewPool).
 // The leader is required; zero followers degrades every call to the
-// leader, which is exactly the single-daemon deployment.
+// leader, which is exactly the single-daemon deployment.  Built this
+// way the set has no socket paths, so failover probes the existing
+// pools but cannot redial a dead one.
 func NewReplicaSet(leader *Pool, followers ...*Pool) *ReplicaSet {
 	if leader == nil {
 		panic("scorerclient: NewReplicaSet requires a leader pool")
 	}
-	return &ReplicaSet{leader: leader, followers: followers}
+	return &ReplicaSet{
+		leader:    leader,
+		followers: followers,
+		active:    -1,
+		backoff:   DefaultBackoff(),
+	}
+}
+
+// SetBackoff overrides the failover retry policy (test seam / tuning).
+func (r *ReplicaSet) SetBackoff(b Backoff) { r.backoff = b }
+
+// ActiveWriter reports which replica currently holds the writer role:
+// -1 = the configured leader, >=0 = that follower index (promoted).
+func (r *ReplicaSet) ActiveWriter() int {
+	r.mu.Lock()
+	defer r.mu.Unlock()
+	return r.active
+}
+
+// pools returns (index, pool) candidates in probe order: the active
+// writer first, then the configured leader, then each follower.
+func (r *ReplicaSet) probeOrder() []int {
+	r.mu.Lock()
+	defer r.mu.Unlock()
+	order := make([]int, 0, len(r.followers)+1)
+	order = append(order, r.active)
+	if r.active != -1 {
+		order = append(order, -1)
+	}
+	for i := range r.followers {
+		if i != r.active {
+			order = append(order, i)
+		}
+	}
+	return order
+}
+
+func (r *ReplicaSet) poolAt(idx int) *Pool {
+	r.mu.Lock()
+	defer r.mu.Unlock()
+	if idx < 0 {
+		return r.leader
+	}
+	if idx < len(r.followers) {
+		return r.followers[idx]
+	}
+	return nil
+}
+
+func (r *ReplicaSet) socketAt(idx int) string {
+	if idx < 0 {
+		return r.leaderSocket
+	}
+	if idx < len(r.followerSockets) {
+		return r.followerSockets[idx]
+	}
+	return ""
+}
+
+// redial replaces a transport-dead pool with a fresh dial when the
+// socket path is known (DialReplicaSet); best-effort — a failed redial
+// leaves the old pool for the next pass.
+func (r *ReplicaSet) redial(idx int) {
+	path := r.socketAt(idx)
+	if path == "" {
+		return
+	}
+	size := r.size
+	if size < 1 {
+		size = DefaultPoolSize
+	}
+	fresh, err := DialPool(path, size)
+	if err != nil {
+		return
+	}
+	r.mu.Lock()
+	var old *Pool
+	if idx < 0 {
+		old, r.leader = r.leader, fresh
+	} else if idx < len(r.followers) {
+		old, r.followers[idx] = r.followers[idx], fresh
+	}
+	r.mu.Unlock()
+	if old != nil {
+		old.Close()
+	}
+}
+
+func (r *ReplicaSet) setActive(idx int) {
+	r.mu.Lock()
+	r.active = idx
+	r.mu.Unlock()
 }
 
 // Followers reports the follower pool count.
@@ -205,47 +340,101 @@ func (r *ReplicaSet) Close() error {
 	return first
 }
 
-// Sync ships the snapshot to the LEADER and fans the acknowledged
-// SnapshotID out to every pool — leader and followers — so a Score on
-// any replica names the snapshot this Sync certified (the follower
-// serves it as soon as the replication frame lands; until then it
-// answers "not resident" and ScoreFlat falls back to the leader).
+// Sync ships the snapshot to the ACTIVE WRITER and fans the
+// acknowledged SnapshotID out to every pool — leader and followers —
+// so a Score on any replica names the snapshot this Sync certified
+// (the follower serves it as soon as the replication frame lands;
+// until then it answers "not resident" and ScoreFlat falls back).
+//
+// Failover: a transport error or "one writer" refusal probes the
+// other replicas under the Backoff policy.  The daemon's delta-
+// continuity machinery stays the guard against an ambiguous apply —
+// a retried delta that DID land bumps the generation twice, fails the
+// caller's continuity check on the next ack, and resolves with one
+// full re-sync; never a silent double-apply.
 func (r *ReplicaSet) Sync(req *SyncRequest) (*SyncReply, error) {
-	reply, err := r.leader.Sync(req)
-	if err != nil {
-		return nil, err
+	deadline := time.Now().Add(r.backoff.Deadline)
+	var last error
+	for attempt := 0; ; attempt++ {
+		for _, idx := range r.probeOrder() {
+			p := r.poolAt(idx)
+			if p == nil {
+				continue
+			}
+			reply, err := p.Sync(req)
+			if err == nil {
+				r.setActive(idx)
+				r.fanOutID(reply.SnapshotID)
+				return reply, nil
+			}
+			last = err
+			if IsNotLeader(err) {
+				continue // a probe answer: the writer is elsewhere
+			}
+			if isTransport(err) {
+				r.redial(idx)
+				continue
+			}
+			return nil, err // the server's decision; surface it
+		}
+		d := r.backoff.Delay(attempt)
+		if time.Now().Add(d).After(deadline) {
+			return nil, last
+		}
+		time.Sleep(d)
 	}
-	for _, p := range r.followers {
-		p.SetSnapshotID(reply.SnapshotID)
+}
+
+// fanOutID pins an acknowledged id on every pool.
+func (r *ReplicaSet) fanOutID(id string) {
+	r.mu.Lock()
+	pools := append([]*Pool{r.leader}, r.followers...)
+	r.mu.Unlock()
+	for _, p := range pools {
+		p.SetSnapshotID(id)
 	}
-	return reply, nil
 }
 
 // next picks the follower pool for this call round-robin.
 func (r *ReplicaSet) next() *Pool {
+	r.mu.Lock()
+	defer r.mu.Unlock()
 	return r.followers[r.rr.Add(1)%uint64(len(r.followers))]
 }
 
+// writerPool is the pool currently holding the writer role.
+func (r *ReplicaSet) writerPool() *Pool {
+	r.mu.Lock()
+	defer r.mu.Unlock()
+	if r.active >= 0 && r.active < len(r.followers) {
+		return r.followers[r.active]
+	}
+	return r.leader
+}
+
 // ScoreFlat runs on the next follower round-robin; a follower still
-// catching up (stale-snapshot rejection) falls back to the leader for
-// this one call.  With no followers the leader serves directly.
+// catching up (stale-snapshot rejection) falls back to the ACTIVE
+// WRITER for this one call.  With no followers the leader serves
+// directly.
 func (r *ReplicaSet) ScoreFlat(topK int64) (*ScoreReply, error) {
 	if len(r.followers) == 0 {
 		return r.leader.ScoreFlat(topK)
 	}
 	reply, err := r.next().ScoreFlat(topK)
 	if err != nil && isStaleSnapshot(err) {
-		return r.leader.ScoreFlat(topK)
+		return r.writerPool().ScoreFlat(topK)
 	}
 	return reply, err
 }
 
-// Assign runs the full cycle on the LEADER: placement is the write-
-// adjacent half of the scheduler loop, and the leader's snapshot is
-// by definition never behind.
-func (r *ReplicaSet) Assign() (*AssignReply, error) { return r.leader.Assign() }
+// Assign runs the full cycle on the ACTIVE WRITER: placement is the
+// write-adjacent half of the scheduler loop, and the writer's
+// snapshot is by definition never behind.
+func (r *ReplicaSet) Assign() (*AssignReply, error) {
+	return r.writerPool().Assign()
+}
 
-// AssignCycle runs on the leader under an explicit correlation id.
+// AssignCycle runs on the active writer under an explicit correlation id.
 func (r *ReplicaSet) AssignCycle(cycleID string) (*AssignReply, error) {
-	return r.leader.AssignCycle(cycleID)
+	return r.writerPool().AssignCycle(cycleID)
 }

@@ -170,3 +170,50 @@ func TestPoolSetSnapshotIDFansToEverySlot(t *testing.T) {
 		}
 	}
 }
+
+func TestIsNotLeaderAndTransportClassifiers(t *testing.T) {
+	if !IsNotLeader(errors.New("scorer error: replica follower does not accept Sync: the tier has one writer")) {
+		t.Fatal("follower refusal must classify as not-leader")
+	}
+	if IsNotLeader(errors.New("scorer error: snapshot 's1-2' is not resident")) {
+		t.Fatal("stale snapshot must NOT classify as not-leader")
+	}
+	if isTransport(errors.New("scorer error: anything the server decided")) {
+		t.Fatal("a server error frame is not a transport failure")
+	}
+	if !isTransport(errors.New("write unix ->/x.sock: broken pipe")) {
+		t.Fatal("a dead socket is a transport failure")
+	}
+}
+
+func TestBackoffDelayLadder(t *testing.T) {
+	b := DefaultBackoff()
+	b.Jitter = 0 // deterministic for the ladder assertions
+	if b.Delay(0) != b.Base {
+		t.Fatalf("attempt 0 delay %v, want base %v", b.Delay(0), b.Base)
+	}
+	if b.Delay(1) != 2*b.Base {
+		t.Fatalf("attempt 1 delay %v, want 2x base", b.Delay(1))
+	}
+	if d := b.Delay(1000); d != b.Cap {
+		t.Fatalf("deep attempt delay %v must clamp to cap %v", d, b.Cap)
+	}
+	b.Jitter = 0.5
+	for i := 0; i < 32; i++ {
+		d := b.Delay(3)
+		if d > 8*b.Base || d < 4*b.Base {
+			t.Fatalf("jittered delay %v outside [half, full] of %v", d, 8*b.Base)
+		}
+	}
+}
+
+func TestReplicaSetActiveWriterDefaultsToLeader(t *testing.T) {
+	leader := NewPool(NewClient(nil))
+	rs := NewReplicaSet(leader)
+	if rs.ActiveWriter() != -1 {
+		t.Fatalf("fresh set active writer = %d, want -1 (the leader)", rs.ActiveWriter())
+	}
+	if rs.writerPool() != leader {
+		t.Fatal("writerPool must be the leader before any failover")
+	}
+}

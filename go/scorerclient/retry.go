@@ -1,0 +1,71 @@
+package scorerclient
+
+// Shared retry policy (ISSUE 11) — the Go twin of
+// koordinator_tpu/replication/retry.py BackoffPolicy.  Every
+// reconnect/failover loop in the Go client retries through this
+// policy instead of hand-rolling fixed sleeps: jitter de-phases the
+// herd a leader restart wakes, the exponential ladder caps what a
+// dead peer costs, and the deadline budget turns an outage into a
+// bounded error instead of a hang.
+
+import (
+	"math/rand"
+	"time"
+)
+
+// Backoff is a jittered exponential backoff under a total deadline
+// budget.  The zero value is NOT usable; take DefaultBackoff() and
+// override fields.
+type Backoff struct {
+	// Base is the first retry's delay (doubling per attempt).
+	Base time.Duration
+	// Cap bounds any single delay.
+	Cap time.Duration
+	// Deadline bounds the TOTAL time spent across all retries of one
+	// logical call; 0 means "one attempt, no retries".
+	Deadline time.Duration
+	// Multiplier grows the delay per attempt (default 2).
+	Multiplier float64
+	// Jitter is the fraction of each delay randomized away (0..1).
+	Jitter float64
+}
+
+// DefaultBackoff mirrors the Python policy's defaults (25 ms base,
+// 2 s cap, 15 s budget, x2, 50% jitter).
+func DefaultBackoff() Backoff {
+	return Backoff{
+		Base:       25 * time.Millisecond,
+		Cap:        2 * time.Second,
+		Deadline:   15 * time.Second,
+		Multiplier: 2.0,
+		Jitter:     0.5,
+	}
+}
+
+// Delay returns the jittered delay before retry attempt (0-based).
+func (b Backoff) Delay(attempt int) time.Duration {
+	mult := b.Multiplier
+	if mult <= 1 {
+		mult = 2.0
+	}
+	d := float64(b.Base)
+	for i := 0; i < attempt; i++ {
+		d *= mult
+		if time.Duration(d) >= b.Cap {
+			d = float64(b.Cap)
+			break
+		}
+	}
+	if time.Duration(d) > b.Cap {
+		d = float64(b.Cap)
+	}
+	j := b.Jitter
+	if j < 0 {
+		j = 0
+	}
+	if j > 1 {
+		j = 1
+	}
+	d *= 1.0 - j*rand.Float64()
+	return time.Duration(d)
+}

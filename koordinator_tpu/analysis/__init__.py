@@ -33,6 +33,12 @@ Rules (each suppressible per line with ``# koordlint: disable=<rule>``):
 * ``broad-except``      — ``except Exception:`` handlers must re-raise,
   log, or surface the bound error; silent swallowers need a reasoned
   ``# koordlint: disable=broad-except(<reason>)`` tag.
+* ``bare-retry``        — a ``while``/``for`` retry loop (one that
+  contains an ``except``) sleeping a FIXED ``time.sleep(<literal>)``
+  cadence: no jitter (thundering herd on recovery), no exponential
+  cap, no deadline budget.  Retries pace through the one shared
+  ``replication.retry.BackoffPolicy``; deliberate fixed-cadence polls
+  take a reasoned disable tag.
 * ``wire-contract``     — statically diffs scorer.proto (the layout
   bridge/codegen.py's emitted ``scorer_pb2`` is generated from) against
   the hand-rolled Go codec in go/scorerclient/wire.go + delta.go:
@@ -61,5 +67,6 @@ RULES = (
     "broad-except",
     "span-leak",
     "lock-held-dispatch",
+    "bare-retry",
     "wire-contract",
 )

@@ -120,6 +120,7 @@ def run_rules_on_source(
     """Run the AST rules over one file's source text (the unit-test seam:
     seeded-regression fixtures feed synthetic sources through here)."""
     from koordinator_tpu.analysis import (
+        bareretry,
         donation,
         excepts,
         hostsync,
@@ -147,6 +148,7 @@ def run_rules_on_source(
         "broad-except": excepts.check,
         "span-leak": spanleak.check,
         "lock-held-dispatch": lockdispatch.check,
+        "bare-retry": bareretry.check,
     }
     for rule, fn in table.items():
         if rules is not None and rule not in rules:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -18,6 +19,34 @@ import grpc
 
 from koordinator_tpu.bridge.codegen import method_path, pb2
 from koordinator_tpu.bridge.state import numpy_to_tensor
+from koordinator_tpu.replication.retry import BackoffPolicy
+
+# channel-level failures: the RPC may or may not have reached the
+# server, but the CLIENT state is intact — retryable through the shared
+# backoff policy, and NEVER a reason to null the delta baseline (the
+# generation-continuity check catches an ambiguous apply on the next
+# acked Sync; ISSUE 11)
+_TRANSIENT_CODES = (
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+)
+
+
+def _is_transient(exc: BaseException) -> bool:
+    return (
+        isinstance(exc, grpc.RpcError)
+        and exc.code() in _TRANSIENT_CODES
+    )
+
+
+def _is_not_leader(exc: BaseException) -> bool:
+    """The follower's Sync refusal (replication/follower.py): a probe
+    result, not an error — the promoted leader is elsewhere."""
+    return (
+        isinstance(exc, grpc.RpcError)
+        and exc.code() == grpc.StatusCode.FAILED_PRECONDITION
+        and "one writer" in (exc.details() or "")
+    )
 
 
 def parse_snapshot_id(snapshot_id: str) -> Tuple[str, int]:
@@ -66,7 +95,8 @@ class _ChannelPool:
 
 class ScorerClient:
     def __init__(self, target: str, channels: int = 1,
-                 followers: Sequence[str] = ()):
+                 followers: Sequence[str] = (),
+                 retry_policy: Optional[BackoffPolicy] = None):
         """``target``: "unix:///path.sock" or host:port.
 
         ``channels``: size of the connection pool Score/Assign calls
@@ -86,9 +116,23 @@ class ScorerClient:
         here yet", not "your baseline is wrong") falls back to the
         leader for that one call — replication lag degrades to leader
         reads, never to a failed cycle or a spurious full re-sync.
-        Assign stays on the leader, whose snapshot is never behind."""
+        Assign stays on the leader, whose snapshot is never behind.
+
+        ``retry_policy`` (ISSUE 11): the shared jittered-exponential
+        backoff/deadline budget (``replication.retry.BackoffPolicy``;
+        default from the ``KOORD_RETRY_*`` envs) that paces every
+        channel-level retry.  Transient UDS/channel errors
+        (``UNAVAILABLE``/``DEADLINE_EXCEEDED``) retry WITHOUT touching
+        the delta baseline — the generation-continuity check on the
+        next acked reply is what guards an ambiguous apply, so a
+        replayed delta can never silently double-apply — and when
+        ``followers`` are configured the Sync/Assign retries PROBE
+        them for a promoted leader (a follower's "one writer" refusal
+        means "not me, keep looking"), so a SIGUSR2/admin-RPC
+        promotion fails over without reconfiguring the client."""
         self._pool = _ChannelPool(target, channels)
         self._channel = self._pool.channels[0]  # Sync's pinned channel
+        self._retry = retry_policy or BackoffPolicy.from_env()
 
         def unary(channel, method, reply_cls):
             return channel.unary_unary(
@@ -112,6 +156,19 @@ class ScorerClient:
             unary(p.channels[0], "Score", pb2.ScoreReply)
             for p in self._follower_pools
         ]
+        self._follower_syncs = [
+            unary(p.channels[0], "Sync", pb2.SyncReply)
+            for p in self._follower_pools
+        ]
+        self._follower_assigns = [
+            unary(p.channels[0], "Assign", pb2.AssignReply)
+            for p in self._follower_pools
+        ]
+        # which target currently holds the writer role: -1 = the
+        # configured leader; 0..N-1 = follower i, promoted (discovered
+        # by the Sync probe's failover).  Writes move with it; Score
+        # keeps its follower round-robin either way.
+        self._leader_idx = -1
         self._rr = itertools.count()
         self._rr_lock = threading.Lock()
         # previous-ACKED-sync mirrors (tensor + scalar columns) for delta
@@ -139,6 +196,51 @@ class ScorerClient:
         with self._rr_lock:
             return next(self._rr) % len(self._scores)
 
+    # -- writer routing + failover (ISSUE 11) --
+    def _writer_stubs(self, kind: str):
+        """``(idx, stub)`` probe order for a write-side RPC: the target
+        last seen holding the writer role first, then every other
+        candidate (the configured leader, then each follower) — a
+        probe pass visits the whole tier once."""
+        leader_stub = (
+            self._sync if kind == "sync"
+            else self._assigns[self._slot()]
+        )
+        table = [(-1, leader_stub)] + list(enumerate(
+            self._follower_syncs if kind == "sync"
+            else self._follower_assigns
+        ))
+        active = self._leader_idx
+        table.sort(key=lambda e: 0 if e[0] == active else 1)
+        return table
+
+    def _call_writer(self, kind: str, request):
+        """Invoke a writer-side RPC (Sync/Assign) against the active
+        leader, failing over through the shared backoff policy:
+        transient channel errors retry, "one writer" refusals probe
+        the next candidate, anything else surfaces immediately (it is
+        the SERVER's answer, and the caller's protocol logic — e.g.
+        sync()'s full-resend fallback — owns it).  The delta baseline
+        is never touched here: an ambiguous apply is caught by the
+        continuity check on the next acked reply."""
+        delays = self._retry.delays()
+        while True:
+            last: Optional[BaseException] = None
+            for idx, stub in self._writer_stubs(kind):
+                try:
+                    reply = stub(request)
+                    self._leader_idx = idx
+                    return reply
+                except grpc.RpcError as exc:
+                    if _is_not_leader(exc) or _is_transient(exc):
+                        last = exc
+                        continue
+                    raise
+            d_ms = next(delays, None)
+            if d_ms is None:
+                raise last
+            time.sleep(d_ms / 1000.0)
+
     def _score_stub(self):
         """Score's routing: round-robin over the follower replicas when
         configured, else over the leader's own channel pool.  Returns
@@ -149,19 +251,46 @@ class ScorerClient:
             return self._follower_scores[i], True
         return self._scores[self._slot()], False
 
+    def _leader_score_stub(self):
+        """The active writer's Score stub — the lag-fallback target
+        (after a promotion the configured leader may be DEAD; the
+        fallback must follow the role, not the config)."""
+        if 0 <= self._leader_idx < len(self._follower_scores):
+            return self._follower_scores[self._leader_idx]
+        return self._scores[self._slot()]
+
     def _call_score(self, request):
-        stub, on_follower = self._score_stub()
-        if on_follower:
+        """Reads retry FREELY (ISSUE 11): they are idempotent against a
+        named snapshot, so a transient channel error just moves to the
+        next replica under the shared backoff budget."""
+        delays = self._retry.delays()
+        while True:
+            stub, on_follower = self._score_stub()
+            if on_follower:
+                try:
+                    return stub(request)
+                except grpc.RpcError as e:
+                    if _is_transient(e):
+                        d_ms = next(delays, None)
+                        if d_ms is None:
+                            raise
+                        time.sleep(d_ms / 1000.0)
+                        continue  # next replica round-robin
+                    if e.code() != grpc.StatusCode.FAILED_PRECONDITION:
+                        raise
+                    # the follower has not applied this generation yet
+                    # (replication lag) — the LEADER certified the id,
+                    # so the baseline is fine: serve this call there
+                    # instead of invalidating anything
             try:
-                return stub(request)
+                return self._call(self._leader_score_stub(), request)
             except grpc.RpcError as e:
-                if e.code() != grpc.StatusCode.FAILED_PRECONDITION:
+                if not _is_transient(e):
                     raise
-                # the follower has not applied this generation yet
-                # (replication lag) — the LEADER certified the id, so
-                # the baseline is fine: serve this call there instead
-                # of invalidating anything
-        return self._call(self._scores[self._slot()], request)
+                d_ms = next(delays, None)
+                if d_ms is None:
+                    raise
+                time.sleep(d_ms / 1000.0)
 
     def _invalidate(self) -> None:
         with self._baseline_lock:
@@ -280,8 +409,17 @@ class ScorerClient:
             baseline = self._prev
             sent_full = False
             try:
-                reply = self._sync(build(baseline, full=False))
-            except grpc.RpcError:
+                reply = self._call_writer("sync", build(baseline, full=False))
+            except grpc.RpcError as exc:
+                if _is_transient(exc) or _is_not_leader(exc):
+                    # channel-level failure that outlived the whole
+                    # retry/probe budget: the BASELINE IS KEPT (ISSUE
+                    # 11 satellite) — nothing verifiably applied, so
+                    # nulling _generation here would silently force a
+                    # full resync on every transient blip; the next
+                    # sync retries the delta and the continuity check
+                    # below guards the ambiguous-apply case
+                    raise
                 if not baseline:
                     # nothing was delta-encoded; the failure is not
                     # recoverable by resending full state
@@ -291,7 +429,9 @@ class ScorerClient:
                 # the delta frame — recoverable within the same cycle with
                 # one full re-sync (ADVICE r5); a second failure is surfaced
                 try:
-                    reply = self._sync(build(baseline, full=True))
+                    reply = self._call_writer(
+                        "sync", build(baseline, full=True)
+                    )
                     sent_full = True
                 except grpc.RpcError:
                     self._invalidate()
@@ -307,7 +447,9 @@ class ScorerClient:
                 # full tensors — from the pre-clear baseline, so fields
                 # omitted this cycle still resend their last acked state.
                 try:
-                    reply = self._sync(build(baseline, full=True))
+                    reply = self._call_writer(
+                        "sync", build(baseline, full=True)
+                    )
                 except grpc.RpcError:
                     # the server may have applied the full sync before
                     # failing; treat the baseline as unknown
@@ -375,10 +517,24 @@ class ScorerClient:
         device program that ran ("pallas"/"scan"/"shard") so callers can
         alarm on a degraded-path cycle instead of discovering it in a
         latency graph."""
-        reply = self._call(
-            self._assigns[self._slot()],
-            pb2.AssignRequest(snapshot_id=self.snapshot_id or ""),
-        )
+        try:
+            reply = self._call_writer(
+                "assign",
+                pb2.AssignRequest(snapshot_id=self.snapshot_id or ""),
+            )
+        except grpc.RpcError as e:
+            # displaced snapshot (stale-id FAILED_PRECONDITION): the
+            # baseline is gone — next sync ships full state.  The
+            # "one writer" flavor CAN escape the probe when no replica
+            # accepts writes inside the retry budget (leader dead,
+            # nothing promoted yet) — that baseline is fine and must
+            # survive, like the sync() transient path.
+            if (
+                e.code() == grpc.StatusCode.FAILED_PRECONDITION
+                and not _is_not_leader(e)
+            ):
+                self._invalidate()
+            raise
         return (
             np.asarray(reply.assignment, np.int32),
             np.asarray(reply.status, np.int32),

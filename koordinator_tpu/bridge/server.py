@@ -254,6 +254,11 @@ class ScorerServicer:
         # to stream every committed Sync to the follower tier; called
         # under _sync_lock, so frames publish in generation order
         self.replication_hook = None
+        # durability seam (ISSUE 11): the frame journal sets this to
+        # append every committed frame's encoded bytes under
+        # --state-dir.  Called BEFORE replication_hook (durability
+        # first, then fan-out), same _sync_lock ordering guarantee.
+        self.journal_hook = None
         self.dispatch = CoalescingDispatcher(
             self._score_launch_batch,
             max_batch=coalesce_max_batch,
@@ -270,6 +275,29 @@ class ScorerServicer:
 
     def snapshot_id(self) -> str:
         return f"s{self._epoch}-{self._generation}"
+
+    def rebase_epoch(self, epoch: Optional[str] = None) -> str:
+        """Mint a fresh epoch while KEEPING the generation (ISSUE 11).
+        Used when journal recovery truncated a torn/corrupt tail:
+        the truncated frames may already have been published, so
+        resuming the identical ``s<epoch>-<gen>`` chain could hand a
+        follower/client generation numbers it already holds with
+        different content — the one fork the epoch fence cannot see.
+        A fresh epoch turns that into the ordinary fenced one-shot
+        full resync.  The memos die with the old chain."""
+        with self._sync_lock:
+            with self._state_lock:
+                return self._rebase_epoch_locked(epoch)
+
+    def _rebase_epoch_locked(self, epoch: Optional[str] = None) -> str:
+        """The bump itself (``_sync_lock`` + ``_state_lock`` held) —
+        shared with FollowerServicer.promote, which composes it with
+        its own promoted flag under one lock hold."""
+        self._epoch = epoch or uuid.uuid4().hex[:8]
+        self._assign_memo.clear()
+        if self._score_memo is not None:
+            self._score_memo.invalidate()
+        return self.snapshot_id()
 
     def _stale_snapshot(
         self, want: str, sid: Optional[str] = None
@@ -397,6 +425,17 @@ class ScorerServicer:
             # has the decoded message (gRPC) passes None and the
             # publisher re-serializes, which is byte-identical (same
             # runtime both ends).
+            jhook = self.journal_hook
+            if jhook is not None:
+                try:
+                    jhook(req, reply.snapshot_id, wire_bytes)
+                except Exception:  # koordlint: disable=broad-except(the Sync IS committed in memory — a full disk must degrade durability, not fail the acked write; the journal logs and counts the miss)
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "journal append failed for %s",
+                        reply.snapshot_id,
+                    )
             hook = self.replication_hook
             if hook is not None:
                 try:

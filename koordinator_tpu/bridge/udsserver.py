@@ -35,8 +35,15 @@ logger = logging.getLogger(__name__)
 METHOD_SYNC = 1
 METHOD_SCORE = 2
 METHOD_ASSIGN = 3
+# admin plane (ISSUE 11): method 4 = Promote — no protobuf body either
+# way; the reply payload is the promoted daemon's new snapshot id
+# (UTF-8), or an error frame when this daemon has no promote handler
+# (a leader, or a follower daemon started without the seam wired).
+# Registered through RawUdsServer(admin_handlers=...), never the
+# servicer method table, so the scorer wire contract is untouched.
+METHOD_PROMOTE = 4
 _METHOD_NAMES = {METHOD_SYNC: "sync", METHOD_SCORE: "score",
-                 METHOD_ASSIGN: "assign"}
+                 METHOD_ASSIGN: "assign", METHOD_PROMOTE: "promote"}
 
 # Sized to the largest realistic SyncRequest (10k pods x 2k nodes of i64
 # request/capacity vectors serializes to a few MB); anything larger is a
@@ -81,9 +88,16 @@ class RawUdsServer:
         servicer: Optional[ScorerServicer] = None,
         cfg: CycleConfig = DEFAULT_CYCLE_CONFIG,
         mesh=None,
+        admin_handlers=None,
     ):
+        """``admin_handlers``: optional ``{method_byte: fn}`` map of
+        admin-plane methods (``fn(payload: bytes) -> bytes``; raise to
+        answer an error frame).  The daemon wires METHOD_PROMOTE here
+        (scheduler/server.py) — admin methods never touch the protobuf
+        wire contract."""
         self.path = path
         self.servicer = servicer or ScorerServicer(cfg, mesh=mesh)
+        self.admin_handlers = dict(admin_handlers or {})
         if os.path.exists(path):
             os.unlink(path)
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -216,6 +230,18 @@ class RawUdsServer:
                         f"a method-{method} payload",
                     )
                     return
+                admin = self.admin_handlers.get(method)
+                if admin is not None:
+                    metrics = self._metrics()
+                    if metrics is not None and method in _METHOD_NAMES:
+                        metrics.count_uds_frame(_METHOD_NAMES[method])
+                    try:
+                        self._reply(conn, 0, admin(payload))
+                    except Exception as exc:  # surfaced to the caller, not lost
+                        if metrics is not None:
+                            metrics.count_uds_error()
+                        self._reply(conn, 1, str(exc).encode())
+                    continue
                 entry = self._methods.get(method)
                 if entry is None:
                     self._count_malformed(

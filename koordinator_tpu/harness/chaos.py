@@ -1,0 +1,448 @@
+"""Chaos fault-injection harness for the serving tier (ISSUE 11).
+
+Composable faults against the replication/journal machinery, with the
+invariant checkers that make a chaos run a TEST instead of a demo.
+Runnable from pytest (tests/test_chaos.py drives the acceptance run)
+and from ``bench.py --config failover`` (which adds the real
+subprocess SIGKILL on top of the in-process faults here).
+
+Faults (compose freely through :class:`FaultPlan` probabilities plus
+the explicit methods):
+
+* **drop / duplicate / reorder** replication frames
+  (:class:`ChaosChannel` — the PR-8 fuzz channel, promoted to a shared
+  home);
+* **corrupt / truncate** frame BYTES (the follower must classify every
+  mutation as a discontinuity, never apply it);
+* **SIGKILL the leader** (:meth:`ChaosTier.crash_leader` drops the
+  leader object with no cleanup — the in-process equivalent of
+  ``kill -9``; the journal file keeps only what reached the OS) and
+  **warm-restart** it from the journal, or **promote a follower**;
+* **stall a follower** (frames buffer; delivered late, they must apply
+  or drop as stale — never double-apply);
+* **fail a device launch mid-batch** (:func:`fail_next_launch` poisons
+  the dispatcher's next launch, exercising the error routing under
+  faulted serving);
+* **truncate the journal tail** (:meth:`ChaosTier.damage_journal` —
+  the torn-write crash shape).
+
+Invariants (raise AssertionError with the failing detail):
+
+* **byte parity vs an unfaulted oracle** — leader mirrors and
+  flat-Score reply bytes equal the oracle's after every converged
+  step, and every caught-up follower equals the leader;
+* **zero torn snapshots** — a frame that did not APPLY leaves the
+  follower's observable state byte-identical to before the offer
+  (checked on every delivery, not just at the end);
+* **zero warm-path retraces** — ``retrace_guard`` holds the post-
+  recovery warm stream at zero jit cache misses;
+* **bounded recovery** — crash→serving wall time under a caller-set
+  budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from koordinator_tpu.bridge.codegen import pb2
+from koordinator_tpu.bridge.server import ScorerServicer
+from koordinator_tpu.replication import codec
+from koordinator_tpu.replication.follower import (
+    APPLIED,
+    RESYNC,
+    FollowerServicer,
+    ReplicaApplier,
+)
+from koordinator_tpu.replication.journal import FrameJournal
+
+# mirror keys asserted byte-identical between replicas (the PR-8 parity
+# surface, shared here so the chaos tests and test_replication.py can
+# never drift on what "parity" means)
+from koordinator_tpu.bridge import state as _bridge_state
+
+MIRROR_KEYS = _bridge_state._DELTA_TENSORS + (
+    "node_fresh", "pod_priority", "pod_priority_class", "pod_gang",
+    "pod_quota", "gang_min",
+)
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """Per-frame fault probabilities for a :class:`ChaosChannel`."""
+
+    drop: float = 0.0
+    duplicate: float = 0.0
+    reorder: float = 0.0
+    corrupt: float = 0.0
+    truncate: float = 0.0
+
+
+class ChaosChannel:
+    """Lossy/reordering/corrupting transport between a leader's frame
+    stream and one follower.  Operates on encoded frame BYTES so
+    corruption and truncation hit the real wire surface."""
+
+    def __init__(self, rng, plan: FaultPlan):
+        self.rng = rng
+        self.plan = plan
+        self.delayed: List[bytes] = []
+        self.injected = {"drop": 0, "duplicate": 0, "reorder": 0,
+                         "corrupt": 0, "truncate": 0}
+
+    def _mutate(self, raw: bytes) -> bytes:
+        roll = self.rng.random()
+        if roll < self.plan.corrupt and len(raw) > codec.HEADER_LEN:
+            self.injected["corrupt"] += 1
+            i = int(self.rng.integers(0, len(raw)))
+            b = bytearray(raw)
+            b[i] ^= 0xFF
+            return bytes(b)
+        if roll < self.plan.corrupt + self.plan.truncate and len(raw) > 1:
+            self.injected["truncate"] += 1
+            return raw[: int(self.rng.integers(1, len(raw)))]
+        return raw
+
+    def send(self, raw: bytes) -> List[bytes]:
+        out: List[bytes] = []
+        roll = self.rng.random()
+        if roll < self.plan.drop:
+            self.injected["drop"] += 1
+        elif roll < self.plan.drop + self.plan.duplicate:
+            self.injected["duplicate"] += 1
+            out += [self._mutate(raw), self._mutate(raw)]
+        elif roll < self.plan.drop + self.plan.duplicate + self.plan.reorder:
+            self.injected["reorder"] += 1
+            self.delayed.append(raw)
+        else:
+            out.append(self._mutate(raw))
+        if self.delayed and self.rng.random() < 0.6:
+            out.append(self.delayed.pop(0))
+        return out
+
+    def flush(self) -> List[bytes]:
+        out, self.delayed = self.delayed, []
+        return out
+
+
+@contextmanager
+def fail_next_launch(servicer, n: int = 1,
+                     exc_factory=lambda: RuntimeError("chaos: injected device launch failure")):
+    """Poison the next ``n`` coalesced launches on ``servicer``: the
+    dispatcher's launch callable raises before touching the device.
+    The dispatcher must route the failure to the batch's callers and
+    keep serving afterwards — the fault a flaky device injects
+    mid-batch."""
+    dispatch = servicer.dispatch
+    real = dispatch._launch_batch
+    remaining = [int(n)]
+
+    def poisoned(batch):
+        if remaining[0] > 0:
+            remaining[0] -= 1
+            raise exc_factory()
+        return real(batch)
+
+    dispatch._launch_batch = poisoned
+    try:
+        yield
+    finally:
+        dispatch._launch_batch = real
+
+
+def flat_score_bytes(sv, sid: str, top_k: int = 8) -> bytes:
+    reply = sv.score(
+        pb2.ScoreRequest(snapshot_id=sid, top_k=top_k, flat=True)
+    )
+    return reply.flat.SerializeToString()
+
+
+def state_digest(sv) -> str:
+    """Order-stable digest of every replicated mirror — the cheap
+    every-delivery torn-snapshot probe (flat_score_bytes is the
+    expensive reply-surface check run at checkpoints)."""
+    h = hashlib.sha256()
+    st = sv.state
+    for key in MIRROR_KEYS:
+        v = getattr(st, key)
+        h.update(key.encode())
+        if v is None:
+            h.update(b"\x00")
+        else:
+            a = np.asarray(v)
+            h.update(str(a.dtype).encode())
+            h.update(str(a.shape).encode())
+            h.update(a.tobytes())
+    h.update(repr(st.node_names).encode())
+    h.update(repr(st.pod_names).encode())
+    h.update(sv.snapshot_id().encode())
+    return h.hexdigest()
+
+
+def assert_mirror_parity(a_sv, b_sv, ids: bool = True) -> None:
+    if ids:
+        assert b_sv.snapshot_id() == a_sv.snapshot_id(), (
+            f"snapshot ids diverged: {a_sv.snapshot_id()} vs "
+            f"{b_sv.snapshot_id()}"
+        )
+    a, b = a_sv.state, b_sv.state
+    for key in MIRROR_KEYS:
+        va, vb = getattr(a, key), getattr(b, key)
+        if va is None or vb is None:
+            assert va is None and vb is None, f"{key}: {va!r} vs {vb!r}"
+        else:
+            va, vb = np.asarray(va), np.asarray(vb)
+            assert va.dtype == vb.dtype, key
+            assert np.array_equal(va, vb), f"mirror {key} diverged"
+    assert a.node_names == b.node_names
+    assert a.pod_names == b.pod_names
+    assert a.node_bucket == b.node_bucket
+    assert a.pod_bucket == b.pod_bucket
+
+
+class _Follower:
+    __slots__ = ("servicer", "applier", "channel", "stalled", "buffer")
+
+    def __init__(self, servicer, applier, channel):
+        self.servicer = servicer
+        self.applier = applier
+        self.channel = channel
+        self.stalled = False
+        self.buffer: List[bytes] = []
+
+
+class ChaosTier:
+    """One in-process serving tier under fault injection: a journaled
+    leader, N followers behind chaos channels, and an UNFAULTED oracle
+    replaying the same Sync stream.
+
+    The tier checks the no-torn-snapshot invariant on EVERY delivery:
+    an offer that does not return APPLIED must leave the follower's
+    state digest untouched.  ``converge()`` then brings every follower
+    to the leader (the documented one-shot full resync where needed)
+    and asserts full byte parity against leader and oracle.
+    """
+
+    def __init__(
+        self,
+        state_dir: str,
+        followers: int = 1,
+        plan: Optional[FaultPlan] = None,
+        seed: int = 0,
+        compact_every: int = 256,
+        servicer_kw: Optional[dict] = None,
+    ):
+        self.state_dir = state_dir
+        self.journal_path = os.path.join(state_dir, "journal.krj")
+        self.compact_every = compact_every
+        self.plan = plan or FaultPlan()
+        self.rng = np.random.default_rng(seed)
+        self.servicer_kw = dict(servicer_kw or {})
+        self.servicer_kw.setdefault("score_memo", False)
+        self.leader = ScorerServicer(**self.servicer_kw)
+        self.journal = FrameJournal(
+            self.journal_path, compact_every=compact_every
+        )
+        self.journal.recover(self.leader)
+        self.journal.attach(self.leader)
+        self._capture_frames(self.leader)
+        self.oracle = ScorerServicer(**self.servicer_kw)
+        self.followers: List[_Follower] = []
+        for _ in range(int(followers)):
+            sv = FollowerServicer(**self.servicer_kw)
+            self.followers.append(_Follower(
+                sv, ReplicaApplier(sv),
+                ChaosChannel(self.rng, self.plan),
+            ))
+        self.resyncs = 0
+        self.torn_checks = 0
+        self.stats: Dict[str, int] = {"syncs": 0, "delivered": 0}
+        for f in self.followers:
+            self._resync(f)
+
+    # -- leader plumbing --
+    def _capture_frames(self, leader) -> None:
+        from koordinator_tpu.bridge.client import parse_snapshot_id
+
+        self._frames: List[bytes] = []
+
+        def hook(req, snapshot_id, wire_bytes=None):
+            epoch, gen = parse_snapshot_id(snapshot_id)
+            payload = (
+                wire_bytes if wire_bytes is not None
+                else req.SerializeToString()
+            )
+            self._frames.append(codec.encode_frame(
+                codec.KIND_DELTA, epoch, gen,
+                int(time.time() * 1e6), payload,
+            ))
+
+        leader.replication_hook = hook
+
+    def full_frame_bytes(self) -> bytes:
+        epoch, gen, payload = self.leader.export_replication_snapshot()
+        return codec.encode_frame(
+            codec.KIND_FULL, epoch, gen, int(time.time() * 1e6), payload
+        )
+
+    # -- the write stream --
+    def sync(self, req: "pb2.SyncRequest", oracle: bool = True) -> str:
+        """One committed Sync on leader (+oracle), frames delivered to
+        every follower through its chaos channel."""
+        if oracle:
+            self.oracle.sync(pb2.SyncRequest.FromString(
+                req.SerializeToString()
+            ))
+        sid = self.leader.sync(req).snapshot_id
+        self.stats["syncs"] += 1
+        frame = self._frames[-1] if self._frames else None
+        for f in self.followers:
+            if frame is not None:
+                self._deliver(f, self.channel_out(f, frame))
+        return sid
+
+    def channel_out(self, f: _Follower, frame: bytes) -> List[bytes]:
+        out = f.channel.send(frame)
+        if f.stalled:
+            f.buffer.extend(out)
+            return []
+        return out
+
+    def _deliver(self, f: _Follower, raws: List[bytes]) -> None:
+        for raw in raws:
+            self.stats["delivered"] += 1
+            before = state_digest(f.servicer)
+            self.torn_checks += 1
+            try:
+                frame = codec.decode_frame(raw)
+            except codec.FrameError:
+                # the transport layer's contract: counted + resync
+                assert state_digest(f.servicer) == before, (
+                    "TORN SNAPSHOT: a malformed frame mutated state"
+                )
+                self._resync(f)
+                continue
+            result = f.applier.offer(frame)
+            after = state_digest(f.servicer)
+            if result == APPLIED:
+                continue
+            assert after == before, (
+                f"TORN SNAPSHOT: offer({result}) mutated follower state"
+            )
+            if result == RESYNC:
+                self._resync(f)
+
+    def _resync(self, f: _Follower) -> None:
+        self.resyncs += 1
+        assert f.applier.offer(
+            codec.decode_frame(self.full_frame_bytes())
+        ) == APPLIED
+
+    # -- explicit faults --
+    def stall_follower(self, i: int) -> None:
+        self.followers[i].stalled = True
+
+    def unstall_follower(self, i: int) -> None:
+        f = self.followers[i]
+        f.stalled = False
+        buffered, f.buffer = f.buffer, []
+        self._deliver(f, buffered)
+
+    def crash_leader(self) -> None:
+        """The in-process SIGKILL: no stop(), no flush, no close — the
+        object graph just dies.  Only what the journal already wrote
+        to the OS survives (FrameJournal flushes per append, exactly
+        the SIGKILL durability contract)."""
+        self.leader = None
+        self.journal = None
+
+    def restart_leader(self) -> dict:
+        """Warm-restart from the journal; returns the replay stats.
+        The restarted leader must resume the same s<epoch>-<gen> chain
+        the journal holds."""
+        assert self.leader is None, "crash_leader first"
+        t0 = time.perf_counter()
+        self.leader = ScorerServicer(**self.servicer_kw)
+        self.journal = FrameJournal(
+            self.journal_path, compact_every=self.compact_every
+        )
+        stats = self.journal.recover(self.leader)
+        self.journal.attach(self.leader)
+        self._capture_frames(self.leader)
+        stats["recovery_ms"] = (time.perf_counter() - t0) * 1000.0
+        # the subscription handshake's fallback, mirrored: a follower
+        # whose position the restarted leader cannot extend (a torn
+        # tail rewound the journal BEHIND the follower — the frames it
+        # already applied are gone from the chain) must full-resync,
+        # exactly what the leader answers a non-coverable hello with.
+        # Without this a rewound leader re-mints generation numbers
+        # the follower already holds with different content — the one
+        # fork the epoch fence cannot see.
+        from koordinator_tpu.bridge.client import parse_snapshot_id
+
+        l_epoch, l_gen = parse_snapshot_id(self.leader.snapshot_id())
+        for f in self.followers:
+            f_epoch, f_gen = f.applier.position()
+            if f_epoch != l_epoch or f_gen > l_gen:
+                self._resync(f)
+        return stats
+
+    def promote(self, i: int) -> str:
+        """Promote follower ``i`` to the writer role: it bumps its
+        epoch, opens its own journal (seeded with a full-state frame)
+        and takes over the frame stream; the old leader — typically
+        already crashed — is forgotten."""
+        f = self.followers.pop(i)
+        sid = f.servicer.promote()
+        self.leader = f.servicer
+        self.journal = FrameJournal(
+            self.journal_path + ".promoted",
+            compact_every=self.compact_every,
+        )
+        epoch, gen, payload = self.leader.export_replication_snapshot()
+        self.journal.write_base(epoch, gen, payload)
+        self.journal.attach(self.leader)
+        self._capture_frames(self.leader)
+        # surviving followers fence on the new epoch at their next
+        # frame; resync them through the documented one-shot path now
+        for other in self.followers:
+            self._resync(other)
+        return sid
+
+    def damage_journal(self, cut_bytes: int = 7) -> None:
+        """Tear the journal tail (the mid-append crash shape)."""
+        size = os.path.getsize(self.journal_path)
+        with open(self.journal_path, "r+b") as fh:
+            fh.truncate(max(0, size - cut_bytes))
+
+    # -- invariants --
+    def converge(self) -> None:
+        """Bring every follower to the leader's exact state (the
+        documented resync where the chain broke) and assert byte
+        parity: follower==leader mirrors + ids, leader==oracle mirrors
+        and flat-Score reply bytes."""
+        for f in self.followers:
+            if f.stalled:
+                continue
+            self._deliver(f, f.channel.flush())
+            if f.servicer.snapshot_id() != self.leader.snapshot_id():
+                self._resync(f)
+            assert_mirror_parity(self.leader, f.servicer)
+        assert_mirror_parity(self.oracle, self.leader, ids=False)
+        sid = self.leader.snapshot_id()
+        want = flat_score_bytes(self.oracle, self.oracle.snapshot_id())
+        assert flat_score_bytes(self.leader, sid) == want, (
+            "leader flat-Score bytes diverged from the unfaulted oracle"
+        )
+        for f in self.followers:
+            if f.stalled:
+                continue
+            assert flat_score_bytes(f.servicer, sid) == want, (
+                "follower flat-Score bytes diverged from the oracle"
+            )

@@ -38,6 +38,13 @@ koord_scorer_replica_frames_total      counter   result (applied|stale|resync|er
 koord_scorer_replica_lag_ms            gauge     —
 koord_scorer_replica_resyncs_total     counter   reason (gap|epoch|decode|apply|connect)
 koord_scorer_replica_followers         gauge     — (leader: live subscribers)
+koord_scorer_journal_frames_total      counter   op (append|replay|compact|truncate)
+koord_scorer_journal_append_us         histogram —
+koord_scorer_journal_position          gauge     — (last journaled generation)
+koord_scorer_journal_bytes             gauge     — (journal file size)
+koord_scorer_journal_compaction_stamp  gauge     — (us since epoch, last compaction)
+koord_scorer_failover_total            counter   event (promoted|warm_restart)
+koord_scorer_retry_total               counter   op (subscribe|resume)
 ====================================== ========= ==========================
 
 The ``koord_scorer_coalesce_*`` families observe the coalescing
@@ -114,6 +121,13 @@ REPLICA_FRAMES = "koord_scorer_replica_frames_total"
 REPLICA_LAG = "koord_scorer_replica_lag_ms"
 REPLICA_RESYNCS = "koord_scorer_replica_resyncs_total"
 REPLICA_FOLLOWERS = "koord_scorer_replica_followers"
+JOURNAL_FRAMES = "koord_scorer_journal_frames_total"
+JOURNAL_APPEND_US = "koord_scorer_journal_append_us"
+JOURNAL_POSITION = "koord_scorer_journal_position"
+JOURNAL_BYTES = "koord_scorer_journal_bytes"
+JOURNAL_COMPACTION_STAMP = "koord_scorer_journal_compaction_stamp"
+FAILOVER_TOTAL = "koord_scorer_failover_total"
+RETRY_TOTAL = "koord_scorer_retry_total"
 
 # occupancy is a count-of-requests-per-launch, not a latency: its own
 # power-of-two buckets (the dispatcher caps batches at 16 by default;
@@ -208,12 +222,45 @@ _FAMILIES = (
      "(gap|epoch|decode|apply|connect)"),
     (REPLICA_FOLLOWERS, "gauge",
      "live replication subscriptions on the leader"),
+    (JOURNAL_FRAMES, "counter",
+     "durable frame-journal operations (ISSUE 11): append wrote one "
+     "committed frame, replay applied one on boot, compact rewrote the "
+     "file as one full-state frame, truncate cut a torn/corrupt tail"),
+    (JOURNAL_APPEND_US, "histogram",
+     "wall time one journal append added to the Sync commit path "
+     "(encode + write + flush); the durability tax on the one writer"),
+    (JOURNAL_POSITION, "gauge",
+     "generation of the last journaled frame (the <gen> the journal "
+     "can recover to); must track koord_scorer_snapshot_generation"),
+    (JOURNAL_BYTES, "gauge",
+     "journal file size; sawtooths with --journal-compact-every"),
+    (JOURNAL_COMPACTION_STAMP, "gauge",
+     "wall clock (us since the unix epoch) of the last journal "
+     "compaction; a stale stamp under write load means compaction "
+     "is failing and the journal grows without bound"),
+    (FAILOVER_TOTAL, "counter",
+     "crash-tolerance transitions: promoted = this follower became "
+     "the leader (SIGUSR2/admin RPC), warm_restart = this daemon "
+     "resumed its s<epoch>-<gen> chain from the journal on boot"),
+    (RETRY_TOTAL, "counter",
+     "backed-off retries through the shared replication.retry policy, "
+     "by operation (subscribe = follower redial; resume = a "
+     "subscription served from the journal instead of a full frame)"),
+)
+
+# journal appends are MICROsecond-scale (a header pack + one buffered
+# write); the default ms latency buckets would collapse them into the
+# first bucket
+_JOURNAL_APPEND_BUCKETS = (
+    10.0, 50.0, 100.0, 500.0, 1_000.0, 5_000.0, 20_000.0, 100_000.0,
+    float("inf"),
 )
 
 # per-family bucket overrides (histograms default to DEFAULT_BUCKETS_MS)
 _BUCKET_OVERRIDES = {
     COALESCE_OCCUPANCY: _OCCUPANCY_BUCKETS,
     INCR_COLS: _INCR_COLS_BUCKETS,
+    JOURNAL_APPEND_US: _JOURNAL_APPEND_BUCKETS,
 }
 
 
@@ -355,3 +402,25 @@ class ScorerMetrics:
 
     def set_replica_followers(self, n: int) -> None:
         self.registry.gauge_set(REPLICA_FOLLOWERS, int(n))
+
+    # -- crash tolerance: journal / failover / retry (ISSUE 11) --
+    def count_journal(self, op: str, n: int = 1) -> None:
+        self.registry.counter_add(JOURNAL_FRAMES, int(n), {"op": op})
+
+    def observe_journal_append_us(self, us: float) -> None:
+        self.registry.histogram_observe(JOURNAL_APPEND_US, float(us))
+
+    def set_journal_position(self, generation: int) -> None:
+        self.registry.gauge_set(JOURNAL_POSITION, int(generation))
+
+    def set_journal_bytes(self, n: int) -> None:
+        self.registry.gauge_set(JOURNAL_BYTES, int(n))
+
+    def set_journal_compaction_stamp(self, stamp_us: int) -> None:
+        self.registry.gauge_set(JOURNAL_COMPACTION_STAMP, int(stamp_us))
+
+    def count_failover(self, event: str) -> None:
+        self.registry.counter_add(FAILOVER_TOTAL, 1, {"event": event})
+
+    def count_retry(self, op: str) -> None:
+        self.registry.counter_add(RETRY_TOTAL, 1, {"op": op})

@@ -23,15 +23,24 @@ Modules:
 * ``leader``     — ReplicationPublisher: per-follower bounded queues
   over a unix socket; the writer path never blocks on a reader.
 * ``follower``   — ReplicaApplier (continuity core) +
-  ReplicationSubscriber (reconnect = full resync) + FollowerServicer
-  (refuses client Syncs).
+  ReplicationSubscriber (reconnect = resync; sends the chain-position
+  hello) + FollowerServicer (refuses client Syncs until promoted) +
+  ``promote_replica`` (the raw-UDS admin call).
+* ``journal``    — FrameJournal (ISSUE 11): the durable, CRC'd,
+  compacting frame journal under ``--state-dir`` that makes the tier
+  crash-tolerant — replay-on-boot resumes the same ``s<epoch>-<gen>``
+  chain, and the publisher serves reconnecting followers just the
+  missing delta frames out of it.
+* ``retry``      — the ONE jittered-exponential-backoff/deadline-budget
+  policy every reconnect/failover loop retries through (koordlint's
+  ``bare-retry`` rule rejects hand-rolled fixed-sleep retry loops).
 
 ``leader``/``follower`` import the bridge server and are therefore NOT
 imported eagerly here (bridge/server.py imports ``admission`` — eager
 re-export would cycle); import them explicitly.
 
 docs/REPLICATION.md has the stream protocol, the fencing rules, the
-shed policy and a failover walkthrough.
+shed policy and the journal/promotion failover walkthrough.
 """
 
 from koordinator_tpu.replication.admission import (  # noqa: F401
@@ -43,6 +52,15 @@ from koordinator_tpu.replication.codec import (  # noqa: F401
     FrameError,
     KIND_DELTA,
     KIND_FULL,
+    KIND_HELLO,
     decode_frame,
     encode_frame,
+)
+from koordinator_tpu.replication.journal import (  # noqa: F401
+    FrameJournal,
+    JournalError,
+)
+from koordinator_tpu.replication.retry import (  # noqa: F401
+    BackoffPolicy,
+    call_with_retry,
 )

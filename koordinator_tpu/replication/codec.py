@@ -17,7 +17,9 @@ Frame (all integers big-endian, matching the raw-UDS scorer framing)::
     magic        u32   0x4B52504C ("KRPL")
     version      u8    1
     kind         u8    1 = delta (payload applies onto gen-1),
-                       2 = full  (payload replaces all resident state)
+                       2 = full  (payload replaces all resident state),
+                       3 = hello (follower->leader resume offer: the
+                           follower's chain position, empty payload)
     epoch        8s    the leader's per-boot epoch (8 hex chars — the
                        <epoch> of "s<epoch>-<gen>" snapshot ids)
     generation   u64   generation AFTER applying the payload
@@ -49,6 +51,15 @@ MAGIC = 0x4B52504C  # "KRPL"
 VERSION = 1
 KIND_DELTA = 1
 KIND_FULL = 2
+# subscription resume offer (ISSUE 11): sent FOLLOWER -> LEADER as the
+# first frame of a new subscription — epoch/generation carry the
+# follower's current chain position, payload is empty.  A leader whose
+# journal covers that position answers with just the missing delta
+# frames (no full-state resync); any other leader (or no hello at all,
+# the pre-journal subscriber) gets the opening kind=full frame.
+KIND_HELLO = 3
+
+_KINDS = (KIND_DELTA, KIND_FULL, KIND_HELLO)
 
 # the one statement of the header layout: (field, byte width) in emit
 # order — the wire-contract rule parses this table by AST and diffs it
@@ -99,7 +110,7 @@ def encode_frame(
     """Serialize one frame.  ``epoch`` must be the 8-char per-boot hex
     nonce every servicer mints (bridge/server.py) — a fixed-width field
     keeps the header seekable without a second length prefix."""
-    if kind not in (KIND_DELTA, KIND_FULL):
+    if kind not in _KINDS:
         raise FrameError(f"unknown frame kind {kind}")
     raw_epoch = epoch.encode("ascii")
     if len(raw_epoch) != 8:
@@ -136,7 +147,7 @@ def decode_header(header: bytes):
         raise FrameError(f"bad frame magic {magic:#x} (want {MAGIC:#x})")
     if version != VERSION:
         raise FrameError(f"unsupported frame version {version}")
-    if kind not in (KIND_DELTA, KIND_FULL):
+    if kind not in _KINDS:
         raise FrameError(f"unknown frame kind {kind}")
     if plen > MAX_PAYLOAD:
         raise FrameError(

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import logging
 import socket
+import struct
 import threading
 import time
 from typing import Optional
@@ -38,6 +39,7 @@ import grpc
 
 from koordinator_tpu.bridge.server import ScorerServicer
 from koordinator_tpu.replication import codec
+from koordinator_tpu.replication.retry import BackoffPolicy
 
 logger = logging.getLogger(__name__)
 
@@ -150,16 +152,46 @@ class ReplicationSubscriber:
         applier: ReplicaApplier,
         reconnect_delay_s: float = 0.05,
         on_frame=None,
+        backoff: Optional[BackoffPolicy] = None,
+        hello: bool = True,
     ):
+        """``backoff`` paces the redial loop (ISSUE 11): jittered
+        exponential from ``reconnect_delay_s`` up to the policy cap —
+        a dead leader is polled at the cap, a thundering herd of
+        followers never re-arrives in phase.  The subscriber retries
+        FOREVER (the deadline budget bounds individual client calls,
+        not a daemon's lifelong subscription); a successful connect
+        resets the ladder.
+
+        ``hello`` sends the follower's chain position as the first
+        frame of every subscription (codec.KIND_HELLO): a leader whose
+        journal covers that position answers with only the missing
+        delta frames — a journal warm-restart costs followers NO full
+        resync.  Leaders ignore unexpected bytes conservatively (a
+        hello to a pre-journal leader just reads as the subscription
+        opening; the full frame still arrives)."""
         self.path = path
         self.applier = applier
         self.reconnect_delay_s = float(reconnect_delay_s)
+        self.backoff = backoff or BackoffPolicy.from_env(
+            base_ms=max(1.0, self.reconnect_delay_s * 1000.0)
+        )
+        self.hello = bool(hello)
         self.on_frame = on_frame
         self._stop = threading.Event()
         self._conn_lock = threading.Lock()
         self._conn: Optional[socket.socket] = None
         self._thread = threading.Thread(target=self._run, daemon=True)
+        # set when the LAST stream ended in a detected discontinuity
+        # (RESYNC/decode): the next dial must skip the hello and take
+        # the full-frame open — offering the same position to a
+        # journal-holding leader would re-serve the very delta that
+        # just failed to apply, forever (the pre-journal "reconnect IS
+        # the full resync" guarantee, preserved exactly where it is
+        # load-bearing)
+        self._force_full = False
         self.connects = 0
+        self.redials = 0
 
     def start(self) -> "ReplicationSubscriber":
         self._thread.start()
@@ -181,6 +213,7 @@ class ReplicationSubscriber:
     # -- internals --
     def _run(self) -> None:
         metrics = self.applier.servicer.telemetry.metrics
+        attempt = 0
         while not self._stop.is_set():
             conn = None
             try:
@@ -189,6 +222,24 @@ class ReplicationSubscriber:
                 with self._conn_lock:
                     self._conn = conn
                 self.connects += 1
+                attempt = 0  # a live leader resets the backoff ladder
+                if self.hello and not self._force_full:
+                    epoch, gen = self.applier.position()
+                    if len(epoch) != 8:
+                        # legacy/malformed id: offer a position no
+                        # journal matches -> ordinary full-frame open
+                        epoch = "00000000"
+                    try:
+                        conn.sendall(codec.encode_frame(
+                            codec.KIND_HELLO, epoch, max(0, gen),
+                            0, b"",
+                        ))
+                    except OSError:
+                        # peer hung up mid-handshake: whatever it
+                        # already sent is still buffered locally — the
+                        # pump below must READ it (a truncated frame
+                        # counts on the error family), not abandon it
+                        pass
                 self._pump(conn, metrics)
             except OSError:
                 pass  # leader down/mid-restart: retry below
@@ -200,9 +251,19 @@ class ReplicationSubscriber:
                         conn.close()
                     except OSError:
                         pass
-            # every redial lands a fresh full frame — reconnect IS the
-            # resync; pace it so a dead leader is polls, not a spin
-            self._stop.wait(self.reconnect_delay_s)
+            # every redial resyncs (a journal-holding leader serves the
+            # missing deltas, anyone else a full frame); pace it on the
+            # shared jittered ladder so a dead leader costs capped
+            # polls, never a spin or a synchronized herd
+            if self._stop.is_set():
+                return
+            self.redials += 1
+            try:
+                metrics.count_retry("subscribe")
+            except Exception:  # koordlint: disable=broad-except(retry accounting must never kill the redial loop)
+                pass
+            self._stop.wait(self.backoff.delay_ms(attempt) / 1000.0)
+            attempt += 1
 
     def _pump(self, conn: socket.socket, metrics) -> None:
         while not self._stop.is_set():
@@ -220,6 +281,7 @@ class ReplicationSubscriber:
                         # reconnecting
                         metrics.count_replica_frame("error")
                         metrics.count_replica_resync("connect")
+                        self._force_full = True
                         return
                     payload = body
                 frame = codec.decode_frame(header + payload)
@@ -229,15 +291,22 @@ class ReplicationSubscriber:
                 )
                 metrics.count_replica_frame("error")
                 metrics.count_replica_resync("decode")
+                self._force_full = True
                 return
             result = self.applier.offer(frame)
+            if result == APPLIED and frame.kind == codec.KIND_FULL:
+                self._force_full = False  # healed: resume is safe again
             if self.on_frame is not None:
                 try:
                     self.on_frame(result, frame)
                 except Exception:  # koordlint: disable=broad-except(status callbacks are observability; they must not kill the stream)
                     logger.exception("replication on_frame callback failed")
             if result == RESYNC:
-                return  # reconnect -> leader reopens with a full frame
+                # reconnect -> the leader must reopen with a FULL
+                # frame: a journal resume at our unchanged position
+                # would re-serve the exact frame that just failed
+                self._force_full = True
+                return
 
 
 class FollowerServicer(ScorerServicer):
@@ -245,13 +314,50 @@ class FollowerServicer(ScorerServicer):
     leader (snapshot ids included — they ARE the leader's after the
     first applied frame) but refuses client Syncs: the tier has one
     writer, and a follower silently accepting a Sync would fork its
-    chain off the leader's and poison every delta baseline."""
+    chain off the leader's and poison every delta baseline.
+
+    :meth:`promote` (ISSUE 11) flips this replica into the tier's
+    writer: it BUMPS THE EPOCH (the old leader's chain must become
+    unmistakably dead — a zombie leader's frames now fail the epoch
+    fence everywhere) while keeping the generation, and starts
+    accepting Syncs.  The daemon layer (scheduler/server.py) wires the
+    surrounding moves: stop the subscription, open a journal, start a
+    publisher on this daemon's own ``<uds>.repl``."""
 
     def __init__(self, *args, leader: str = "", **kwargs):
         super().__init__(*args, **kwargs)
         self._leader_hint = leader
+        self._promoted = False
+
+    @property
+    def promoted(self) -> bool:
+        return self._promoted
+
+    def promote(self, epoch: Optional[str] = None) -> str:
+        """Become the writer: new epoch, same generation, memos dead.
+        Idempotent — a second promote returns the current id without
+        bumping again.  Returns the new ``s<epoch>-<gen>`` id."""
+        with self._sync_lock:
+            with self._state_lock:
+                if self._promoted:
+                    return self.snapshot_id()
+                self._promoted = True
+                # the ONE epoch-bump implementation (memos die with
+                # the old chain) — shared with the torn-tail rebase
+                sid = self._rebase_epoch_locked(epoch)
+        m = self.telemetry.metrics
+        m.set_replica_role("leader")
+        m.count_failover("promoted")
+        logger.warning(
+            "follower promoted to leader at %s (epoch bumped; clients "
+            "full-resync once on the epoch fence, reads were never "
+            "interrupted)", sid,
+        )
+        return sid
 
     def sync(self, req, ctx=None, wire_bytes=None):
+        if self._promoted:
+            return super().sync(req, ctx, wire_bytes=wire_bytes)
         msg = (
             "replica follower does not accept Sync: the tier has one "
             "writer"
@@ -261,3 +367,40 @@ class FollowerServicer(ScorerServicer):
         if ctx is not None:
             ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, msg)
         raise NotLeader(msg)
+
+
+def promote_replica(raw_sock_path: str, timeout_s: float = 30.0) -> str:
+    """Operator/admin seam: ask the follower daemon at ``<uds>.raw`` to
+    promote itself (the raw-UDS admin method — SIGUSR2 is the signal
+    twin).  Returns the promoted daemon's new snapshot id; raises
+    :class:`RuntimeError` with the server's message on refusal."""
+    from koordinator_tpu.bridge.udsserver import METHOD_PROMOTE
+
+    conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    conn.settimeout(timeout_s)
+    try:
+        conn.connect(raw_sock_path)
+        conn.sendall(struct.pack(">BI", METHOD_PROMOTE, 0))
+        header = b""
+        while len(header) < 5:
+            chunk = conn.recv(5 - len(header))
+            if not chunk:
+                raise RuntimeError("promote: connection closed mid-reply")
+            header += chunk
+        status, length = struct.unpack(">BI", header)
+        payload = b""
+        while len(payload) < length:
+            chunk = conn.recv(length - len(payload))
+            if not chunk:
+                raise RuntimeError("promote: connection closed mid-reply")
+            payload += chunk
+        if status != 0:
+            raise RuntimeError(
+                f"promote refused: {payload.decode(errors='replace')}"
+            )
+        return payload.decode()
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass

@@ -149,10 +149,23 @@ class ReplicationPublisher:
         path: str,
         queue_frames: int = DEFAULT_QUEUE_FRAMES,
         clock=time.time,
+        journal=None,
+        hello_timeout_s: float = 0.25,
     ):
+        """``journal`` (ISSUE 11, a ``replication.journal.FrameJournal``)
+        lets a subscription RESUME instead of full-resyncing: a
+        follower opens with a ``kind=hello`` frame naming its chain
+        position, and when the journal's delta chain covers it the
+        subscription is served just the missing frames — after a
+        journal warm-restart, reconnecting followers observe no full
+        resync.  Followers that send no hello within
+        ``hello_timeout_s`` (pre-journal subscribers, plain taps) get
+        the PR-8 behavior: a full opening frame."""
         self.servicer = servicer
         self.path = path
         self.queue_frames = max(1, int(queue_frames))
+        self.journal = journal
+        self.hello_timeout_s = float(hello_timeout_s)
         self._clock = clock
         # RLock: an enqueue overflow inside the fan-out (lock held)
         # drops the subscriber, and the drop re-enters to unregister
@@ -169,6 +182,7 @@ class ReplicationPublisher:
         # lifetime stats (tests/bench)
         self.published = 0
         self.subscriptions = 0
+        self.resumed_subscriptions = 0
 
     # -- lifecycle --
     def attach(self) -> "ReplicationPublisher":
@@ -240,27 +254,93 @@ class ReplicationPublisher:
                 except OSError:
                     pass
 
+    def _read_hello(self, conn: socket.socket):
+        """Peek for the subscriber's opening hello frame (bounded wait).
+        Returns the decoded position frame, or None — no hello within
+        the window, or anything unexpected, degrades to the PR-8
+        full-frame open, never to a failed subscription.  The window
+        is a WHOLE-handshake deadline, not per-recv: this runs on the
+        one accept thread, and a peer dribbling bytes must not be able
+        to stretch one handshake past ``hello_timeout_s`` total."""
+        deadline = time.monotonic() + self.hello_timeout_s
+        try:
+            buf = b""
+            while len(buf) < codec.HEADER_LEN:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                conn.settimeout(left)
+                chunk = conn.recv(codec.HEADER_LEN - len(buf))
+                if not chunk:
+                    return None
+                buf += chunk
+            frame, plen = codec.decode_header(buf)
+            if frame.kind != codec.KIND_HELLO:
+                return None
+            while plen > 0:  # a hello payload is spec'd empty; drain
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                conn.settimeout(left)
+                chunk = conn.recv(min(65536, plen))
+                if not chunk:
+                    return None
+                plen -= len(chunk)
+            return frame
+        except (socket.timeout, OSError, codec.FrameError):
+            return None
+        finally:
+            try:
+                conn.settimeout(None)
+            except OSError:
+                pass
+
     def _register(self, conn: socket.socket) -> None:
-        """Under the publisher lock: export the current state as the
-        opening full frame, enqueue it, then admit the subscriber —
-        atomically against the fan-out, so no committed delta can slip
-        between the export and the subscription (the continuity
-        argument in the module docstring)."""
+        """Under the publisher lock: serve the subscription's opening
+        state — the journal's missing-delta resume when the follower's
+        hello position is covered (ISSUE 11), else the full-state
+        export — then admit the subscriber, atomically against the
+        fan-out, so no committed delta can slip between the opening
+        frames and the subscription (the continuity argument in the
+        module docstring; a frame journaled-but-not-yet-fanned-out can
+        be enqueued twice, and the follower drops the second as
+        stale)."""
+        hello = self._read_hello(conn)
         sub = _Subscriber(conn, self.queue_frames, self._drop)
+        resumed = False
         with self._lock:
-            epoch, gen, payload = (
-                self.servicer.export_replication_snapshot()
-            )
-            full = codec.encode_frame(
-                codec.KIND_FULL, epoch, gen,
-                int(self._clock() * 1e6), payload,
-            )
-            sub.enqueue(full)
+            if hello is not None and self.journal is not None:
+                frames = self.journal.frames_since(
+                    hello.epoch, hello.generation
+                )
+                if frames is not None and len(frames) >= self.queue_frames:
+                    # the resume frames must fit the subscriber's
+                    # bounded queue (the drain thread starts after
+                    # admission); a follower this far behind resyncs
+                    # cheaper with one full frame anyway
+                    frames = None
+                if frames is not None:
+                    for fb in frames:
+                        sub.enqueue(fb)
+                    resumed = True
+                    self.resumed_subscriptions += 1
+            if not resumed:
+                epoch, gen, payload = (
+                    self.servicer.export_replication_snapshot()
+                )
+                full = codec.encode_frame(
+                    codec.KIND_FULL, epoch, gen,
+                    int(self._clock() * 1e6), payload,
+                )
+                sub.enqueue(full)
             self._subs.append(sub)
             self.subscriptions += 1
             n = len(self._subs)
         sub.start()
-        self.servicer.telemetry.metrics.set_replica_followers(n)
+        metrics = self.servicer.telemetry.metrics
+        metrics.set_replica_followers(n)
+        if resumed:
+            metrics.count_retry("resume")
 
     def _drop(self, sub: "_Subscriber") -> None:
         # from the sender thread (no lock) or re-entrantly from an

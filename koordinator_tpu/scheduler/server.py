@@ -86,6 +86,9 @@ class SchedulerServer:
         max_inflight: Optional[int] = None,
         replicate_from: Optional[str] = None,
         score_incr_max_ratio: Optional[float] = None,
+        journal: bool = False,
+        journal_compact_every: Optional[int] = None,
+        journal_fsync: bool = False,
     ):
         # persistent compile cache under the daemon's state dir: a
         # restarted sidecar skips the multi-second (16.5s on TPU,
@@ -202,6 +205,27 @@ class SchedulerServer:
         self._publisher = None
         self._subscriber = None
         self.applier = None
+        # crash tolerance (ISSUE 11): --journal appends every committed
+        # frame to a CRC'd journal under --state-dir; on boot the
+        # journal replays through the stage/commit seam and the daemon
+        # resumes the SAME s<epoch>-<gen> chain (no client/follower
+        # resync storm).  A follower opens its own journal at
+        # promotion.
+        self.journal = None
+        self.journal_replay: Optional[dict] = None
+        self._journal_enabled = bool(journal)
+        self._journal_compact_every = journal_compact_every
+        self._journal_fsync = bool(journal_fsync)
+        self._promote_lock = threading.Lock()
+        self._promoted = False
+        if self._journal_enabled and not state_dir:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "--journal needs a writable --state-dir; journaling "
+                "disabled for this run"
+            )
+            self._journal_enabled = False
         if replicate_from:
             from koordinator_tpu.replication.follower import (
                 FollowerServicer,
@@ -302,10 +326,17 @@ class SchedulerServer:
         return self._httpd.server_address[1]
 
     def replica_health(self) -> dict:
-        """The /healthz replication block: role, and either follower
-        chain position + lag or the leader's live subscriber count."""
+        """The /healthz replication block: role, chain position, the
+        journal's durable position/compaction stamp and replay outcome
+        (ISSUE 11), follower lag or the leader's live subscriber count,
+        and the promotion flag — the fields the failover runbooks in
+        docs/REPLICATION.md key off."""
+        role = "leader"
+        if self.replicate_from and not self._promoted:
+            role = "follower"
         out = {
-            "role": "follower" if self.replicate_from else "leader",
+            "role": role,
+            "promoted": self._promoted,
             "snapshot_id": self.servicer.snapshot_id(),
             "shed": self.servicer.admission.stats()["shed"],
         }
@@ -313,17 +344,149 @@ class SchedulerServer:
             out["applied_frames"] = self.applier.applied
             out["resyncs"] = self.applier.resyncs
             out["lag_ms"] = self.applier.last_lag_ms
+        if self._subscriber is not None:
+            out["redials"] = self._subscriber.redials
         if self._publisher is not None:
             out["followers"] = self._publisher.follower_count()
+            out["resumed_subscriptions"] = (
+                self._publisher.resumed_subscriptions
+            )
+        if self.journal is not None:
+            st = self.journal.stats()
+            out["journal"] = {
+                "position": st["generation"],
+                "bytes": st["bytes"],
+                "appends": st["appends"],
+                "compactions": st["compactions"],
+                "truncations": st["truncations"],
+                "last_compaction_us": st["last_compaction_us"],
+                "compact_every": st["compact_every"],
+            }
+            if self.journal_replay is not None:
+                out["journal"]["replayed_frames"] = (
+                    self.journal_replay["replayed_frames"]
+                )
+                out["journal"]["replay_ms"] = (
+                    self.journal_replay["replay_ms"]
+                )
         return out
+
+    # -- crash tolerance (ISSUE 11) --
+    def _journal_path(self) -> str:
+        return os.path.join(self.state_dir, "journal.krj")
+
+    def _open_journal(self):
+        from koordinator_tpu.replication.journal import FrameJournal
+
+        kw = {}
+        if self._journal_compact_every is not None:
+            kw["compact_every"] = int(self._journal_compact_every)
+        return FrameJournal(
+            self._journal_path(), fsync=self._journal_fsync, **kw
+        )
+
+    def _boot_journal(self) -> None:
+        """Leader boot: replay the journal through the stage/commit
+        seam BEFORE any transport serves, so the first client RPC
+        already sees the resumed ``s<epoch>-<gen>`` chain."""
+        journal = self._open_journal()
+        stats = journal.recover(self.servicer)
+        journal.attach(self.servicer)
+        self.journal = journal
+        self.journal_replay = stats
+        if stats["replayed_frames"]:
+            self.servicer.telemetry.metrics.count_failover("warm_restart")
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "journal warm-restart: replayed %d frame(s) in %.1f ms, "
+                "resumed %s (truncated tail: %s)",
+                stats["replayed_frames"], stats["replay_ms"],
+                stats["resumed_id"], stats["truncated"],
+            )
+
+    def promote(self) -> str:
+        """Promote this follower daemon to the tier's leader (ISSUE 11;
+        SIGUSR2 and the raw-UDS admin RPC both land here): stop the
+        subscription, bump the epoch on the servicer (clients
+        full-resync ONCE on the epoch fence; reads never stop), open
+        this daemon's own journal seeded with a full-state frame, and
+        start publishing on its own ``<uds>.repl``.  Idempotent;
+        raises on a daemon that is already the leader role."""
+        if not self.replicate_from:
+            raise RuntimeError(
+                "promote: this daemon is already the leader role"
+            )
+        with self._promote_lock:
+            if self._promoted:
+                return self.servicer.snapshot_id()
+            if self._subscriber is not None:
+                self._subscriber.stop()
+                self._subscriber = None
+            sid = self.servicer.promote()
+            if self._journal_enabled:
+                journal = self._open_journal()
+                epoch, gen, payload = (
+                    self.servicer.export_replication_snapshot()
+                )
+                journal.write_base(epoch, gen, payload)
+                journal.attach(self.servicer)
+                self.journal = journal
+            from koordinator_tpu.replication.leader import (
+                ReplicationPublisher,
+            )
+
+            self._publisher = ReplicationPublisher(
+                self.servicer, self.repl_path, journal=self.journal
+            ).attach().start()
+            self._promoted = True
+            return sid
+
+    def _install_sigusr2(self) -> None:
+        """SIGUSR2 = promote (main thread only, like the flight
+        recorder's SIGUSR1; a no-op on leaders so a fat-fingered
+        signal cannot hurt)."""
+        import logging
+        import signal
+
+        def _handler(signum, frame):
+            def run():
+                try:
+                    self.promote()
+                except Exception:  # koordlint: disable=broad-except(a failed promotion must be logged, never kill the daemon from a signal handler thread)
+                    logging.getLogger(__name__).exception(
+                        "SIGUSR2 promotion failed"
+                    )
+
+            if self.replicate_from:
+                # off the signal frame: promotion joins threads and
+                # takes servicer locks, neither safe in a handler
+                threading.Thread(target=run, daemon=True).start()
+
+        try:
+            signal.signal(signal.SIGUSR2, _handler)
+        except ValueError:
+            pass  # not the main thread (embedded/test use)
 
     def start(self) -> "SchedulerServer":
         os.makedirs(os.path.dirname(self.uds_path) or ".", exist_ok=True)
         # operator seam: `kill -USR1 <pid>` dumps the last K cycles'
-        # spans under <state-dir>/flight (no-op off the main thread)
+        # spans under <state-dir>/flight (no-op off the main thread);
+        # SIGUSR2 promotes a follower (ISSUE 11)
         self.servicer.telemetry.flight.install_sigusr1()
+        self._install_sigusr2()
+        # journal replay BEFORE any transport binds: the first RPC a
+        # reconnecting client lands must already see the resumed chain
+        if self._journal_enabled and not self.replicate_from:
+            self._boot_journal()
+        from koordinator_tpu.bridge.udsserver import METHOD_PROMOTE
+
+        def _promote_admin(payload: bytes) -> bytes:
+            return self.promote().encode()
+
         self._raw_server = RawUdsServer(
-            self.uds_path + ".raw", servicer=self.servicer
+            self.uds_path + ".raw", servicer=self.servicer,
+            admin_handlers={METHOD_PROMOTE: _promote_admin},
         ).start()
         if self.enable_grpc:
             self._grpc_server = make_server(servicer=self.servicer)
@@ -345,7 +508,7 @@ class SchedulerServer:
             )
 
             self._publisher = ReplicationPublisher(
-                self.servicer, self.repl_path
+                self.servicer, self.repl_path, journal=self.journal
             ).attach().start()
         self._http.start()
         self._elector_thread = threading.Thread(
@@ -366,6 +529,8 @@ class SchedulerServer:
             self._raw_server.stop()
         if self._grpc_server:
             self._grpc_server.stop(0)
+        if self.journal is not None:
+            self.journal.close()
         self._http.stop()
 
 
@@ -456,6 +621,34 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "KOORD_SCORE_INCR_MAX_RATIO)",
     )
     ap.add_argument(
+        "--journal", action="store_true",
+        default=bool(os.environ.get("KOORD_JOURNAL")),
+        help="crash tolerance (docs/REPLICATION.md): append every "
+        "committed Sync's encoded frame to a CRC'd journal at "
+        "<state-dir>/journal.krj and replay it on boot, resuming the "
+        "same s<epoch>-<gen> chain — reconnecting clients/followers "
+        "see no full resync; a torn tail truncates to the last valid "
+        "frame (env: KOORD_JOURNAL=1)",
+    )
+    ap.add_argument(
+        "--journal-compact-every", type=int,
+        default=(
+            int(os.environ["KOORD_JOURNAL_COMPACT_EVERY"])
+            if os.environ.get("KOORD_JOURNAL_COMPACT_EVERY") else None
+        ),
+        help="delta frames between journal compactions (a full-state "
+        "frame atomically replaces the file; default 256; env: "
+        "KOORD_JOURNAL_COMPACT_EVERY)",
+    )
+    ap.add_argument(
+        "--journal-fsync", action="store_true",
+        default=bool(os.environ.get("KOORD_JOURNAL_FSYNC")),
+        help="fsync every journal append (power-loss durability at a "
+        "per-commit fsync cost; default flushes to the OS, which "
+        "already survives the process crashes the tier replicates "
+        "against; env: KOORD_JOURNAL_FSYNC=1)",
+    )
+    ap.add_argument(
         "--state-dir", default=None,
         help="daemon state directory (default: $XDG_STATE_HOME/"
         "koord-scheduler, per-user); the persistent XLA compile cache "
@@ -482,6 +675,9 @@ def main(argv=None) -> int:
         max_inflight=args.max_inflight,
         replicate_from=args.replicate_from,
         score_incr_max_ratio=args.score_incr_max_ratio,
+        journal=args.journal,
+        journal_compact_every=args.journal_compact_every,
+        journal_fsync=args.journal_fsync,
     ).start()
     try:
         threading.Event().wait()

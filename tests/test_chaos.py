@@ -1,0 +1,249 @@
+"""Chaos fault-injection acceptance (ISSUE 11): the tier survives a
+leader kill (journal warm-restart AND follower promotion) under
+injected frame drop/corrupt/truncate/reorder, ending byte-identical to
+the unfaulted single-daemon oracle with zero torn snapshots — and the
+warm path holds zero jit cache misses after recovery.
+"""
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.bridge.codegen import pb2
+from koordinator_tpu.bridge.state import numpy_to_tensor
+from koordinator_tpu.harness import generators
+from koordinator_tpu.harness.chaos import (
+    ChaosTier,
+    FaultPlan,
+    fail_next_launch,
+    flat_score_bytes,
+)
+from koordinator_tpu.harness.golden import build_sync_request
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.replication.admission import ResourceExhausted
+
+
+def _tiny_sync(pods=32, nodes=8, seed=3):
+    nodes_l, pods_l, gangs, quotas = generators.quota_colocation(
+        seed=seed, pods=pods, nodes=nodes, tenants=2
+    )
+    req, _ = build_sync_request(nodes_l, pods_l, gangs, quotas)
+    return req, nodes_l
+
+
+def _warm_usage_frame(prev, bump):
+    cur = prev.copy()
+    cur.flat[bump % cur.size] += 1 + bump
+    warm = pb2.SyncRequest()
+    warm.nodes.usage.CopyFrom(numpy_to_tensor(cur, prev))
+    return warm, cur
+
+
+NASTY = FaultPlan(drop=0.12, duplicate=0.12, reorder=0.18,
+                  corrupt=0.08, truncate=0.06)
+
+
+class TestChaosAcceptance:
+    def test_leader_kill_warm_restart_then_promotion(self, tmp_path):
+        """THE acceptance run: ~36 warm/scalar Syncs through drop/
+        corrupt/truncate/reorder channels; the leader is killed twice
+        mid-storm — recovered once by journal warm-restart (same
+        chain, no follower full resync beyond what the faults caused)
+        and once by promoting a follower (epoch fence) — and the tier
+        ends byte-identical to the unfaulted oracle.  The torn-
+        snapshot invariant is asserted on EVERY delivery inside
+        ChaosTier; this test also pins the fault mix actually fired."""
+        req, nodes_l = _tiny_sync()
+        tier = ChaosTier(
+            str(tmp_path), followers=2, plan=NASTY, seed=11
+        )
+        tier.sync(req)
+        prev = np.asarray(
+            [res.resource_vector(n.get("usage", {})) for n in nodes_l],
+            dtype=np.int64,
+        )
+        rng = np.random.default_rng(5)
+        pre_kill_sid = None
+        for step in range(36):
+            if step == 12:
+                pre_kill_sid = tier.leader.snapshot_id()
+                tier.crash_leader()
+                stats = tier.restart_leader()
+                # journal warm-restart: the SAME s<epoch>-<gen> chain
+                assert stats["truncated"] is None
+                assert stats["resumed_id"] == pre_kill_sid
+                assert tier.leader.snapshot_id() == pre_kill_sid
+                assert stats["replay_ms"] is not None
+                continue
+            if step == 24:
+                tier.crash_leader()
+                old_epoch = pre_kill_sid.split("-")[0]
+                sid = tier.promote(0)
+                # promotion bumps the epoch (the fence), keeps serving
+                assert not sid.startswith(old_epoch)
+                continue
+            if step % 7 == 3:
+                scalar = pb2.SyncRequest()
+                P = tier.leader.state.pod_requests.shape[0]
+                scalar.pods.priority.extend(
+                    int(v) for v in rng.integers(0, 9000, P)
+                )
+                tier.sync(scalar)
+            else:
+                warm, prev = _warm_usage_frame(
+                    prev, int(rng.integers(0, 64))
+                )
+                tier.sync(warm)
+            tier.converge()
+        tier.converge()
+        # the faults actually fired — this was a chaos run, not a
+        # happy path that would pass vacuously
+        fired = {}
+        for f in tier.followers:
+            for k, v in f.channel.injected.items():
+                fired[k] = fired.get(k, 0) + v
+        assert fired.get("drop", 0) > 0
+        assert fired.get("corrupt", 0) + fired.get("truncate", 0) > 0
+        assert fired.get("reorder", 0) > 0
+        assert tier.resyncs > 0  # the documented recovery path ran
+        assert tier.torn_checks > 30
+
+    def test_warm_path_retrace_free_after_recovery(self, tmp_path):
+        """After a crash + journal warm-restart, the leader's warm
+        delta/Score stream must hold ZERO jit cache misses — recovery
+        replays through the same stage/commit seam, so the compiled
+        warm path survives the restart's state rebuild."""
+        from koordinator_tpu.analysis import retrace_guard
+
+        req, nodes_l = _tiny_sync()
+        tier = ChaosTier(str(tmp_path), followers=1, seed=2)
+        tier.sync(req)
+        prev = np.asarray(
+            [res.resource_vector(n.get("usage", {})) for n in nodes_l],
+            dtype=np.int64,
+        )
+        for i in range(2):
+            warm, prev = _warm_usage_frame(prev, i)
+            tier.sync(warm)
+        tier.crash_leader()
+        stats = tier.restart_leader()
+        assert stats["resumed_id"] is not None
+
+        def warm_step(i):
+            nonlocal prev
+            warm, prev = _warm_usage_frame(prev, i)
+            tier.sync(warm)
+            sid = tier.leader.snapshot_id()
+            tier.leader.score(pb2.ScoreRequest(
+                snapshot_id=sid, top_k=4, flat=True
+            ))
+
+        # one warm-up rep compiles against the replayed snapshot; the
+        # guarded stream must then be retrace-free
+        warm_step(100)
+        with retrace_guard(budget=0) as counter:
+            for i in range(101, 104):
+                warm_step(i)
+        assert counter.traces == 0 and counter.compiles == 0
+        tier.converge()
+
+    def test_stalled_follower_catches_up_without_double_apply(
+        self, tmp_path
+    ):
+        """A stalled follower buffers the live stream; on unstall the
+        late frames apply IN ORDER (duplicates drop as stale) and the
+        follower converges — never a double apply, never a tear."""
+        req, nodes_l = _tiny_sync()
+        tier = ChaosTier(
+            str(tmp_path), followers=2,
+            plan=FaultPlan(duplicate=0.3), seed=7,
+        )
+        tier.sync(req)
+        tier.stall_follower(1)
+        prev = np.asarray(
+            [res.resource_vector(n.get("usage", {})) for n in nodes_l],
+            dtype=np.int64,
+        )
+        for i in range(6):
+            warm, prev = _warm_usage_frame(prev, i)
+            tier.sync(warm)
+        stalled_sid = tier.followers[1].servicer.snapshot_id()
+        assert stalled_sid != tier.leader.snapshot_id()
+        # reads on the stalled follower still serve (stale, consistent)
+        assert flat_score_bytes(tier.followers[1].servicer, stalled_sid)
+        tier.unstall_follower(1)
+        tier.converge()
+
+    def test_injected_launch_failure_routes_to_caller_only(
+        self, tmp_path
+    ):
+        """A device launch failing mid-batch errors THAT caller and
+        leaves the daemon serving: the next Score succeeds against
+        unchanged state."""
+        req, _ = _tiny_sync()
+        tier = ChaosTier(str(tmp_path), followers=0, seed=1)
+        sid = tier.sync(req)
+        want = flat_score_bytes(tier.leader, sid)
+        with fail_next_launch(tier.leader):
+            with pytest.raises(RuntimeError, match="chaos"):
+                tier.leader.score(pb2.ScoreRequest(
+                    snapshot_id=sid, top_k=8, flat=True
+                ))
+        assert flat_score_bytes(tier.leader, sid) == want
+        tier.converge()
+
+    def test_journal_tail_damage_mid_tier_fences_not_forks(
+        self, tmp_path
+    ):
+        """Tear the journal tail while a follower already holds the
+        torn frames, then warm-restart: the leader rebases onto a
+        fresh epoch (the fenced resync) and the follower converges to
+        it — the rewound generation numbers are never re-minted on the
+        old chain (the fork the epoch fence alone cannot see)."""
+        req, nodes_l = _tiny_sync()
+        tier = ChaosTier(str(tmp_path), followers=1, seed=4)
+        tier.sync(req)
+        prev = np.asarray(
+            [res.resource_vector(n.get("usage", {})) for n in nodes_l],
+            dtype=np.int64,
+        )
+        for i in range(3):
+            warm, prev = _warm_usage_frame(prev, i)
+            tier.sync(warm)
+        tier.converge()
+        old_sid = tier.leader.snapshot_id()
+        tier.crash_leader()
+        tier.damage_journal(cut_bytes=9)
+        stats = tier.restart_leader()
+        assert stats["truncated"] is not None
+        new_sid = tier.leader.snapshot_id()
+        assert new_sid != old_sid
+        assert new_sid.split("-")[0] != old_sid.split("-")[0]
+        # the tier reconverges on the new chain; oracle parity is
+        # deliberately NOT asserted here — the torn frame's Sync is
+        # gone from the journal, so the leader serves the last DURABLE
+        # state (that is the contract: recovered, consistent, fenced)
+        for f in tier.followers:
+            assert f.servicer.snapshot_id() == new_sid
+
+    def test_admission_still_sheds_during_recovery(self, tmp_path):
+        """Crash tolerance composes with admission control: a gated,
+        journal-recovered daemon still sheds past --max-inflight."""
+        req, _ = _tiny_sync()
+        tier = ChaosTier(
+            str(tmp_path), followers=0, seed=3,
+            servicer_kw={"score_memo": False, "max_inflight": 1},
+        )
+        sid = tier.sync(req)
+        tier.crash_leader()
+        tier.restart_leader()
+        sid = tier.leader.snapshot_id()
+        held = tier.leader.admission.admit("score")
+        held.__enter__()
+        try:
+            with pytest.raises(ResourceExhausted):
+                tier.leader.score(pb2.ScoreRequest(
+                    snapshot_id=sid, top_k=4, flat=True
+                ))
+        finally:
+            held.__exit__(None, None, None)
+        assert flat_score_bytes(tier.leader, sid)

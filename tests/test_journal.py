@@ -1,0 +1,495 @@
+"""Durable frame journal (ISSUE 11): append/replay round trips, the
+same-chain warm-restart contract, compaction, the subscription resume
+seam, and every recovery negative — truncated tail, flipped CRC byte,
+bad magic, compaction snapshot newer than tail frames — each of which
+must recover to the last valid prefix and never a torn snapshot.
+"""
+
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.bridge.client import parse_snapshot_id
+from koordinator_tpu.bridge.codegen import pb2
+from koordinator_tpu.bridge.server import ScorerServicer
+from koordinator_tpu.bridge.state import numpy_to_tensor
+from koordinator_tpu.harness import generators
+from koordinator_tpu.harness.chaos import (
+    assert_mirror_parity,
+    flat_score_bytes,
+)
+from koordinator_tpu.harness.golden import build_sync_request
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.replication import codec
+from koordinator_tpu.replication.journal import (
+    _REC_HEADER,
+    _REC_HEADER_LEN,
+    FrameJournal,
+)
+
+
+def _tiny_sync(pods=32, nodes=8, seed=3):
+    nodes_l, pods_l, gangs, quotas = generators.quota_colocation(
+        seed=seed, pods=pods, nodes=nodes, tenants=2
+    )
+    req, _ = build_sync_request(nodes_l, pods_l, gangs, quotas)
+    return req, nodes_l
+
+
+def _warm_usage_frame(prev, bump):
+    cur = prev.copy()
+    cur.flat[bump % cur.size] += 1 + bump
+    warm = pb2.SyncRequest()
+    warm.nodes.usage.CopyFrom(numpy_to_tensor(cur, prev))
+    return warm, cur
+
+
+def _journaled_leader(tmp_path, syncs=4, compact_every=100):
+    """A leader with an attached journal and ``syncs`` warm deltas on
+    top of the initial full sync.  Returns (servicer, journal, path)."""
+    req, nodes_l = _tiny_sync()
+    path = os.path.join(str(tmp_path), "journal.krj")
+    sv = ScorerServicer(score_memo=False)
+    j = FrameJournal(path, compact_every=compact_every)
+    j.recover(sv)
+    j.attach(sv)
+    sv.sync(req)
+    prev = np.asarray(
+        [res.resource_vector(n.get("usage", {})) for n in nodes_l],
+        dtype=np.int64,
+    )
+    for i in range(syncs):
+        warm, prev = _warm_usage_frame(prev, i)
+        sv.sync(warm)
+    return sv, j, path
+
+
+def _replayed(path, compact_every=100):
+    sv = ScorerServicer(score_memo=False)
+    j = FrameJournal(path, compact_every=compact_every)
+    stats = j.recover(sv)
+    return sv, j, stats
+
+
+def _records(path):
+    """[(offset, record_bytes)] of every valid record in the file."""
+    out = []
+    with open(path, "rb") as fh:
+        data = fh.read()
+    off = 0
+    while off + _REC_HEADER_LEN <= len(data):
+        length, _crc = struct.unpack_from(_REC_HEADER, data, off)
+        end = off + _REC_HEADER_LEN + length
+        if end > len(data):
+            break
+        out.append((off, data[off:end]))
+        off = end
+    return out
+
+
+class TestJournalRoundTrip:
+    def test_fresh_journal_seeds_base_full_frame(self, tmp_path):
+        sv = ScorerServicer(score_memo=False)
+        path = os.path.join(str(tmp_path), "journal.krj")
+        j = FrameJournal(path)
+        stats = j.recover(sv)
+        assert stats["replayed_frames"] == 0
+        recs = _records(path)
+        assert len(recs) == 1
+        frame = codec.decode_frame(recs[0][1][_REC_HEADER_LEN:])
+        assert frame.kind == codec.KIND_FULL
+        assert frame.snapshot_id == sv.snapshot_id()
+
+    def test_warm_restart_resumes_same_chain(self, tmp_path):
+        """THE acceptance shape: replay lands byte-identical state at
+        the same s<epoch>-<gen>, and the NEXT Sync extends that chain
+        — a reconnecting delta client passes its continuity check."""
+        sv, j, path = _journaled_leader(tmp_path)
+        sid = sv.snapshot_id()
+        j.close()  # simulate SIGKILL: the object dies, the file stays
+        sv2, j2, stats = _replayed(path)
+        assert stats["truncated"] is None
+        assert stats["resumed_id"] == sid
+        assert sv2.snapshot_id() == sid
+        assert_mirror_parity(sv, sv2)
+        assert flat_score_bytes(sv2, sid) == flat_score_bytes(sv, sid)
+        # the chain CONTINUES: same epoch, generation + 1
+        j2.attach(sv2)
+        warm = pb2.SyncRequest()
+        sid2 = sv2.sync(warm).snapshot_id
+        e1, g1 = parse_snapshot_id(sid)
+        e2, g2 = parse_snapshot_id(sid2)
+        assert (e2, g2) == (e1, g1 + 1)
+
+    def test_empty_delta_sync_journals_and_replays(self, tmp_path):
+        """A no-change Sync serializes to b"" — its journal record must
+        replay as the empty delta it is (the quiet-cluster heartbeat),
+        not classify as a reset."""
+        sv, j, path = _journaled_leader(tmp_path, syncs=0)
+        sv.sync(pb2.SyncRequest())
+        sid = sv.snapshot_id()
+        j.close()
+        sv2, _, stats = _replayed(path)
+        assert sv2.snapshot_id() == sid
+        assert stats["truncated"] is None
+
+    def test_journal_append_rides_wire_bytes(self, tmp_path):
+        """The hook journals the client's ORIGINAL wire bytes when the
+        transport kept them (the raw-UDS path): the journaled payload
+        is the same O(changed) frame the publisher streams."""
+        sv, j, path = _journaled_leader(tmp_path, syncs=0)
+        warm = pb2.SyncRequest()
+        wire = warm.SerializeToString()
+        sv.sync(warm, wire_bytes=wire)
+        recs = _records(path)
+        frame = codec.decode_frame(recs[-1][1][_REC_HEADER_LEN:])
+        assert frame.kind == codec.KIND_DELTA
+        assert frame.payload == wire
+
+    def test_stats_and_gauges_move(self, tmp_path):
+        sv, j, path = _journaled_leader(tmp_path, syncs=3)
+        st = j.stats()
+        assert st["appends"] == 4  # initial full sync + 3 warm deltas
+        assert st["generation"] == 4
+        assert st["bytes"] == os.path.getsize(path)
+        render = sv.telemetry.registry.render()
+        assert 'koord_scorer_journal_frames_total{op="append"} 4' in render
+        assert "koord_scorer_journal_position 4" in render
+        assert "koord_scorer_journal_append_us_bucket" in render
+
+
+class TestCompaction:
+    def test_compacts_every_n_deltas(self, tmp_path):
+        sv, j, path = _journaled_leader(
+            tmp_path, syncs=7, compact_every=3
+        )
+        assert j.compactions >= 2
+        recs = _records(path)
+        first = codec.decode_frame(recs[0][1][_REC_HEADER_LEN:])
+        assert first.kind == codec.KIND_FULL
+        # compaction bounds the file: never more than compact_every
+        # deltas after the base frame
+        assert len(recs) <= 1 + 3
+        st = j.stats()
+        assert st["last_compaction_us"] is not None
+        # replay of a compacted journal still resumes the exact chain
+        sid = sv.snapshot_id()
+        j.close()
+        sv2, _, _ = _replayed(path, compact_every=3)
+        assert sv2.snapshot_id() == sid
+        assert_mirror_parity(sv, sv2)
+
+    def test_compaction_snapshot_newer_than_tail_frames(self, tmp_path):
+        """The stale-tail negative: a full frame at generation G
+        followed by deltas with generation <= G (a botched compaction
+        interleave).  Replay must drop them as stale — recovering to
+        the snapshot, byte-parity with the oracle — never apply them
+        backwards or error out."""
+        sv, j, path = _journaled_leader(tmp_path, syncs=3)
+        sid = sv.snapshot_id()
+        recs = _records(path)
+        # rebuild the file as: [full snapshot at CURRENT state] +
+        # [the old delta records, all gen <= G now]
+        epoch, gen, payload = sv.export_replication_snapshot()
+        full = codec.encode_frame(codec.KIND_FULL, epoch, gen, 0, payload)
+        with open(path, "wb") as fh:
+            fh.write(struct.pack(
+                _REC_HEADER, len(full), zlib.crc32(full)
+            ) + full)
+            for _off, rec in recs[1:]:  # the old deltas (gen 1..G)
+                fh.write(rec)
+        sv2, j2, stats = _replayed(path)
+        assert stats["truncated"] is None
+        assert stats["stale_frames"] == len(recs) - 1
+        assert sv2.snapshot_id() == sid
+        assert_mirror_parity(sv, sv2)
+        assert flat_score_bytes(sv2, sid) == flat_score_bytes(sv, sid)
+
+
+class TestRecoveryNegatives:
+    """Each damage shape recovers to the last valid prefix: replayed
+    state equals the state as of the last intact frame, the file is
+    truncated there, and — because the truncated tail may have been
+    published — the daemon resumes on a FRESH epoch at the recovered
+    generation (the fenced resync, never a silent fork)."""
+
+    def _damaged_replay(self, tmp_path, damage):
+        sv, j, path = _journaled_leader(tmp_path, syncs=4)
+        recs = _records(path)
+        j.close()
+        damage(path, recs)
+        sv2, j2, stats = _replayed(path)
+        return sv, sv2, j2, stats, recs, path
+
+    def _assert_recovered_prefix(self, sv2, stats, recs, n_valid):
+        """Replay applied exactly the first ``n_valid`` records and the
+        daemon sits at that generation on a FRESH epoch."""
+        assert stats["truncated"] is not None
+        last = codec.decode_frame(recs[n_valid - 1][1][_REC_HEADER_LEN:])
+        epoch2, gen2 = parse_snapshot_id(sv2.snapshot_id())
+        assert gen2 == last.generation
+        assert epoch2 != last.epoch  # fenced: truncation = new epoch
+
+    def test_truncated_tail(self, tmp_path):
+        sv, sv2, j2, stats, recs, path = self._damaged_replay(
+            tmp_path,
+            lambda path, recs: open(path, "r+b").truncate(
+                os.path.getsize(path) - 7
+            ),
+        )
+        assert stats["truncated"] in ("torn-frame", "torn-header")
+        self._assert_recovered_prefix(sv2, stats, recs, len(recs) - 1)
+        # the file itself is now the valid prefix + the fresh base the
+        # rebase compaction wrote — fully decodable front to back
+        for _off, rec in _records(path):
+            codec.decode_frame(rec[_REC_HEADER_LEN:])
+
+    def test_flipped_crc_byte(self, tmp_path):
+        def damage(path, recs):
+            # flip one payload byte INSIDE the second-to-last record,
+            # leaving its length header intact: only the CRC can tell
+            off, rec = recs[-2]
+            flip = off + _REC_HEADER_LEN + len(rec) - _REC_HEADER_LEN - 1
+            with open(path, "r+b") as fh:
+                fh.seek(flip)
+                b = fh.read(1)
+                fh.seek(flip)
+                fh.write(bytes([b[0] ^ 0xFF]))
+
+        sv, sv2, j2, stats, recs, path = self._damaged_replay(
+            tmp_path, damage
+        )
+        assert stats["truncated"] == "crc"
+        # everything BEFORE the flipped record replayed; the flipped
+        # record and the (valid!) one after it are gone — a hole in
+        # the middle makes the whole tail unusable
+        self._assert_recovered_prefix(sv2, stats, recs, len(recs) - 2)
+
+    def test_bad_magic(self, tmp_path):
+        def damage(path, recs):
+            # corrupt the frame MAGIC of the last record and fix up the
+            # record CRC so only the frame decode can reject it
+            off, rec = recs[-1]
+            frame = bytearray(rec[_REC_HEADER_LEN:])
+            frame[0] ^= 0xFF
+            with open(path, "r+b") as fh:
+                fh.seek(off)
+                fh.write(struct.pack(
+                    _REC_HEADER, len(frame), zlib.crc32(bytes(frame))
+                ) + bytes(frame))
+
+        sv, sv2, j2, stats, recs, path = self._damaged_replay(
+            tmp_path, damage
+        )
+        assert stats["truncated"] == "decode"
+        self._assert_recovered_prefix(sv2, stats, recs, len(recs) - 1)
+
+    def test_absurd_record_length(self, tmp_path):
+        def damage(path, recs):
+            off, _rec = recs[-1]
+            with open(path, "r+b") as fh:
+                fh.seek(off)
+                fh.write(struct.pack(">I", 0xFFFFFFFF))
+
+        sv, sv2, j2, stats, recs, path = self._damaged_replay(
+            tmp_path, damage
+        )
+        assert stats["truncated"] == "bad-length"
+        self._assert_recovered_prefix(sv2, stats, recs, len(recs) - 1)
+
+    def test_generation_gap_truncates(self, tmp_path):
+        """A delta whose generation skips ahead (a hole in the file)
+        ends the usable prefix — everything after it is unreachable
+        state and must not apply."""
+        def damage(path, recs):
+            # drop the second-to-last record entirely, splicing the
+            # last one directly after the earlier prefix
+            off, _rec = recs[-2]
+            _off2, rec2 = recs[-1]
+            with open(path, "r+b") as fh:
+                fh.seek(off)
+                fh.write(rec2)
+                fh.truncate(off + len(rec2))
+
+        sv, sv2, j2, stats, recs, path = self._damaged_replay(
+            tmp_path, damage
+        )
+        assert stats["truncated"] == "gap"
+        self._assert_recovered_prefix(sv2, stats, recs, len(recs) - 2)
+
+    def test_recovered_daemon_keeps_serving_and_journaling(self, tmp_path):
+        """After a truncating recovery the daemon is fully live: reads
+        serve the recovered snapshot, writes append to the compacted
+        journal, and a SECOND restart replays cleanly."""
+        sv, sv2, j2, stats, recs, path = self._damaged_replay(
+            tmp_path,
+            lambda path, recs: open(path, "r+b").truncate(
+                os.path.getsize(path) - 3
+            ),
+        )
+        j2.attach(sv2)
+        sid = sv2.snapshot_id()
+        out = flat_score_bytes(sv2, sid)
+        assert out
+        sid2 = sv2.sync(pb2.SyncRequest()).snapshot_id
+        j2.close()
+        sv3, _, stats3 = _replayed(path)
+        assert stats3["truncated"] is None
+        assert sv3.snapshot_id() == sid2
+        assert_mirror_parity(sv2, sv3)
+
+
+class TestResumeSeam:
+    def test_frames_since_returns_missing_deltas(self, tmp_path):
+        sv, j, path = _journaled_leader(tmp_path, syncs=4)
+        epoch, gen = parse_snapshot_id(sv.snapshot_id())
+        frames = j.frames_since(epoch, gen - 2)
+        assert frames is not None and len(frames) == 2
+        decoded = [codec.decode_frame(f) for f in frames]
+        assert [f.generation for f in decoded] == [gen - 1, gen]
+        # fully caught up -> empty resume, NOT a full frame
+        assert j.frames_since(epoch, gen) == []
+
+    def test_frames_since_refuses_uncovered_positions(self, tmp_path):
+        sv, j, path = _journaled_leader(
+            tmp_path, syncs=7, compact_every=3
+        )
+        epoch, gen = parse_snapshot_id(sv.snapshot_id())
+        # a position before the last compaction base is gone
+        assert j.frames_since(epoch, 0) is None
+        # a foreign epoch can never resume
+        assert j.frames_since("ffffffff", gen) is None
+        # a position AHEAD of the chain (the rewound-leader guard)
+        assert j.frames_since(epoch, gen + 5) is None
+
+    def test_apply_failure_resync_heals_despite_journal_resume(
+        self, tmp_path
+    ):
+        """Post-review regression: a follower whose APPLY of a delta
+        fails must not wedge — its reconnect skips the hello once, so
+        the journal-holding leader serves the full frame instead of
+        re-serving the exact delta that just failed, and the stream
+        then resumes normally."""
+        import time
+
+        from koordinator_tpu.replication.follower import (
+            FollowerServicer,
+            ReplicaApplier,
+            ReplicationSubscriber,
+        )
+        from koordinator_tpu.replication.leader import (
+            ReplicationPublisher,
+        )
+
+        def wait_until(pred, timeout_s=30.0):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if pred():
+                    return True
+                time.sleep(0.01)
+            return pred()
+
+        sv, j, path = _journaled_leader(tmp_path, syncs=1)
+        repl = os.path.join(str(tmp_path), "l.repl")
+        pub = ReplicationPublisher(sv, repl, journal=j).attach().start()
+        follower = FollowerServicer(score_memo=False)
+        applier = ReplicaApplier(follower)
+        # poison exactly ONE delta apply: the next generation's first
+        # delivery raises; the reconnect full frame (and everything
+        # after) applies normally
+        real_apply = follower.apply_replica_frame
+        poisoned_gen = parse_snapshot_id(sv.snapshot_id())[1] + 1
+        fails = {"n": 0}
+
+        def flaky_apply(frame):
+            if (
+                frame.kind == codec.KIND_DELTA
+                and frame.generation == poisoned_gen
+                and fails["n"] == 0
+            ):
+                fails["n"] += 1
+                raise RuntimeError("poisoned apply")
+            return real_apply(frame)
+
+        follower.apply_replica_frame = flaky_apply
+        sub = ReplicationSubscriber(repl, applier).start()
+        try:
+            assert wait_until(
+                lambda: follower.snapshot_id() == sv.snapshot_id()
+            )
+            sid = sv.sync(pb2.SyncRequest()).snapshot_id  # poisoned gen
+            assert wait_until(
+                lambda: follower.snapshot_id() == sid
+            ), "follower wedged after an apply-failure resync"
+            assert fails["n"] == 1
+            assert applier.resyncs >= 1
+            # and the stream keeps flowing after the heal
+            sid2 = sv.sync(pb2.SyncRequest()).snapshot_id
+            assert wait_until(lambda: follower.snapshot_id() == sid2)
+            assert_mirror_parity(sv, follower)
+        finally:
+            sub.stop()
+            pub.stop()
+
+    def test_publisher_serves_resume_over_uds(self, tmp_path):
+        """End to end over the real socket: a follower that already
+        holds generation G reconnects after a leader warm-restart and
+        receives ONLY the missing delta frames — its resync counter
+        never moves (the no-full-resync acceptance)."""
+        import time
+
+        from koordinator_tpu.replication.follower import (
+            FollowerServicer,
+            ReplicaApplier,
+            ReplicationSubscriber,
+        )
+        from koordinator_tpu.replication.leader import (
+            ReplicationPublisher,
+        )
+
+        def wait_until(pred, timeout_s=30.0):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if pred():
+                    return True
+                time.sleep(0.01)
+            return pred()
+
+        sv, j, path = _journaled_leader(tmp_path, syncs=2)
+        repl = os.path.join(str(tmp_path), "l.repl")
+        pub = ReplicationPublisher(sv, repl, journal=j).attach().start()
+        follower = FollowerServicer(score_memo=False)
+        applier = ReplicaApplier(follower)
+        sub = ReplicationSubscriber(repl, applier).start()
+        try:
+            assert wait_until(
+                lambda: follower.snapshot_id() == sv.snapshot_id()
+            )
+            resyncs0 = applier.resyncs
+            # leader "crashes" and warm-restarts from the journal
+            pub.stop()
+            j.close()
+            sv2, j2, stats = _replayed(path)
+            assert stats["truncated"] is None
+            assert sv2.snapshot_id() == sv.snapshot_id()
+            j2.attach(sv2)
+            pub2 = ReplicationPublisher(
+                sv2, repl, journal=j2
+            ).attach().start()
+            try:
+                # commit one more delta; the reconnected follower must
+                # land it WITHOUT any full resync
+                sid = sv2.sync(pb2.SyncRequest()).snapshot_id
+                assert wait_until(
+                    lambda: follower.snapshot_id() == sid
+                )
+                assert applier.resyncs == resyncs0
+                assert pub2.resumed_subscriptions >= 1
+                assert_mirror_parity(sv2, follower)
+            finally:
+                pub2.stop()
+        finally:
+            sub.stop()

@@ -864,6 +864,133 @@ class TestLockHeldDispatch:
         assert out == []
 
 
+class TestBareRetry:
+    """ISSUE 11: retry loops with a fixed ``time.sleep`` and no
+    jitter/cap/deadline fail lint; computed (policy-paced) delays and
+    poll loops without failure-eating handlers stay clean."""
+
+    def test_fixed_sleep_retry_loop_caught(self):
+        out = lint(
+            """
+            import time
+
+            def redial(path):
+                while True:
+                    try:
+                        connect(path)
+                        return
+                    except OSError:
+                        pass
+                    time.sleep(0.05)
+            """,
+            ["bare-retry"],
+        )
+        assert len(out) == 1
+        assert out[0].rule == "bare-retry"
+        assert "BackoffPolicy" in out[0].message
+
+    def test_bare_sleep_import_form_caught(self):
+        out = lint(
+            """
+            from time import sleep
+
+            def poll():
+                for attempt in range(5):
+                    try:
+                        return fetch()
+                    except ValueError:
+                        sleep(2)
+            """,
+            ["bare-retry"],
+        )
+        assert len(out) == 1
+
+    def test_policy_paced_delay_is_clean(self):
+        out = lint(
+            """
+            import time
+
+            def redial(path, backoff):
+                attempt = 0
+                while True:
+                    try:
+                        connect(path)
+                        return
+                    except OSError:
+                        pass
+                    time.sleep(backoff.delay_ms(attempt) / 1000.0)
+                    attempt += 1
+            """,
+            ["bare-retry"],
+        )
+        assert out == []
+
+    def test_poll_loop_without_except_is_clean(self):
+        # a liveness/status poll retries nothing — no handler in the
+        # loop, no violation (bench's ppid watch is this shape)
+        out = lint(
+            """
+            import time
+
+            def watch(ppid):
+                while alive(ppid):
+                    time.sleep(0.5)
+            """,
+            ["bare-retry"],
+        )
+        assert out == []
+
+    def test_except_outside_loop_is_clean(self):
+        out = lint(
+            """
+            import time
+
+            def watch(ppid):
+                try:
+                    while alive(ppid):
+                        time.sleep(0.5)
+                except KeyboardInterrupt:
+                    pass
+            """,
+            ["bare-retry"],
+        )
+        assert out == []
+
+    def test_nested_loops_report_once(self):
+        out = lint(
+            """
+            import time
+
+            def drain(items):
+                while True:
+                    for it in items:
+                        try:
+                            push(it)
+                        except OSError:
+                            time.sleep(1)
+            """,
+            ["bare-retry"],
+        )
+        assert len(out) == 1
+
+    def test_suppression_tag(self):
+        out = lint(
+            """
+            import time
+
+            def watch(path):
+                while True:
+                    try:
+                        check(path)
+                    except OSError:
+                        pass
+                    time.sleep(0.5)  # koordlint: disable=bare-retry(fixed-cadence status poll, not a retry)
+            """,
+            ["bare-retry"],
+        )
+        assert out == []
+
+
 class TestBroadExcept:
     def test_silent_swallow_caught_and_tag_respected(self):
         got = lint("""

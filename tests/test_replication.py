@@ -936,6 +936,119 @@ class TestReplicaAwareClient:
                 fsrv.stop(0)
 
 
+# ---- client retry policy: baseline survival + leader failover ----
+
+class TestClientRetryAndFailover:
+    def _client_kit(self, tmp):
+        from koordinator_tpu.bridge.client import ScorerClient
+        from koordinator_tpu.bridge.server import make_server
+        from koordinator_tpu.replication.retry import BackoffPolicy
+
+        req, _ = _tiny_sync(pods=16, nodes=4)
+        lsock = os.path.join(tmp, "l.sock")
+        fsock = os.path.join(tmp, "f.sock")
+        leader_sv = ScorerServicer(score_memo=False)
+        follower_sv = FollowerServicer(score_memo=False)
+        lsrv = make_server(servicer=leader_sv)
+        lsrv.add_insecure_port(f"unix://{lsock}")
+        lsrv.start()
+        fsrv = make_server(servicer=follower_sv)
+        fsrv.add_insecure_port(f"unix://{fsock}")
+        fsrv.start()
+        client = ScorerClient(
+            f"unix://{lsock}", followers=[f"unix://{fsock}"],
+            retry_policy=BackoffPolicy(
+                base_ms=5, cap_ms=40, deadline_ms=1500
+            ),
+        )
+        kw = dict(
+            node_allocatable=np.frombuffer(
+                req.nodes.allocatable.data, "<i8"
+            ).reshape(tuple(req.nodes.allocatable.shape)),
+            node_usage=np.frombuffer(
+                req.nodes.usage.data, "<i8"
+            ).reshape(tuple(req.nodes.usage.shape)),
+            pod_requests=np.frombuffer(
+                req.pods.requests.data, "<i8"
+            ).reshape(tuple(req.pods.requests.shape)),
+        )
+        return leader_sv, follower_sv, lsrv, fsrv, client, kw
+
+    def test_sync_keeps_delta_baseline_across_transient_errors(self):
+        """The ISSUE-11 satellite regression: a transient channel
+        outage (leader down, UNAVAILABLE through the whole retry
+        budget) must surface the error with the BASELINE INTACT —
+        no nulled ``_generation``, no silently-forced full resync —
+        and the next sync after the leader returns rides the delta
+        path with its continuity check satisfied."""
+        with tempfile.TemporaryDirectory() as tmp:
+            leader_sv, follower_sv, lsrv, fsrv, client, kw = (
+                self._client_kit(tmp)
+            )
+            try:
+                client.sync(**kw)
+                gen = client._generation
+                assert gen is not None
+                baseline_keys = set(client._prev)
+                lsrv.stop(0)  # transient outage begins
+                import grpc as _grpc
+
+                with pytest.raises(_grpc.RpcError):
+                    client.sync(
+                        node_usage=kw["node_usage"] + 1
+                    )
+                # the one assertion this satellite exists for:
+                assert client._generation == gen
+                assert set(client._prev) == baseline_keys
+                # leader returns (same servicer, same epoch/state):
+                # the DELTA path resumes — no full resync needed
+                from koordinator_tpu.bridge.server import make_server
+
+                lsrv2 = make_server(servicer=leader_sv)
+                lsrv2.add_insecure_port(
+                    f"unix://{os.path.join(tmp, 'l.sock')}"
+                )
+                lsrv2.start()
+                try:
+                    reply = client.sync(node_usage=kw["node_usage"] + 1)
+                    assert client._generation == gen + 1
+                    assert reply.snapshot_id == leader_sv.snapshot_id()
+                finally:
+                    lsrv2.stop(0)
+            finally:
+                client.close()
+                fsrv.stop(0)
+
+    def test_sync_fails_over_to_promoted_follower(self):
+        """Leader dead, follower promoted: the Sync probe finds the
+        new writer ("one writer" refusals mean keep looking), the
+        epoch fence forces exactly one full resync, and Assign
+        follows the writer role."""
+        with tempfile.TemporaryDirectory() as tmp:
+            leader_sv, follower_sv, lsrv, fsrv, client, kw = (
+                self._client_kit(tmp)
+            )
+            try:
+                client.sync(**kw)
+                # follower holds the leader's state, then the leader
+                # dies and the follower is promoted
+                applier = ReplicaApplier(follower_sv)
+                assert applier.offer(_full_frame(leader_sv)) == APPLIED
+                lsrv.stop(0)
+                follower_sv.promote()
+                reply = client.sync(node_usage=kw["node_usage"] + 3)
+                assert reply.snapshot_id == follower_sv.snapshot_id()
+                assert client._leader_idx == 0
+                # reads and Assign follow the new writer
+                out = client.score_flat(top_k=4)
+                assert out[0].size
+                assignment, status, _ms, _path = client.assign()
+                assert assignment.size
+            finally:
+                client.close()
+                fsrv.stop(0)
+
+
 # ---- scheduler daemon integration ----
 
 class TestSchedulerServerRoles:
@@ -985,3 +1098,96 @@ class TestSchedulerServerRoles:
                 if follower_srv is not None:
                     follower_srv.stop()
                 leader_srv.stop()
+
+    def test_journal_daemon_warm_restart_and_promotion(self):
+        """ISSUE 11 end to end at the daemon layer: a --journal leader
+        warm-restarts onto the same chain (healthz carries the journal
+        block), and a follower daemon promotes through the raw-UDS
+        admin RPC — accepting Syncs, publishing on its own .repl."""
+        from koordinator_tpu.replication.follower import promote_replica
+        from koordinator_tpu.scheduler.server import SchedulerServer
+
+        with tempfile.TemporaryDirectory() as tmp:
+            state_dir = os.path.join(tmp, "state")
+            req, _ = _tiny_sync(pods=16, nodes=4)
+
+            def leader_daemon():
+                return SchedulerServer(
+                    lease_path=os.path.join(tmp, "l.lease"),
+                    uds_path=os.path.join(tmp, "l.sock"),
+                    http_port=0,
+                    enable_grpc=False,
+                    state_dir=state_dir,
+                    journal=True,
+                ).start()
+
+            leader_srv = leader_daemon()
+            try:
+                leader_srv.servicer.sync(req)
+                sid = leader_srv.servicer.snapshot_id()
+                health = leader_srv.replica_health()
+                assert health["journal"]["position"] == 1
+                assert health["journal"]["appends"] == 1
+            finally:
+                leader_srv.stop()
+            # restart against the same state dir: same chain resumed
+            leader_srv = leader_srv2 = leader_daemon()
+            follower_srv = None
+            try:
+                assert leader_srv2.servicer.snapshot_id() == sid
+                health = leader_srv2.replica_health()
+                assert health["journal"]["replayed_frames"] >= 1
+                assert health["journal"]["replay_ms"] is not None
+                # a follower joins, then gets promoted via admin RPC
+                follower_srv = SchedulerServer(
+                    lease_path=os.path.join(tmp, "f.lease"),
+                    uds_path=os.path.join(tmp, "f.sock"),
+                    http_port=0,
+                    enable_grpc=False,
+                    state_dir=os.path.join(tmp, "fstate"),
+                    journal=True,
+                    replicate_from=leader_srv2.repl_path,
+                ).start()
+                assert _wait_until(
+                    lambda: follower_srv.servicer.snapshot_id() == sid
+                )
+                new_sid = promote_replica(
+                    os.path.join(tmp, "f.sock") + ".raw"
+                )
+                assert new_sid == follower_srv.servicer.snapshot_id()
+                assert new_sid.split("-")[0] != sid.split("-")[0]
+                health = follower_srv.replica_health()
+                assert health["role"] == "leader"
+                assert health["promoted"] is True
+                assert health["journal"]["position"] is not None
+                # the promoted daemon accepts Syncs and publishes on
+                # its own .repl (a fresh follower can subscribe)
+                follower_srv.servicer.sync(pb2.SyncRequest())
+                assert os.path.exists(follower_srv.repl_path)
+                # idempotent: a second promote returns the current id
+                assert promote_replica(
+                    os.path.join(tmp, "f.sock") + ".raw"
+                ) == follower_srv.servicer.snapshot_id()
+            finally:
+                if follower_srv is not None:
+                    follower_srv.stop()
+                leader_srv2.stop()
+
+    def test_promote_refused_on_leader_daemon(self):
+        from koordinator_tpu.replication.follower import promote_replica
+        from koordinator_tpu.scheduler.server import SchedulerServer
+
+        with tempfile.TemporaryDirectory() as tmp:
+            srv = SchedulerServer(
+                lease_path=os.path.join(tmp, "l.lease"),
+                uds_path=os.path.join(tmp, "l.sock"),
+                http_port=0,
+                enable_grpc=False,
+                state_dir=None,
+            ).start()
+            try:
+                with pytest.raises(RuntimeError) as ei:
+                    promote_replica(os.path.join(tmp, "l.sock") + ".raw")
+                assert "already the leader" in str(ei.value)
+            finally:
+                srv.stop()
