@@ -151,6 +151,19 @@ class _ArtifactDeadline:
         return self
 
 
+def _bad_finite_nonneg(v, minimum: float = 0.0) -> bool:
+    """True when ``v`` is NOT a finite number >= ``minimum`` (bools
+    excluded) — the one numeric-acceptance rule every per-field check
+    in ``_validate_artifact`` shares."""
+    return (
+        isinstance(v, bool)
+        or not isinstance(v, (int, float))
+        or v != v
+        or v in (float("inf"), float("-inf"))
+        or v < minimum
+    )
+
+
 def _validate_artifact(line: Optional[str]) -> list:
     """Small schema over the one BENCH_*.json line: a crashed or
     half-finished stage must not publish a partial artifact the driver
@@ -210,13 +223,7 @@ def _validate_artifact(line: Optional[str]) -> list:
         v = doc.get(key)
         if v is None:
             return
-        if (
-            isinstance(v, bool)
-            or not isinstance(v, (int, float))
-            or v != v
-            or v in (float("inf"), float("-inf"))
-            or v < minimum
-        ):
+        if _bad_finite_nonneg(v, minimum):
             problems.append(f"'{key}' must be null or a finite number >= {minimum:g}")
 
     _finite_nonneg("coalesce_batch_mean", minimum=1.0)
@@ -283,12 +290,73 @@ def _validate_artifact(line: Optional[str]) -> list:
     _finite_nonneg("warm_restart_ms")
     _finite_nonneg("journal_replay_ms")
     _finite_nonneg("journal_append_us")
-    for key in ("resyncs_during_failover", "reads_during_failover"):
+    # non-negative count fields, one rule: the crash-tolerance probe's
+    # (ISSUE 11) and the trace replay's (ISSUE 12) — the latter are
+    # the realistic-workload numbers every future round carries
+    for key in ("resyncs_during_failover", "reads_during_failover",
+                "trace_events", "trace_parity_checks", "trace_retraces",
+                "trace_seed"):
         v = doc.get(key)
         if v is not None and (
             isinstance(v, bool) or not isinstance(v, int) or v < 0
         ):
             problems.append(f"'{key}' must be null or an int >= 0")
+    # trace-replay SLO-gate fields (ISSUE 12): per-band / per-RPC
+    # p99s and the declarative SLO verdicts; malformed ones must not
+    # be archived
+    td = doc.get("trace_digest")
+    if td is not None and (not isinstance(td, str) or not td):
+        problems.append("'trace_digest' must be a non-empty string")
+    tsp = doc.get("trace_slo_pass")
+    if tsp is not None and not isinstance(tsp, bool):
+        problems.append("'trace_slo_pass' must be a boolean")
+    for key in ("trace_band_p99_ms", "trace_rpc_p99_ms"):
+        obj = doc.get(key)
+        if obj is None:
+            continue
+        if not isinstance(obj, dict):
+            problems.append(f"'{key}' must be an object")
+            continue
+        for name, v in obj.items():
+            if not isinstance(name, str) or not name:
+                problems.append(f"'{key}' keys must be non-empty strings")
+            elif v is not None and _bad_finite_nonneg(v):
+                problems.append(
+                    f"'{key}.{name}' must be null or a finite number >= 0"
+                )
+    slo = doc.get("trace_slo")
+    if slo is not None:
+        if not isinstance(slo, list):
+            problems.append("'trace_slo' must be a list")
+        else:
+            for i, verdict in enumerate(slo):
+                if not isinstance(verdict, dict):
+                    problems.append(f"'trace_slo[{i}]' must be an object")
+                    continue
+                if not isinstance(verdict.get("name"), str) or not verdict.get("name"):
+                    problems.append(
+                        f"'trace_slo[{i}].name' must be a non-empty string"
+                    )
+                if not isinstance(verdict.get("ok"), bool):
+                    problems.append(f"'trace_slo[{i}].ok' must be a boolean")
+                q = verdict.get("quantile")
+                if (
+                    isinstance(q, bool)
+                    or not isinstance(q, (int, float))
+                    or not 0.0 < q <= 1.0
+                ):
+                    problems.append(
+                        f"'trace_slo[{i}].quantile' must be in (0, 1]"
+                    )
+                for field in ("threshold_ms", "observed_ms"):
+                    v = verdict.get(field)
+                    if field == "observed_ms" and v is None:
+                        continue  # no-data verdicts observe nothing
+                    if _bad_finite_nonneg(v):
+                        problems.append(
+                            f"'trace_slo[{i}].{field}' must be a finite "
+                            "number >= 0"
+                        )
     # per-stage span summary (ISSUE 4): stage name -> milliseconds, or
     # null for a stage that measured nothing (a failed best-effort leg
     # must stay VISIBLE as null, never invented) — so BENCH_*.json
@@ -301,13 +369,7 @@ def _validate_artifact(line: Optional[str]) -> list:
             for name, v in spans.items():
                 if not isinstance(name, str) or not name:
                     problems.append("'spans' keys must be non-empty strings")
-                elif v is not None and (
-                    isinstance(v, bool)
-                    or not isinstance(v, (int, float))
-                    or v != v
-                    or v in (float("inf"), float("-inf"))
-                    or v < 0
-                ):
+                elif v is not None and _bad_finite_nonneg(v):
                     problems.append(
                         f"'spans.{name}' must be null or a finite "
                         "number >= 0"
@@ -1029,6 +1091,128 @@ def child_config(platform: str, config: str) -> None:
             if p.get("priority_class") is not None
             else PriorityClass.from_priority_value(p.get("priority")),
         )
+
+    if config == "trace":
+        # ISSUE 12: trace-driven cluster simulator + continuous SLO
+        # gate.  A seeded multi-band event stream (gang arrivals
+        # respecting minMember, ElasticQuota pressure waves, node
+        # drains/resizes, per-band priority churn) replays through the
+        # full client -> UDS gRPC -> coalescer -> device path on BOTH
+        # the full-engine servicer and the serialized oracle: reply
+        # digests compared per event, the measured pass held at zero
+        # jit cache misses (retrace_guard raises otherwise), and the
+        # per-band p99s judged by the declarative obs/slo.py specs —
+        # the artifact carries the verdicts, so every future round has
+        # a realistic-workload number beside the microbenchmark.
+        from koordinator_tpu.harness.trace import (
+            TraceConfig,
+            TraceReplay,
+            default_slo_specs,
+            generate_trace,
+        )
+        from koordinator_tpu.obs import validate_flight_dump
+        from koordinator_tpu.obs import slo as slo_mod
+
+        def _env_int(name, default):
+            # `or`: empty value means unset (the KOORD_* convention)
+            return int(os.environ.get(name) or default)
+
+        on_cpu = backend == "cpu"
+        # the gang region and tenant count scale WITH the pod-slot
+        # knob (floored at 16 slots): pinning them while pod_slots is
+        # operator-sizable would make small KOORD_BENCH_TRACE_PODS
+        # values crash generate_trace's gang-region check instead of
+        # producing a smaller trace
+        pod_slots = max(16, _env_int(
+            "KOORD_BENCH_TRACE_PODS", 256 if on_cpu else 2048
+        ))
+        gang_min_member = 4
+        gangs = max(1, min(12, pod_slots // (4 * gang_min_member)))
+        tcfg = TraceConfig(
+            seed=_env_int("KOORD_BENCH_TRACE_SEED", 0),
+            nodes=_env_int(
+                "KOORD_BENCH_TRACE_NODES", 64 if on_cpu else 512
+            ),
+            pod_slots=pod_slots,
+            tenants=max(2, min(8, pod_slots // 32)),
+            gangs=gangs,
+            gang_min_member=gang_min_member,
+            events=max(1, _env_int(
+                "KOORD_BENCH_TRACE_EVENTS", 48 if on_cpu else 96
+            )),
+        )
+        trace = generate_trace(tcfg)
+        phase(
+            "trace_generated",
+            events=len(trace.events),
+            digest=trace.digest()[:12],
+            bands=trace.bands(),
+        )
+        # run() = one untimed warm-up pass over the identical stream,
+        # then the measured pass under retrace_guard(budget=0): a warm
+        # event that retraces, or a reply byte diverging from the
+        # serial oracle, raises here — no artifact is published on a
+        # broken invariant
+        report = TraceReplay(trace).run()
+        phase(
+            "trace_replayed",
+            wall_ms=round(report.wall_ms, 1),
+            parity_checks=report.parity_checks,
+            retraces=report.retraces,
+        )
+        timeline = report.timeline_document()
+        problems = validate_flight_dump(timeline)
+        assert not problems, (
+            f"trace timeline failed the flight-dump schema: {problems}"
+        )
+        specs = default_slo_specs(trace.bands())
+        verdicts = slo_mod.evaluate_slos(report.registry, specs)
+        band_p99 = {
+            band: report.quantile(0.99, band=band)
+            for band in trace.bands()
+        }
+        rpc_p99 = {
+            rpc: report.quantile(0.99, rpc=rpc)
+            for rpc in ("sync", "score", "assign", "cycle")
+        }
+        overall_p99 = report.quantile(0.99)
+        # a replay with zero recorded steps (a pathological mix where
+        # the generator could act on nothing) has no latency to
+        # publish — fail the stage honestly instead of crashing on
+        # round(None) below; the parent's error artifact says why
+        assert overall_p99 is not None, (
+            "trace replay recorded no latency observations "
+            f"({report.events_replayed} events replayed)"
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "trace_cycle_p99_ms",
+                    "value": round(float(overall_p99), 3),
+                    "unit": "ms",
+                    "backend": backend,
+                    "trace_seed": tcfg.seed,
+                    "trace_digest": trace.digest(),
+                    "trace_events": report.events_replayed,
+                    "trace_parity_checks": report.parity_checks,
+                    "trace_retraces": report.retraces,
+                    "trace_band_p99_ms": {
+                        b: (None if v is None else round(v, 3))
+                        for b, v in band_p99.items()
+                    },
+                    "trace_rpc_p99_ms": {
+                        r: (None if v is None else round(v, 3))
+                        for r, v in rpc_p99.items()
+                    },
+                    "trace_slo": [v.to_doc() for v in verdicts],
+                    "trace_slo_pass": slo_mod.slos_pass(verdicts),
+                    "trace_nodes": tcfg.nodes,
+                    "trace_pods": tcfg.pod_slots,
+                }
+            ),
+            flush=True,
+        )
+        return
 
     if config == "spark":
         # BASELINE config #1: exact NodeScoreList parity on the 3-node
@@ -3253,7 +3437,7 @@ def main() -> int:
         default=None,
         choices=[
             "spark", "loadaware", "gang", "extras", "rebalance", "smoke",
-            "bridge", "mesh", "replica", "failover",
+            "bridge", "mesh", "replica", "failover", "trace",
         ],
         help="measure a secondary BASELINE config instead of the headline "
         "10k x 2k quota_colocation cycle (driver contract: no args prints "

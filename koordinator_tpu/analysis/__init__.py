@@ -44,6 +44,11 @@ Rules (each suppressible per line with ``# koordlint: disable=<rule>``):
   the hand-rolled Go codec in go/scorerclient/wire.go + delta.go:
   field names, numbers, emit order, integer widths, endianness helpers
   and the shared delta-ratio constant.
+* ``metrics-doc-drift`` — statically diffs the ``koord_scorer_*``
+  families registered in obs/scorer_metrics.py against the family
+  table in docs/OBSERVABILITY.md, both directions plus the declared
+  kind: an undocumented metric or a documented-but-never-exported one
+  fails lint like a one-sided wire edit.
 
 The runtime companion ``analysis.retrace_guard`` locks the warm path's
 compile economics in at test time (tests/test_resident_warm.py).
@@ -69,4 +74,5 @@ RULES = (
     "lock-held-dispatch",
     "bare-retry",
     "wire-contract",
+    "metrics-doc-drift",
 )

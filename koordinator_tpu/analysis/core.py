@@ -188,8 +188,9 @@ def run_repo(
     wire: bool = True,
 ) -> List[Violation]:
     """The full pass: AST rules over every repo Python file plus the
-    cross-language wire-contract diff.  Returns sorted violations."""
-    from koordinator_tpu.analysis import wire_contract
+    cross-language wire-contract diff and the metrics-vs-doc table
+    diff.  Returns sorted violations."""
+    from koordinator_tpu.analysis import metricsdoc, wire_contract
 
     root = root or find_repo_root(os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__)))))
@@ -206,6 +207,8 @@ def run_repo(
             out.extend(_run_file(path, root, rules))
     if wire and (rules is None or "wire-contract" in rules):
         out.extend(_filter_file_comments(root, wire_contract.check_repo(root)))
+    if rules is None or "metrics-doc-drift" in rules:
+        out.extend(_filter_file_comments(root, metricsdoc.check_repo(root)))
     out.sort(key=lambda v: (v.path, v.line, v.rule))
     return out
 

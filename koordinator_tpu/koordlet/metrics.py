@@ -204,6 +204,26 @@ class MetricsRegistry:
                 return None
             return int(state[-1]), float(state[-2])
 
+    def histogram_series(
+        self, name: str
+    ) -> List[Tuple[Dict[str, str], Tuple[float, ...], Tuple[int, ...], float, int]]:
+        """Every series of one histogram family as
+        ``(labels, bucket_bounds, cumulative_counts, sum, count)`` —
+        the read seam obs/slo.py's quantile estimator consumes.
+        ``cumulative_counts[i]`` is observations ``<= bucket_bounds[i]``
+        (the exposition's ``_bucket{le=...}`` semantics).  Copies are
+        returned, so callers can diff windows without racing writers."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None or fam.kind != "histogram" or fam.buckets is None:
+                return []
+            n = len(fam.buckets)
+            return [
+                (dict(k), fam.buckets, tuple(state[:n]),
+                 float(state[-2]), int(state[-1]))
+                for k, state in fam.series.items()
+            ]
+
     # -- the koordlet metric families (metrics/*.go) --
     def record_container_cpi(
         self, pod: str, container: str, cycles: float, instructions: float

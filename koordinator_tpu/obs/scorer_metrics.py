@@ -45,6 +45,7 @@ koord_scorer_journal_bytes             gauge     — (journal file size)
 koord_scorer_journal_compaction_stamp  gauge     — (us since epoch, last compaction)
 koord_scorer_failover_total            counter   event (promoted|warm_restart)
 koord_scorer_retry_total               counter   op (subscribe|resume)
+koord_scorer_trace_cycle_ms            histogram band, rpc
 ====================================== ========= ==========================
 
 The ``koord_scorer_coalesce_*`` families observe the coalescing
@@ -128,6 +129,7 @@ JOURNAL_BYTES = "koord_scorer_journal_bytes"
 JOURNAL_COMPACTION_STAMP = "koord_scorer_journal_compaction_stamp"
 FAILOVER_TOTAL = "koord_scorer_failover_total"
 RETRY_TOTAL = "koord_scorer_retry_total"
+TRACE_CYCLE = "koord_scorer_trace_cycle_ms"
 
 # occupancy is a count-of-requests-per-launch, not a latency: its own
 # power-of-two buckets (the dispatcher caps batches at 16 by default;
@@ -246,6 +248,12 @@ _FAMILIES = (
      "backed-off retries through the shared replication.retry policy, "
      "by operation (subscribe = follower redial; resume = a "
      "subscription served from the journal instead of a full frame)"),
+    (TRACE_CYCLE, "histogram",
+     "client-observed latency of one trace-replay step (ISSUE 12, "
+     "harness/trace.py), by priority band (koord-prod|mid|batch|free; "
+     "infra = node/quota events) and rpc (sync|score|assign|cycle = "
+     "the whole step); the obs/slo.py SLO gate judges its per-band "
+     "p99s in bench.py --config trace"),
 )
 
 # journal appends are MICROsecond-scale (a header pack + one buffered
@@ -424,3 +432,13 @@ class ScorerMetrics:
 
     def count_retry(self, op: str) -> None:
         self.registry.counter_add(RETRY_TOTAL, 1, {"op": op})
+
+    # -- trace-driven replay (ISSUE 12) --
+    def observe_trace_cycle(self, band: str, rpc: str, ms: float) -> None:
+        """One replay step's client-observed latency: ``rpc`` is the
+        individual RPC (sync/score/assign) or ``cycle`` for the whole
+        step, ``band`` the priority band of the workload the step
+        schedules (``infra`` for node/quota events)."""
+        self.registry.histogram_observe(
+            TRACE_CYCLE, float(ms), {"band": band or "infra", "rpc": rpc}
+        )

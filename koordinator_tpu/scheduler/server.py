@@ -246,6 +246,16 @@ class SchedulerServer:
                 **servicer_kw,
             )
         self.api = APIService()
+        # /healthz slo block (ISSUE 12): last-window p50/p99 per
+        # cycle-latency series (path/wave labels), from the SAME
+        # obs/slo.py estimator the trace-replay SLO gate judges with —
+        # operators read the identical numbers.  One window per
+        # /healthz request.
+        from koordinator_tpu.obs.slo import SloWindow
+        from koordinator_tpu.obs.scorer_metrics import CYCLE_LATENCY
+
+        self._slo_window = SloWindow(families=(CYCLE_LATENCY,))
+        self._slo_lock = threading.Lock()
         self.uds_path = uds_path
         self.enable_grpc = enable_grpc
         self._raw_server: Optional[RawUdsServer] = None
@@ -278,6 +288,10 @@ class SchedulerServer:
                             "last_sync_path": outer.servicer.state.last_sync_path,
                             # replication tier visibility (ISSUE 8)
                             "replica": outer.replica_health(),
+                            # SLO visibility (ISSUE 12): last-window
+                            # per-series quantiles from the gate's
+                            # own estimator
+                            "slo": outer.slo_health(),
                         },
                     )
                     return
@@ -370,6 +384,18 @@ class SchedulerServer:
                     self.journal_replay["replay_ms"]
                 )
         return out
+
+    def slo_health(self) -> dict:
+        """The /healthz ``slo`` block: per-series p50/p99 of the cycle
+        latency histogram over the window since the LAST /healthz
+        request (first request: since boot), estimated by the same
+        ``obs/slo.py`` bucket quantiles the trace-replay SLO gate
+        uses (docs/OBSERVABILITY.md "The SLO gate")."""
+        with self._slo_lock:
+            window = self._slo_window.advance(
+                self.servicer.telemetry.registry
+            )
+        return {"window": window}
 
     # -- crash tolerance (ISSUE 11) --
     def _journal_path(self) -> str:

@@ -301,3 +301,113 @@ class TestArtifactSchemaSpans:
         src = inspect.getsource(bench.child)
         for key in ("lowering_probe", "wave_compile", "cpu_native_mt"):
             assert f'"{key}": None' in src
+
+
+class TestTraceArtifactFields:
+    """ISSUE 12: the trace-replay SLO-gate fields must be archived
+    well-formed or not at all, and a deadline-killed trace replay must
+    still flush one schema-valid ``"truncated": true`` artifact."""
+
+    def _line(self, **extra):
+        doc = {"metric": "trace_cycle_p99_ms", "value": 9.9, "unit": "ms"}
+        doc.update(extra)
+        return json.dumps(doc)
+
+    def _verdict(self, **over):
+        doc = {"name": "koord-prod-cycle-p99", "quantile": 0.99,
+               "threshold_ms": 2500.0, "observed_ms": 12.5,
+               "count": 5, "ok": True}
+        doc.update(over)
+        return doc
+
+    def test_valid_trace_fields_pass(self):
+        assert bench._validate_artifact(self._line(
+            trace_events=48,
+            trace_parity_checks=49,
+            trace_retraces=0,
+            trace_seed=0,
+            trace_digest="abc123",
+            trace_band_p99_ms={"koord-prod": 12.5, "infra": None},
+            trace_rpc_p99_ms={"sync": 1.0, "score": 3.0},
+            trace_slo=[self._verdict(),
+                       self._verdict(ok=False, observed_ms=None)],
+            trace_slo_pass=True,
+        )) == []
+        # every trace field is optional (other configs omit them all)
+        assert bench._validate_artifact(self._line()) == []
+
+    def test_malformed_counts_fail(self):
+        assert bench._validate_artifact(self._line(trace_events=-1))
+        assert bench._validate_artifact(self._line(trace_events=True))
+        assert bench._validate_artifact(self._line(trace_retraces=1.5))
+        assert bench._validate_artifact(self._line(trace_parity_checks="x"))
+        assert bench._validate_artifact(self._line(trace_digest=""))
+        assert bench._validate_artifact(self._line(trace_slo_pass="yes"))
+
+    def test_malformed_band_maps_fail(self):
+        assert bench._validate_artifact(self._line(trace_band_p99_ms=[1]))
+        assert bench._validate_artifact(
+            self._line(trace_band_p99_ms={"prod": -1})
+        )
+        assert bench._validate_artifact(
+            self._line(trace_rpc_p99_ms={"sync": float("inf")})
+        )
+        assert bench._validate_artifact(
+            self._line(trace_band_p99_ms={"": 1.0})
+        )
+
+    def test_malformed_verdicts_fail(self):
+        assert bench._validate_artifact(self._line(trace_slo={}))
+        assert bench._validate_artifact(self._line(trace_slo=[[]]))
+        assert bench._validate_artifact(
+            self._line(trace_slo=[self._verdict(name="")])
+        )
+        assert bench._validate_artifact(
+            self._line(trace_slo=[self._verdict(ok="yes")])
+        )
+        assert bench._validate_artifact(
+            self._line(trace_slo=[self._verdict(quantile=0.0)])
+        )
+        assert bench._validate_artifact(
+            self._line(trace_slo=[self._verdict(quantile=1.5)])
+        )
+        assert bench._validate_artifact(
+            self._line(trace_slo=[self._verdict(threshold_ms=-5)])
+        )
+        assert bench._validate_artifact(
+            self._line(trace_slo=[self._verdict(observed_ms=float("nan"))])
+        )
+
+    def test_deadline_killed_trace_replay_flushes_truncated_artifact(self):
+        """The _ArtifactDeadline flush path covers --config trace: a
+        replay hanging past the budget (a wedged UDS server, a compile
+        storm) must still put ONE schema-valid truncated artifact on
+        stdout, stamped with the trace stage it died in — the
+        BENCH_r05 rc=124-no-artifact class must not reopen for the new
+        config."""
+        emitted, fired = [], []
+        now = [0.0]
+
+        def sleep(s):
+            now[0] += s
+
+        d = bench._ArtifactDeadline(
+            100.0,
+            emit=lambda line: emitted.append(line) or True,
+            clock=lambda: now[0],
+            sleep=sleep,
+            on_fire=lambda rc: fired.append(rc),
+            metric="trace",  # main() arms the deadline with args.config
+        )
+        old_stage = bench._PROGRESS["stage"]
+        try:
+            bench._PROGRESS["stage"] = "config_trace_cpu"
+            d.watch()
+        finally:
+            bench._PROGRESS["stage"] = old_stage
+        assert fired == [1] and len(emitted) == 1
+        assert bench._validate_artifact(emitted[0]) == []
+        doc = json.loads(emitted[0])
+        assert doc["truncated"] is True
+        assert doc["metric"] == "trace"
+        assert "config_trace_cpu" in doc["error"]

@@ -1029,6 +1029,115 @@ class TestBroadExcept:
         """) == []
 
 
+class TestMetricsDocDrift:
+    """ISSUE 12: the koord_scorer_* family table in OBSERVABILITY.md is
+    the operator contract — one-sided drift against the families
+    registered in obs/scorer_metrics.py must fail lint in BOTH
+    directions, kinds included (the wire-contract shape applied to
+    observability)."""
+
+    PY_FIXTURE = textwrap.dedent('''
+        CYCLE_LATENCY = "koord_scorer_cycle_latency_ms"
+        SHED_TOTAL = "koord_scorer_shed_total"
+
+        _FAMILIES = (
+            (CYCLE_LATENCY, "histogram", "cycle latency"),
+            (SHED_TOTAL, "counter", "shed reads"),
+            ("koord_scorer_replica_lag_ms", "gauge", "inline literal"),
+        )
+    ''')
+    MD_FIXTURE = textwrap.dedent("""
+        # Observability
+
+        | family | kind | labels | meaning |
+        |---|---|---|---|
+        | `koord_scorer_cycle_latency_ms` | histogram | `path` | latency |
+        | `koord_scorer_shed_total` | counter | `method` | shed reads |
+        | `koord_scorer_replica_lag_ms` | gauge | — | follower lag |
+    """)
+
+    def test_aligned_sources_are_clean(self):
+        from koordinator_tpu.analysis import metricsdoc
+
+        assert metricsdoc.diff_metrics_doc(
+            self.PY_FIXTURE, self.MD_FIXTURE
+        ) == []
+
+    def test_head_is_clean(self):
+        from koordinator_tpu.analysis import metricsdoc
+
+        root = find_repo_root(REPO)
+        assert metricsdoc.check_repo(root) == []
+
+    def test_registered_but_undocumented_caught(self):
+        from koordinator_tpu.analysis import metricsdoc
+
+        bad_md = self.MD_FIXTURE.replace(
+            "| `koord_scorer_shed_total` | counter | `method` | shed reads |\n",
+            "",
+        )
+        got = metricsdoc.diff_metrics_doc(self.PY_FIXTURE, bad_md)
+        assert len(got) == 1
+        assert got[0].rule == "metrics-doc-drift"
+        assert "koord_scorer_shed_total" in got[0].message
+        assert "missing" in got[0].message
+        # flags the _FAMILIES entry's line in the PY source
+        assert got[0].path.endswith("scorer_metrics.py")
+        assert got[0].line > 0
+
+    def test_documented_but_unregistered_caught(self):
+        from koordinator_tpu.analysis import metricsdoc
+
+        bad_py = self.PY_FIXTURE.replace(
+            '    (SHED_TOTAL, "counter", "shed reads"),\n', ""
+        )
+        got = metricsdoc.diff_metrics_doc(bad_py, self.MD_FIXTURE)
+        assert len(got) == 1
+        assert "never registered" in got[0].message
+        # flags the doc row's line
+        assert got[0].path.endswith("OBSERVABILITY.md")
+        assert got[0].line > 0
+
+    def test_kind_mismatch_caught(self):
+        from koordinator_tpu.analysis import metricsdoc
+
+        bad_md = self.MD_FIXTURE.replace(
+            "| `koord_scorer_replica_lag_ms` | gauge |",
+            "| `koord_scorer_replica_lag_ms` | counter |",
+        )
+        got = metricsdoc.diff_metrics_doc(self.PY_FIXTURE, bad_md)
+        assert any(
+            "documented as 'counter'" in v.message
+            and "registered as 'gauge'" in v.message
+            for v in got
+        )
+
+    def test_unknown_documented_kind_caught(self):
+        from koordinator_tpu.analysis import metricsdoc
+
+        bad_md = self.MD_FIXTURE.replace(
+            "| `koord_scorer_shed_total` | counter |",
+            "| `koord_scorer_shed_total` | summary |",
+        )
+        got = metricsdoc.diff_metrics_doc(self.PY_FIXTURE, bad_md)
+        assert any("unknown kind 'summary'" in v.message for v in got)
+
+    def test_vanished_tables_fail_loudly(self):
+        from koordinator_tpu.analysis import metricsdoc
+
+        # a refactor that moves either table must update the parser,
+        # not silently disable the rule
+        got = metricsdoc.diff_metrics_doc("X = 1\n", self.MD_FIXTURE)
+        assert any("no _FAMILIES entries" in v.message for v in got)
+        got = metricsdoc.diff_metrics_doc(self.PY_FIXTURE, "# no table\n")
+        assert any("no koord_scorer_* rows" in v.message for v in got)
+
+    def test_rule_is_registered_and_runs_in_run_repo(self):
+        assert "metrics-doc-drift" in RULES
+        # rules-filtered run executes only this rule and stays clean
+        assert run_repo(root=REPO, rules=["metrics-doc-drift"]) == []
+
+
 class TestWireContract:
     """Seeded one-sided edits to a wire.go fixture must each fail."""
 

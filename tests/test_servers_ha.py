@@ -922,6 +922,56 @@ class TestRemoteHookDispatch:
             backend.stop()
 
 
+class TestHealthzSloBlock:
+    def test_healthz_serves_last_window_rpc_quantiles(self, tmp_path):
+        """ISSUE 12: /healthz carries an ``slo`` block — last-window
+        per-RPC p50/p99 over the cycle-latency histogram, from the
+        SAME obs/slo.py estimator the trace-replay SLO gate judges
+        with.  Window semantics: the second request sees only what
+        arrived since the first."""
+        import urllib.request
+
+        from koordinator_tpu.scheduler.server import SchedulerServer
+
+        s = SchedulerServer(
+            lease_path=str(tmp_path / "l.lease"),
+            uds_path=str(tmp_path / "scorer.sock"),
+            enable_grpc=False,
+        ).start()
+
+        def healthz():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{s.http_port}/healthz", timeout=5
+            ) as r:
+                return json.loads(r.read())
+
+        try:
+            metrics = s.servicer.telemetry.metrics
+            metrics.observe_cycle(12.0, path="scan", wave=1)
+            metrics.observe_cycle(14.0, path="scan", wave=1)
+            doc = healthz()
+            window = doc["slo"]["window"]["koord_scorer_cycle_latency_ms"]
+            series = window["path=scan,wave=1"]
+            assert series["count"] == 2
+            assert series["p50"] is not None and series["p99"] is not None
+            assert 0 < series["p50"] <= series["p99"]
+            # the next scrape's window is EMPTY until new cycles land
+            doc2 = healthz()
+            series2 = doc2["slo"]["window"][
+                "koord_scorer_cycle_latency_ms"
+            ]["path=scan,wave=1"]
+            assert series2["count"] == 0
+            assert series2["p99"] is None
+            metrics.observe_cycle(99.0, path="scan", wave=1)
+            series3 = healthz()["slo"]["window"][
+                "koord_scorer_cycle_latency_ms"
+            ]["path=scan,wave=1"]
+            assert series3["count"] == 1
+            assert series3["p99"] > series["p99"]
+        finally:
+            s.stop()
+
+
 class TestKernelDemotionSurfacing:
     def test_healthz_and_metrics_expose_demotions(self, tmp_path):
         import urllib.request
