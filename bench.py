@@ -295,22 +295,45 @@ def _validate_artifact(line: Optional[str]) -> list:
     # the realistic-workload numbers every future round carries
     for key in ("resyncs_during_failover", "reads_during_failover",
                 "trace_events", "trace_parity_checks", "trace_retraces",
-                "trace_seed"):
+                "trace_seed", "chaos_trace_events", "chaos_trace_seed",
+                "chaos_trace_errors", "chaos_trace_retraces",
+                "degraded_replies", "breaker_trips"):
         v = doc.get(key)
         if v is not None and (
             isinstance(v, bool) or not isinstance(v, int) or v < 0
         ):
             problems.append(f"'{key}' must be null or an int >= 0")
+    # chaos x trace gate fields (ISSUE 13): the recovery wall, the
+    # per-band shed ladder outcome and the combined SLO verdicts —
+    # malformed ones must not be archived
+    _finite_nonneg("recovery_ms")
+    sbb = doc.get("shed_by_band")
+    if sbb is not None:
+        if not isinstance(sbb, dict):
+            problems.append("'shed_by_band' must be an object")
+        else:
+            for name, v in sbb.items():
+                if not isinstance(name, str) or not name:
+                    problems.append(
+                        "'shed_by_band' keys must be non-empty strings"
+                    )
+                elif isinstance(v, bool) or not isinstance(v, int) or v < 0:
+                    problems.append(
+                        f"'shed_by_band.{name}' must be an int >= 0"
+                    )
     # trace-replay SLO-gate fields (ISSUE 12): per-band / per-RPC
     # p99s and the declarative SLO verdicts; malformed ones must not
     # be archived
-    td = doc.get("trace_digest")
-    if td is not None and (not isinstance(td, str) or not td):
-        problems.append("'trace_digest' must be a non-empty string")
-    tsp = doc.get("trace_slo_pass")
-    if tsp is not None and not isinstance(tsp, bool):
-        problems.append("'trace_slo_pass' must be a boolean")
-    for key in ("trace_band_p99_ms", "trace_rpc_p99_ms"):
+    for key in ("trace_digest", "chaos_trace_digest"):
+        td = doc.get(key)
+        if td is not None and (not isinstance(td, str) or not td):
+            problems.append(f"'{key}' must be a non-empty string")
+    for key in ("trace_slo_pass", "chaos_trace_slo_pass"):
+        tsp = doc.get(key)
+        if tsp is not None and not isinstance(tsp, bool):
+            problems.append(f"'{key}' must be a boolean")
+    for key in ("trace_band_p99_ms", "trace_rpc_p99_ms",
+                "storm_band_p99_ms"):
         obj = doc.get(key)
         if obj is None:
             continue
@@ -324,39 +347,46 @@ def _validate_artifact(line: Optional[str]) -> list:
                 problems.append(
                     f"'{key}.{name}' must be null or a finite number >= 0"
                 )
-    slo = doc.get("trace_slo")
-    if slo is not None:
+    def _check_slo_list(key):
+        """One SLO-verdict-list field (trace_slo / chaos_trace_slo):
+        the obs/slo.py SloVerdict.to_doc shape."""
+        slo = doc.get(key)
+        if slo is None:
+            return
         if not isinstance(slo, list):
-            problems.append("'trace_slo' must be a list")
-        else:
-            for i, verdict in enumerate(slo):
-                if not isinstance(verdict, dict):
-                    problems.append(f"'trace_slo[{i}]' must be an object")
-                    continue
-                if not isinstance(verdict.get("name"), str) or not verdict.get("name"):
+            problems.append(f"'{key}' must be a list")
+            return
+        for i, verdict in enumerate(slo):
+            if not isinstance(verdict, dict):
+                problems.append(f"'{key}[{i}]' must be an object")
+                continue
+            if not isinstance(verdict.get("name"), str) or not verdict.get("name"):
+                problems.append(
+                    f"'{key}[{i}].name' must be a non-empty string"
+                )
+            if not isinstance(verdict.get("ok"), bool):
+                problems.append(f"'{key}[{i}].ok' must be a boolean")
+            q = verdict.get("quantile")
+            if (
+                isinstance(q, bool)
+                or not isinstance(q, (int, float))
+                or not 0.0 < q <= 1.0
+            ):
+                problems.append(
+                    f"'{key}[{i}].quantile' must be in (0, 1]"
+                )
+            for field in ("threshold_ms", "observed_ms"):
+                v = verdict.get(field)
+                if field == "observed_ms" and v is None:
+                    continue  # no-data verdicts observe nothing
+                if _bad_finite_nonneg(v):
                     problems.append(
-                        f"'trace_slo[{i}].name' must be a non-empty string"
+                        f"'{key}[{i}].{field}' must be a finite "
+                        "number >= 0"
                     )
-                if not isinstance(verdict.get("ok"), bool):
-                    problems.append(f"'trace_slo[{i}].ok' must be a boolean")
-                q = verdict.get("quantile")
-                if (
-                    isinstance(q, bool)
-                    or not isinstance(q, (int, float))
-                    or not 0.0 < q <= 1.0
-                ):
-                    problems.append(
-                        f"'trace_slo[{i}].quantile' must be in (0, 1]"
-                    )
-                for field in ("threshold_ms", "observed_ms"):
-                    v = verdict.get(field)
-                    if field == "observed_ms" and v is None:
-                        continue  # no-data verdicts observe nothing
-                    if _bad_finite_nonneg(v):
-                        problems.append(
-                            f"'trace_slo[{i}].{field}' must be a finite "
-                            "number >= 0"
-                        )
+
+    _check_slo_list("trace_slo")
+    _check_slo_list("chaos_trace_slo")
     # per-stage span summary (ISSUE 4): stage name -> milliseconds, or
     # null for a stage that measured nothing (a failed best-effort leg
     # must stay VISIBLE as null, never invented) — so BENCH_*.json
@@ -828,8 +858,8 @@ def _score_storm(sock_path, snapshot_id, clients=8, per_client=3, top_k=32,
                 return out
 
             call()  # warm-up: compile + cold snapshot build, untimed
-            warmed.wait()
-            released.wait()
+            warmed.wait()  # koordlint: disable=unbounded-wait(storm barrier; the parent _spawn window and _ArtifactDeadline bound the whole process)
+            released.wait()  # koordlint: disable=unbounded-wait(storm barrier; the parent _spawn window and _ArtifactDeadline bound the whole process)
             for _ in range(per_client):
                 t0 = time.perf_counter()
                 out = call()
@@ -858,7 +888,7 @@ def _score_storm(sock_path, snapshot_id, clients=8, per_client=3, top_k=32,
     for t in threads:
         t.start()
     try:
-        warmed.wait()
+        warmed.wait()  # koordlint: disable=unbounded-wait(storm barrier; the parent _spawn window and _ArtifactDeadline bound the whole process)
         if on_start is not None:
             # snapshot dispatcher stats AFTER the untimed warm-ups and
             # BEFORE any worker is released, so batch-occupancy means
@@ -866,7 +896,7 @@ def _score_storm(sock_path, snapshot_id, clients=8, per_client=3, top_k=32,
             # timed request)
             on_start()
         t0 = time.perf_counter()
-        released.wait()
+        released.wait()  # koordlint: disable=unbounded-wait(storm barrier; the parent _spawn window and _ArtifactDeadline bound the whole process)
     except threading.BrokenBarrierError:
         t0 = time.perf_counter()  # a worker failed; error is collected
     for t in threads:
@@ -903,7 +933,7 @@ def _shed_storm(sock_path, snapshot_id, clients=32, top_k=32):
         try:
             conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             conn.connect(sock_path)
-            released.wait()
+            released.wait()  # koordlint: disable=unbounded-wait(storm barrier; the parent _spawn window and _ArtifactDeadline bound the whole process)
             t0 = time.perf_counter()
             conn.sendall(struct.pack(">BI", METHOD_SCORE, len(body)) + body)
             status, ln = struct.unpack(">BI", _recv_exact(conn, 5))
@@ -939,7 +969,7 @@ def _shed_storm(sock_path, snapshot_id, clients=32, top_k=32):
     for t in threads:
         t.start()
     try:
-        released.wait()
+        released.wait()  # koordlint: disable=unbounded-wait(storm barrier; the parent _spawn window and _ArtifactDeadline bound the whole process)
     except threading.BrokenBarrierError:
         pass
     for t in threads:
@@ -1208,6 +1238,162 @@ def child_config(platform: str, config: str) -> None:
                     "trace_slo_pass": slo_mod.slos_pass(verdicts),
                     "trace_nodes": tcfg.nodes,
                     "trace_pods": tcfg.pod_slots,
+                }
+            ),
+            flush=True,
+        )
+        return
+
+    if config == "chaos-trace":
+        # ISSUE 13: the chaos x trace gate (ROADMAP 5(c)) — a seeded
+        # realistic trace replays through the full serving path while
+        # the chaos harness injects a launch-failure burst (the
+        # breaker must trip, brownout must serve bounded-staleness
+        # degraded Scores, a half-open probe must recover) and an
+        # in-process leader kill + journal warm-restart mid-replay
+        # (recovery_ms measured client-side), followed by an overload
+        # band storm (free sheds absorb, prod p99 holds).  Judged by
+        # the obs/slo.py spec set INCLUDING a recovery-time SLO, with
+        # post-convergence digest parity vs the unfaulted oracle and
+        # zero warm-path retraces after recovery.
+        import tempfile
+
+        from koordinator_tpu.harness.chaos import (
+            ChaosTraceReplay,
+            chaos_trace_slo_specs,
+            overload_band_storm,
+        )
+        from koordinator_tpu.harness.trace import (
+            TraceConfig,
+            generate_trace,
+        )
+        from koordinator_tpu.obs import slo as slo_mod
+        from koordinator_tpu.obs.scorer_metrics import TRACE_CYCLE
+        from koordinator_tpu.obs.slo import SloSpec
+
+        def _env_int(name, default):
+            # `or`: empty value means unset (the KOORD_* convention)
+            return int(os.environ.get(name) or default)
+
+        on_cpu = backend == "cpu"
+        pod_slots = max(16, _env_int(
+            "KOORD_BENCH_CHAOS_PODS", 96 if on_cpu else 512
+        ))
+        gang_min_member = 4
+        gangs = max(1, min(6, pod_slots // (4 * gang_min_member)))
+        tcfg = TraceConfig(
+            seed=_env_int("KOORD_BENCH_CHAOS_SEED", 0),
+            nodes=_env_int(
+                "KOORD_BENCH_CHAOS_NODES", 32 if on_cpu else 128
+            ),
+            pod_slots=pod_slots,
+            tenants=max(2, min(6, pod_slots // 24)),
+            gangs=gangs,
+            gang_min_member=gang_min_member,
+            events=max(8, _env_int(
+                "KOORD_BENCH_CHAOS_EVENTS", 24 if on_cpu else 48
+            )),
+        )
+        trace = generate_trace(tcfg)
+        events = len(trace.events)
+        fail_at = max(1, events // 4)
+        kill_at = max(fail_at + 4, (2 * events) // 3)
+        phase(
+            "chaos_trace_generated",
+            events=events,
+            digest=trace.digest()[:12],
+            fail_at=fail_at,
+            kill_at=kill_at,
+        )
+        with tempfile.TemporaryDirectory(prefix="koord-bench-chaos-") as td:
+            report = ChaosTraceReplay(
+                trace, td, fail_at=fail_at, fail_n=4, kill_at=kill_at,
+            ).run()
+        phase(
+            "chaos_trace_replayed",
+            rpc_errors=report.rpc_errors,
+            degraded=report.degraded_replies,
+            breaker_trips=report.breaker_trips,
+            recovery_ms=(
+                None if report.recovery_ms is None
+                else round(report.recovery_ms, 1)
+            ),
+            retraces=report.retraces,
+        )
+        # the hard invariants fail the stage honestly — no artifact on
+        # a broken contract (the parent's error artifact says why)
+        assert report.parity_ok, (
+            f"post-convergence parity vs the unfaulted oracle failed: "
+            f"{report.parity_detail}"
+        )
+        assert report.retraces == 0, (
+            f"{report.retraces} warm-path retrace(s) after recovery"
+        )
+        assert report.recovery_ms is not None, "leader kill never recovered"
+        assert report.breaker_trips > 0, (
+            "the injected launch-failure burst never tripped the breaker"
+        )
+        assert report.degraded_replies > 0, (
+            "the brownout cache never served a degraded reply"
+        )
+        verdicts = slo_mod.evaluate_slos(
+            report.registry, chaos_trace_slo_specs(report.bands)
+        )
+        # overload band storm: free sheds absorb, prod p99 holds
+        storm = overload_band_storm()
+        phase(
+            "band_storm",
+            served=storm["served"],
+            shed_by_band=storm["shed_by_band"],
+        )
+        assert storm["shed_by_band"].get("koord-free", 0) > 0, (
+            "the overload storm shed nothing in the free band"
+        )
+        assert storm["shed_by_band"].get("koord-prod", 0) == 0, (
+            "prod-band requests shed under a storm the free band "
+            "should have absorbed"
+        )
+        # `or`: empty env value means unset (the KOORD_* convention)
+        prod_p99_ms = float(
+            os.environ.get("KOORD_CHAOS_PROD_P99_MS") or "2000"
+        )
+        verdicts.extend(slo_mod.evaluate_slos(storm["registry"], [
+            SloSpec(
+                name="prod-storm-score-p99",
+                family=TRACE_CYCLE,
+                quantile=0.99,
+                threshold_ms=prod_p99_ms,
+                labels={"band": "koord-prod", "rpc": "score"},
+            ),
+        ]))
+        gate_pass = slo_mod.slos_pass(verdicts)
+        # the per-band shed ladder outcome, merged: the replay's sheds
+        # (usually none — it is serial) plus the storm's
+        shed_by_band = dict(report.shed_by_band)
+        for b, n in storm["shed_by_band"].items():
+            shed_by_band[b] = shed_by_band.get(b, 0) + n
+        print(
+            json.dumps(
+                {
+                    "metric": "chaos_trace_recovery_ms",
+                    "value": round(float(report.recovery_ms), 3),
+                    "unit": "ms",
+                    "backend": backend,
+                    "chaos_trace_events": report.events_replayed,
+                    "chaos_trace_seed": tcfg.seed,
+                    "chaos_trace_digest": trace.digest(),
+                    "chaos_trace_errors": report.rpc_errors,
+                    "degraded_replies": report.degraded_replies,
+                    "breaker_trips": report.breaker_trips,
+                    "recovery_ms": round(float(report.recovery_ms), 3),
+                    "chaos_trace_retraces": report.retraces,
+                    "shed_by_band": shed_by_band,
+                    "storm_band_p99_ms": {
+                        b: (None if v is None else round(v, 3))
+                        for b, v in storm["band_p99_ms"].items()
+                    },
+                    "chaos_trace_slo": [v.to_doc() for v in verdicts],
+                    "chaos_trace_slo_pass": gate_pass,
                 }
             ),
             flush=True,
@@ -2795,7 +2981,7 @@ def child_config(platform: str, config: str) -> None:
                 in_failover.set()
                 t_kill = time.perf_counter()
                 leader.kill()
-                leader.wait()
+                leader.wait()  # koordlint: disable=unbounded-wait(storm barrier; the parent _spawn window and _ArtifactDeadline bound the whole process)
                 leader = spawn_leader()
                 procs.append(leader)
                 lstat = wait_status(
@@ -2834,7 +3020,7 @@ def child_config(platform: str, config: str) -> None:
                 in_failover.set()
                 t_kill = time.perf_counter()
                 leader.kill()
-                leader.wait()
+                leader.wait()  # koordlint: disable=unbounded-wait(storm barrier; the parent _spawn window and _ArtifactDeadline bound the whole process)
                 os.kill(follower.pid, _signal.SIGUSR2)
                 fstat = wait_status(
                     fstatus, lambda s: s.get("promoted"), wait_s,
@@ -3438,6 +3624,7 @@ def main() -> int:
         choices=[
             "spark", "loadaware", "gang", "extras", "rebalance", "smoke",
             "bridge", "mesh", "replica", "failover", "trace",
+            "chaos-trace",
         ],
         help="measure a secondary BASELINE config instead of the headline "
         "10k x 2k quota_colocation cycle (driver contract: no args prints "

@@ -172,12 +172,16 @@ def main() -> None:
 
     sv = _golden_servicer("0601den0")
     sync_reply = sv.sync(req)
+    # deadline budget + band ride the request fixtures (ISSUE 13) so
+    # the Go marshaler's new fields are byte-pinned like every other
     score_req = pb2.ScoreRequest(
-        snapshot_id=sync_reply.snapshot_id, top_k=TOP_K, flat=True
+        snapshot_id=sync_reply.snapshot_id, top_k=TOP_K, flat=True,
+        deadline_ms=1500, band="koord-batch",
     )
     score_reply = sv.score(score_req)
     assign_req = pb2.AssignRequest(
-        snapshot_id=sync_reply.snapshot_id, cycle_id="golden-cycle-1"
+        snapshot_id=sync_reply.snapshot_id, cycle_id="golden-cycle-1",
+        deadline_ms=2500, band="koord-prod",
     )
     assign_reply = sv.assign(assign_req)
     # measured timings pinned to exact float64 constants: a fixture
@@ -196,6 +200,10 @@ def main() -> None:
 
     expected = {
         "top_k": TOP_K,
+        "score_request": {
+            "deadline_ms": score_req.deadline_ms,
+            "band": score_req.band,
+        },
         "sync_request": {
             "node_bucket": req.node_bucket,
             "pod_bucket": req.pod_bucket,
@@ -241,6 +249,8 @@ def main() -> None:
             # the correlation id the sidecar echoes (and stamps on its
             # span/flight telemetry); byte-parity tests re-marshal it
             "cycle_id": assign_req.cycle_id,
+            "deadline_ms": assign_req.deadline_ms,
+            "band": assign_req.band,
         },
         "assign_reply": {
             "assignment": list(assign_reply.assignment),

@@ -45,6 +45,18 @@ type Client struct {
 	// only safe single-goroutine.
 	idMu       sync.Mutex
 	SnapshotID string
+	// Band is this caller's priority band (koord-prod|mid|batch|free;
+	// empty = legacy, prod treatment), stamped on every Score/Assign:
+	// the daemon's admission gate sheds on a band ladder under
+	// overload — free absorbs the sheds first, prod last (ISSUE 13).
+	Band string
+	// DeadlineMs is the per-RPC deadline budget stamped onto every
+	// Score/Assign request (0 = none): the daemon evicts a request
+	// whose budget expired before it occupies a launch slot, answering
+	// DEADLINE_EXCEEDED instead of running a device program the caller
+	// can no longer use.  The raw framing has no transport deadline,
+	// so this field is the only carrier (ISSUE 13).
+	DeadlineMs int64
 }
 
 // snapshotID reads the last acknowledged id under idMu (Pool.Sync
@@ -157,7 +169,10 @@ func (c *Client) Sync(req *SyncRequest) (*SyncReply, error) {
 // ScoreFlat requests the flat top-k layout (scorer.proto FlatScores) —
 // the O(1)-assembly path on both ends.
 func (c *Client) ScoreFlat(topK int64) (*ScoreReply, error) {
-	req := ScoreRequest{SnapshotID: c.snapshotID(), TopK: topK, Flat: true}
+	req := ScoreRequest{
+		SnapshotID: c.snapshotID(), TopK: topK, Flat: true,
+		DeadlineMs: c.DeadlineMs, Band: c.Band,
+	}
 	body, err := c.call(MethodScore, req.Marshal())
 	if err != nil {
 		return nil, err
@@ -187,7 +202,10 @@ func (c *Client) Assign() (*AssignReply, error) {
 // a bad cycle found in plugin logs is directly addressable in the
 // sidecar's /metrics and --state-dir flight dumps.
 func (c *Client) AssignCycle(cycleID string) (*AssignReply, error) {
-	req := AssignRequest{SnapshotID: c.snapshotID(), CycleID: cycleID}
+	req := AssignRequest{
+		SnapshotID: c.snapshotID(), CycleID: cycleID,
+		DeadlineMs: c.DeadlineMs, Band: c.Band,
+	}
 	body, err := c.call(MethodAssign, req.Marshal())
 	if err != nil {
 		return nil, err

@@ -125,8 +125,26 @@ func IsResourceExhausted(err error) bool {
 	return err != nil && strings.Contains(err.Error(), "RESOURCE_EXHAUSTED")
 }
 
-// RetryAfterMS extracts the shed reply's retry-after hint
-// ("retry_after_ms=<n>"); 0 when absent.
+// IsDeadlineExceeded reports whether an error is the daemon's
+// propagated-deadline rejection (ISSUE 13): the request's DeadlineMs
+// budget ran out before a launch slot and the daemon answered without
+// running any device work.  Retrying is only useful with a fresh
+// budget.
+func IsDeadlineExceeded(err error) bool {
+	return err != nil && strings.Contains(err.Error(), "DEADLINE_EXCEEDED")
+}
+
+// IsBreakerOpen reports whether an error is the daemon's circuit
+// breaker failing fast (ISSUE 13): the device launch path is failing
+// and the request was refused instead of queued behind it.  Back off
+// RetryAfterMS (the remaining cooldown before the next half-open
+// probe) or route to another replica.
+func IsBreakerOpen(err error) bool {
+	return err != nil && strings.Contains(err.Error(), "BREAKER_OPEN")
+}
+
+// RetryAfterMS extracts the retry-after hint ("retry_after_ms=<n>")
+// a shed or breaker-open reply carries; 0 when absent.
 func RetryAfterMS(err error) int64 {
 	if err == nil {
 		return 0

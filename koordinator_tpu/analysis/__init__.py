@@ -33,6 +33,13 @@ Rules (each suppressible per line with ``# koordlint: disable=<rule>``):
 * ``broad-except``      — ``except Exception:`` handlers must re-raise,
   log, or surface the bound error; silent swallowers need a reasoned
   ``# koordlint: disable=broad-except(<reason>)`` tag.
+* ``unbounded-wait``    — ``Condition.wait()``/``Event.wait()`` with no
+  timeout (a lost notify or a dead peer turns into a hang; use the
+  backstop ``wait(timeout=1.0)`` re-check-loop idiom) and client RPC
+  stub calls with no ``timeout=``/``deadline=`` kwarg (a hung daemon
+  must not hang every caller — ISSUE 13's deadline propagation needs
+  the transport to give up too).  Deliberate forever-parks take a
+  reasoned disable tag.
 * ``bare-retry``        — a ``while``/``for`` retry loop (one that
   contains an ``except``) sleeping a FIXED ``time.sleep(<literal>)``
   cadence: no jitter (thundering herd on recovery), no exponential
@@ -73,6 +80,7 @@ RULES = (
     "span-leak",
     "lock-held-dispatch",
     "bare-retry",
+    "unbounded-wait",
     "wire-contract",
     "metrics-doc-drift",
 )

@@ -127,6 +127,7 @@ def run_rules_on_source(
         lockdispatch,
         retrace,
         spanleak,
+        unboundedwait,
     )
 
     try:
@@ -149,6 +150,7 @@ def run_rules_on_source(
         "span-leak": spanleak.check,
         "lock-held-dispatch": lockdispatch.check,
         "bare-retry": bareretry.check,
+        "unbounded-wait": unboundedwait.check,
     }
     for rule, fn in table.items():
         if rules is not None and rule not in rules:

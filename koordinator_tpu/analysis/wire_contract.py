@@ -336,6 +336,118 @@ def diff_proto_go(
     return out
 
 
+# ---- the third mirror: bridge/wirecheck.py message decoders ----
+# The runtime golden round-trips exercise wirecheck against scorer_pb2,
+# but only for field values the fixtures happen to populate — a decoder
+# branch MISSING for a new field (the ISSUE-13 deadline/band/degraded
+# additions are the motivating case) silently drops the value instead
+# of failing a test.  This check parses the hand-rolled
+# ``if field == N: r["name"] = ...`` walks out of wirecheck.py via AST
+# and diffs them against the proto: every scalar field must have a
+# branch, under its proto name, at its proto number.
+
+_WIRECHECK_DECODERS = {
+    "ScoreRequest": "decode_score_request",
+    "AssignRequest": "decode_assign_request",
+    "ScoreReply": "decode_score_reply",
+    "SyncReply": "decode_sync_reply",
+    "AssignReply": "decode_assign_reply",
+}
+
+
+def _branch_field_keys(fn: ast.FunctionDef):
+    """[(field_num, {r-subscript keys used in the branch}, line)] for
+    every ``if field == <const>`` branch in a wirecheck decoder."""
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If):
+            continue
+        test = node.test
+        if not (
+            isinstance(test, ast.Compare)
+            and isinstance(test.left, ast.Name)
+            and test.left.id == "field"
+            and len(test.comparators) == 1
+            and isinstance(test.comparators[0], ast.Constant)
+            and isinstance(test.comparators[0].value, int)
+        ):
+            continue
+        num = int(test.comparators[0].value)
+        keys = set()
+        for sub in node.body:
+            for n in ast.walk(sub):
+                if (
+                    isinstance(n, ast.Subscript)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == "r"
+                    and isinstance(n.slice, ast.Constant)
+                    and isinstance(n.slice.value, str)
+                ):
+                    keys.add(n.slice.value)
+        out.append((num, keys, node.lineno))
+    return out
+
+
+def check_wirecheck_messages(
+    proto_text: str,
+    wirecheck_text: str,
+    path: str = "koordinator_tpu/bridge/wirecheck.py",
+) -> List[Violation]:
+    proto = parse_proto(proto_text)
+    out: List[Violation] = []
+    try:
+        tree = ast.parse(wirecheck_text)
+    except SyntaxError:
+        return out  # the AST rules already report a parse error
+    funcs = {
+        n.name: n for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef)
+    }
+    for msg, fname in _WIRECHECK_DECODERS.items():
+        fields = proto.get(msg)
+        if fields is None:
+            continue
+        fn = funcs.get(fname)
+        if fn is None:
+            out.append(Violation(
+                RULE, path, 0,
+                f"wirecheck.py decoder {fname} for proto message "
+                f"{msg} not found (the independent mirror lost a "
+                "message)",
+            ))
+            continue
+        branches = _branch_field_keys(fn)
+        by_num = {num: (keys, line) for num, keys, line in branches}
+        for f in fields:
+            got = by_num.get(f.num)
+            if got is None:
+                out.append(Violation(
+                    RULE, path, fn.lineno,
+                    f"{fname} has no 'field == {f.num}' branch: proto "
+                    f"{msg}.{f.name} would be silently dropped by the "
+                    "wirecheck mirror",
+                ))
+                continue
+            keys, line = got
+            # message-typed fields decode into nested dicts whose key
+            # usually matches; scalar fields MUST land under the proto
+            # name so the two mirrors stay diffable
+            if keys and f.name not in keys:
+                out.append(Violation(
+                    RULE, path, line,
+                    f"{fname} field {f.num} writes {sorted(keys)} but "
+                    f"proto {msg} field {f.num} is '{f.name}'",
+                ))
+        for num, _keys, line in branches:
+            if num not in {f.num for f in fields}:
+                out.append(Violation(
+                    RULE, path, line,
+                    f"{fname} decodes field {num} which does not exist "
+                    f"in proto message {msg}",
+                ))
+    return out
+
+
 _GO_RATIO = re.compile(r"DefaultMaxDeltaRatio\s*=\s*([0-9.]+)")
 
 
@@ -609,6 +721,9 @@ def check_repo(root: str) -> List[Violation]:
     wire = read("go", "scorerclient", "wire.go")
     if wire is not None:
         out.extend(diff_proto_go(proto, wire))
+    wcheck_msgs = read("koordinator_tpu", "bridge", "wirecheck.py")
+    if wcheck_msgs is not None:
+        out.extend(check_wirecheck_messages(proto, wcheck_msgs))
     delta = read("go", "scorerclient", "delta.go")
     state = read("koordinator_tpu", "bridge", "state.py")
     if delta is not None and state is not None:

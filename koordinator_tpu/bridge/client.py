@@ -9,6 +9,8 @@ sparse deltas on warm cycles) and gets NodeScoreLists / assignments back.
 from __future__ import annotations
 
 import itertools
+import os
+import re
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -31,12 +33,39 @@ _TRANSIENT_CODES = (
     grpc.StatusCode.DEADLINE_EXCEEDED,
 )
 
+# every stub call carries a transport deadline so a hung daemon can
+# never hang the caller forever (the koordlint unbounded-wait rule's
+# client half); generous by default — cold compiles are minutes on a
+# slow host — and tightened per-call by the propagated deadline budget
+DEFAULT_RPC_TIMEOUT_MS = 300_000.0
+
+_RETRY_AFTER_RE = re.compile(r"retry_after_ms=(\d+(?:\.\d+)?)")
+
 
 def _is_transient(exc: BaseException) -> bool:
     return (
         isinstance(exc, grpc.RpcError)
         and exc.code() in _TRANSIENT_CODES
     )
+
+
+def _is_shed(exc: BaseException) -> bool:
+    """An admission-gate shed (RESOURCE_EXHAUSTED + retry-after hint):
+    transient BY CONTRACT — the server is healthy and said when to come
+    back — and never a reason to touch the delta baseline."""
+    return (
+        isinstance(exc, grpc.RpcError)
+        and exc.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+    )
+
+
+def retry_after_ms(exc: BaseException) -> Optional[float]:
+    """The machine-parsable ``retry_after_ms=<n>`` hint a shed or
+    breaker-open reply carries, or None."""
+    if not isinstance(exc, grpc.RpcError):
+        return None
+    m = _RETRY_AFTER_RE.search(exc.details() or "")
+    return float(m.group(1)) if m else None
 
 
 def _is_not_leader(exc: BaseException) -> bool:
@@ -96,7 +125,10 @@ class _ChannelPool:
 class ScorerClient:
     def __init__(self, target: str, channels: int = 1,
                  followers: Sequence[str] = (),
-                 retry_policy: Optional[BackoffPolicy] = None):
+                 retry_policy: Optional[BackoffPolicy] = None,
+                 band: str = "",
+                 deadline_ms: Optional[float] = None,
+                 rpc_timeout_ms: Optional[float] = None):
         """``target``: "unix:///path.sock" or host:port.
 
         ``channels``: size of the connection pool Score/Assign calls
@@ -129,8 +161,42 @@ class ScorerClient:
         ``followers`` are configured the Sync/Assign retries PROBE
         them for a promoted leader (a follower's "one writer" refusal
         means "not me, keep looking"), so a SIGUSR2/admin-RPC
-        promotion fails over without reconfiguring the client."""
+        promotion fails over without reconfiguring the client.
+
+        ``band`` (ISSUE 13): this client's priority band
+        (koord-prod|mid|batch|free; empty = legacy, prod treatment),
+        stamped on every Score/Assign so the daemon's admission gate
+        sheds on the band ladder — free absorbs overload first, prod
+        last.
+
+        ``deadline_ms`` (ISSUE 13 deadline propagation): per-RPC
+        deadline budget stamped onto the wire (``deadline_ms`` request
+        field) AND set as the gRPC transport deadline for Score/Assign;
+        the server evicts a request whose budget ran out before it
+        occupies a launch slot.  Default from ``KOORD_DEADLINE_MS``
+        (unset/empty = no propagated deadline).  Shed replies
+        (RESOURCE_EXHAUSTED) and breaker fast-fails (UNAVAILABLE) carry
+        a ``retry_after_ms`` hint; retries sleep the HINT in place of
+        the backoff delay — one pause per attempt, never both, so the
+        hint cannot double-count against the retry budget.
+
+        ``rpc_timeout_ms``: transport deadline applied to EVERY stub
+        call (``KOORD_RPC_TIMEOUT_MS``, default 300 s) so a hung daemon
+        can never hang the caller forever; ``deadline_ms`` tightens it
+        per call when set."""
         self._pool = _ChannelPool(target, channels)
+        self.band = band or ""
+        # `or`: empty env value means unset (the KOORD_* convention)
+        if deadline_ms is None:
+            env = os.environ.get("KOORD_DEADLINE_MS") or ""
+            deadline_ms = float(env) if env else 0.0
+        self._deadline_ms = max(0.0, float(deadline_ms))
+        if rpc_timeout_ms is None:
+            rpc_timeout_ms = float(
+                os.environ.get("KOORD_RPC_TIMEOUT_MS")
+                or DEFAULT_RPC_TIMEOUT_MS
+            )
+        self._rpc_timeout_ms = max(1.0, float(rpc_timeout_ms))
         self._channel = self._pool.channels[0]  # Sync's pinned channel
         self._retry = retry_policy or BackoffPolicy.from_env()
 
@@ -186,6 +252,9 @@ class ScorerClient:
         self._generation: Optional[int] = None
         self._epoch: Optional[str] = None
         self.snapshot_id: Optional[str] = None
+        # whether the last flat Score reply carried the brownout
+        # degraded flag (ISSUE 13)
+        self.last_degraded = False
 
     def close(self) -> None:
         self._pool.close()
@@ -214,32 +283,61 @@ class ScorerClient:
         table.sort(key=lambda e: 0 if e[0] == active else 1)
         return table
 
+    def _timeout_s(self) -> float:
+        """Transport deadline for one stub call: the client-wide cap,
+        tightened by the propagated per-RPC budget when one is set."""
+        t = self._rpc_timeout_ms
+        if self._deadline_ms > 0.0:
+            t = min(t, self._deadline_ms)
+        return t / 1000.0
+
+    def _pause_ms(self, delays, last: Optional[BaseException]):
+        """The ONE pause before the next retry attempt, or None when
+        the budget is spent: a server retry-after hint (shed/breaker
+        replies) REPLACES the backoff delay for this attempt — the
+        attempt still consumes its slot in the policy's deadline
+        budget, so a hint can never double-count against it."""
+        d_ms = next(delays, None)
+        if d_ms is None:
+            return None
+        hint = retry_after_ms(last) if last is not None else None
+        if hint is not None:
+            # cap at the policy's backoff ceiling: a 30 s free-band
+            # hint must not park a caller past its own retry budget
+            return min(hint, self._retry.cap_ms)
+        return d_ms
+
     def _call_writer(self, kind: str, request):
         """Invoke a writer-side RPC (Sync/Assign) against the active
         leader, failing over through the shared backoff policy:
         transient channel errors retry, "one writer" refusals probe
-        the next candidate, anything else surfaces immediately (it is
-        the SERVER's answer, and the caller's protocol logic — e.g.
-        sync()'s full-resend fallback — owns it).  The delta baseline
-        is never touched here: an ambiguous apply is caught by the
-        continuity check on the next acked reply."""
+        the next candidate, admission sheds retry after the server's
+        hint, anything else surfaces immediately (it is the SERVER's
+        answer, and the caller's protocol logic — e.g. sync()'s
+        full-resend fallback — owns it).  The delta baseline is never
+        touched here: an ambiguous apply is caught by the continuity
+        check on the next acked reply."""
         delays = self._retry.delays()
+        timeout = self._timeout_s()
         while True:
             last: Optional[BaseException] = None
             for idx, stub in self._writer_stubs(kind):
                 try:
-                    reply = stub(request)
+                    reply = stub(request, timeout=timeout)
                     self._leader_idx = idx
                     return reply
                 except grpc.RpcError as exc:
-                    if _is_not_leader(exc) or _is_transient(exc):
+                    if (
+                        _is_not_leader(exc) or _is_transient(exc)
+                        or _is_shed(exc)
+                    ):
                         last = exc
                         continue
                     raise
-            d_ms = next(delays, None)
-            if d_ms is None:
+            pause = self._pause_ms(delays, last)
+            if pause is None:
                 raise last
-            time.sleep(d_ms / 1000.0)
+            time.sleep(pause / 1000.0)
 
     def _score_stub(self):
         """Score's routing: round-robin over the follower replicas when
@@ -262,19 +360,22 @@ class ScorerClient:
     def _call_score(self, request):
         """Reads retry FREELY (ISSUE 11): they are idempotent against a
         named snapshot, so a transient channel error just moves to the
-        next replica under the shared backoff budget."""
+        next replica under the shared backoff budget.  A shed
+        (RESOURCE_EXHAUSTED) retries too, paced by the server's
+        retry-after hint in place of the backoff delay (ISSUE 13)."""
         delays = self._retry.delays()
+        timeout = self._timeout_s()
         while True:
             stub, on_follower = self._score_stub()
             if on_follower:
                 try:
-                    return stub(request)
+                    return stub(request, timeout=timeout)
                 except grpc.RpcError as e:
-                    if _is_transient(e):
-                        d_ms = next(delays, None)
-                        if d_ms is None:
+                    if _is_transient(e) or _is_shed(e):
+                        pause = self._pause_ms(delays, e)
+                        if pause is None:
                             raise
-                        time.sleep(d_ms / 1000.0)
+                        time.sleep(pause / 1000.0)
                         continue  # next replica round-robin
                     if e.code() != grpc.StatusCode.FAILED_PRECONDITION:
                         raise
@@ -285,12 +386,12 @@ class ScorerClient:
             try:
                 return self._call(self._leader_score_stub(), request)
             except grpc.RpcError as e:
-                if not _is_transient(e):
+                if not (_is_transient(e) or _is_shed(e)):
                     raise
-                d_ms = next(delays, None)
-                if d_ms is None:
+                pause = self._pause_ms(delays, e)
+                if pause is None:
                     raise
-                time.sleep(d_ms / 1000.0)
+                time.sleep(pause / 1000.0)
 
     def _invalidate(self) -> None:
         with self._baseline_lock:
@@ -469,16 +570,22 @@ class ScorerClient:
         displaced by another client's Sync) invalidate the baseline so the
         caller's next sync() ships full state, then surface the error."""
         try:
-            return stub(request)
+            return stub(request, timeout=self._timeout_s())
         except grpc.RpcError as e:
             if e.code() == grpc.StatusCode.FAILED_PRECONDITION:
                 self._invalidate()
             raise
 
-    def score(self, top_k: int = 0) -> List[List[Tuple[int, int]]]:
-        reply = self._call_score(
-            pb2.ScoreRequest(snapshot_id=self.snapshot_id or "", top_k=top_k),
+    def _score_request(self, top_k: int, flat: bool = False):
+        """One Score request with the propagated deadline budget and
+        this client's band stamped on (ISSUE 13)."""
+        return pb2.ScoreRequest(
+            snapshot_id=self.snapshot_id or "", top_k=top_k, flat=flat,
+            deadline_ms=int(self._deadline_ms), band=self.band,
         )
+
+    def score(self, top_k: int = 0) -> List[List[Tuple[int, int]]]:
+        reply = self._call_score(self._score_request(top_k))
         return [
             list(zip(entry.node_index, entry.score)) for entry in reply.pods
         ]
@@ -490,11 +597,12 @@ class ScorerClient:
         arrays decoded straight from the packed reply bytes — the O(1)
         assembly path on both ends (round-3 review #8).  Entry group g
         (pod pod_index[g]) covers counts[g] consecutive entries."""
-        reply = self._call_score(
-            pb2.ScoreRequest(
-                snapshot_id=self.snapshot_id or "", top_k=top_k, flat=True
-            ),
-        )
+        reply = self._call_score(self._score_request(top_k, flat=True))
+        # degraded visibility (ISSUE 13): True when the LAST flat Score
+        # was served stale from the daemon's brownout cache while its
+        # breaker was open — callers alarm on it instead of discovering
+        # staleness in a placement graph
+        self.last_degraded = bool(reply.degraded)
         if not reply.HasField("flat"):
             # a pre-flat server ignores the unknown request flag and sends
             # legacy lists; empty arrays here would read as "no feasible
@@ -520,7 +628,11 @@ class ScorerClient:
         try:
             reply = self._call_writer(
                 "assign",
-                pb2.AssignRequest(snapshot_id=self.snapshot_id or ""),
+                pb2.AssignRequest(
+                    snapshot_id=self.snapshot_id or "",
+                    deadline_ms=int(self._deadline_ms),
+                    band=self.band,
+                ),
             )
         except grpc.RpcError as e:
             # displaced snapshot (stale-id FAILED_PRECONDITION): the

@@ -102,17 +102,43 @@ class SnapshotNotResident(ValueError):
     the serial paths; callers translate to FAILED_PRECONDITION)."""
 
 
+class DeadlineExpired(Exception):
+    """A request's propagated deadline budget ran out before the device
+    could serve it (ISSUE 13 deadline propagation).  ``stage`` says
+    where the expiry was caught: ``queue`` = the request arrived with
+    an already-exhausted budget (rejected at RPC entry, before it could
+    deepen any queue), ``gather`` = it expired while queued and the
+    batch leader evicted it at gather time — BEFORE it occupied a
+    launch slot, so an expired request never costs a device launch.
+    Transports map this to gRPC DEADLINE_EXCEEDED."""
+
+    def __init__(self, method: str, stage: str, budget_ms: float):
+        self.method = method
+        self.stage = stage
+        self.budget_ms = float(budget_ms)
+        super().__init__(
+            f"DEADLINE_EXCEEDED: {method} deadline budget "
+            f"({self.budget_ms:.0f} ms) expired at stage={stage}; "
+            "the request was never launched"
+        )
+
+
 class PendingRequest:
     """One caller's slot in a coalesced batch.  The executor fills
     ``reply`` (or ``error``); the dispatcher stamps queue/batch stats
-    and flips ``done`` under the queue condition."""
+    and flips ``done`` under the queue condition.  ``deadline_at`` is
+    the absolute (dispatcher-clock) expiry the propagated per-RPC
+    budget pins — None = no deadline; the batch leader evicts expired
+    entries at gather time before they occupy a launch slot."""
 
     __slots__ = (
         "req", "enqueued_at", "reply", "error", "done",
-        "queue_delay_ms", "batch_size",
+        "queue_delay_ms", "batch_size", "deadline_at", "budget_ms",
     )
 
-    def __init__(self, req, enqueued_at: float):
+    def __init__(self, req, enqueued_at: float,
+                 deadline_at: Optional[float] = None,
+                 budget_ms: float = 0.0):
         self.req = req
         self.enqueued_at = enqueued_at
         self.reply = None
@@ -120,6 +146,8 @@ class PendingRequest:
         self.done = False
         self.queue_delay_ms = 0.0
         self.batch_size = 0
+        self.deadline_at = deadline_at
+        self.budget_ms = budget_ms
 
 
 class ScoreMemo:
@@ -296,13 +324,36 @@ class CoalescingDispatcher:
         # launches that entered the device section while a previous
         # batch was still in flight — the pipeline actually pipelining
         self.launch_overlaps = 0
+        # deadline eviction (ISSUE 13): entries whose propagated budget
+        # expired while queued, evicted at gather time (never launched)
+        self.deadline_evicted = 0
+        # servicer seams: ``deadline_hook(n)`` observes gather-time
+        # evictions (the stage="gather" telemetry feed);
+        # ``launch_outcome_hook(outcome, exc)`` observes every launch
+        # attempt's fate — "ok" (launch AND readback completed: with
+        # async dispatch a failing device program usually surfaces at
+        # the readback's device_get, so success is only known there),
+        # "error" (either half raised), "none" (no device work:
+        # all-stale/all-expired batch or a memo serve) — the circuit
+        # breaker's failure feed (replication/admission.py
+        # CircuitBreaker; the servicer filters request-level
+        # rejections before counting)
+        self.deadline_hook: Optional[Callable[[int], None]] = None
+        self.launch_outcome_hook: Optional[Callable] = None
 
     # -- public API --
-    def submit(self, req) -> PendingRequest:
+    def submit(self, req, deadline_at: Optional[float] = None,
+               budget_ms: float = 0.0) -> PendingRequest:
         """Enqueue ``req`` and block until a batch containing it ran.
         Returns the finished entry; raises its error if the executor
-        (or the batch as a whole) failed."""
-        entry = PendingRequest(req, self._clock())
+        (or the batch as a whole) failed.  ``deadline_at`` (dispatcher
+        clock) arms gather-time eviction: an entry still queued past it
+        fails with :class:`DeadlineExpired` instead of occupying a
+        launch slot."""
+        entry = PendingRequest(
+            req, self._clock(), deadline_at=deadline_at,
+            budget_ms=budget_ms,
+        )
         with self._cond:
             self.window.observe_arrival(entry.enqueued_at)
             self._queue.append(entry)
@@ -346,7 +397,14 @@ class CoalescingDispatcher:
                 while self._inflight >= self.depth:
                     self._cond.wait(timeout=1.0)
                 launch_at = self._clock()
-            readback = launch_fn()
+            try:
+                readback = launch_fn()
+            except Exception as exc:
+                # same breaker seam as the coalesced path: the servicer
+                # filters request-level rejections (stale snapshot,
+                # expired deadline) before a failure counts
+                self._launch_outcome("error", exc)
+                raise
             with self._cond:
                 # accounted only now: a launch_fn that raised (e.g. a
                 # displaced Assign's generation re-check) put nothing
@@ -360,7 +418,16 @@ class CoalescingDispatcher:
             with self._cond:
                 self._cond.notify_all()
         try:
-            return readback()
+            try:
+                result = readback()
+            except Exception as exc:
+                # readback-phase device fault: the breaker's failure
+                # surface (async dispatch reports failing programs at
+                # device_get, not at enqueue)
+                self._launch_outcome("error", exc)
+                raise
+            self._launch_outcome("ok", None)
+            return result
         finally:
             if launched:
                 with self._cond:
@@ -462,7 +529,18 @@ class CoalescingDispatcher:
             try:
                 try:
                     hook = readback()
+                    if launched:
+                        # the device program actually completed (the
+                        # stacked device_get drained): NOW the breaker
+                        # may count a success
+                        self._launch_outcome("ok", None)
                 except BaseException as exc:
+                    if launched and isinstance(exc, Exception):
+                        # a readback-phase device fault (async
+                        # dispatch surfaces failing programs at
+                        # device_get, not at enqueue) counts exactly
+                        # like a launch-half failure
+                        self._launch_outcome("error", exc)
                     # a whole-readback failure is every unfilled caller's
                     # failure; per-entry errors the executor routed stay.
                     # BaseException too: a KeyboardInterrupt delivered
@@ -493,14 +571,41 @@ class CoalescingDispatcher:
             if not batch:
                 return [], None, False
             now = self._clock()
+            expired = 0
             for entry in batch:
                 entry.queue_delay_ms = (now - entry.enqueued_at) * 1000.0
                 entry.batch_size = len(batch)
+                # deadline eviction (ISSUE 13): an entry whose
+                # propagated budget ran out while it queued is answered
+                # DEADLINE_EXCEEDED here — BEFORE the executor sees it,
+                # so an expired request never occupies a launch slot,
+                # and a batch whose every entry expired never launches
+                if (
+                    entry.deadline_at is not None
+                    and now >= entry.deadline_at
+                ):
+                    entry.error = DeadlineExpired(
+                        "score", "gather", entry.budget_ms
+                    )
+                    expired += 1
+                    self.deadline_evicted += 1
+        if expired and self.deadline_hook is not None:
+            self.deadline_hook(expired)
+        live = [e for e in batch if e.error is None]
+        if not live:
+            # every entry expired: nothing launches, the callers get
+            # their DEADLINE_EXCEEDED immediately
+            self._launch_outcome("none", None)
+            self._finalize(batch, launched=False)
+            return batch, None, False
         readback = None
+        failed = False
         try:
-            readback = self._launch_batch(batch)
+            readback = self._launch_batch(live)
         except Exception as exc:
-            for entry in batch:
+            failed = True
+            self._launch_outcome("error", exc)
+            for entry in live:
                 if entry.reply is None and entry.error is None:
                     entry.error = exc
         if readback is None:
@@ -508,6 +613,8 @@ class CoalescingDispatcher:
             # rejected) every entry during the launch phase — nothing
             # launched, so the device-idle gap stays open and no
             # overlap is counted
+            if not failed:
+                self._launch_outcome("none", None)
             self._finalize(batch, launched=False)
             return batch, None, False
         if getattr(readback, "no_device", False):
@@ -515,11 +622,30 @@ class CoalescingDispatcher:
             # runs with the lock released like a readback, but nothing
             # is on the device — no in-flight slot, no launch
             # accounting, and a donating drain never waits on it
+            self._launch_outcome("none", None)
             return batch, readback, False
         with self._cond:
             self._note_launch_locked(now)
             self._inflight += 1
+        # no outcome yet: with async dispatch the launch half only
+        # proves enqueue — success/failure is known at the readback
+        # (_try_lead reports it after the closure runs)
         return batch, readback, True
+
+    def _launch_outcome(self, outcome: str, exc) -> None:
+        """Feed the launch-outcome seam (the circuit breaker); the hook
+        must never fail the batch it observed."""
+        hook = self.launch_outcome_hook
+        if hook is None:
+            return
+        try:
+            hook(outcome, exc)
+        except Exception:  # koordlint: disable=broad-except(an observability/breaker hook failing must not fail callers whose launch already resolved)
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "launch outcome hook failed"
+            )
 
     def _gather_stragglers(self) -> None:
         """Idle-pipeline straggler wait (launch lock held).  Only worth

@@ -70,6 +70,7 @@ from koordinator_tpu.bridge.coalesce import (
     AdaptiveGatherWindow,
     CoalescingDispatcher,
     DEFAULT_DEPTH,
+    DeadlineExpired,
     PendingRequest,
     ScoreMemo,
     SnapshotNotResident,
@@ -81,6 +82,8 @@ from koordinator_tpu.model.snapshot import pad_bucket
 from koordinator_tpu.obs import CycleTelemetry
 from koordinator_tpu.replication.admission import (
     AdmissionGate,
+    BreakerOpen,
+    CircuitBreaker,
     ResourceExhausted,
 )
 from koordinator_tpu.solver import (
@@ -129,6 +132,9 @@ class ScorerServicer:
         max_inflight: int = 0,
         score_incr: bool = True,
         score_incr_max_ratio: Optional[float] = None,
+        breaker_threshold: Optional[int] = None,
+        breaker_cooldown_ms: Optional[float] = None,
+        brownout_max_lag: Optional[int] = None,
     ):
         """``mesh``: a ``jax.sharding.Mesh`` turns the ASSIGN RPC into
         the round-based multi-chip cycle (parallel/shard_assign.py
@@ -175,8 +181,13 @@ class ScorerServicer:
         relative to a full rescore) above which a Score falls back to
         the full ``score_cycle`` (rescoring most of the tensor
         incrementally costs MORE: two scatters plus worse fusion).
-        Default from ``KOORD_SCORE_INCR_MAX_RATIO``, else 0.25; daemon
-        flag ``--score-incr-max-ratio``.
+        Default from ``KOORD_SCORE_INCR_MAX_RATIO``, else 0.5 — tuned
+        with the trace harness against realistic delta-size mixes
+        (ISSUE 13 / ROADMAP 5(b), docs/KERNEL.md "Tuning the ratio
+        gate": the incr/full crossover measures at ~0.6 dirty
+        fraction, and the old 0.25 default was refusing the 1.7-3x
+        wins in the 0.25-0.5 band that usage-drift events actually
+        produce); daemon flag ``--score-incr-max-ratio``.
 
         ``coalesce_cap_ms``: clamp of the adaptive gather window's
         straggler wait (AdaptiveGatherWindow cap_ms; default 5.0) —
@@ -197,6 +208,27 @@ class ScorerServicer:
         ``KOORD_MAX_INFLIGHT``).  0 (the default) disables the gate.
         Sync is never shed — the one-writer path must not degrade
         under a read storm.
+
+        ``breaker_threshold`` / ``breaker_cooldown_ms`` (ISSUE 13):
+        consecutive launch failures that trip the circuit breaker OPEN,
+        and how long it stays open before admitting one half-open
+        probe.  While open, Score degrades to the brownout cache
+        (below) and Assign fails fast with UNAVAILABLE + retry-after
+        instead of queueing behind a failing device.  Defaults from
+        ``KOORD_BREAKER_THRESHOLD`` (3; 0 disables the breaker) and
+        ``KOORD_BREAKER_COOLDOWN_MS`` (250); daemon flags
+        ``--breaker-threshold`` / ``--breaker-cooldown-ms``.  Admission
+        sheds and request-level rejections (stale snapshot, expired
+        deadline) never feed the breaker.
+
+        ``brownout_max_lag`` (ISSUE 13): maximum generations behind the
+        current snapshot a breaker-open Score may be served from the
+        host-side brownout cache (the last launch's padded top-k
+        readback).  A reply within the bound carries an explicit
+        ``degraded`` flag; one past it is REFUSED (UNAVAILABLE +
+        retry-after), never served — stale-but-bounded, by contract.
+        Default from ``KOORD_BROWNOUT_MAX_LAG`` (2); daemon flag
+        ``--brownout-max-lag``.  Assign never serves stale.
 
         ``coalesce_max_batch``: Score requests sharing one device launch
         at most (1 = the pre-coalescing serialized behavior, the bench
@@ -242,14 +274,50 @@ class ScorerServicer:
         if score_incr_max_ratio is None:
             # `or`: an empty env value means unset (every KOORD_* knob's
             # convention), not a float('') crash at daemon startup
+            # 0.5: tuned via the trace-harness sweep (ROADMAP 5(b) /
+            # docs/KERNEL.md "Tuning the ratio gate") — the measured
+            # incr-vs-full crossover sits at ~0.6 dirty fraction
             score_incr_max_ratio = float(
-                os.environ.get("KOORD_SCORE_INCR_MAX_RATIO") or "0.25"
+                os.environ.get("KOORD_SCORE_INCR_MAX_RATIO") or "0.5"
             )
         self._score_incr_max_ratio = float(score_incr_max_ratio)
         # admission gate in front of the dispatch queue (ISSUE 8):
         # Score/Assign reserve a slot before touching the coalescer,
         # overload sheds fast instead of queueing without bound
+        # (band-aware ladder since ISSUE 13: free sheds first, prod
+        # last, Sync never)
         self.admission = AdmissionGate(max_inflight)
+        # circuit breaker + brownout ladder (ISSUE 13): consecutive
+        # launch failures trip the breaker; while open, Score serves
+        # stale-but-bounded from the host-side brownout cache and
+        # Assign fails fast.  `or`: empty env value means unset (the
+        # KOORD_* convention).
+        if breaker_threshold is None:
+            breaker_threshold = int(
+                os.environ.get("KOORD_BREAKER_THRESHOLD") or "3"
+            )
+        if breaker_cooldown_ms is None:
+            breaker_cooldown_ms = float(
+                os.environ.get("KOORD_BREAKER_COOLDOWN_MS") or "250"
+            )
+        if brownout_max_lag is None:
+            brownout_max_lag = int(
+                os.environ.get("KOORD_BROWNOUT_MAX_LAG") or "2"
+            )
+        self.breaker = CircuitBreaker(
+            threshold=breaker_threshold,
+            cooldown_ms=breaker_cooldown_ms,
+            on_transition=self._breaker_transition,
+        )
+        self._brownout_max_lag = max(0, int(brownout_max_lag))
+        # host-side brownout cache: the last Score launch's padded
+        # top-k readback plus the (epoch, generation, cfg, geometry)
+        # it certified.  Unlike the ScoreMemo it deliberately SURVIVES
+        # generation bumps — that staleness, bounded by
+        # --brownout-max-lag, is exactly what the breaker-open path
+        # serves.  Guarded by _state_lock.
+        self._brownout = None
+        self.degraded_replies = 0  # lifetime stat (bench/tests)
         # replication seam (ISSUE 8): the leader's publisher sets this
         # to stream every committed Sync to the follower tier; called
         # under _sync_lock, so frames publish in generation order
@@ -272,6 +340,62 @@ class ScorerServicer:
             gather_window_s=(coalesce_window_ms or 0.0) / 1000.0,
             depth=pipeline_depth,
         )
+        # degradation-ladder seams into the dispatcher (ISSUE 13):
+        # gather-time deadline evictions feed the stage="gather"
+        # counter, launch outcomes feed the breaker (filtered below)
+        self.dispatch.deadline_hook = self._count_gather_expired
+        self.dispatch.launch_outcome_hook = self._launch_outcome
+        self.telemetry.metrics.set_breaker_state(self.breaker.state())
+
+    # -- degradation ladder seams (ISSUE 13) --
+    def _breaker_transition(self, to: str) -> None:
+        self.telemetry.metrics.set_breaker_state(to)
+        self.telemetry.metrics.count_breaker_transition(to)
+
+    def _count_gather_expired(self, n: int) -> None:
+        self.telemetry.metrics.count_deadline_expired("gather", n)
+
+    def _launch_outcome(self, outcome: str, exc) -> None:
+        """The dispatcher's launch-outcome hook -> the breaker.  Only
+        REAL launch faults count as failures: request-level rejections
+        (a displaced snapshot, an expired deadline) say nothing about
+        the device, and a batch that performed no device work releases
+        a half-open probe slot without a verdict."""
+        if outcome == "ok":
+            self.breaker.record_success()
+        elif outcome == "error":
+            if isinstance(exc, (SnapshotNotResident, DeadlineExpired,
+                                ResourceExhausted)):
+                self.breaker.release_probe()
+            else:
+                self.breaker.record_failure()
+        else:  # "none": no device work happened, nothing was probed
+            self.breaker.release_probe()
+
+    def _deadline_budget_ms(self, req, ctx) -> float:
+        """Effective remaining deadline budget for this RPC in ms: the
+        wire field (``deadline_ms``, stamped by the client at send
+        time) and the gRPC transport deadline, whichever is tighter.
+        0 = no deadline; NEGATIVE = already expired on arrival (the
+        stage="queue" rejection).  The raw-UDS framing has no
+        transport deadline — the wire field is its only carrier."""
+        budget = float(getattr(req, "deadline_ms", 0) or 0)
+        if ctx is not None:
+            try:
+                remaining = ctx.time_remaining()
+            except Exception:  # koordlint: disable=broad-except(a transport without deadline support must not fail the RPC; the wire field still applies)
+                remaining = None
+            if remaining is not None:
+                # an exhausted transport deadline must read as expired,
+                # not as "no deadline" — never let it round to 0
+                remaining_ms = float(remaining) * 1000.0
+                if remaining_ms <= 0.0:
+                    remaining_ms = -1.0
+                if budget <= 0.0:
+                    budget = remaining_ms
+                else:
+                    budget = min(budget, remaining_ms)
+        return budget
 
     def snapshot_id(self) -> str:
         return f"s{self._epoch}-{self._generation}"
@@ -561,30 +685,113 @@ class ScorerServicer:
         )
 
     def score(self, req: "pb2.ScoreRequest", ctx=None) -> "pb2.ScoreReply":
-        # admission first (ISSUE 8): the gate sheds BEFORE the request
-        # can deepen the dispatch queue, so a read storm past the
-        # configured depth degrades to fast RESOURCE_EXHAUSTED replies
-        # while everything already admitted completes untouched
+        # the degradation ladder, in rung order (ISSUE 13 /
+        # docs/REPLICATION.md "Degradation ladder"):
+        #   1. admission sheds BEFORE the request can deepen the
+        #      dispatch queue — band-aware since ISSUE 13 (free sheds
+        #      first, prod last); everything admitted completes
+        #   2. an already-exhausted deadline budget is refused here
+        #      (stage="queue") — it must never cost a device launch
+        #   3. an open breaker serves the brownout cache (bounded
+        #      staleness, explicit degraded flag) or fails fast
+        #   4. the coalescer evicts entries whose budget expires while
+        #      queued (stage="gather") before they occupy a launch slot
+        band = getattr(req, "band", "") or ""
         try:
-            gate = self.admission.admit("score")
+            gate = self.admission.admit("score", band)
             gate.__enter__()
         except ResourceExhausted as exc:
-            self.telemetry.metrics.count_shed("score")
+            self.telemetry.metrics.count_shed("score", band)
             if ctx is not None:
                 ctx.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
             raise
         try:
+            budget = self._deadline_budget_ms(req, ctx)
+            if budget < 0.0:
+                self.telemetry.metrics.count_deadline_expired("queue")
+                exc = DeadlineExpired("score", "queue", budget)
+                if ctx is not None:
+                    ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
+                raise exc
+            if not self.breaker.allow_launch():
+                reply = self._serve_brownout(req)
+                if reply is not None:
+                    return reply
+                self.telemetry.metrics.count_breaker_rejected("score")
+                exc = BreakerOpen(
+                    "score", self.breaker.retry_after_ms(),
+                    "brownout cache cannot serve within "
+                    f"--brownout-max-lag={self._brownout_max_lag}",
+                )
+                if ctx is not None:
+                    ctx.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
+                raise exc
+            deadline_at = (
+                time.perf_counter() + budget / 1000.0
+                if budget > 0.0 else None
+            )
             # the coalescer runs the batch in whichever caller leads;
             # this caller's slot carries its reply or its error back
             try:
-                entry = self.dispatch.submit(req)
+                entry = self.dispatch.submit(
+                    req, deadline_at=deadline_at, budget_ms=budget
+                )
             except SnapshotNotResident as exc:
                 if ctx is not None:
                     ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(exc))
                 raise
+            except DeadlineExpired as exc:
+                # already counted stage="gather" by the dispatcher hook
+                if ctx is not None:
+                    ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
+                raise
             return entry.reply
         finally:
             gate.__exit__(None, None, None)
+
+    def _serve_brownout(self, req) -> Optional["pb2.ScoreReply"]:
+        """Serve one breaker-open Score STALE from the brownout cache,
+        or return None when the bound (or the cache's coverage) refuses
+        it.  The reply carries ``degraded=True`` and certifies a
+        generation at most ``--brownout-max-lag`` behind the id the
+        client named — same epoch, same geometry, same CycleConfig, a
+        top-k no wider than the cached launch.  Host numpy only: the
+        whole point is answering without touching the failing device."""
+        with self._state_lock:
+            # the id contract is unchanged: the client must name the
+            # CURRENT snapshot (its Sync ack) — brownout changes which
+            # GENERATION the scores certify, never which id is live
+            if self._stale_snapshot(
+                getattr(req, "snapshot_id", "")
+            ) is not None:
+                return None
+            cache = self._brownout
+            if cache is None or cache["epoch"] != self._epoch:
+                return None
+            lag = self._generation - cache["gen"]
+            if lag < 0 or lag > self._brownout_max_lag:
+                return None
+            if cache["cfg"] != self.cfg:
+                return None
+            st = self.state
+            if (
+                st.node_alloc is None
+                or st.pod_requests is None
+                or st.node_alloc.shape[0] != cache["nodes"]
+                or st.pod_requests.shape[0] != cache["pods"]
+            ):
+                return None  # geometry moved: the cached rows misalign
+            k = min(int(req.top_k) or cache["N"], cache["N"])
+            if k > cache["kb"]:
+                return None  # wider top-k than the cached launch holds
+        reply = self._assemble_score_reply(
+            req, k, cache["ts"], cache["ti"], cache["feasible"],
+            cache["valid"], cache["P"], degraded=True,
+        )
+        with self._state_lock:
+            self.degraded_replies += 1
+            self.telemetry.metrics.count_degraded("score")
+        return reply
 
     # -- coalesced Score execution: launch phase (leader thread, launch
     #    lock held) returning the readback closure the dispatcher runs
@@ -621,6 +828,15 @@ class ScorerServicer:
                 if max(memo_ks) > memo["kb"]:
                     memo = None  # needs a wider launch; it will replace
             incr = None
+            # geometry AT LAUNCH for the brownout cache: serve-time
+            # checks compare against it so a resize between this launch
+            # and a breaker-open serve can never misalign cached rows
+            mirror_rows = (
+                self.state.node_alloc.shape[0]
+                if self.state.node_alloc is not None else 0,
+                self.state.pod_requests.shape[0]
+                if self.state.pod_requests is not None else 0,
+            )
             if memo is None:
                 try:
                     snap = self.state.snapshot()
@@ -749,13 +965,44 @@ class ScorerServicer:
                 # never serve a future generation; the guard just keeps
                 # the dict from carrying a dead entry until the next
                 # bump's clear)
-                if self._score_memo is not None:
-                    with self._state_lock:
-                        if sid == self.snapshot_id():
-                            self._score_memo.put(sid, self.cfg, dict(
-                                kb=k_launch, N=N, P=P, ts=ts, ti=ti,
-                                feasible=feasible_np, valid=valid,
-                            ))
+                with self._state_lock:
+                    if (
+                        self._score_memo is not None
+                        and sid == self.snapshot_id()
+                    ):
+                        self._score_memo.put(sid, self.cfg, dict(
+                            kb=k_launch, N=N, P=P, ts=ts, ti=ti,
+                            feasible=feasible_np, valid=valid,
+                        ))
+                    # brownout cache (ISSUE 13): unlike the memo this
+                    # SURVIVES generation bumps — bounded staleness is
+                    # what the breaker-open path serves.  Pipelined
+                    # readbacks may complete out of order, so an older
+                    # launch never replaces a newer cache entry.
+                    b_epoch, _, b_gen = sid[1:].rpartition("-")
+                    try:
+                        b_gen = int(b_gen)
+                    except ValueError:
+                        b_gen = -1
+                    prev = self._brownout
+                    # b_epoch must ALSO match the current epoch: a
+                    # rebase keeps the generation, so an out-of-order
+                    # pre-rebase readback could otherwise satisfy the
+                    # gen comparison and clobber a fresh post-rebase
+                    # entry with one the serve-time epoch check will
+                    # forever refuse
+                    if b_gen >= 0 and b_epoch == self._epoch and (
+                        prev is None
+                        or prev["epoch"] != self._epoch
+                        or b_gen >= prev["gen"]
+                    ):
+                        self._brownout = dict(
+                            epoch=b_epoch, gen=b_gen, cfg=self.cfg,
+                            kb=k_launch, N=N, P=P,
+                            nodes=mirror_rows[0], pods=mirror_rows[1],
+                            ts=ts, ti=ti, feasible=feasible_np,
+                            valid=valid,
+                        )
                 # host-side assembly failures are per-entry: the launch
                 # served everyone else, so one bad demux must not fail
                 # callers whose replies are already built — and routing
@@ -884,16 +1131,22 @@ class ScorerServicer:
         return _hook
 
     def _assemble_score_reply(
-        self, req, k, top_scores, top_idx, feasible_np, valid, P
+        self, req, k, top_scores, top_idx, feasible_np, valid, P,
+        degraded: bool = False,
     ) -> "pb2.ScoreReply":
         """Demux one caller's reply from the shared readback: slice the
         k-prefix of the padded top-k (bit-identical with a serial
         ``lax.top_k(masked, k)``), then the same flat/legacy assembly
-        the serialized path used."""
+        the serialized path used.  ``degraded`` stamps the brownout
+        path's explicit staleness flag (ISSUE 13) — a fresh launch
+        never sets it, so reply bytes off the breaker path are
+        untouched."""
         ts = top_scores[:, :k]
         ti = top_idx[:, :k]
         ok = np.take_along_axis(feasible_np, ti, axis=1)
         reply = pb2.ScoreReply()
+        if degraded:
+            reply.degraded = True
         t0 = time.perf_counter()
         if req.flat:
             # flat layout (round-3 review #8): O(1) Python calls —
@@ -982,16 +1235,44 @@ class ScorerServicer:
     def assign(self, req: "pb2.AssignRequest", ctx=None) -> "pb2.AssignReply":
         # same admission gate as Score (ISSUE 8): Assign is read
         # traffic against the resident snapshot, so it sheds with the
-        # same RESOURCE_EXHAUSTED-before-the-queue-drowns contract
+        # same RESOURCE_EXHAUSTED-before-the-queue-drowns contract —
+        # band-aware since ISSUE 13 (free sheds first, prod last)
+        band = getattr(req, "band", "") or ""
         try:
-            gate = self.admission.admit("assign")
+            gate = self.admission.admit("assign", band)
             gate.__enter__()
         except ResourceExhausted as exc:
-            self.telemetry.metrics.count_shed("assign")
+            self.telemetry.metrics.count_shed("assign", band)
             if ctx is not None:
                 ctx.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
             raise
         try:
+            # deadline propagation (ISSUE 13): an already-exhausted
+            # budget is refused before it can queue behind the device
+            budget = self._deadline_budget_ms(req, ctx)
+            if budget < 0.0:
+                self.telemetry.metrics.count_deadline_expired("queue")
+                exc = DeadlineExpired("assign", "queue", budget)
+                if ctx is not None:
+                    ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
+                raise exc
+            # breaker (ISSUE 13): Assign must NOT serve stale — a
+            # placement certifies the live snapshot — so an open
+            # breaker fails fast with retry-after instead of queueing
+            # this RPC behind a failing device
+            if not self.breaker.allow_launch():
+                self.telemetry.metrics.count_breaker_rejected("assign")
+                exc = BreakerOpen(
+                    "assign", self.breaker.retry_after_ms(),
+                    "assign never serves stale",
+                )
+                if ctx is not None:
+                    ctx.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
+                raise exc
+            deadline_at = (
+                time.perf_counter() + budget / 1000.0
+                if budget > 0.0 else None
+            )
             # bounded retry: a waiter that inherited an OWNER's failure
             # re-runs the memo protocol (the failed entry was removed,
             # so one waiter promotes to owner); the last attempt
@@ -999,7 +1280,8 @@ class ScorerServicer:
             # a pathologically failing owner can never starve waiters
             for attempt in range(3):
                 outcome = self._assign_once(
-                    req, ctx, bypass_memo=attempt == 2
+                    req, ctx, bypass_memo=attempt == 2,
+                    deadline_at=deadline_at, budget_ms=budget,
                 )
                 if outcome is not None:
                     return outcome
@@ -1010,7 +1292,8 @@ class ScorerServicer:
             gate.__exit__(None, None, None)
 
     def _assign_once(
-        self, req: "pb2.AssignRequest", ctx, bypass_memo: bool = False
+        self, req: "pb2.AssignRequest", ctx, bypass_memo: bool = False,
+        deadline_at: Optional[float] = None, budget_ms: float = 0.0,
     ) -> Optional["pb2.AssignReply"]:
         """One pass of the Assign memo protocol.  Returns the reply, or
         None when this thread waited on a memo owner that failed (the
@@ -1040,9 +1323,19 @@ class ScorerServicer:
                 adopt_pending=owner or bypass_memo,
             )
         if entry is not None and not owner:
+            # no device work will happen on this RPC: if assign()'s
+            # allow_launch() granted it the one half-open probe slot,
+            # that slot must free for a caller that WILL launch —
+            # a memo hit says nothing about the device, and a wedged
+            # probe slot would hold the breaker half-open forever
+            # (release_probe is a no-op outside half-open)
+            self.breaker.release_probe()
             return self._assign_from_memo(entry, scope, t_rpc)
         try:
-            reply = self._assign_compute(req, ctx, scope, memo=entry)
+            reply = self._assign_compute(
+                req, ctx, scope, memo=entry,
+                deadline_at=deadline_at, budget_ms=budget_ms,
+            )
         except BaseException as exc:
             if owner:
                 # unpublish BEFORE waiters act on it: the entry leaves
@@ -1062,7 +1355,11 @@ class ScorerServicer:
         Waits OUTSIDE every lock; returns None (caller retries) when the
         owner failed — its error class may have been specific to that
         RPC, and serial semantics are re-established by re-running."""
-        entry.done.wait()
+        # backstop timeout (unbounded-wait idiom): the owner always
+        # sets done — success AND failure paths — so the loop exits on
+        # the event; the 1 Hz re-check only bounds a bug's blast radius
+        while not entry.done.wait(timeout=1.0):
+            pass
         if entry.error is not None or entry.result is None:
             # the waiter's private scope must not be abandoned: commit
             # it to the flight ring (no disk dump, no error counter —
@@ -1104,6 +1401,7 @@ class ScorerServicer:
     def _assign_compute(
         self, req: "pb2.AssignRequest", ctx, scope,
         memo: Optional[_AssignMemo] = None,
+        deadline_at: Optional[float] = None, budget_ms: float = 0.0,
     ) -> "pb2.AssignReply":
         """Run one real device cycle through the pipelined dispatcher
         and (as memo owner) publish its certified result.  ``memo`` is
@@ -1133,6 +1431,12 @@ class ScorerServicer:
             # in-flight slot keeps a donating Sync OUT (run_exclusive
             # drains) until the readback below completes.
             t0[0] = time.perf_counter()
+            # gather-stage deadline check (ISSUE 13): the budget may
+            # have drained while this RPC waited for pipeline headroom
+            # and the launch lock — an expired Assign must fail HERE,
+            # before the full device cycle it can no longer use
+            if deadline_at is not None and t0[0] >= deadline_at:
+                raise DeadlineExpired("assign", "gather", budget_ms)
             with self._state_lock:
                 self._check_generation(req, None)
                 snap = self.state.snapshot()
@@ -1182,6 +1486,18 @@ class ScorerServicer:
                 self.telemetry.abort_scope(scope, "assign", exc, dump=False)
             if ctx is not None:
                 ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(exc))
+            raise
+        except DeadlineExpired as exc:
+            # the CLIENT's budget ran out while this RPC queued: a
+            # protocol condition like displacement, not a cycle failure
+            # — no flight dump, no error counter, the record says what
+            # happened (and the device never launched)
+            with self._state_lock:
+                self.telemetry.metrics.count_deadline_expired("gather")
+                scope.note("deadline_expired", True)
+                self.telemetry.abort_scope(scope, "assign", exc, dump=False)
+            if ctx is not None:
+                ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
             raise
         except Exception as exc:
             # count + flight-dump the bad cycle before surfacing it

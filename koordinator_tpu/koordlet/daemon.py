@@ -282,7 +282,7 @@ def main(argv=None) -> int:
     daemon.start(args.interval)
     http.start()
     try:
-        threading.Event().wait()
+        threading.Event().wait()  # koordlint: disable=unbounded-wait(main thread parks forever by design; the daemon threads own the work and KeyboardInterrupt unparks)
     except KeyboardInterrupt:
         pass
     finally:

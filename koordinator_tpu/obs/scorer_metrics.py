@@ -33,6 +33,12 @@ koord_scorer_score_memo_total          counter   result (hit|miss)
 koord_scorer_score_incr_total          counter   result (incr|full|fallback)
 koord_scorer_incr_cols                 histogram —
 koord_scorer_shed_total                counter   method (score|assign)
+koord_scorer_shed_band_total           counter   band (koord-prod|mid|batch|free|none)
+koord_scorer_deadline_expired_total    counter   stage (queue|gather)
+koord_scorer_degraded_total            counter   rpc (score)
+koord_scorer_breaker_state             gauge     state (closed|half-open|open)
+koord_scorer_breaker_transitions_total counter   to (closed|half-open|open)
+koord_scorer_breaker_rejected_total    counter   method (score|assign)
 koord_scorer_replica_role              gauge     role (leader|follower)
 koord_scorer_replica_frames_total      counter   result (applied|stale|resync|error)
 koord_scorer_replica_lag_ms            gauge     —
@@ -90,6 +96,7 @@ from __future__ import annotations
 from typing import Mapping, Optional
 
 from koordinator_tpu.koordlet.metrics import DEFAULT_BUCKETS_MS, MetricsRegistry
+from koordinator_tpu.replication.admission import band_label
 
 CYCLE_LATENCY = "koord_scorer_cycle_latency_ms"
 CYCLE_ROUNDS = "koord_scorer_cycle_rounds"
@@ -117,6 +124,12 @@ SCORE_MEMO = "koord_scorer_score_memo_total"
 SCORE_INCR = "koord_scorer_score_incr_total"
 INCR_COLS = "koord_scorer_incr_cols"
 SHED_TOTAL = "koord_scorer_shed_total"
+SHED_BAND = "koord_scorer_shed_band_total"
+DEADLINE_EXPIRED = "koord_scorer_deadline_expired_total"
+DEGRADED_TOTAL = "koord_scorer_degraded_total"
+BREAKER_STATE = "koord_scorer_breaker_state"
+BREAKER_TRANSITIONS = "koord_scorer_breaker_transitions_total"
+BREAKER_REJECTED = "koord_scorer_breaker_rejected_total"
 REPLICA_ROLE = "koord_scorer_replica_role"
 REPLICA_FRAMES = "koord_scorer_replica_frames_total"
 REPLICA_LAG = "koord_scorer_replica_lag_ms"
@@ -207,8 +220,34 @@ _FAMILIES = (
      "(O(P x d) of the O(P x N) a full rescore pays)"),
     (SHED_TOTAL, "counter",
      "read RPCs the admission gate refused with RESOURCE_EXHAUSTED "
-     "(queue depth at --max-inflight), by method; in-flight work "
-     "completes untouched"),
+     "(queue depth at the band's rung of --max-inflight), by method; "
+     "in-flight work completes untouched"),
+    (SHED_BAND, "counter",
+     "admission sheds by priority band (ISSUE 13 band ladder: free "
+     "sheds at half the configured depth, batch/mid in between, prod "
+     "and unbanded legacy clients only at the full depth); under an "
+     "overload storm the free/batch rates climb while prod stays ~0"),
+    (DEADLINE_EXPIRED, "counter",
+     "requests whose propagated deadline budget expired before any "
+     "device work ran, by stage: queue = already expired at RPC "
+     "entry, gather = evicted by the batch leader at gather time; "
+     "either way the request never occupied a launch slot"),
+    (DEGRADED_TOTAL, "counter",
+     "replies served STALE from the brownout cache while the circuit "
+     "breaker was open (explicit degraded flag on the reply, "
+     "staleness bounded by --brownout-max-lag generations), by rpc"),
+    (BREAKER_STATE, "gauge",
+     "circuit breaker state as a label (closed|half-open|open); the "
+     "current state's series is 1, the others 0"),
+    (BREAKER_TRANSITIONS, "counter",
+     "circuit breaker state transitions, by destination state (to= "
+     "open is a trip or a failed half-open probe; to=closed is a "
+     "successful probe recovering the device path)"),
+    (BREAKER_REJECTED, "counter",
+     "requests the open breaker failed fast with UNAVAILABLE + "
+     "retry-after instead of queueing behind a failing device "
+     "(Assign always; Score when the brownout cache could not serve "
+     "it within the staleness bound), by method"),
     (REPLICA_ROLE, "gauge",
      "replication role of this daemon as a label (leader|follower); "
      "value is always 1"),
@@ -393,8 +432,36 @@ class ScorerMetrics:
         self.registry.histogram_observe(INCR_COLS, float(cols))
 
     # -- replicated serving tier (ISSUE 8) --
-    def count_shed(self, method: str) -> None:
+    def count_shed(self, method: str, band: str = "") -> None:
         self.registry.counter_add(SHED_TOTAL, 1, {"method": method})
+        self.registry.counter_add(
+            SHED_BAND, 1, {"band": band_label(band)}
+        )
+
+    # -- degradation ladder (ISSUE 13) --
+    def count_deadline_expired(self, stage: str, n: int = 1) -> None:
+        self.registry.counter_add(
+            DEADLINE_EXPIRED, int(n), {"stage": stage}
+        )
+
+    def count_degraded(self, rpc: str, n: int = 1) -> None:
+        self.registry.counter_add(DEGRADED_TOTAL, int(n), {"rpc": rpc})
+
+    def set_breaker_state(self, state: str) -> None:
+        """Flip the state gauge: the current state's series reads 1,
+        every other state's 0 (so a scrape always sees exactly one)."""
+        for s in ("closed", "half-open", "open"):
+            self.registry.gauge_set(
+                BREAKER_STATE, 1 if s == state else 0, {"state": s}
+            )
+
+    def count_breaker_transition(self, to: str) -> None:
+        self.registry.counter_add(BREAKER_TRANSITIONS, 1, {"to": to})
+
+    def count_breaker_rejected(self, method: str) -> None:
+        self.registry.counter_add(
+            BREAKER_REJECTED, 1, {"method": method}
+        )
 
     def set_replica_role(self, role: str) -> None:
         self.registry.gauge_set(REPLICA_ROLE, 1, {"role": role})

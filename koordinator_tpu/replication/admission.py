@@ -1,4 +1,5 @@
-"""Admission control + load shedding for the bridge daemon (ISSUE 8).
+"""Admission control, band-aware load shedding and the circuit breaker
+for the bridge daemon (ISSUE 8; band ladder + breaker ISSUE 13).
 
 Overload on the old daemon degraded as latency collapse: every Score
 past the coalescer's throughput queued without bound, so p99 grew with
@@ -12,54 +13,121 @@ transports map to gRPC ``RESOURCE_EXHAUSTED`` / a tagged raw-UDS error
 frame.  In-flight work is untouched — the gate never cancels, it only
 refuses to deepen the queue.
 
+ISSUE 13 makes the shedding BAND-AWARE: requests stamped with one of
+the koord-prod|mid|batch|free priority bands (the bands the trace
+generator already schedules; ``ScoreRequest.band`` on the wire) shed on
+a LADDER instead of all at the same depth.  Each band owns a fraction
+of ``max_inflight`` past which ITS new requests shed:
+
+    koord-free   0.50   (sheds first: half the configured depth)
+    koord-batch  0.65
+    koord-mid    0.80
+    koord-prod   1.00   (sheds last, at the full configured depth)
+    <unbanded>   1.00   (legacy clients = prod treatment, so the
+                         pre-band gate behavior is unchanged)
+
+so under pressure the free/batch tiers absorb the sheds while prod
+keeps its full admission depth — the Synergy-style multi-tenant
+treatment (2110.06073) applied to the overload path.  Shed replies
+carry BAND-SCALED retry-after hints (a shed free-band client backs off
+4x the observed service period; prod 1x), pushing the recovered
+capacity toward the bands that matter.  Sync is deliberately NEVER
+shed or banded: the one-writer path the followers replicate from must
+not degrade under a read storm.
+
 The depth the gate counts is exactly the dispatch queue's upstream
 population (admitted Score/Assign RPCs that have not finished), which
-bounds the coalescer's gather queue plus everything in execution.  Sync
-is deliberately NEVER shed: the paper's one-writer design means the
-write path must stay live for the whole tier — followers replicate
-from it — while read storms are the thing to shed.
+bounds the coalescer's gather queue plus everything in execution.
 
 ``max_inflight=0`` (the default) disables the gate entirely; the
 daemon flag is ``--max-inflight`` / ``KOORD_MAX_INFLIGHT``.  Sheds
-count on the ``koord_scorer_shed_total{method}`` family.
+count on the ``koord_scorer_shed_total{method}`` and
+``koord_scorer_shed_band_total{band}`` families.
+
+:class:`CircuitBreaker` is the next rung of the degradation ladder
+(ISSUE 13, docs/REPLICATION.md "Degradation ladder"): ``threshold``
+consecutive DEVICE failures — a launch half raising, or the readback's
+``device_get`` raising, where async dispatch actually surfaces a
+failing program (the chaos harness's ``fail_next_launch`` /
+``fail_next_readback`` idioms) — trip it OPEN, and while open the servicer
+stops queueing work behind the dead device — Score degrades to the
+bounded-staleness brownout cache (an explicit ``degraded`` reply
+flag), Assign fails fast with :class:`BreakerOpen` + retry-after.
+After ``cooldown_ms`` the breaker goes HALF-OPEN: exactly one launch
+is admitted as a probe; success closes the breaker, failure re-opens
+it for another cooldown.  Admission sheds happen BEFORE the dispatch
+queue, so a shed storm can never feed the breaker — and the breaker's
+failure feed additionally ignores request-level rejections
+(stale snapshot, expired deadline), counting only real launch faults.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Optional
+from typing import Dict, Optional
+
+# the shed ladder: fraction of max_inflight each band may fill before
+# ITS new requests shed.  Unknown/empty bands get prod treatment (shed
+# last) so legacy clients keep the exact pre-band gate behavior.
+BAND_SHED_FRACTION = {
+    "koord-free": 0.50,
+    "koord-batch": 0.65,
+    "koord-mid": 0.80,
+    "koord-prod": 1.00,
+}
+# retry-after hint multiplier per band: shed low-priority clients back
+# off harder, leaving the recovering capacity to the bands above them
+BAND_HINT_SCALE = {
+    "koord-free": 4.0,
+    "koord-batch": 2.0,
+    "koord-mid": 1.5,
+    "koord-prod": 1.0,
+}
+_UNBANDED = "none"  # metric label for requests that carried no band
+
+
+def band_label(band: Optional[str]) -> str:
+    """Normalized metric/stats label for a request band (empty/None ->
+    the explicit ``none`` so label values are never empty strings)."""
+    return band if band else _UNBANDED
 
 
 class ResourceExhausted(Exception):
     """The admission gate refused a request: the dispatch queue is at
-    its configured depth.  ``retry_after_ms`` is the server's hint —
-    one observed service period, i.e. when a slot plausibly frees.
+    the refusing band's rung of the ladder.  ``retry_after_ms`` is the
+    server's hint — the observed service period scaled by the band's
+    back-off factor, i.e. when a slot plausibly frees for THIS band.
     Transports map this to gRPC RESOURCE_EXHAUSTED; the message itself
-    carries the machine-parsable ``retry_after_ms=<n>`` token the Go
-    client's ``IsResourceExhausted``/``RetryAfterMS`` helpers read."""
+    carries the machine-parsable ``retry_after_ms=<n>`` token the
+    clients' ``IsResourceExhausted``/``RetryAfterMS`` helpers read."""
 
     def __init__(self, method: str, depth: int, limit: int,
-                 retry_after_ms: float):
+                 retry_after_ms: float, band: str = ""):
         self.method = method
         self.depth = depth
         self.limit = limit
+        self.band = band
         self.retry_after_ms = float(retry_after_ms)
+        at = f" ({band} band)" if band else ""
         super().__init__(
             f"RESOURCE_EXHAUSTED: {method} shed at queue depth "
-            f"{depth}/{limit}; retry_after_ms={self.retry_after_ms:.0f}"
+            f"{depth}/{limit}{at}; "
+            f"retry_after_ms={self.retry_after_ms:.0f}"
         )
 
 
 class AdmissionGate:
-    """Queue-depth gate with a service-time EWMA for the retry hint.
+    """Queue-depth gate with a per-band shed ladder and a service-time
+    EWMA for the retry hint.
 
-    ``admit(method)`` returns a context manager; entering it either
-    reserves a slot or raises :class:`ResourceExhausted` *immediately*
-    (the bounded-deadline property: a shed response never waits on the
-    device).  Exiting releases the slot and feeds the EWMA with the
-    observed service time, so the retry-after hint tracks the actual
-    per-request cost under the current load, not a config constant.
+    ``admit(method, band)`` returns a context manager; entering it
+    either reserves a slot or raises :class:`ResourceExhausted`
+    *immediately* (the bounded-deadline property: a shed response never
+    waits on the device).  Exiting releases the slot and feeds the EWMA
+    with the observed service time, so the retry-after hint tracks the
+    actual per-request cost under the current load, not a config
+    constant.
 
     Thread contract: everything under one small lock; no blocking calls
     inside it (the gate is on the RPC fast path of every Score)."""
@@ -81,6 +149,7 @@ class AdmissionGate:
         # lifetime stats (bench + /metrics feed)
         self.admitted = 0
         self.shed = 0
+        self.shed_by_band: Dict[str, int] = {}
 
     @property
     def enabled(self) -> bool:
@@ -90,14 +159,22 @@ class AdmissionGate:
         with self._lock:
             return self._inflight
 
-    def retry_after_ms(self) -> float:
-        """One observed service period, clamped (the hint a shed reply
-        carries)."""
-        with self._lock:
-            return self._hint_locked()
+    def band_limit(self, band: str) -> int:
+        """The ladder rung: admitted-but-unfinished reads at or past
+        which a NEW request of ``band`` sheds.  Unknown bands get prod
+        treatment (the full depth) — never a surprise shed."""
+        frac = BAND_SHED_FRACTION.get(band, 1.0)
+        return max(1, int(self.max_inflight * frac))
 
-    def _hint_locked(self) -> float:
+    def retry_after_ms(self, band: str = "") -> float:
+        """The band-scaled observed service period, clamped (the hint a
+        shed reply carries)."""
+        with self._lock:
+            return self._hint_locked(band)
+
+    def _hint_locked(self, band: str = "") -> float:
         ewma = self._ewma_ms if self._ewma_ms is not None else 50.0
+        ewma *= BAND_HINT_SCALE.get(band, 1.0)
         return min(self._MAX_HINT_MS, max(self._MIN_HINT_MS, ewma))
 
     def stats(self) -> dict:
@@ -107,20 +184,25 @@ class AdmissionGate:
                 "max_inflight": self.max_inflight,
                 "admitted": self.admitted,
                 "shed": self.shed,
+                "shed_by_band": dict(self.shed_by_band),
                 "ewma_service_ms": self._ewma_ms,
             }
 
-    def admit(self, method: str) -> "_Admission":
-        return _Admission(self, method)
+    def admit(self, method: str, band: str = "") -> "_Admission":
+        return _Admission(self, method, band)
 
     # -- slot accounting (called by _Admission) --
-    def _enter(self, method: str) -> float:
+    def _enter(self, method: str, band: str = "") -> float:
         with self._lock:
-            if self.enabled and self._inflight >= self.max_inflight:
+            if self.enabled and self._inflight >= self.band_limit(band):
                 self.shed += 1
+                label = band_label(band)
+                self.shed_by_band[label] = (
+                    self.shed_by_band.get(label, 0) + 1
+                )
                 raise ResourceExhausted(
-                    method, self._inflight, self.max_inflight,
-                    self._hint_locked(),
+                    method, self._inflight, self.band_limit(band),
+                    self._hint_locked(band), band=band,
                 )
             self._inflight += 1
             self.admitted += 1
@@ -142,15 +224,16 @@ class AdmissionGate:
 class _Admission:
     """One RPC's pass through the gate (context manager)."""
 
-    __slots__ = ("_gate", "_method", "_entered_at")
+    __slots__ = ("_gate", "_method", "_band", "_entered_at")
 
-    def __init__(self, gate: AdmissionGate, method: str):
+    def __init__(self, gate: AdmissionGate, method: str, band: str = ""):
         self._gate = gate
         self._method = method
+        self._band = band
         self._entered_at: Optional[float] = None
 
     def __enter__(self) -> "_Admission":
-        self._entered_at = self._gate._enter(self._method)
+        self._entered_at = self._gate._enter(self._method, self._band)
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
@@ -158,3 +241,176 @@ class _Admission:
             self._gate._exit(self._entered_at)
             self._entered_at = None
         return False
+
+
+class BreakerOpen(Exception):
+    """The circuit breaker refused a request outright: the device's
+    launch path is failing and this RPC must not queue behind it (and,
+    for Score, the brownout cache could not serve it within the
+    staleness bound either).  ``retry_after_ms`` is the remaining
+    cooldown before the next half-open probe — the earliest moment a
+    retry could find the breaker willing to try the device again.
+    Transports map this to gRPC UNAVAILABLE with the machine-parsable
+    ``retry_after_ms=<n>`` token."""
+
+    def __init__(self, method: str, retry_after_ms: float, detail: str = ""):
+        self.method = method
+        self.retry_after_ms = max(1.0, float(retry_after_ms))
+        tail = f"; {detail}" if detail else ""
+        super().__init__(
+            f"BREAKER_OPEN: {method} refused while the device launch "
+            f"path is failing{tail}; "
+            f"retry_after_ms={self.retry_after_ms:.0f}"
+        )
+
+
+class CircuitBreaker:
+    """Consecutive-launch-failure breaker with half-open probes.
+
+    States: ``closed`` (all launches admitted), ``open`` (no launches;
+    Score degrades to the brownout cache, Assign fails fast), and
+    ``half-open`` (exactly ONE probe launch admitted; its outcome
+    decides).  ``threshold=0`` disables the breaker entirely —
+    ``allow_launch`` always grants.
+
+    The failure feed is the dispatcher's launch outcome hook, filtered
+    by the servicer: only real launch faults count.  Request-level
+    rejections (stale snapshot, expired deadline) and admission sheds
+    never reach this object — a shed storm cannot trip the breaker
+    (regression-tested).
+
+    Thread contract: every method takes the one internal lock; no
+    blocking calls inside it (the breaker sits on the launch path)."""
+
+    def __init__(self, threshold: int = 3, cooldown_ms: float = 250.0,
+                 clock=time.monotonic, on_transition=None):
+        self.threshold = max(0, int(threshold))
+        self.cooldown_ms = max(1.0, float(cooldown_ms))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._state = "closed"
+        self._opened_at: Optional[float] = None
+        self._probe_out = False
+        # observability seam (servicer wires the breaker-state gauge +
+        # transition counter); called OUTSIDE the lock
+        self.on_transition = on_transition
+        # lifetime stats (bench + tests)
+        self.trips = 0
+        self.probes = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.threshold > 0
+
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if self._state == "open" and self._cooldown_left_locked() <= 0.0:
+            return "half-open"
+        return self._state
+
+    def _cooldown_left_locked(self) -> float:
+        if self._opened_at is None:
+            return 0.0
+        spent = (self._clock() - self._opened_at) * 1000.0
+        return max(0.0, self.cooldown_ms - spent)
+
+    def retry_after_ms(self) -> float:
+        """Remaining cooldown (the hint a fast-fail reply carries); at
+        least 1 ms so a shed client never busy-spins."""
+        with self._lock:
+            return max(1.0, self._cooldown_left_locked())
+
+    def allow_launch(self) -> bool:
+        """True when a launch may proceed: breaker closed, or this
+        caller won the one half-open probe slot.  False = serve the
+        degraded path instead (brownout / fast fail)."""
+        if not self.enabled:
+            return True
+        transition = None
+        with self._lock:
+            state = self._state_locked()
+            if state == "closed":
+                return True
+            if state == "half-open" and not self._probe_out:
+                self._probe_out = True
+                self._state = "half-open"
+                self.probes += 1
+                transition = "half-open"
+            else:
+                return False
+        self._notify(transition)
+        return True
+
+    def record_failure(self) -> None:
+        """One real launch fault (the servicer filters request-level
+        rejections out before calling)."""
+        if not self.enabled:
+            return
+        transition = None
+        with self._lock:
+            self._consecutive += 1
+            was = self._state
+            if self._state == "half-open":
+                # the probe failed: re-open for a fresh cooldown
+                self._state = "open"
+                self._opened_at = self._clock()
+                self._probe_out = False
+                transition = "open"
+            elif (
+                was == "closed"
+                and self._consecutive >= self.threshold
+            ):
+                self._state = "open"
+                self._opened_at = self._clock()
+                self.trips += 1
+                transition = "open"
+        self._notify(transition)
+
+    def release_probe(self) -> None:
+        """A half-open probe slot was granted but the batch performed
+        no device launch after all (every entry stale/expired, or a
+        memo served it): the device was not probed, so no verdict —
+        the slot frees for the next caller instead of wedging the
+        breaker half-open forever."""
+        with self._lock:
+            if self._state == "half-open":
+                self._probe_out = False
+
+    def record_success(self) -> None:
+        if not self.enabled:
+            return
+        transition = None
+        with self._lock:
+            self._consecutive = 0
+            if self._state != "closed":
+                self._state = "closed"
+                self._opened_at = None
+                self._probe_out = False
+                transition = "closed"
+        self._notify(transition)
+
+    def _notify(self, transition: Optional[str]) -> None:
+        if transition is not None and self.on_transition is not None:
+            try:
+                self.on_transition(transition)
+            except Exception:  # koordlint: disable=broad-except(an observability hook must never fail the launch path; the transition itself already happened)
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "breaker transition hook failed"
+                )
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state_locked(),
+                "threshold": self.threshold,
+                "cooldown_ms": self.cooldown_ms,
+                "consecutive_failures": self._consecutive,
+                "trips": self.trips,
+                "probes": self.probes,
+            }

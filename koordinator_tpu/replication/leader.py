@@ -126,7 +126,9 @@ class _Subscriber:
         while True:
             with self._cond:
                 while not self._frames and not self._dead:
-                    self._cond.wait()
+                    # backstop timeout only (unbounded-wait idiom):
+                    # every enqueue/close notifies this condition
+                    self._cond.wait(timeout=1.0)
                 if self._dead:
                     return
                 frame = self._frames.popleft()

@@ -89,6 +89,9 @@ class SchedulerServer:
         journal: bool = False,
         journal_compact_every: Optional[int] = None,
         journal_fsync: bool = False,
+        breaker_threshold: Optional[int] = None,
+        breaker_cooldown_ms: Optional[float] = None,
+        brownout_max_lag: Optional[int] = None,
     ):
         # persistent compile cache under the daemon's state dir: a
         # restarted sidecar skips the multi-second (16.5s on TPU,
@@ -193,6 +196,15 @@ class SchedulerServer:
             servicer_kw["max_inflight"] = int(max_inflight)
         if score_incr_max_ratio is not None:
             servicer_kw["score_incr_max_ratio"] = float(score_incr_max_ratio)
+        # degradation ladder knobs (ISSUE 13, docs/REPLICATION.md
+        # "Degradation ladder"): breaker trip/cooldown + brownout
+        # staleness bound
+        if breaker_threshold is not None:
+            servicer_kw["breaker_threshold"] = int(breaker_threshold)
+        if breaker_cooldown_ms is not None:
+            servicer_kw["breaker_cooldown_ms"] = float(breaker_cooldown_ms)
+        if brownout_max_lag is not None:
+            servicer_kw["brownout_max_lag"] = int(brownout_max_lag)
         # replication role (ISSUE 8, koordinator_tpu/replication/):
         # --replicate-from makes this daemon a READ FOLLOWER — it
         # subscribes to the named leader's replication socket, applies
@@ -288,6 +300,10 @@ class SchedulerServer:
                             "last_sync_path": outer.servicer.state.last_sync_path,
                             # replication tier visibility (ISSUE 8)
                             "replica": outer.replica_health(),
+                            # degradation ladder visibility (ISSUE 13):
+                            # breaker state, per-band sheds, degraded
+                            # replies served from the brownout cache
+                            "degrade": outer.degrade_health(),
                             # SLO visibility (ISSUE 12): last-window
                             # per-series quantiles from the gate's
                             # own estimator
@@ -396,6 +412,22 @@ class SchedulerServer:
                 self.servicer.telemetry.registry
             )
         return {"window": window}
+
+    def degrade_health(self) -> dict:
+        """The /healthz ``degrade`` block (ISSUE 13): where on the
+        degradation ladder this daemon currently sits — breaker state
+        (any non-closed state in the prod path is page-worthy), the
+        admission gate's per-band shed counts, degraded (brownout)
+        replies served, and deadline-expired evictions."""
+        sv = self.servicer
+        out = {
+            "breaker": sv.breaker.stats(),
+            "admission": sv.admission.stats(),
+            "degraded_replies": sv.degraded_replies,
+            "deadline_evicted": sv.dispatch.deadline_evicted,
+            "brownout_max_lag": sv._brownout_max_lag,
+        }
+        return out
 
     # -- crash tolerance (ISSUE 11) --
     def _journal_path(self) -> str:
@@ -643,7 +675,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "\"Incremental scoring\"): dirty-cost fraction "
         "(dirty_nodes/N + dirty_pods/P) above which a warm Score "
         "full-rescores instead of advancing the resident [P, N] score "
-        "tensor column-wise (default 0.25; env: "
+        "tensor column-wise (default 0.5, tuned by the trace-harness "
+        "sweep — the measured crossover is ~0.6; env: "
         "KOORD_SCORE_INCR_MAX_RATIO)",
     )
     ap.add_argument(
@@ -675,6 +708,41 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "against; env: KOORD_JOURNAL_FSYNC=1)",
     )
     ap.add_argument(
+        "--breaker-threshold", type=int,
+        default=(
+            int(os.environ["KOORD_BREAKER_THRESHOLD"])
+            if os.environ.get("KOORD_BREAKER_THRESHOLD") else None
+        ),
+        help="circuit breaker (docs/REPLICATION.md \"Degradation "
+        "ladder\"): consecutive device-launch failures that trip it "
+        "open — Score then serves the bounded-staleness brownout "
+        "cache with an explicit degraded flag, Assign fails fast with "
+        "retry-after; 0 disables (default 3; env: "
+        "KOORD_BREAKER_THRESHOLD)",
+    )
+    ap.add_argument(
+        "--breaker-cooldown-ms", type=float,
+        default=(
+            float(os.environ["KOORD_BREAKER_COOLDOWN_MS"])
+            if os.environ.get("KOORD_BREAKER_COOLDOWN_MS") else None
+        ),
+        help="how long an open breaker waits before admitting one "
+        "half-open probe launch (default 250 ms; env: "
+        "KOORD_BREAKER_COOLDOWN_MS)",
+    )
+    ap.add_argument(
+        "--brownout-max-lag", type=int,
+        default=(
+            int(os.environ["KOORD_BROWNOUT_MAX_LAG"])
+            if os.environ.get("KOORD_BROWNOUT_MAX_LAG") else None
+        ),
+        help="bounded staleness of breaker-open Score replies: max "
+        "generations behind the current snapshot the brownout cache "
+        "may serve (degraded flag set); a reply past the bound is "
+        "REFUSED, never served (default 2; env: "
+        "KOORD_BROWNOUT_MAX_LAG).  Assign never serves stale",
+    )
+    ap.add_argument(
         "--state-dir", default=None,
         help="daemon state directory (default: $XDG_STATE_HOME/"
         "koord-scheduler, per-user); the persistent XLA compile cache "
@@ -704,9 +772,12 @@ def main(argv=None) -> int:
         journal=args.journal,
         journal_compact_every=args.journal_compact_every,
         journal_fsync=args.journal_fsync,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_ms=args.breaker_cooldown_ms,
+        brownout_max_lag=args.brownout_max_lag,
     ).start()
     try:
-        threading.Event().wait()
+        threading.Event().wait()  # koordlint: disable=unbounded-wait(main thread parks forever by design; the server threads own the work and KeyboardInterrupt unparks)
     except KeyboardInterrupt:
         pass
     finally:

@@ -411,3 +411,114 @@ class TestTraceArtifactFields:
         assert doc["truncated"] is True
         assert doc["metric"] == "trace"
         assert "config_trace_cpu" in doc["error"]
+
+
+class TestChaosTraceArtifactFields:
+    """ISSUE 13: the chaos x trace gate fields must be archived
+    well-formed or not at all, and a deadline-killed chaos-trace run
+    must still flush one schema-valid truncated artifact."""
+
+    def _line(self, **extra):
+        doc = {"metric": "chaos_trace_recovery_ms", "value": 6.6,
+               "unit": "ms"}
+        doc.update(extra)
+        return json.dumps(doc)
+
+    def _verdict(self, **over):
+        doc = {"name": "recovery-p99", "quantile": 0.99,
+               "threshold_ms": 5000.0, "observed_ms": 9.9,
+               "count": 1, "ok": True}
+        doc.update(over)
+        return doc
+
+    def test_valid_chaos_trace_fields_pass(self):
+        assert bench._validate_artifact(self._line(
+            chaos_trace_events=24,
+            chaos_trace_seed=0,
+            chaos_trace_errors=4,
+            chaos_trace_retraces=0,
+            chaos_trace_digest="abc123",
+            degraded_replies=1,
+            breaker_trips=1,
+            recovery_ms=6.6,
+            shed_by_band={"koord-free": 96, "none": 2},
+            storm_band_p99_ms={"koord-prod": 49.7, "koord-free": None},
+            chaos_trace_slo=[self._verdict(),
+                             self._verdict(ok=False, observed_ms=None)],
+            chaos_trace_slo_pass=True,
+        )) == []
+        # every chaos-trace field is optional (other configs omit them)
+        assert bench._validate_artifact(self._line()) == []
+
+    def test_malformed_counts_fail(self):
+        assert bench._validate_artifact(self._line(chaos_trace_events=-1))
+        assert bench._validate_artifact(self._line(degraded_replies=1.5))
+        assert bench._validate_artifact(self._line(breaker_trips=True))
+        assert bench._validate_artifact(self._line(recovery_ms=-1))
+        assert bench._validate_artifact(
+            self._line(recovery_ms=float("nan"))
+        )
+        assert bench._validate_artifact(self._line(chaos_trace_digest=""))
+        assert bench._validate_artifact(
+            self._line(chaos_trace_slo_pass="yes")
+        )
+
+    def test_malformed_shed_by_band_fails(self):
+        assert bench._validate_artifact(self._line(shed_by_band=[1]))
+        assert bench._validate_artifact(
+            self._line(shed_by_band={"koord-free": -1})
+        )
+        assert bench._validate_artifact(
+            self._line(shed_by_band={"koord-free": 1.5})
+        )
+        assert bench._validate_artifact(
+            self._line(shed_by_band={"": 3})
+        )
+        assert bench._validate_artifact(
+            self._line(storm_band_p99_ms={"koord-prod": -1})
+        )
+
+    def test_malformed_verdicts_fail(self):
+        assert bench._validate_artifact(self._line(chaos_trace_slo={}))
+        assert bench._validate_artifact(
+            self._line(chaos_trace_slo=[self._verdict(name="")])
+        )
+        assert bench._validate_artifact(
+            self._line(chaos_trace_slo=[self._verdict(quantile=0.0)])
+        )
+        assert bench._validate_artifact(
+            self._line(chaos_trace_slo=[self._verdict(threshold_ms=-5)])
+        )
+
+    def test_deadline_killed_chaos_trace_flushes_truncated_artifact(self):
+        """The _ArtifactDeadline truncated-flush path covers --config
+        chaos-trace: a run wedged mid-chaos (a kill that never
+        recovers, a hung storm thread) must still put ONE schema-valid
+        truncated artifact on stdout stamped with the stage it died
+        in."""
+        emitted, fired = [], []
+        now = [0.0]
+
+        def sleep(s):
+            now[0] += s
+
+        d = bench._ArtifactDeadline(
+            100.0,
+            emit=lambda line: emitted.append(line) or True,
+            clock=lambda: now[0],
+            sleep=sleep,
+            on_fire=lambda rc: fired.append(rc),
+            metric="chaos-trace",  # main() arms it with args.config
+        )
+        old_stage = bench._PROGRESS["stage"]
+        try:
+            bench._PROGRESS["stage"] = "config_chaos-trace_cpu"
+            d.watch()
+        finally:
+            bench._PROGRESS["stage"] = old_stage
+        assert fired == [1] and len(emitted) == 1
+        assert bench._validate_artifact(emitted[0]) == []
+        doc = json.loads(emitted[0])
+        assert doc["truncated"] is True
+        assert doc["metric"] == "chaos-trace"
+        assert "config_chaos-trace_cpu" in doc["error"]

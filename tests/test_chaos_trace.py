@@ -1,0 +1,111 @@
+"""chaos x trace gate (ISSUE 13, ROADMAP 5(c)): the trace harness and
+the chaos harness compose, and obs/slo.py judges the outcome — the
+acceptance run ``bench.py --config chaos-trace`` drives, plus the
+PR-11 inverse-control pattern: the SAME gate must demonstrably FAIL
+under an injected unrecovered fault, so a green gate means the faults
+were actually survived, not that the gate cannot see."""
+
+import pytest
+
+from koordinator_tpu.harness.chaos import (
+    ChaosTraceReplay,
+    chaos_trace_slo_specs,
+)
+from koordinator_tpu.harness.trace import TraceConfig, generate_trace
+from koordinator_tpu.obs import slo as slo_mod
+from koordinator_tpu.obs.scorer_metrics import ScorerMetrics
+
+
+def _trace(events=18, seed=3):
+    return generate_trace(TraceConfig(
+        seed=seed, nodes=16, pod_slots=64, gangs=3, gang_min_member=2,
+        events=events, top_k=4,
+    ))
+
+
+def _gate(report, verdicts) -> bool:
+    """The chaos-trace gate, exactly as the bench composes it."""
+    return (
+        slo_mod.slos_pass(verdicts)
+        and report.parity_ok
+        and report.retraces == 0
+    )
+
+
+class TestChaosTraceGate:
+    @pytest.fixture(scope="class")
+    def report(self, tmp_path_factory):
+        """ONE full chaos replay shared by the assertions below (the
+        replay is the expensive part: warm-up pass + faulted pass +
+        kill/recovery)."""
+        trace = _trace()
+        td = tmp_path_factory.mktemp("chaos-trace")
+        return ChaosTraceReplay(
+            trace, str(td), fail_at=5, fail_n=4, kill_at=12,
+        ).run()
+
+    def test_breaker_tripped_and_brownout_served(self, report):
+        assert report.breaker_trips >= 1, (
+            "the launch-failure burst never tripped the breaker"
+        )
+        assert report.degraded_replies >= 1, (
+            "the brownout cache never served a degraded reply"
+        )
+        assert report.rpc_errors >= 3  # the consecutive failures
+
+    def test_leader_kill_recovers_within_slo(self, report):
+        assert report.recovery_ms is not None
+        verdicts = slo_mod.evaluate_slos(
+            report.registry, chaos_trace_slo_specs(report.bands)
+        )
+        by_name = {v.spec.name: v for v in verdicts}
+        assert by_name["recovery-p99"].ok, by_name["recovery-p99"].reason
+        assert by_name["recovery-p99"].count >= 1
+
+    def test_post_convergence_parity_and_zero_retraces(self, report):
+        assert report.parity_ok, report.parity_detail
+        assert report.retraces == 0, (
+            f"{report.retraces} warm-path retrace(s) after recovery"
+        )
+
+    def test_gate_passes_end_to_end(self, report):
+        verdicts = slo_mod.evaluate_slos(
+            report.registry, chaos_trace_slo_specs(report.bands)
+        )
+        assert _gate(report, verdicts), "\n".join(
+            f"{v.spec.name}: {v.reason}" for v in verdicts if not v.ok
+        )
+
+
+class TestInverseControl:
+    def test_unrecovered_fault_fails_the_gate(self, tmp_path):
+        """The PR-11 inverse-control pattern: with the launch poison
+        never lifted, the run completes (the harness must not hang)
+        but the gate FAILS — parity is broken (the engine never
+        recovers fresh scoring) and the recovery SLO has nothing to
+        see (no-data = failed verdict)."""
+        trace = _trace(events=12)
+        report = ChaosTraceReplay(
+            trace, str(tmp_path), fail_at=4, unrecovered=True,
+            warmup=False,
+        ).run()
+        assert not report.parity_ok
+        verdicts = slo_mod.evaluate_slos(
+            report.registry, chaos_trace_slo_specs(report.bands)
+        )
+        by_name = {v.spec.name: v for v in verdicts}
+        # no kill happened, so no recovery observation: the spec must
+        # FAIL with no-data, never silently pass
+        assert not by_name["recovery-p99"].ok
+        assert "no data" in by_name["recovery-p99"].reason
+        assert not _gate(report, verdicts)
+
+    def test_recovery_spec_fails_on_empty_registry(self):
+        """A gate that cannot see recovery is a failed gate."""
+        metrics = ScorerMetrics()
+        verdicts = slo_mod.evaluate_slos(
+            metrics.registry,
+            chaos_trace_slo_specs(["koord-prod"]),
+        )
+        assert all(not v.ok for v in verdicts)
+        assert all("no data" in v.reason for v in verdicts)

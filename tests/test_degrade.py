@@ -1,0 +1,533 @@
+"""Degradation ladder (ISSUE 13): band-aware admission, deadline
+propagation, circuit breaker + brownout.
+
+Layers covered:
+
+* :class:`replication.admission.AdmissionGate`'s band ladder — free
+  sheds before prod under the SAME pressure, hints scale per band;
+* deadline propagation end to end: queue-stage rejection at RPC entry,
+  gather-stage eviction by the batch leader BEFORE a launch slot, and
+  the eviction-parity contract (survivors' reply bytes identical to a
+  no-deadline run);
+* the circuit breaker: trips on consecutive launch failures, serves
+  brownout Scores with the explicit ``degraded`` flag inside the
+  staleness bound, REFUSES past it, recovers through a half-open
+  probe — and is never fed by admission sheds (the shed-storm
+  regression) or request-level rejections;
+* the overload band storm: free-band sheds absorb the pressure while
+  prod-band p99 holds (the acceptance surface ``bench.py --config
+  chaos-trace`` publishes as ``shed_by_band``).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from koordinator_tpu.bridge.codegen import pb2
+from koordinator_tpu.bridge.coalesce import (
+    CoalescingDispatcher,
+    DeadlineExpired,
+)
+from koordinator_tpu.bridge.server import ScorerServicer
+from koordinator_tpu.harness.chaos import fail_next_launch
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.replication.admission import (
+    AdmissionGate,
+    BreakerOpen,
+    CircuitBreaker,
+    ResourceExhausted,
+)
+
+R = res.NUM_RESOURCES
+
+
+def _tensor(a):
+    t = pb2.Tensor()
+    a = np.ascontiguousarray(a, np.int64)
+    t.shape.extend(a.shape)
+    t.data = a.tobytes()
+    return t
+
+
+def _full_sync_request(nodes=4, pods=8, quotas=1):
+    req = pb2.SyncRequest()
+    nalloc = np.zeros((nodes, R), np.int64)
+    nalloc[:, :] = 1000
+    req.nodes.allocatable.CopyFrom(_tensor(nalloc))
+    req.nodes.requested.CopyFrom(_tensor(np.zeros((nodes, R), np.int64)))
+    req.nodes.usage.CopyFrom(_tensor(np.zeros((nodes, R), np.int64)))
+    req.nodes.metric_fresh.extend([True] * nodes)
+    preq = np.zeros((pods, R), np.int64)
+    preq[:, 0] = 10
+    req.pods.requests.CopyFrom(_tensor(preq))
+    req.pods.estimated.CopyFrom(_tensor(preq))
+    req.pods.priority.extend([9000] * pods)
+    req.pods.gang_id.extend([-1] * pods)
+    req.pods.quota_id.extend([0] * pods)
+    qrt = np.zeros((quotas, R), np.int64)
+    qrt[:, :] = 100000
+    req.quotas.runtime.CopyFrom(_tensor(qrt))
+    req.quotas.used.CopyFrom(_tensor(np.zeros((quotas, R), np.int64)))
+    req.quotas.limited.CopyFrom(_tensor(np.zeros((quotas, R), np.int64)))
+    return req
+
+
+def _delta_sync_request(pods=8, slot=0, cpu=20):
+    """A warm single-cell pod delta (bumps the generation by one)."""
+    req = pb2.SyncRequest()
+    t = pb2.Tensor()
+    t.shape.extend([pods, R])
+    t.delta_idx = np.asarray([slot * R], "<i8").tobytes()
+    t.delta_val = np.asarray([cpu], "<i8").tobytes()
+    req.pods.requests.CopyFrom(t)
+    return req
+
+
+@pytest.fixture
+def servicer():
+    sv = ScorerServicer(breaker_cooldown_ms=60.0, brownout_max_lag=2)
+    sv.sync(_full_sync_request())
+    return sv
+
+
+def _score(sv, **kw):
+    return sv.score(pb2.ScoreRequest(
+        snapshot_id=sv.snapshot_id(), top_k=4, flat=True, **kw
+    ))
+
+
+class TestBandLadder:
+    def test_free_sheds_before_prod_at_the_same_depth(self):
+        gate = AdmissionGate(max_inflight=4)
+        # occupy half the depth: free's rung (0.5 * 4 = 2) is full,
+        # prod's (4) is not
+        held = [gate.admit("score").__enter__() for _ in range(2)]
+        with pytest.raises(ResourceExhausted):
+            gate.admit("score", "koord-free").__enter__()
+        prod = gate.admit("score", "koord-prod").__enter__()
+        prod.__exit__(None, None, None)
+        for h in held:
+            h.__exit__(None, None, None)
+        assert gate.stats()["shed_by_band"] == {"koord-free": 1}
+
+    def test_ladder_ordering_is_monotonic(self):
+        gate = AdmissionGate(max_inflight=20)
+        limits = [
+            gate.band_limit(b)
+            for b in ("koord-free", "koord-batch", "koord-mid",
+                      "koord-prod")
+        ]
+        assert limits == sorted(limits)
+        assert limits[0] < limits[-1]
+        # unbanded legacy clients get prod treatment: the pre-band
+        # gate behavior is unchanged
+        assert gate.band_limit("") == gate.band_limit("koord-prod")
+        assert gate.band_limit("unknown-band") == gate.max_inflight
+
+    def test_hints_scale_per_band(self):
+        gate = AdmissionGate(max_inflight=1)
+        with gate.admit("score"):
+            time.sleep(0.01)
+        free = gate.retry_after_ms("koord-free")
+        prod = gate.retry_after_ms("koord-prod")
+        assert free > prod  # shed free clients back off harder
+
+    def test_shed_message_carries_hint_and_band(self):
+        gate = AdmissionGate(max_inflight=1)
+        held = gate.admit("score").__enter__()
+        with pytest.raises(ResourceExhausted) as ei:
+            gate.admit("score", "koord-free").__enter__()
+        held.__exit__(None, None, None)
+        assert "retry_after_ms=" in str(ei.value)
+        assert "koord-free" in str(ei.value)
+
+
+class TestDeadlinePropagation:
+    def test_expired_on_arrival_is_rejected_at_queue_stage(self, servicer):
+        with pytest.raises(DeadlineExpired) as ei:
+            _score(servicer, deadline_ms=-1)
+        assert ei.value.stage == "queue"
+        assert servicer.telemetry.registry.get(
+            "koord_scorer_deadline_expired_total", {"stage": "queue"}
+        ) == 1
+
+    def test_gather_eviction_never_occupies_a_launch_slot(self, servicer):
+        """An entry whose budget drains while queued is evicted by the
+        batch leader at gather time — and the no-device batch performs
+        zero launches."""
+        sv = servicer
+        launches_before = sv.dispatch.batches
+        # hold the launch lock so the request must queue
+        sv.dispatch._launch_lock.acquire()
+        out = {}
+
+        def call():
+            try:
+                _score(sv, deadline_ms=25)
+            except DeadlineExpired as exc:
+                out["exc"] = exc
+
+        t = threading.Thread(target=call)
+        t.start()
+        time.sleep(0.12)  # the 25 ms budget drains while queued
+        sv.dispatch._launch_lock.release()
+        t.join(timeout=10.0)
+        assert isinstance(out.get("exc"), DeadlineExpired)
+        assert out["exc"].stage == "gather"
+        assert sv.dispatch.deadline_evicted == 1
+        assert sv.dispatch.batches == launches_before  # nothing launched
+        assert sv.telemetry.registry.get(
+            "koord_scorer_deadline_expired_total", {"stage": "gather"}
+        ) == 1
+
+    def test_eviction_parity_survivors_bytes_identical(self, servicer):
+        """Survivors of a batch that evicted an expired sibling get
+        reply bytes identical to a run with no deadlines at all."""
+        sv = servicer
+        want = _score(sv).flat.SerializeToString()  # no-deadline oracle
+        sv.dispatch._launch_lock.acquire()
+        results = {}
+
+        def expired():
+            try:
+                _score(sv, deadline_ms=25)
+            except DeadlineExpired as exc:
+                results["expired"] = exc
+
+        def survivor(i):
+            results[i] = _score(sv, deadline_ms=60_000)
+
+        threads = [threading.Thread(target=expired)] + [
+            threading.Thread(target=survivor, args=(i,)) for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.12)
+        sv.dispatch._launch_lock.release()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert isinstance(results.get("expired"), DeadlineExpired)
+        for i in range(3):
+            assert results[i].flat.SerializeToString() == want
+            assert not results[i].degraded
+
+    def test_assign_deadline_checked_before_the_cycle(self, servicer):
+        with pytest.raises(DeadlineExpired) as ei:
+            servicer.assign(pb2.AssignRequest(
+                snapshot_id=servicer.snapshot_id(), deadline_ms=-1
+            ))
+        assert ei.value.stage == "queue"
+
+    def test_expired_deadlines_never_feed_the_breaker(self, servicer):
+        for _ in range(5):
+            with pytest.raises(DeadlineExpired):
+                _score(servicer, deadline_ms=-1)
+        stats = servicer.breaker.stats()
+        assert stats["state"] == "closed"
+        assert stats["consecutive_failures"] == 0
+
+
+class TestCircuitBreaker:
+    def test_trips_on_consecutive_launch_failures(self, servicer):
+        with fail_next_launch(servicer, n=3):
+            for _ in range(3):
+                with pytest.raises(RuntimeError):
+                    _score(servicer)
+        assert servicer.breaker.state() in ("open", "half-open")
+        assert servicer.breaker.stats()["trips"] == 1
+
+    def test_brownout_serves_degraded_within_bound(self, servicer):
+        fresh = _score(servicer).flat.SerializeToString()
+        with fail_next_launch(servicer, n=3):
+            for _ in range(3):
+                with pytest.raises(RuntimeError):
+                    _score(servicer)
+        # one warm delta: generation advances by 1, lag 1 <= bound 2
+        servicer.sync(_delta_sync_request())
+        reply = _score(servicer)
+        assert reply.degraded
+        assert servicer.degraded_replies == 1
+        # the degraded bytes certify the PRE-delta generation: they
+        # equal the stale launch's bytes (same geometry, bounded lag)
+        assert reply.flat.SerializeToString() == fresh
+
+    def test_brownout_refuses_past_the_staleness_bound(self, servicer):
+        with fail_next_launch(servicer, n=3):
+            for _ in range(3):
+                with pytest.raises(RuntimeError):
+                    _score(servicer)
+        # three warm deltas: lag 3 > brownout_max_lag 2 -> REFUSED
+        for i in range(3):
+            servicer.sync(_delta_sync_request(slot=i, cpu=20 + i))
+        with pytest.raises(BreakerOpen) as ei:
+            _score(servicer)
+        assert "retry_after_ms=" in str(ei.value)
+        assert servicer.degraded_replies == 0
+
+    def test_assign_fails_fast_never_stale(self, servicer):
+        with fail_next_launch(servicer, n=3):
+            for _ in range(3):
+                with pytest.raises(RuntimeError):
+                    _score(servicer)
+        with pytest.raises(BreakerOpen) as ei:
+            servicer.assign(pb2.AssignRequest(
+                snapshot_id=servicer.snapshot_id()
+            ))
+        assert "retry_after_ms=" in str(ei.value)
+
+    def test_half_open_probe_recovers(self, servicer):
+        with fail_next_launch(servicer, n=3):
+            for _ in range(3):
+                with pytest.raises(RuntimeError):
+                    _score(servicer)
+        assert servicer.breaker.state() == "open"
+        time.sleep(0.08)  # past the 60 ms cooldown -> half-open
+        # memo would serve without probing the device; force a launch
+        # by advancing the generation first
+        servicer.sync(_delta_sync_request())
+        reply = _score(servicer)
+        assert not reply.degraded  # the probe launched fresh
+        assert servicer.breaker.state() == "closed"
+
+    def test_failed_probe_reopens(self, servicer):
+        with fail_next_launch(servicer, n=4):
+            for _ in range(3):
+                with pytest.raises(RuntimeError):
+                    _score(servicer)
+            time.sleep(0.08)
+            servicer.sync(_delta_sync_request())
+            with pytest.raises(RuntimeError):
+                _score(servicer)  # the probe eats poison #4
+        assert servicer.breaker.state() == "open"
+        assert servicer.breaker.stats()["probes"] == 1
+
+    def test_readback_failures_trip_the_breaker(self, servicer):
+        """Review hardening: async dispatch surfaces a failing device
+        program at the readback's device_get, not at enqueue — those
+        faults must feed the breaker exactly like launch-half ones."""
+        from koordinator_tpu.harness.chaos import fail_next_readback
+
+        with fail_next_readback(servicer, n=3):
+            for _ in range(3):
+                with pytest.raises(RuntimeError):
+                    _score(servicer)
+        assert servicer.breaker.state() in ("open", "half-open")
+        assert servicer.breaker.stats()["trips"] == 1
+
+    def test_assign_readback_failure_feeds_the_breaker(self, servicer):
+        # the Assign path reads back through run_pipelined: wrap the
+        # launch fn so its returned readback closure raises — the
+        # launch half succeeds, the device_get phase fails
+        real = servicer.dispatch.run_pipelined
+
+        def poisoned(launch_fn):
+            def wrapped():
+                launch_fn()  # real launch; its readback is discarded
+
+                def bad():
+                    raise RuntimeError("chaos: assign readback failure")
+
+                return bad
+
+            return real(wrapped)
+
+        servicer.dispatch.run_pipelined = poisoned
+        try:
+            with pytest.raises(RuntimeError):
+                servicer.assign(pb2.AssignRequest(
+                    snapshot_id=servicer.snapshot_id()
+                ))
+        finally:
+            servicer.dispatch.run_pipelined = real
+        assert servicer.breaker.stats()["consecutive_failures"] >= 1
+
+    def test_memo_assign_during_half_open_releases_probe(self, servicer):
+        """Review hardening: an Assign served from the result memo
+        while the breaker is half-open performs no device work — it
+        must RELEASE the probe slot, not wedge the breaker half-open
+        forever."""
+        # populate the assign memo for the current generation
+        servicer.assign(pb2.AssignRequest(
+            snapshot_id=servicer.snapshot_id()
+        ))
+        with fail_next_launch(servicer, n=3):
+            for _ in range(3):
+                with pytest.raises(RuntimeError):
+                    _score(servicer)
+        assert servicer.breaker.state() == "open"
+        time.sleep(0.08)  # cooldown (60 ms fixture) -> half-open
+        # memo hit: takes the probe slot, launches nothing, releases it
+        servicer.assign(pb2.AssignRequest(
+            snapshot_id=servicer.snapshot_id()
+        ))
+        # the slot is free again: a launch-needing request probes the
+        # device and recovers the breaker (generation bump clears the
+        # memos so the score below must actually launch)
+        servicer.sync(_delta_sync_request())
+        reply = _score(servicer)
+        assert not reply.degraded
+        assert servicer.breaker.state() == "closed"
+
+    def test_shed_storm_never_trips_the_breaker(self):
+        """Satellite regression (ISSUE 13): transient sheds
+        (RESOURCE_EXHAUSTED) must not count toward the breaker."""
+        sv = ScorerServicer(max_inflight=1)
+        sv.sync(_full_sync_request())
+        held = sv.admission.admit("score").__enter__()
+        try:
+            for _ in range(10):
+                with pytest.raises(ResourceExhausted):
+                    _score(sv)
+        finally:
+            held.__exit__(None, None, None)
+        stats = sv.breaker.stats()
+        assert stats["state"] == "closed"
+        assert stats["trips"] == 0
+        assert stats["consecutive_failures"] == 0
+        assert sv.admission.stats()["shed"] == 10
+
+    def test_displacement_never_feeds_the_breaker(self, servicer):
+        from koordinator_tpu.bridge.coalesce import SnapshotNotResident
+
+        for _ in range(5):
+            with pytest.raises(SnapshotNotResident):
+                servicer.score(pb2.ScoreRequest(
+                    snapshot_id="s-deadbeef-999", top_k=4, flat=True
+                ))
+        assert servicer.breaker.stats()["consecutive_failures"] == 0
+
+    def test_threshold_zero_disables(self):
+        sv = ScorerServicer(breaker_threshold=0)
+        sv.sync(_full_sync_request())
+        with fail_next_launch(sv, n=5):
+            for _ in range(5):
+                with pytest.raises(RuntimeError):
+                    _score(sv)
+        assert sv.breaker.state() == "closed"
+        _score(sv)  # still serving fresh, no brownout involved
+
+    def test_breaker_unit_half_open_slot_is_exclusive(self):
+        clock = [0.0]
+        br = CircuitBreaker(threshold=1, cooldown_ms=100.0,
+                            clock=lambda: clock[0])
+        br.record_failure()
+        assert not br.allow_launch()
+        clock[0] = 0.2  # cooldown elapsed
+        assert br.allow_launch()       # the one probe
+        assert not br.allow_launch()   # siblings still fail fast
+        br.release_probe()             # no-device batch: slot frees
+        assert br.allow_launch()
+        br.record_success()
+        assert br.state() == "closed"
+
+
+class TestOverloadBandStorm:
+    def test_free_absorbs_prod_holds(self):
+        """The ISSUE-13 acceptance: under an overload storm, free-band
+        sheds absorb the pressure while the prod band is served within
+        its SLO (the surface bench publishes as ``shed_by_band``)."""
+        from koordinator_tpu.harness.chaos import overload_band_storm
+
+        storm = overload_band_storm(
+            max_inflight=3, free_threads=4, prod_threads=2, reps=16,
+            launch_delay_ms=10.0,
+        )
+        assert storm["shed_by_band"].get("koord-free", 0) > 0
+        assert storm["shed_by_band"].get("koord-prod", 0) == 0
+        assert storm["served"].get("koord-prod", 0) > 0
+        prod_p99 = storm["band_p99_ms"]["koord-prod"]
+        assert prod_p99 is not None and prod_p99 < 2000.0
+
+
+class TestWireFields:
+    def test_deadline_band_degraded_round_trip(self):
+        r = pb2.ScoreRequest(deadline_ms=123, band="koord-free")
+        assert pb2.ScoreRequest.FromString(
+            r.SerializeToString()
+        ).deadline_ms == 123
+        a = pb2.AssignRequest(deadline_ms=5, band="koord-mid")
+        back = pb2.AssignRequest.FromString(a.SerializeToString())
+        assert (back.deadline_ms, back.band) == (5, "koord-mid")
+        rep = pb2.ScoreReply(degraded=True)
+        assert pb2.ScoreReply.FromString(rep.SerializeToString()).degraded
+
+    def test_client_stamps_deadline_and_band(self):
+        from koordinator_tpu.bridge.client import ScorerClient
+
+        c = ScorerClient.__new__(ScorerClient)
+        c.snapshot_id = "s1-1"
+        c.band = "koord-batch"
+        c._deadline_ms = 777.0
+        req = c._score_request(top_k=3, flat=True)
+        assert req.deadline_ms == 777
+        assert req.band == "koord-batch"
+
+    def test_client_retry_after_parsing(self):
+        from koordinator_tpu.bridge.client import retry_after_ms
+
+        class FakeErr(Exception):
+            pass
+
+        assert retry_after_ms(FakeErr()) is None
+
+    def test_shed_pause_uses_hint_not_both(self):
+        """The satellite fix: a shed's retry-after hint REPLACES the
+        backoff delay — one pause per attempt, never hint + backoff."""
+        import grpc
+
+        from koordinator_tpu.bridge.client import ScorerClient
+        from koordinator_tpu.replication.retry import BackoffPolicy
+
+        class FakeShed(grpc.RpcError):
+            def code(self):
+                return grpc.StatusCode.RESOURCE_EXHAUSTED
+
+            def details(self):
+                return "RESOURCE_EXHAUSTED: shed; retry_after_ms=42"
+
+        c = ScorerClient.__new__(ScorerClient)
+        c._retry = BackoffPolicy(base_ms=1000.0, cap_ms=1000.0,
+                                 deadline_ms=60_000.0)
+        delays = iter([999.0, 999.0])
+        # the hint (42 ms) replaces the 999 ms backoff slot entirely
+        assert c._pause_ms(delays, FakeShed()) == 42.0
+        # budget exhausted -> None regardless of the hint
+        assert c._pause_ms(iter([]), FakeShed()) is None
+        # no hint -> the backoff delay is the pause
+        assert c._pause_ms(iter([7.0]), None) == 7.0
+
+    def test_dispatcher_deadline_mechanics_with_injected_clock(self):
+        """Pure dispatcher-level eviction: entries past deadline_at at
+        gather time error with stage=gather; the executor only ever
+        sees survivors."""
+        now = [0.0]
+        seen = []
+
+        def executor(batch):
+            seen.append([e.req for e in batch])
+            for e in batch:
+                e.reply = e.req
+            return None
+
+        d = CoalescingDispatcher(executor, max_batch=4,
+                                 clock=lambda: now[0])
+        evicted = []
+        d.deadline_hook = evicted.append
+        # queue two entries by hand (no leading thread), then lead
+        from koordinator_tpu.bridge.coalesce import PendingRequest
+
+        live = PendingRequest("live", 0.0, deadline_at=None)
+        dead = PendingRequest("dead", 0.0, deadline_at=5.0,
+                              budget_ms=5.0)
+        with d._cond:
+            d._queue.extend([live, dead])
+        now[0] = 6.0  # past dead's deadline
+        assert d._try_lead() is not None
+        assert live.reply == "live"
+        assert isinstance(dead.error, DeadlineExpired)
+        assert dead.error.stage == "gather"
+        assert seen == [["live"]]  # the executor never saw the corpse
+        assert evicted == [1]
+        assert d.deadline_evicted == 1

@@ -1465,3 +1465,201 @@ class TestGoToolchainGate:
                 f"unavailable: {proc.stderr.strip()[:200]}"
             )
         assert proc.returncode == 0, proc.stderr
+
+
+class TestUnboundedWait:
+    """unbounded-wait (ISSUE 13): no-timeout Condition/Event waits and
+    deadline-less client stub calls fail lint; the backstop idiom,
+    bounded waits and reasoned suppressions stay clean."""
+
+    def test_bare_event_wait_caught(self):
+        out = lint(
+            """
+            import threading
+
+            def park(done):
+                done.wait()
+            """,
+            ["unbounded-wait"],
+        )
+        assert len(out) == 1
+        assert out[0].rule == "unbounded-wait"
+        assert "timeout" in out[0].message
+
+    def test_bare_condition_wait_caught(self):
+        out = lint(
+            """
+            def drain(self):
+                with self._cond:
+                    while not self._frames:
+                        self._cond.wait()
+            """,
+            ["unbounded-wait"],
+        )
+        assert len(out) == 1
+
+    def test_backstop_idiom_clean(self):
+        out = lint(
+            """
+            def follow(self, entry):
+                with self._cond:
+                    while not entry.done:
+                        self._cond.wait(timeout=1.0)
+                while not entry.flag.wait(timeout=1.0):
+                    pass
+            """,
+            ["unbounded-wait"],
+        )
+        assert out == []
+
+    def test_positional_timeout_clean(self):
+        out = lint(
+            """
+            def join(self, t):
+                t.wait(5.0)
+            """,
+            ["unbounded-wait"],
+        )
+        assert out == []
+
+    def test_stub_call_without_timeout_caught(self):
+        out = lint(
+            """
+            def call(self, request):
+                stub = self._score_stub()
+                return stub(request)
+            """,
+            ["unbounded-wait"],
+        )
+        assert len(out) == 1
+        assert "stub" in out[0].message
+
+    def test_stub_call_with_timeout_clean(self):
+        out = lint(
+            """
+            def call(self, request):
+                stub = self._score_stub()
+                return stub(request, timeout=self._timeout_s())
+            """,
+            ["unbounded-wait"],
+        )
+        assert out == []
+
+    def test_stub_factory_call_clean(self):
+        # zero-arg calls are stub FACTORIES, not RPC invocations
+        out = lint(
+            """
+            def pick(self):
+                return self._leader_score_stub()
+            """,
+            ["unbounded-wait"],
+        )
+        assert out == []
+
+    def test_kwargs_splat_not_flagged(self):
+        # a **kw splat may carry timeout=: cannot prove it missing
+        out = lint(
+            """
+            def call(self, request, **kw):
+                return stub(request, **kw)
+            """,
+            ["unbounded-wait"],
+        )
+        assert out == []
+
+    def test_suppression_honored(self):
+        out = lint(
+            """
+            import threading
+
+            def main():
+                threading.Event().wait()  # koordlint: disable=unbounded-wait(main thread parks forever by design)
+            """,
+            ["unbounded-wait"],
+        )
+        assert out == []
+
+
+class TestWirecheckMessageMirror:
+    """wire-contract's third-mirror extension (ISSUE 13): the
+    hand-rolled wirecheck.py decoders are statically diffed against
+    the proto so a new field (the deadline/band/degraded additions
+    being the motivating case) cannot be silently dropped by the
+    independent mirror."""
+
+    PROTO = """
+    message ScoreRequest {
+      string snapshot_id = 1;
+      int64 top_k = 2;
+      bool flat = 3;
+      int64 deadline_ms = 4;
+    }
+    """
+
+    GOOD = '''
+def decode_score_request(b):
+    r = {"snapshot_id": "", "top_k": 0, "flat": False, "deadline_ms": 0}
+    for field, _wtype, v in split_fields(b):
+        if field == 1:
+            r["snapshot_id"] = v.decode("utf-8")
+        elif field == 2:
+            r["top_k"] = _signed(v)
+        elif field == 3:
+            r["flat"] = bool(v)
+        elif field == 4:
+            r["deadline_ms"] = _signed(v)
+    return r
+'''
+
+    def test_matching_mirror_clean(self):
+        out = wire_contract.check_wirecheck_messages(
+            textwrap.dedent(self.PROTO), self.GOOD
+        )
+        assert out == []
+
+    def test_missing_branch_caught(self):
+        src = self.GOOD.replace(
+            '        elif field == 4:\n'
+            '            r["deadline_ms"] = _signed(v)\n', ''
+        )
+        out = wire_contract.check_wirecheck_messages(
+            textwrap.dedent(self.PROTO), src
+        )
+        assert len(out) == 1
+        assert "field == 4" in out[0].message
+        assert "deadline_ms" in out[0].message
+
+    def test_wrong_key_caught(self):
+        src = self.GOOD.replace('r["deadline_ms"] = _signed(v)',
+                                'r["deadline"] = _signed(v)')
+        out = wire_contract.check_wirecheck_messages(
+            textwrap.dedent(self.PROTO), src
+        )
+        assert len(out) == 1
+        assert "deadline_ms" in out[0].message
+
+    def test_phantom_field_caught(self):
+        src = self.GOOD.replace(
+            'elif field == 4:',
+            'elif field == 9:\n            r["ghost"] = v\n'
+            '        elif field == 4:'
+        )
+        out = wire_contract.check_wirecheck_messages(
+            textwrap.dedent(self.PROTO), src
+        )
+        assert len(out) == 1
+        assert "field 9" in out[0].message
+
+    def test_missing_decoder_caught(self):
+        out = wire_contract.check_wirecheck_messages(
+            textwrap.dedent(self.PROTO), "def unrelated():\n    pass\n"
+        )
+        assert len(out) == 1
+        assert "decode_score_request" in out[0].message
+
+    def test_repo_wirecheck_mirror_is_clean(self):
+        out = wire_contract.check_wirecheck_messages(
+            read("koordinator_tpu", "bridge", "scorer.proto"),
+            read("koordinator_tpu", "bridge", "wirecheck.py"),
+        )
+        assert out == [], "\n".join(v.format() for v in out)
