@@ -297,12 +297,23 @@ def _validate_artifact(line: Optional[str]) -> list:
                 "trace_events", "trace_parity_checks", "trace_retraces",
                 "trace_seed", "chaos_trace_events", "chaos_trace_seed",
                 "chaos_trace_errors", "chaos_trace_retraces",
-                "degraded_replies", "breaker_trips"):
+                "degraded_replies", "breaker_trips",
+                "assembled_traces", "orphan_spans"):
         v = doc.get(key)
         if v is not None and (
             isinstance(v, bool) or not isinstance(v, int) or v < 0
         ):
             problems.append(f"'{key}' must be null or an int >= 0")
+    # distributed-tracing overhead field (ISSUE 14): tracing-on vs
+    # tracing-off p99 delta in percent — NEGATIVE is legitimate (run
+    # noise on a quiet replay), but it must be finite and can never be
+    # below -100 (the traced run cannot take negative time)
+    top = doc.get("trace_overhead_p99_pct")
+    if top is not None and _bad_finite_nonneg(top, minimum=-100.0):
+        problems.append(
+            "'trace_overhead_p99_pct' must be null or a finite "
+            "number >= -100"
+        )
     # chaos x trace gate fields (ISSUE 13): the recovery wall, the
     # per-band shed ladder outcome and the combined SLO verdicts —
     # malformed ones must not be archived
@@ -1214,6 +1225,82 @@ def child_config(platform: str, config: str) -> None:
             "trace replay recorded no latency observations "
             f"({report.events_replayed} events replayed)"
         )
+        # distributed-tracing overhead (ISSUE 14): replay the SAME
+        # stream with span export on (client + servicer), measure the
+        # p99 delta against the untraced run above, and assemble the
+        # export directory — 100% of the replayed RPCs must come back
+        # as complete trees with zero orphans, or the artifact is not
+        # published (a tracing layer that loses spans measured nothing)
+        import tempfile
+
+        from koordinator_tpu.obs import assemble as assemble_mod
+
+        def _raw_cycle_p99(rep):
+            # RAW per-event latencies from the replay timeline, not the
+            # bucket-quantile estimate: at bench scale the histogram
+            # buckets are coarse enough that one sample crossing a
+            # boundary reads as a 2x "regression" — the overhead delta
+            # needs exact percentiles, the SLO gate keeps its
+            # Prometheus-semantics estimator
+            lat = [c["notes"]["latency_ms"] for c in rep.timeline]
+            assert lat, "replay timeline is empty"
+            return float(np.percentile(np.asarray(lat, float), 99))
+
+        # interleaved min-of-k: back-to-back replays on this shared
+        # container swing 2x run to run (scheduler noise), so a single
+        # off/on pair cannot resolve a 5% delta — alternate the modes
+        # and take each mode's BEST p99 (the run least perturbed by
+        # the machine), the standard noise-robust estimator.  Repeat
+        # passes skip the warm-up (the process jit cache already holds
+        # every shape the first run compiled).
+        reps = max(1, int(
+            os.environ.get("KOORD_TRACE_OVERHEAD_REPS") or "3"
+        ))
+        p99_off_runs = [_raw_cycle_p99(report)]
+        p99_on_runs = []
+        with tempfile.TemporaryDirectory(
+            prefix="koord-bench-traces-"
+        ) as trace_td:
+            for rep_i in range(reps):
+                traced_report = TraceReplay(
+                    trace, trace_export=trace_td, warmup=False
+                ).run()
+                p99_on_runs.append(_raw_cycle_p99(traced_report))
+                if rep_i + 1 < reps:
+                    p99_off_runs.append(_raw_cycle_p99(
+                        TraceReplay(trace, warmup=False).run()
+                    ))
+            assembly = assemble_mod.assemble([trace_td])
+            assembled_traces = len(assembly.traces)
+            orphan_spans = len(assembly.orphan_spans)
+            incomplete = len(assembly.incomplete)
+        p99_off = min(p99_off_runs)
+        p99_on = min(p99_on_runs)
+        overhead_pct = (p99_on - p99_off) / p99_off * 100.0
+        phase(
+            "trace_overhead",
+            p99_off_ms=round(p99_off, 3),
+            p99_on_ms=round(p99_on, 3),
+            overhead_pct=round(overhead_pct, 2),
+            assembled_traces=assembled_traces,
+            orphan_spans=orphan_spans,
+            incomplete_traces=incomplete,
+        )
+        assert assembled_traces > 0, "tracing-on replay exported no traces"
+        assert orphan_spans == 0 and incomplete == 0, (
+            f"{orphan_spans} orphan span(s), {incomplete} incomplete "
+            "trace(s) after assembling the traced replay's exports"
+        )
+        # the acceptance bound (≤5% by default); overridable for noisy
+        # shared hosts (`or`: empty env value means unset)
+        max_overhead_pct = float(
+            os.environ.get("KOORD_TRACE_OVERHEAD_MAX_PCT") or "5.0"
+        )
+        assert overhead_pct <= max_overhead_pct, (
+            f"tracing overhead {overhead_pct:.2f}% exceeds the "
+            f"{max_overhead_pct:.1f}% bound (raw cycle p99 "
+            f"{p99_off:.3f} -> {p99_on:.3f} ms)"
+        )
         print(
             json.dumps(
                 {
@@ -1238,6 +1325,9 @@ def child_config(platform: str, config: str) -> None:
                     "trace_slo_pass": slo_mod.slos_pass(verdicts),
                     "trace_nodes": tcfg.nodes,
                     "trace_pods": tcfg.pod_slots,
+                    "trace_overhead_p99_pct": round(overhead_pct, 3),
+                    "assembled_traces": assembled_traces,
+                    "orphan_spans": orphan_spans,
                 }
             ),
             flush=True,
@@ -1305,10 +1395,22 @@ def child_config(platform: str, config: str) -> None:
             fail_at=fail_at,
             kill_at=kill_at,
         )
+        from koordinator_tpu.obs import assemble as assemble_mod
+
         with tempfile.TemporaryDirectory(prefix="koord-bench-chaos-") as td:
+            # tracing ON (ISSUE 14): the client, the leader AND its
+            # warm-restarted successor all export spans to one
+            # directory; the assembly below must reconstruct every
+            # client-observed RPC across the kill
+            trace_dir = os.path.join(td, "traces")
             report = ChaosTraceReplay(
                 trace, td, fail_at=fail_at, fail_n=4, kill_at=kill_at,
+                trace_export=trace_dir,
             ).run()
+            assembly = assemble_mod.assemble([trace_dir])
+            assembled_traces = len(assembly.traces)
+            orphan_spans = len(assembly.orphan_spans)
+            client_orphans = len(assembly.client_orphans)
         phase(
             "chaos_trace_replayed",
             rpc_errors=report.rpc_errors,
@@ -1335,6 +1437,14 @@ def child_config(platform: str, config: str) -> None:
         )
         assert report.degraded_replies > 0, (
             "the brownout cache never served a degraded reply"
+        )
+        # the tracing gate (ISSUE 14): every client-observed RPC —
+        # retried, shed, brownout-degraded, across the kill — must
+        # assemble into a complete tree with zero orphan client spans
+        assert assembled_traces > 0, "chaos replay exported no traces"
+        assert client_orphans == 0, (
+            f"{client_orphans} orphan client span(s) after assembling "
+            "the chaos replay's exports"
         )
         verdicts = slo_mod.evaluate_slos(
             report.registry, chaos_trace_slo_specs(report.bands)
@@ -1394,6 +1504,8 @@ def child_config(platform: str, config: str) -> None:
                     },
                     "chaos_trace_slo": [v.to_doc() for v in verdicts],
                     "chaos_trace_slo_pass": gate_pass,
+                    "assembled_traces": assembled_traces,
+                    "orphan_spans": orphan_spans,
                 }
             ),
             flush=True,

@@ -171,17 +171,26 @@ def main() -> None:
     )
 
     sv = _golden_servicer("0601den0")
+    # trace context rides every request fixture (ISSUE 14) so the Go
+    # marshaler's trace_id/parent_span fields are byte-pinned, and the
+    # replies carry the servicer's DETERMINISTIC span ids (counter-
+    # based under the pinned epoch: sp0601den0-<n>) for the unmarshal
+    # tests.  Pinned values, never minted — regen determinism.
+    req.trace_id = "ab" * 16
+    req.parent_span = "1111222233334444"
     sync_reply = sv.sync(req)
     # deadline budget + band ride the request fixtures (ISSUE 13) so
     # the Go marshaler's new fields are byte-pinned like every other
     score_req = pb2.ScoreRequest(
         snapshot_id=sync_reply.snapshot_id, top_k=TOP_K, flat=True,
         deadline_ms=1500, band="koord-batch",
+        trace_id="cd" * 16, parent_span="5555666677778888",
     )
     score_reply = sv.score(score_req)
     assign_req = pb2.AssignRequest(
         snapshot_id=sync_reply.snapshot_id, cycle_id="golden-cycle-1",
         deadline_ms=2500, band="koord-prod",
+        trace_id="ef" * 16, parent_span="9999aaaabbbbcccc",
     )
     assign_reply = sv.assign(assign_req)
     # measured timings pinned to exact float64 constants: a fixture
@@ -203,10 +212,14 @@ def main() -> None:
         "score_request": {
             "deadline_ms": score_req.deadline_ms,
             "band": score_req.band,
+            "trace_id": score_req.trace_id,
+            "parent_span": score_req.parent_span,
         },
         "sync_request": {
             "node_bucket": req.node_bucket,
             "pod_bucket": req.pod_bucket,
+            "trace_id": req.trace_id,
+            "parent_span": req.parent_span,
             "nodes": {
                 "names": list(req.nodes.names),
                 "metric_fresh": list(req.nodes.metric_fresh),
@@ -234,6 +247,7 @@ def main() -> None:
             "snapshot_id": sync_reply.snapshot_id,
             "nodes": sync_reply.nodes,
             "pods": sync_reply.pods,
+            "server_span": sync_reply.server_span,
         },
         "score_reply": {
             "pod_index": np.frombuffer(
@@ -251,13 +265,17 @@ def main() -> None:
             "cycle_id": assign_req.cycle_id,
             "deadline_ms": assign_req.deadline_ms,
             "band": assign_req.band,
+            "trace_id": assign_req.trace_id,
+            "parent_span": assign_req.parent_span,
         },
         "assign_reply": {
             "assignment": list(assign_reply.assignment),
             "status": list(assign_reply.status),
             "path": assign_reply.path,
             "cycle_id": assign_reply.cycle_id,
+            "server_span": assign_reply.server_span,
         },
+        "score_reply_server_span": score_reply.server_span,
     }
     plugin_flow_fixtures(blobs, expected)
 

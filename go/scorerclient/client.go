@@ -1,13 +1,16 @@
 package scorerclient
 
 import (
+	"crypto/rand"
 	"encoding/binary"
+	"encoding/hex"
 	"fmt"
 	"io"
 	"net"
 	"strconv"
 	"strings"
 	"sync"
+	"time"
 )
 
 // Method bytes of the raw framing (bridge/udsserver.py).
@@ -57,6 +60,14 @@ type Client struct {
 	// can no longer use.  The raw framing has no transport deadline,
 	// so this field is the only carrier (ISSUE 13).
 	DeadlineMs int64
+	// TraceID/ParentSpan are the distributed-tracing context stamped on
+	// every RPC (ISSUE 14): set TraceID once per logical request and
+	// ParentSpan per attempt (NewSpanID mints one), so a retried-then-
+	// failed-over request assembles into ONE trace with one span per
+	// attempt.  Empty = tracing off, zero wire cost.  Replies echo the
+	// sidecar's span id (ServerSpan) for the offline assembler.
+	TraceID    string
+	ParentSpan string
 }
 
 // snapshotID reads the last acknowledged id under idMu (Pool.Sync
@@ -152,8 +163,28 @@ func Generation(snapshotID string) int64 {
 	return gen
 }
 
+// NewSpanID mints a 16-hex span id for ParentSpan stamping (one per
+// attempt; crypto-strength uniqueness is not needed for correlation).
+func NewSpanID() string {
+	var b [8]byte
+	if _, err := rand.Read(b[:]); err != nil {
+		// correlation ids degrade, they never fail the RPC
+		binary.BigEndian.PutUint64(b[:], uint64(time.Now().UnixNano()))
+	}
+	return hex.EncodeToString(b[:])
+}
+
+// NewTraceID mints a 32-hex trace id (one per logical request; every
+// retry/failover attempt keeps it so the attempts assemble as one tree).
+func NewTraceID() string { return NewSpanID() + NewSpanID() }
+
 // Sync ships the cluster snapshot and records the acknowledged id.
+// The client's trace context rides the request (retries re-Marshal, so
+// a caller updating ParentSpan per attempt stamps each attempt's span).
 func (c *Client) Sync(req *SyncRequest) (*SyncReply, error) {
+	if req.TraceID == "" && c.TraceID != "" {
+		req.TraceID, req.ParentSpan = c.TraceID, c.ParentSpan
+	}
 	body, err := c.call(MethodSync, req.Marshal())
 	if err != nil {
 		return nil, err
@@ -172,6 +203,7 @@ func (c *Client) ScoreFlat(topK int64) (*ScoreReply, error) {
 	req := ScoreRequest{
 		SnapshotID: c.snapshotID(), TopK: topK, Flat: true,
 		DeadlineMs: c.DeadlineMs, Band: c.Band,
+		TraceID: c.TraceID, ParentSpan: c.ParentSpan,
 	}
 	body, err := c.call(MethodScore, req.Marshal())
 	if err != nil {
@@ -205,6 +237,7 @@ func (c *Client) AssignCycle(cycleID string) (*AssignReply, error) {
 	req := AssignRequest{
 		SnapshotID: c.snapshotID(), CycleID: cycleID,
 		DeadlineMs: c.DeadlineMs, Band: c.Band,
+		TraceID: c.TraceID, ParentSpan: c.ParentSpan,
 	}
 	body, err := c.call(MethodAssign, req.Marshal())
 	if err != nil {

@@ -21,6 +21,8 @@ import grpc
 
 from koordinator_tpu.bridge.codegen import method_path, pb2
 from koordinator_tpu.bridge.state import numpy_to_tensor
+from koordinator_tpu.obs.export import SpanExporter, resolve_export_dir
+from koordinator_tpu.obs.spans import ClientTraceOp
 from koordinator_tpu.replication.retry import BackoffPolicy
 
 # channel-level failures: the RPC may or may not have reached the
@@ -128,7 +130,8 @@ class ScorerClient:
                  retry_policy: Optional[BackoffPolicy] = None,
                  band: str = "",
                  deadline_ms: Optional[float] = None,
-                 rpc_timeout_ms: Optional[float] = None):
+                 rpc_timeout_ms: Optional[float] = None,
+                 trace_export: Optional[str] = None):
         """``target``: "unix:///path.sock" or host:port.
 
         ``channels``: size of the connection pool Score/Assign calls
@@ -183,7 +186,19 @@ class ScorerClient:
         ``rpc_timeout_ms``: transport deadline applied to EVERY stub
         call (``KOORD_RPC_TIMEOUT_MS``, default 300 s) so a hung daemon
         can never hang the caller forever; ``deadline_ms`` tightens it
-        per call when set."""
+        per call when set.
+
+        ``trace_export`` (ISSUE 14, distributed tracing): directory
+        this client appends its OWN completed spans to as OTLP-shaped
+        JSON lines (default from ``KOORD_TRACE_EXPORT``; None/unset =
+        tracing off, zero cost).  When on, every logical RPC mints ONE
+        trace id and a root op span, every ATTEMPT — retries, failover
+        probes, the Sync full-resend — gets a child span whose id is
+        stamped as the wire ``parent_span``, and the server's echoed
+        ``server_span`` is recorded on the attempt.  A retried-then-
+        shed-then-served request therefore assembles into one tree
+        with one span per attempt (``python -m
+        koordinator_tpu.obs.assemble`` over the export dirs)."""
         self._pool = _ChannelPool(target, channels)
         self.band = band or ""
         # `or`: empty env value means unset (the KOORD_* convention)
@@ -255,11 +270,52 @@ class ScorerClient:
         # whether the last flat Score reply carried the brownout
         # degraded flag (ISSUE 13)
         self.last_degraded = False
+        # distributed tracing (ISSUE 14): the client's own span
+        # exporter; None = tracing off (the default)
+        self._exporter: Optional[SpanExporter] = None
+        export_to = resolve_export_dir(trace_export)
+        if export_to is not None:
+            self._exporter = SpanExporter(
+                export_to, service="scorer-client"
+            )
 
     def close(self) -> None:
         self._pool.close()
         for p in self._follower_pools:
             p.close()
+        if self._exporter is not None:
+            self._exporter.close()
+
+    # -- distributed tracing (ISSUE 14) --
+    def _trace_op(self, name: str) -> Optional[ClientTraceOp]:
+        """One logical RPC's trace (root op span + per-attempt child
+        spans), or None when tracing is off."""
+        if self._exporter is None:
+            return None
+        return ClientTraceOp(name, sink=self._exporter.export)
+
+    def _traced_call(self, op: Optional[ClientTraceOp], stub, request,
+                     timeout: float):
+        """One ATTEMPT: stamp the op's trace context on the request
+        (each attempt re-stamps its own span id as ``parent_span``),
+        invoke, record the server's echoed span id, end — or abort
+        with the error so sheds/deadline/transport failures stay
+        visible per attempt in the assembled tree."""
+        if op is None:
+            return stub(request, timeout=timeout)
+        span = op.attempt()
+        request.trace_id = op.trace_id
+        request.parent_span = span.span_id
+        try:
+            reply = stub(request, timeout=timeout)
+        except BaseException as exc:
+            span.abort(exc)
+            raise
+        server_span = getattr(reply, "server_span", "") or ""
+        if server_span:
+            span.set_attr("server_span", server_span)
+        span.end()
+        return reply
 
     def _slot(self) -> int:
         with self._rr_lock:
@@ -307,7 +363,7 @@ class ScorerClient:
             return min(hint, self._retry.cap_ms)
         return d_ms
 
-    def _call_writer(self, kind: str, request):
+    def _call_writer(self, kind: str, request, op=None):
         """Invoke a writer-side RPC (Sync/Assign) against the active
         leader, failing over through the shared backoff policy:
         transient channel errors retry, "one writer" refusals probe
@@ -323,7 +379,7 @@ class ScorerClient:
             last: Optional[BaseException] = None
             for idx, stub in self._writer_stubs(kind):
                 try:
-                    reply = stub(request, timeout=timeout)
+                    reply = self._traced_call(op, stub, request, timeout)
                     self._leader_idx = idx
                     return reply
                 except grpc.RpcError as exc:
@@ -357,7 +413,7 @@ class ScorerClient:
             return self._follower_scores[self._leader_idx]
         return self._scores[self._slot()]
 
-    def _call_score(self, request):
+    def _call_score(self, request, op=None):
         """Reads retry FREELY (ISSUE 11): they are idempotent against a
         named snapshot, so a transient channel error just moves to the
         next replica under the shared backoff budget.  A shed
@@ -369,7 +425,7 @@ class ScorerClient:
             stub, on_follower = self._score_stub()
             if on_follower:
                 try:
-                    return stub(request, timeout=timeout)
+                    return self._traced_call(op, stub, request, timeout)
                 except grpc.RpcError as e:
                     if _is_transient(e) or _is_shed(e):
                         pause = self._pause_ms(delays, e)
@@ -384,7 +440,7 @@ class ScorerClient:
                     # so the baseline is fine: serve this call there
                     # instead of invalidating anything
             try:
-                return self._call(self._leader_score_stub(), request)
+                return self._call(self._leader_score_stub(), request, op=op)
             except grpc.RpcError as e:
                 if not (_is_transient(e) or _is_shed(e)):
                     raise
@@ -401,8 +457,34 @@ class ScorerClient:
             self._epoch = None
             self.snapshot_id = None
 
-    def sync(
+    def _with_op(self, name: str, fn):
+        """Run one logical RPC under a client trace op (ISSUE 14):
+        ``fn(op)`` gets the op (or None with tracing off) to thread
+        into the retrying call helpers; the root span ends — with the
+        escaping error attached, or clean — on every exit."""
+        op = self._trace_op(name)
+        if op is None:
+            return fn(None)
+        try:
+            result = fn(op)
+        except BaseException as exc:
+            op.finish(error=exc)
+            raise
+        op.finish()
+        return result
+
+    def sync(self, **kwargs) -> "pb2.SyncReply":
+        """One logical Sync (delta-encoded against the acked baseline;
+        see :meth:`_sync_op` for the keyword surface).  Traced as ONE
+        op: the delta attempt, any failover probes and the full-resend
+        fallback are sibling attempt spans of the same trace."""
+        return self._with_op(
+            "sync", lambda op: self._sync_op(op, **kwargs)
+        )
+
+    def _sync_op(
         self,
+        op=None,
         *,
         node_allocatable: Optional[np.ndarray] = None,
         node_requested: Optional[np.ndarray] = None,
@@ -510,7 +592,9 @@ class ScorerClient:
             baseline = self._prev
             sent_full = False
             try:
-                reply = self._call_writer("sync", build(baseline, full=False))
+                reply = self._call_writer(
+                    "sync", build(baseline, full=False), op=op
+                )
             except grpc.RpcError as exc:
                 if _is_transient(exc) or _is_not_leader(exc):
                     # channel-level failure that outlived the whole
@@ -531,7 +615,7 @@ class ScorerClient:
                 # one full re-sync (ADVICE r5); a second failure is surfaced
                 try:
                     reply = self._call_writer(
-                        "sync", build(baseline, full=True)
+                        "sync", build(baseline, full=True), op=op
                     )
                     sent_full = True
                 except grpc.RpcError:
@@ -549,7 +633,7 @@ class ScorerClient:
                 # omitted this cycle still resend their last acked state.
                 try:
                     reply = self._call_writer(
-                        "sync", build(baseline, full=True)
+                        "sync", build(baseline, full=True), op=op
                     )
                 except grpc.RpcError:
                     # the server may have applied the full sync before
@@ -565,12 +649,12 @@ class ScorerClient:
             return reply
 
     # -- score / assign --
-    def _call(self, stub, request):
+    def _call(self, stub, request, op=None):
         """Invoke Score/Assign; on FAILED_PRECONDITION (our snapshot was
         displaced by another client's Sync) invalidate the baseline so the
         caller's next sync() ships full state, then surface the error."""
         try:
-            return stub(request, timeout=self._timeout_s())
+            return self._traced_call(op, stub, request, self._timeout_s())
         except grpc.RpcError as e:
             if e.code() == grpc.StatusCode.FAILED_PRECONDITION:
                 self._invalidate()
@@ -585,7 +669,10 @@ class ScorerClient:
         )
 
     def score(self, top_k: int = 0) -> List[List[Tuple[int, int]]]:
-        reply = self._call_score(self._score_request(top_k))
+        reply = self._with_op(
+            "score",
+            lambda op: self._call_score(self._score_request(top_k), op=op),
+        )
         return [
             list(zip(entry.node_index, entry.score)) for entry in reply.pods
         ]
@@ -597,7 +684,12 @@ class ScorerClient:
         arrays decoded straight from the packed reply bytes — the O(1)
         assembly path on both ends (round-3 review #8).  Entry group g
         (pod pod_index[g]) covers counts[g] consecutive entries."""
-        reply = self._call_score(self._score_request(top_k, flat=True))
+        reply = self._with_op(
+            "score",
+            lambda op: self._call_score(
+                self._score_request(top_k, flat=True), op=op
+            ),
+        )
         # degraded visibility (ISSUE 13): True when the LAST flat Score
         # was served stale from the daemon's brownout cache while its
         # breaker was open — callers alarm on it instead of discovering
@@ -625,6 +717,9 @@ class ScorerClient:
         device program that ran ("pallas"/"scan"/"shard") so callers can
         alarm on a degraded-path cycle instead of discovering it in a
         latency graph."""
+        return self._with_op("assign", self._assign_op)
+
+    def _assign_op(self, op=None):
         try:
             reply = self._call_writer(
                 "assign",
@@ -633,6 +728,7 @@ class ScorerClient:
                     deadline_ms=int(self._deadline_ms),
                     band=self.band,
                 ),
+                op=op,
             )
         except grpc.RpcError as e:
             # displaced snapshot (stale-id FAILED_PRECONDITION): the

@@ -134,11 +134,12 @@ class PendingRequest:
     __slots__ = (
         "req", "enqueued_at", "reply", "error", "done",
         "queue_delay_ms", "batch_size", "deadline_at", "budget_ms",
+        "trace_span",
     )
 
     def __init__(self, req, enqueued_at: float,
                  deadline_at: Optional[float] = None,
-                 budget_ms: float = 0.0):
+                 budget_ms: float = 0.0, trace_span=None):
         self.req = req
         self.enqueued_at = enqueued_at
         self.reply = None
@@ -148,6 +149,11 @@ class PendingRequest:
         self.batch_size = 0
         self.deadline_at = deadline_at
         self.budget_ms = budget_ms
+        # this RPC's distributed-trace span (obs/spans.py TraceSpan,
+        # ISSUE 14) or None: the batch leader fan-in links it to the
+        # ONE launch span the coalesced batch shares — the span's
+        # lifecycle (end/abort) stays with the submitting RPC body
+        self.trace_span = trace_span
 
 
 class ScoreMemo:
@@ -343,16 +349,17 @@ class CoalescingDispatcher:
 
     # -- public API --
     def submit(self, req, deadline_at: Optional[float] = None,
-               budget_ms: float = 0.0) -> PendingRequest:
+               budget_ms: float = 0.0, trace_span=None) -> PendingRequest:
         """Enqueue ``req`` and block until a batch containing it ran.
         Returns the finished entry; raises its error if the executor
         (or the batch as a whole) failed.  ``deadline_at`` (dispatcher
         clock) arms gather-time eviction: an entry still queued past it
         fails with :class:`DeadlineExpired` instead of occupying a
-        launch slot."""
+        launch slot.  ``trace_span`` rides the entry for the executor's
+        fan-in linking (ISSUE 14); the dispatcher never ends it."""
         entry = PendingRequest(
             req, self._clock(), deadline_at=deadline_at,
-            budget_ms=budget_ms,
+            budget_ms=budget_ms, trace_span=trace_span,
         )
         with self._cond:
             self.window.observe_arrival(entry.enqueued_at)

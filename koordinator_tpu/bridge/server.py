@@ -107,13 +107,17 @@ class _AssignMemo:
     the bump, which is exactly the serial schedule where its Assign ran
     first."""
 
-    __slots__ = ("done", "result", "error")
+    __slots__ = ("done", "result", "error", "span_ref")
 
     def __init__(self):
         self.done = threading.Event()
         # (assignment, status, valid, path, rounds, eff_wave, cycle_ms)
         self.result = None
         self.error: Optional[BaseException] = None
+        # the owner's (trace_id, span_id) when its RPC was traced
+        # (ISSUE 14): memo-served Assigns fan-in link to the span that
+        # certified the shared result
+        self.span_ref = None
 
 
 class ScorerServicer:
@@ -135,6 +139,8 @@ class ScorerServicer:
         breaker_threshold: Optional[int] = None,
         breaker_cooldown_ms: Optional[float] = None,
         brownout_max_lag: Optional[int] = None,
+        trace_export: Optional[str] = None,
+        shed_fractions=None,
     ):
         """``mesh``: a ``jax.sharding.Mesh`` turns the ASSIGN RPC into
         the round-based multi-chip cycle (parallel/shard_assign.py
@@ -221,6 +227,23 @@ class ScorerServicer:
         sheds and request-level rejections (stale snapshot, expired
         deadline) never feed the breaker.
 
+        ``trace_export`` (ISSUE 14, distributed tracing): directory the
+        span exporter appends OTLP-shaped JSON lines to ("1"/"true" =
+        the default ``<state-dir>/traces``; None falls back to the
+        ``KOORD_TRACE_EXPORT`` env).  Tracing itself is request-driven:
+        a request carrying ``trace_id`` gets a server span (parented
+        under the client's attempt span, echoed as ``server_span`` in
+        the reply) whether or not an exporter persists it — an
+        untraced request pays one string check.  Coalesced batches mint
+        ONE launch span; every rider's RPC span fan-in links to it, as
+        do memo and brownout serves to the launch that produced their
+        cached bytes (docs/OBSERVABILITY.md "Distributed tracing").
+
+        ``shed_fractions`` (ISSUE 14 satellite): per-band shed ladder
+        overrides for the admission gate (``--shed-fraction-<band>`` /
+        ``KOORD_SHED_FRACTION_*``; validated monotone across bands and
+        in (0, 1] — replication/admission.py).
+
         ``brownout_max_lag`` (ISSUE 13): maximum generations behind the
         current snapshot a breaker-open Score may be served from the
         host-side brownout cache (the last launch's padded top-k
@@ -252,7 +275,8 @@ class ScorerServicer:
         # makes the restart unmistakable (ADVICE r5)
         self._epoch = uuid.uuid4().hex[:8]
         self.telemetry = telemetry or CycleTelemetry(
-            epoch=self._epoch, cfg=cfg, state_dir=state_dir
+            epoch=self._epoch, cfg=cfg, state_dir=state_dir,
+            trace_export=trace_export,
         )
         # the lock split (module docstring): _sync_lock serializes Sync
         # decodes against the mirror baseline; _state_lock guards mirror
@@ -285,8 +309,10 @@ class ScorerServicer:
         # Score/Assign reserve a slot before touching the coalescer,
         # overload sheds fast instead of queueing without bound
         # (band-aware ladder since ISSUE 13: free sheds first, prod
-        # last, Sync never)
-        self.admission = AdmissionGate(max_inflight)
+        # last, Sync never; fractions flag/env-tunable since ISSUE 14)
+        self.admission = AdmissionGate(
+            max_inflight, shed_fractions=shed_fractions
+        )
         # circuit breaker + brownout ladder (ISSUE 13): consecutive
         # launch failures trip the breaker; while open, Score serves
         # stale-but-bounded from the host-side brownout cache and
@@ -448,9 +474,44 @@ class ScorerServicer:
                 ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(exc))
             raise exc
 
+    # -- distributed tracing (ISSUE 14) --
+    def _start_rpc_span(self, name: str, req, **attrs):
+        """The per-RPC server span, or None when the request carries no
+        trace context (the untraced fast path: one truthiness check).
+        Parented under the CLIENT's attempt span — the id the wire
+        ``parent_span`` field names — so per-process exports assemble
+        into one cross-process tree offline (obs/assemble.py)."""
+        trace_id = getattr(req, "trace_id", "") or ""
+        if not trace_id:
+            return None
+        return self.telemetry.spans.start_trace_span(
+            name, trace_id,
+            parent_id=getattr(req, "parent_span", "") or None,
+            kind="server", attrs={k: v for k, v in attrs.items() if v},
+        )
+
     # -- RPC bodies (request -> reply functions) --
     def sync(self, req: "pb2.SyncRequest", ctx=None,
              wire_bytes: Optional[bytes] = None) -> "pb2.SyncReply":
+        """Tracing shell over :meth:`_sync_impl`: the server span
+        covers decode + commit + journal/replication hooks, ends (or
+        aborts, error visible) on every exit, and its id rides the
+        reply so the client's attempt span can reference it."""
+        tspan = self._start_rpc_span("sync", req)
+        if tspan is None:
+            return self._sync_impl(req, ctx, wire_bytes)
+        try:
+            reply = self._sync_impl(req, ctx, wire_bytes)
+        except BaseException as exc:
+            tspan.abort(exc)
+            raise
+        tspan.set_attr("snapshot_id", reply.snapshot_id)
+        reply.server_span = tspan.span_id
+        tspan.end()
+        return reply
+
+    def _sync_impl(self, req: "pb2.SyncRequest", ctx=None,
+                   wire_bytes: Optional[bytes] = None) -> "pb2.SyncReply":
         # Phase 1 under _sync_lock only: the protobuf->numpy decode +
         # validation runs while the device may still be scattering the
         # PREVIOUS sync's deltas (async dispatch) and while coalesced
@@ -588,7 +649,7 @@ class ScorerServicer:
             req = self.state.export_sync_request()
         return epoch, gen, (b"" if req is None else req.SerializeToString())
 
-    def apply_replica_frame(self, frame) -> dict:
+    def apply_replica_frame(self, frame, origin: str = "replica_apply") -> dict:
         """Apply one replication frame (replication/codec.py Frame) and
         adopt the LEADER's ``(epoch, generation)`` — the follower's
         snapshot ids mirror the leader's exactly, so a client holding
@@ -609,7 +670,16 @@ class ScorerServicer:
 
         A frame that fails validation raises WITHOUT mutating anything
         (stage-then-commit): the follower keeps serving its last good
-        snapshot — never a torn one — and resyncs."""
+        snapshot — never a torn one — and resyncs.
+
+        Distributed tracing (ISSUE 14): a delta frame's payload is the
+        client's ORIGINAL SyncRequest bytes, so the originating
+        commit's ``trace_id``/``parent_span`` ride it verbatim — this
+        apply opens a span in the SAME trace (``origin`` names it:
+        "replica_apply" for a live follower frame, "journal_replay"
+        for the boot replay), making replication lag and failover gaps
+        per-frame measurable in the assembled tree instead of EWMA
+        gauges."""
         from koordinator_tpu.replication import codec
 
         payload = frame.payload
@@ -623,45 +693,73 @@ class ScorerServicer:
             req = pb2.SyncRequest.FromString(payload) if payload else None
         else:
             req = pb2.SyncRequest.FromString(payload)
-        with self._sync_lock:
-            if frame.kind == codec.KIND_FULL:
-                fresh = ResidentState(mesh=self.state.mesh)
-                staged = None if req is None else fresh.stage_sync(req)
-
-                def commit_full() -> dict:
-                    with self._state_lock:
-                        self.state = fresh
-                        info = (
-                            {"path": "cold", "delta_tensors": 0,
-                             "full_tensors": 0}
-                            if staged is None
-                            else fresh.commit_sync(staged)
-                        )
-                        self._adopt_replica_locked(frame, info)
-                        return info
-
-                return self.dispatch.run_exclusive(
-                    commit_full, drain=False
-                )
-
-            staged = self.state.stage_sync(req)
-            plan_cell = [None]
-
-            def commit_seq() -> dict:
-                with self._state_lock:
-                    info = self.state.commit_sync(
-                        staged, plan=plan_cell[0]
-                    )
-                    self._adopt_replica_locked(frame, info)
-                    return info
-
-            def _decide_drain() -> bool:
-                plan_cell[0] = self.state.plan_commit(staged)
-                return self.state.commit_donates(staged, plan=plan_cell[0])
-
-            return self.dispatch.run_exclusive(
-                commit_seq, drain=_decide_drain
+        aspan = None
+        if req is not None and (getattr(req, "trace_id", "") or ""):
+            aspan = self.telemetry.spans.start_trace_span(
+                origin, req.trace_id,
+                parent_id=getattr(req, "parent_span", "") or None,
+                kind="consumer",
+                attrs={
+                    "epoch": frame.epoch,
+                    "generation": int(frame.generation),
+                    "frame_kind": (
+                        "full" if frame.kind == codec.KIND_FULL
+                        else "delta"
+                    ),
+                },
             )
+        try:
+            with self._sync_lock:
+                if frame.kind == codec.KIND_FULL:
+                    fresh = ResidentState(mesh=self.state.mesh)
+                    staged = None if req is None else fresh.stage_sync(req)
+
+                    def commit_full() -> dict:
+                        with self._state_lock:
+                            self.state = fresh
+                            info = (
+                                {"path": "cold", "delta_tensors": 0,
+                                 "full_tensors": 0}
+                                if staged is None
+                                else fresh.commit_sync(staged)
+                            )
+                            self._adopt_replica_locked(frame, info)
+                            return info
+
+                    info = self.dispatch.run_exclusive(
+                        commit_full, drain=False
+                    )
+                else:
+                    staged = self.state.stage_sync(req)
+                    plan_cell = [None]
+
+                    def commit_seq() -> dict:
+                        with self._state_lock:
+                            info = self.state.commit_sync(
+                                staged, plan=plan_cell[0]
+                            )
+                            self._adopt_replica_locked(frame, info)
+                            return info
+
+                    def _decide_drain() -> bool:
+                        plan_cell[0] = self.state.plan_commit(staged)
+                        return self.state.commit_donates(
+                            staged, plan=plan_cell[0]
+                        )
+
+                    info = self.dispatch.run_exclusive(
+                        commit_seq, drain=_decide_drain
+                    )
+        except BaseException as exc:
+            # the span must say the apply FAILED (the follower resyncs;
+            # the trace shows where the chain broke)
+            if aspan is not None:
+                aspan.abort(exc)
+            raise
+        if aspan is not None:
+            aspan.set_attr("snapshot_id", self.snapshot_id())
+            aspan.end()
+        return info
 
     def _adopt_replica_locked(self, frame, info) -> None:
         """Adopt the leader's snapshot id after a replica apply
@@ -685,6 +783,30 @@ class ScorerServicer:
         )
 
     def score(self, req: "pb2.ScoreRequest", ctx=None) -> "pb2.ScoreReply":
+        """Tracing shell over :meth:`_score_impl` (see :meth:`sync`);
+        the span's error status makes a shed / expired-deadline /
+        breaker fast-fail visible in the assembled tree, not just in
+        counters."""
+        tspan = self._start_rpc_span(
+            "score", req,
+            band=getattr(req, "band", "") or "",
+            top_k=int(getattr(req, "top_k", 0) or 0),
+        )
+        if tspan is None:
+            return self._score_impl(req, ctx, None)
+        try:
+            reply = self._score_impl(req, ctx, tspan)
+        except BaseException as exc:
+            tspan.abort(exc)
+            raise
+        if reply.degraded:
+            tspan.set_attr("degraded", True)
+        reply.server_span = tspan.span_id
+        tspan.end()
+        return reply
+
+    def _score_impl(self, req: "pb2.ScoreRequest", ctx=None,
+                    tspan=None) -> "pb2.ScoreReply":
         # the degradation ladder, in rung order (ISSUE 13 /
         # docs/REPLICATION.md "Degradation ladder"):
         #   1. admission sheds BEFORE the request can deepen the
@@ -714,7 +836,7 @@ class ScorerServicer:
                     ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
                 raise exc
             if not self.breaker.allow_launch():
-                reply = self._serve_brownout(req)
+                reply = self._serve_brownout(req, tspan)
                 if reply is not None:
                     return reply
                 self.telemetry.metrics.count_breaker_rejected("score")
@@ -734,7 +856,8 @@ class ScorerServicer:
             # this caller's slot carries its reply or its error back
             try:
                 entry = self.dispatch.submit(
-                    req, deadline_at=deadline_at, budget_ms=budget
+                    req, deadline_at=deadline_at, budget_ms=budget,
+                    trace_span=tspan,
                 )
             except SnapshotNotResident as exc:
                 if ctx is not None:
@@ -749,14 +872,17 @@ class ScorerServicer:
         finally:
             gate.__exit__(None, None, None)
 
-    def _serve_brownout(self, req) -> Optional["pb2.ScoreReply"]:
+    def _serve_brownout(self, req, tspan=None) -> Optional["pb2.ScoreReply"]:
         """Serve one breaker-open Score STALE from the brownout cache,
         or return None when the bound (or the cache's coverage) refuses
         it.  The reply carries ``degraded=True`` and certifies a
         generation at most ``--brownout-max-lag`` behind the id the
         client named — same epoch, same geometry, same CycleConfig, a
         top-k no wider than the cached launch.  Host numpy only: the
-        whole point is answering without touching the failing device."""
+        whole point is answering without touching the failing device.
+        A traced serve fan-in links ``tspan`` to the launch span that
+        produced the cached bytes (ISSUE 14): the degraded reply's
+        provenance is one link-hop away in the assembled tree."""
         with self._state_lock:
             # the id contract is unchanged: the client must name the
             # CURRENT snapshot (its Sync ack) — brownout changes which
@@ -788,6 +914,9 @@ class ScorerServicer:
             req, k, cache["ts"], cache["ti"], cache["feasible"],
             cache["valid"], cache["P"], degraded=True,
         )
+        if tspan is not None:
+            tspan.link_ref(cache.get("launch_span"))
+            tspan.set_attr("brownout_lag", lag)
         with self._state_lock:
             self.degraded_replies += 1
             self.telemetry.metrics.count_degraded("score")
@@ -867,6 +996,25 @@ class ScorerServicer:
 
             _serve.no_device = True
             return _serve
+        # fan-in tracing (ISSUE 14): ONE launch span for the whole
+        # coalesced batch, parented under the first traced rider's RPC
+        # span; every traced rider LINKS to it instead of each minting
+        # its own — the tree shows N RPCs converging on one device
+        # launch.  The span ends in the readback closure (off the
+        # launch lock) or aborts on either half's failure below.
+        traced = [e.trace_span for e in accepted
+                  if e.trace_span is not None]
+        launch_span = None
+        if traced:
+            lead = traced[0]
+            launch_span = self.telemetry.spans.start_trace_span(  # koordlint: disable=span-leak(ends in the readback closure the dispatcher always runs off the launch lock; both failure paths abort it explicitly)
+                "score_launch", lead.trace_id, parent_id=lead.span_id,
+                kind="internal",
+                attrs={"batch": len(accepted), "snapshot_id": sid},
+            )
+            for t in traced:
+                t.link_ref(launch_span.ref)
+        launch_ref = None if launch_span is None else launch_span.ref
         try:
             # execution clock starts HERE: the cycle-latency histogram
             # keeps the serialized daemon's semantics (device dispatch +
@@ -942,6 +1090,8 @@ class ScorerServicer:
             # readback closure the dispatcher runs off the launch lock
             dispatch_s = time.perf_counter() - t_exec
         except Exception as exc:
+            if launch_span is not None:
+                launch_span.abort(exc)
             with self._state_lock:
                 self.telemetry.abort_cycle("score", exc)
             raise
@@ -957,6 +1107,13 @@ class ScorerServicer:
                     (top_scores, top_idx, feasible, snap.pods.valid)
                 )
                 readback_s = time.perf_counter() - t0
+                # device work is done: the launch span closes HERE (off
+                # the launch lock), covering async dispatch + the
+                # stacked transfer — per-entry assembly failures are
+                # the individual RPC spans' errors, not the launch's
+                if launch_span is not None:
+                    launch_span.set_attr("k_bucket", k_launch)
+                    launch_span.end()
                 ti = ti.astype(np.int32)
                 valid = valid_np[:P].astype(bool)
                 # publish the padded readback for Score-storm reuse —
@@ -973,6 +1130,7 @@ class ScorerServicer:
                         self._score_memo.put(sid, self.cfg, dict(
                             kb=k_launch, N=N, P=P, ts=ts, ti=ti,
                             feasible=feasible_np, valid=valid,
+                            launch_span=launch_ref,
                         ))
                     # brownout cache (ISSUE 13): unlike the memo this
                     # SURVIVES generation bumps — bounded staleness is
@@ -1001,7 +1159,7 @@ class ScorerServicer:
                             kb=k_launch, N=N, P=P,
                             nodes=mirror_rows[0], pods=mirror_rows[1],
                             ts=ts, ti=ti, feasible=feasible_np,
-                            valid=valid,
+                            valid=valid, launch_span=launch_ref,
                         )
                 # host-side assembly failures are per-entry: the launch
                 # served everyone else, so one bad demux must not fail
@@ -1022,6 +1180,8 @@ class ScorerServicer:
                         n_failed += 1
                 exec_ms = (time.perf_counter() - t_exec) * 1000.0
             except Exception as exc:
+                if launch_span is not None:
+                    launch_span.abort(exc)
                 with self._state_lock:
                     self.telemetry.abort_cycle("score", exc)
                 raise
@@ -1085,6 +1245,13 @@ class ScorerServicer:
         n_failed = 0
         for entry, k in zip(accepted, ks):
             try:
+                # traced memo hits fan-in link to the launch that
+                # produced the cached readback (ISSUE 14): a prefix
+                # slice's provenance is the ORIGINAL device launch,
+                # possibly from another caller's trace
+                if entry.trace_span is not None:
+                    entry.trace_span.link_ref(memo.get("launch_span"))
+                    entry.trace_span.set_attr("memo_hit", True)
                 entry.reply = self._assemble_score_reply(
                     entry.req, k, memo["ts"], memo["ti"],
                     memo["feasible"], memo["valid"], memo["P"],
@@ -1233,6 +1400,24 @@ class ScorerServicer:
                 )
 
     def assign(self, req: "pb2.AssignRequest", ctx=None) -> "pb2.AssignReply":
+        """Tracing shell over :meth:`_assign_impl` (see :meth:`sync`)."""
+        tspan = self._start_rpc_span(
+            "assign", req, band=getattr(req, "band", "") or "",
+        )
+        if tspan is None:
+            return self._assign_impl(req, ctx, None)
+        try:
+            reply = self._assign_impl(req, ctx, tspan)
+        except BaseException as exc:
+            tspan.abort(exc)
+            raise
+        tspan.set_attr("cycle_id", reply.cycle_id)
+        reply.server_span = tspan.span_id
+        tspan.end()
+        return reply
+
+    def _assign_impl(self, req: "pb2.AssignRequest", ctx=None,
+                     tspan=None) -> "pb2.AssignReply":
         # same admission gate as Score (ISSUE 8): Assign is read
         # traffic against the resident snapshot, so it sheds with the
         # same RESOURCE_EXHAUSTED-before-the-queue-drowns contract —
@@ -1282,6 +1467,7 @@ class ScorerServicer:
                 outcome = self._assign_once(
                     req, ctx, bypass_memo=attempt == 2,
                     deadline_at=deadline_at, budget_ms=budget,
+                    tspan=tspan,
                 )
                 if outcome is not None:
                     return outcome
@@ -1294,6 +1480,7 @@ class ScorerServicer:
     def _assign_once(
         self, req: "pb2.AssignRequest", ctx, bypass_memo: bool = False,
         deadline_at: Optional[float] = None, budget_ms: float = 0.0,
+        tspan=None,
     ) -> Optional["pb2.AssignReply"]:
         """One pass of the Assign memo protocol.  Returns the reply, or
         None when this thread waited on a memo owner that failed (the
@@ -1321,8 +1508,19 @@ class ScorerServicer:
                 snapshot_id=sid,
                 cycle_id=req.cycle_id or None,
                 adopt_pending=owner or bypass_memo,
+                trace_id=getattr(req, "trace_id", "") or None,
             )
+            if tspan is not None and (owner or bypass_memo):
+                # the owner's RPC span is what memo waiters link to:
+                # publish the ref on the entry the waiters hold
+                if entry is not None:
+                    entry.span_ref = tspan.ref
         if entry is not None and not owner:
+            if tspan is not None:
+                # memo-served: fan-in link to the owner's span — the
+                # device cycle this RPC's result actually came from
+                tspan.link_ref(entry.span_ref)
+                tspan.set_attr("memo_hit", True)
             # no device work will happen on this RPC: if assign()'s
             # allow_launch() granted it the one half-open probe slot,
             # that slot must free for a caller that WILL launch —

@@ -552,9 +552,17 @@ class ChaosTraceReplay:
         servicer_kw: Optional[dict] = None,
         retry_policy=None,
         warmup: bool = True,
+        trace_export: Optional[str] = None,
     ):
+        """``trace_export`` (ISSUE 14): export directory for the
+        distributed-trace spans of the ENGINE side — the client shim,
+        the leader, AND its warm-restarted successor all append there,
+        so ``obs.assemble`` over the one directory reconstructs every
+        client-observed RPC across the kill (the acceptance gate in
+        tests/test_chaos_trace.py).  The oracle stays untraced."""
         self.trace = trace
         self.state_dir = state_dir
+        self.trace_export = trace_export
         self.fail_at = fail_at
         self.fail_n = int(fail_n)
         self.kill_at = kill_at
@@ -577,7 +585,12 @@ class ChaosTraceReplay:
     def _start_leader(self, sock: str):
         from koordinator_tpu.bridge.server import make_server
 
-        sv = ScorerServicer(**self.servicer_kw)
+        # each leader incarnation (including the warm restart) opens
+        # its OWN export file in the shared directory; False pins
+        # tracing off when the harness was not asked for it
+        kw = dict(self.servicer_kw)
+        kw.setdefault("trace_export", self.trace_export or False)
+        sv = ScorerServicer(**kw)
         journal = FrameJournal(self.journal_path)
         journal.recover(sv)
         journal.attach(sv)
@@ -629,12 +642,18 @@ class ChaosTraceReplay:
             sock = os.path.join(tmp, "engine.sock")
             osock = os.path.join(tmp, "oracle.sock")
             leader, journal, server = self._start_leader(sock)
-            oracle_sv = ScorerServicer(**ORACLE_KW)
+            oracle_sv = ScorerServicer(trace_export=False, **ORACLE_KW)
             oracle_server = make_server(servicer=oracle_sv)
             oracle_server.add_insecure_port(f"unix://{osock}")
             oracle_server.start()
-            engine = ScorerClient(f"unix://{sock}", retry_policy=policy)
-            oracle = ScorerClient(f"unix://{osock}", retry_policy=policy)
+            engine = ScorerClient(
+                f"unix://{sock}", retry_policy=policy,
+                trace_export=self.trace_export or False,
+            )
+            oracle = ScorerClient(
+                f"unix://{osock}", retry_policy=policy,
+                trace_export=False,
+            )
             try:
                 model = ClusterModel(trace.init)
                 full_kw = dict(
@@ -696,6 +715,15 @@ class ChaosTraceReplay:
                             degraded += leader.degraded_replies
                             t_kill = time.perf_counter()
                             server.stop(0)
+                            # drain the dying leader's span exporter
+                            # BEFORE dropping the object graph: every
+                            # reply the client observed had its server
+                            # span enqueued first, and the writer
+                            # thread must not leak parked forever (a
+                            # real SIGKILL loses at most the µs-old
+                            # tail batch — the per-batch flush is the
+                            # durability story there, not this close)
+                            leader.telemetry.close()
                             leader = journal = None
                             leader, journal, server = self._start_leader(
                                 sock
@@ -800,6 +828,12 @@ class ChaosTraceReplay:
                 except Exception:  # koordlint: disable=broad-except(teardown of an already-killed server)
                     pass
                 oracle_server.stop(0)
+                # drain the surviving leader's (and oracle's) span
+                # writers: the caller assembles the export directory
+                # right after run() returns
+                for sv in (leader, oracle_sv):
+                    if sv is not None:
+                        sv.telemetry.close()
 
         return ChaosTraceReport(
             events_replayed=len(trace.events),

@@ -162,6 +162,72 @@ class Trace:
         return seen
 
 
+def export_trace(trace: Trace) -> List[str]:
+    """Serialize a trace as concrete JSON audit lines (ISSUE 14
+    satellite / ROADMAP 5(a)): one ``trace_header`` line carrying the
+    config + initial cluster state, then one ``trace_event`` line per
+    event — the shape a real cluster's audit log drains into, so
+    :func:`import_trace` is also the importer for externally captured
+    streams."""
+    lines = [json.dumps(
+        {"event": "trace_header", "config": trace.config.to_doc(),
+         "init": trace.init},
+        sort_keys=True,
+    )]
+    lines.extend(
+        json.dumps({"event": "trace_event", **e.to_doc()}, sort_keys=True)
+        for e in trace.events
+    )
+    return lines
+
+
+def import_trace(lines) -> Trace:
+    """Rebuild a :class:`Trace` from concrete JSON audit lines (strings
+    or already-parsed dicts).  The result is digest-identical to the
+    exported trace — every payload is absolute rows, so import is pure
+    parsing — and replays through :class:`TraceReplay` unchanged.
+    Unknown line shapes raise: an audit stream this module cannot
+    faithfully replay must fail loudly, never replay approximately."""
+    header: Optional[Dict] = None
+    events: List[TraceEvent] = []
+    for i, line in enumerate(lines):
+        doc = json.loads(line) if isinstance(line, (str, bytes)) else line
+        if not isinstance(doc, dict):
+            raise ValueError(f"audit line {i} is not a JSON object")
+        kind = doc.get("event")
+        if kind == "trace_header":
+            if header is not None:
+                raise ValueError(
+                    f"audit line {i}: duplicate trace_header"
+                )
+            header = doc
+        elif kind == "trace_event":
+            if header is None:
+                raise ValueError(
+                    f"audit line {i}: trace_event before trace_header"
+                )
+            events.append(TraceEvent(
+                kind=str(doc["kind"]), band=str(doc["band"]),
+                payload=dict(doc["payload"]),
+            ))
+        else:
+            raise ValueError(
+                f"audit line {i}: unknown event shape {kind!r}"
+            )
+    if header is None:
+        raise ValueError("audit stream carries no trace_header line")
+    cdoc = dict(header["config"])
+    cdoc["mix"] = tuple(
+        (str(k), float(w)) for k, w in cdoc.get("mix", ())
+    )
+    cdoc["band_mix"] = tuple(float(v) for v in cdoc.get("band_mix", ()))
+    return Trace(
+        config=TraceConfig(**cdoc),
+        init=dict(header["init"]),
+        events=tuple(events),
+    )
+
+
 class ClusterModel:
     """The mutable numpy cluster state one trace replays over — shared
     verbatim by the generator (to mint concrete payloads) and the
@@ -616,13 +682,20 @@ class TraceReplay:
         slow_score_ms: float = 0.0,
         retrace_budget: int = 0,
         warmup: bool = True,
+        trace_export: Optional[str] = None,
     ):
+        """``trace_export`` (ISSUE 14): directory the ENGINE side —
+        servicer and client both — exports its distributed-trace spans
+        to during the measured pass; the oracle stays untraced (its
+        replies are the parity baseline, not part of the request
+        tree).  The warm-up pass is untraced either way."""
         self.trace = trace
         self.engine_kw = dict(engine_kw or {})
         self.oracle_kw = dict(oracle_kw or ORACLE_KW)
         self.slow_score_ms = float(slow_score_ms)
         self.retrace_budget = int(retrace_budget)
         self.warmup = bool(warmup)
+        self.trace_export = trace_export
 
     def run(self) -> TraceReport:
         from koordinator_tpu.analysis import retrace_guard
@@ -641,9 +714,16 @@ class TraceReplay:
         from koordinator_tpu.bridge.client import ScorerClient
         from koordinator_tpu.bridge.server import ScorerServicer, make_server
 
+        # tracing only on the MEASURED pass (warm-up stays untraced so
+        # export files hold exactly the replayed stream's spans)
+        export = self.trace_export if record else None
+        engine_kw = dict(self.engine_kw)
+        engine_kw["trace_export"] = export if export else False
+        oracle_kw = dict(self.oracle_kw)
+        oracle_kw.setdefault("trace_export", False)
         with tempfile.TemporaryDirectory(prefix="koord-trace-") as tmp:
-            engine_sv = ScorerServicer(**self.engine_kw)
-            oracle_sv = ScorerServicer(**self.oracle_kw)
+            engine_sv = ScorerServicer(**engine_kw)
+            oracle_sv = ScorerServicer(**oracle_kw)
             servers, clients = [], []
             try:
                 for name, sv in (("engine", engine_sv),
@@ -653,7 +733,17 @@ class TraceReplay:
                     server.add_insecure_port(f"unix://{sock}")
                     server.start()
                     servers.append(server)
-                    clients.append(ScorerClient(f"unix://{sock}"))
+                    clients.append(ScorerClient(
+                        f"unix://{sock}",
+                        # False forces tracing OFF (env included) on
+                        # the oracle and on untraced passes — the
+                        # export dir must hold exactly the measured
+                        # engine stream's spans
+                        trace_export=(
+                            export if name == "engine" and export
+                            else False
+                        ),
+                    ))
                 return self._drive(engine_sv, clients[0], clients[1],
                                    record=record)
             finally:
@@ -661,6 +751,13 @@ class TraceReplay:
                     client.close()
                 for server in servers:
                     server.stop(0)
+                # close() drains each side's background span writer and
+                # unhooks the process-wide feeds: the caller assembles
+                # the export directory IMMEDIATELY after run(), so the
+                # servicer's tail spans must be on disk by now — and a
+                # replay must not leak a writer thread per pass
+                for sv in (engine_sv, oracle_sv):
+                    sv.telemetry.close()
 
     def _drive(self, engine_sv, engine, oracle,
                record: bool) -> Optional[TraceReport]:

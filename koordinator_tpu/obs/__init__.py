@@ -29,12 +29,21 @@ from __future__ import annotations
 import logging
 from typing import Dict, Optional
 
+from koordinator_tpu.obs.export import (  # noqa: F401
+    SpanExporter,
+    resolve_export_dir,
+)
 from koordinator_tpu.obs.flight import (  # noqa: F401
     FlightRecorder,
     validate_flight_dump,
 )
 from koordinator_tpu.obs.scorer_metrics import ScorerMetrics
-from koordinator_tpu.obs.spans import CycleScope, SpanRecorder  # noqa: F401
+from koordinator_tpu.obs.spans import (  # noqa: F401
+    ClientTraceOp,
+    CycleScope,
+    SpanRecorder,
+    TraceSpan,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -64,6 +73,7 @@ class CycleTelemetry:
         state_dir: Optional[str] = None,
         capacity: int = 64,
         registry=None,
+        trace_export: Optional[str] = None,
     ):
         self.spans = SpanRecorder(epoch=epoch)
         self.metrics = ScorerMetrics(registry=registry)
@@ -72,8 +82,28 @@ class CycleTelemetry:
             capacity=capacity, state_dir=state_dir,
             config={"epoch": epoch, **_config_knobs(cfg)},
         )
+        # distributed-trace export (ISSUE 14): completed TraceSpans
+        # flow recorder -> trace_sink -> exporter as OTLP-shaped JSON
+        # lines under the export dir ("<state-dir>/traces" by default
+        # when --trace-export / KOORD_TRACE_EXPORT turns it on).  With
+        # no exporter the sink still feeds the span-count family —
+        # spans only exist when a client stamped a trace_id, so the
+        # counter is exact either way.
+        self.exporter: Optional[SpanExporter] = None
+        directory = resolve_export_dir(trace_export, state_dir)
+        if directory is not None:
+            self.exporter = SpanExporter(
+                directory,
+                on_drop=self.metrics.count_trace_export_dropped,
+            )
+        self.spans.trace_sink = self._sink_trace_span
         self._unhooks = []
         self._install_feeds()
+
+    def _sink_trace_span(self, record) -> None:
+        self.metrics.count_trace_span(str(record.get("kind") or "unknown"))
+        if self.exporter is not None:
+            self.exporter.export(record)
 
     # -- process-wide feeds --
     def _install_feeds(self) -> None:
@@ -116,13 +146,16 @@ class CycleTelemetry:
         )
 
     def close(self) -> None:
-        """Unhook the process-wide feeds (tests; daemons run for life)."""
+        """Unhook the process-wide feeds (tests; daemons run for life)
+        and close the span exporter handle."""
         for unhook in self._unhooks:
             try:
                 unhook()
             except Exception:  # koordlint: disable=broad-except(best-effort teardown; one failed unhook must not keep the rest hooked)
                 logger.warning("telemetry unhook failed", exc_info=True)
         self._unhooks = []
+        if self.exporter is not None:
+            self.exporter.close()
 
     # -- event sinks --
     def on_demotion(self, bucket, failures) -> None:
@@ -173,14 +206,17 @@ class CycleTelemetry:
         snapshot_id: Optional[str] = None,
         cycle_id: Optional[str] = None,
         adopt_pending: bool = True,
+        trace_id: Optional[str] = None,
     ):
         """A private cycle for one RPC (see obs/spans.py CycleScope).
         The correlating RPC of a Sync→Score→Assign flow adopts the
         pending cycle atomically; concurrent siblings mint fresh ones
-        and can no longer relabel or stamp it."""
+        and can no longer relabel or stamp it.  ``trace_id`` stamps
+        the distributed-trace correlation onto the cycle record
+        (ISSUE 14) so flight dumps and assembled trees cross-reference."""
         return self.spans.open_scope(
             snapshot_id=snapshot_id, cycle_id=cycle_id,
-            adopt_pending=adopt_pending,
+            adopt_pending=adopt_pending, trace_id=trace_id,
         )
 
     def commit_scope(

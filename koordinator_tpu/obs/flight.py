@@ -73,6 +73,12 @@ def _check_cycle(cyc, where: str, problems: List[str]) -> None:
     sid = cyc.get("snapshot_id")
     if sid is not None and not isinstance(sid, str):
         problems.append(f"{where}.snapshot_id must be null or a string")
+    # distributed-trace correlation (ISSUE 14): the trace id of the
+    # request the cycle served, when the client sent one — nullable,
+    # never any other type
+    tid = cyc.get("trace_id")
+    if tid is not None and not isinstance(tid, str):
+        problems.append(f"{where}.trace_id must be null or a string")
     if not _finite(cyc.get("started_unix")):
         problems.append(f"{where}.started_unix must be a finite number")
     err = cyc.get("error")

@@ -52,6 +52,8 @@ koord_scorer_journal_compaction_stamp  gauge     — (us since epoch, last compa
 koord_scorer_failover_total            counter   event (promoted|warm_restart)
 koord_scorer_retry_total               counter   op (subscribe|resume)
 koord_scorer_trace_cycle_ms            histogram band, rpc
+koord_scorer_trace_spans_total         counter   kind (client|server|internal|consumer)
+koord_scorer_trace_export_dropped_total counter  reason (closed|rate|bytes|encode|io)
 ====================================== ========= ==========================
 
 The ``koord_scorer_coalesce_*`` families observe the coalescing
@@ -143,6 +145,8 @@ JOURNAL_COMPACTION_STAMP = "koord_scorer_journal_compaction_stamp"
 FAILOVER_TOTAL = "koord_scorer_failover_total"
 RETRY_TOTAL = "koord_scorer_retry_total"
 TRACE_CYCLE = "koord_scorer_trace_cycle_ms"
+TRACE_SPANS = "koord_scorer_trace_spans_total"
+TRACE_EXPORT_DROPPED = "koord_scorer_trace_export_dropped_total"
 
 # occupancy is a count-of-requests-per-launch, not a latency: its own
 # power-of-two buckets (the dispatcher caps batches at 16 by default;
@@ -293,6 +297,17 @@ _FAMILIES = (
      "infra = node/quota events) and rpc (sync|score|assign|cycle = "
      "the whole step); the obs/slo.py SLO gate judges its per-band "
      "p99s in bench.py --config trace"),
+    (TRACE_SPANS, "counter",
+     "distributed-trace spans completed and handed to the exporter "
+     "(ISSUE 14), by span kind: client = shim op/attempt spans, "
+     "server = RPC spans, internal = launch/readback spans, consumer "
+     "= replica-apply/journal-replay spans; zero while no client "
+     "stamps a trace_id"),
+    (TRACE_EXPORT_DROPPED, "counter",
+     "spans the export sink dropped instead of writing (ISSUE 14), by "
+     "reason (closed|rate|bytes|encode|io); any nonzero rate means "
+     "assembled traces are INCOMPLETE — widen the bound or stop the "
+     "span storm before trusting a tree"),
 )
 
 # journal appends are MICROsecond-scale (a header pack + one buffered
@@ -499,6 +514,15 @@ class ScorerMetrics:
 
     def count_retry(self, op: str) -> None:
         self.registry.counter_add(RETRY_TOTAL, 1, {"op": op})
+
+    # -- distributed tracing (ISSUE 14) --
+    def count_trace_span(self, kind: str) -> None:
+        self.registry.counter_add(TRACE_SPANS, 1, {"kind": kind})
+
+    def count_trace_export_dropped(self, reason: str) -> None:
+        self.registry.counter_add(
+            TRACE_EXPORT_DROPPED, 1, {"reason": reason}
+        )
 
     # -- trace-driven replay (ISSUE 12) --
     def observe_trace_cycle(self, band: str, rpc: str, ms: float) -> None:

@@ -41,11 +41,194 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from typing import Dict, List, Optional
+import uuid
+from typing import Dict, List, Optional, Tuple
 
 # hard cap on spans buffered per cycle: a runaway instrumentation loop
 # must cost a counter bump, not memory
 MAX_SPANS_PER_CYCLE = 256
+
+# the one cross-trace link type (ISSUE 14): the many RPC spans of a
+# coalesced batch — and every memo/brownout serve — reference the ONE
+# launch/readback span that produced the shared bytes
+LINK_FANIN = "fanin"
+
+
+def mint_trace_id() -> str:
+    """32-hex trace id, minted ONCE per logical client request; every
+    retry/failover attempt keeps it, so the attempts assemble into one
+    tree (obs/assemble.py)."""
+    return uuid.uuid4().hex
+
+
+def mint_span_id() -> str:
+    """16-hex span id for spans minted outside a SpanRecorder (client
+    shims; servers use ``SpanRecorder.mint_span_id`` so ids stay
+    deterministic under a pinned epoch — the golden-fixture contract)."""
+    return uuid.uuid4().hex[:16]
+
+
+class TraceSpan:
+    """One exportable span of a cross-process distributed trace
+    (ISSUE 14).  Unlike the cycle-scoped stage spans below, a TraceSpan
+    carries an identity — ``(trace_id, span_id)`` — a parent link, and
+    fan-in links to spans in OTHER traces, so the offline assembler
+    (``python -m koordinator_tpu.obs.assemble``) can merge per-process
+    exports into one whole-request tree.
+
+    Single-shot: ``end()`` (or ``abort()``) finalizes the span exactly
+    once and hands the OTLP-shaped record to ``sink`` (the process's
+    SpanExporter, obs/export.py).  Host-side Python scalars only — the
+    same no-host-sync contract as the rest of this module.  Call sites
+    that create one MUST end or abort it on every exit path (koordlint's
+    ``span-leak`` rule checks ``start_trace_span`` callers statically);
+    the context-manager form is leak-proof by construction."""
+
+    __slots__ = (
+        "trace_id", "span_id", "parent_id", "name", "kind",
+        "start_unix", "_clock", "_t0", "dur_ms", "error",
+        "attrs", "links", "_sink", "_done",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        trace_id: str,
+        span_id: str,
+        parent_id: Optional[str] = None,
+        kind: str = "server",
+        sink=None,
+        attrs: Optional[Dict[str, object]] = None,
+        clock=time.perf_counter,
+        wall_clock=time.time,
+    ):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id or None
+        self.kind = kind
+        self.start_unix = wall_clock()
+        self._clock = clock
+        self._t0 = clock()
+        self.dur_ms: Optional[float] = None
+        self.error: Optional[str] = None
+        self.attrs: Dict[str, object] = dict(attrs or {})
+        self.links: List[Dict[str, str]] = []
+        self._sink = sink
+        self._done = False
+
+    @property
+    def ref(self) -> Tuple[str, str]:
+        """The cross-process handle other spans link to."""
+        return (self.trace_id, self.span_id)
+
+    def set_attr(self, key: str, value) -> None:
+        """Host-side scalars only (the ``note()`` contract)."""
+        self.attrs[key] = value
+
+    def link(self, trace_id: str, span_id: str,
+             link_type: str = LINK_FANIN) -> None:
+        """Reference a span that may live in a DIFFERENT trace — the
+        fan-in shape: N coalesced RPC spans -> one launch span."""
+        self.links.append({
+            "traceId": trace_id, "spanId": span_id, "type": link_type,
+        })
+
+    def link_ref(self, ref: Optional[Tuple[str, str]],
+                 link_type: str = LINK_FANIN) -> None:
+        """``link()`` over a stored ``(trace_id, span_id)`` ref (memo /
+        brownout entries store these); None is a no-op so cache entries
+        produced by an untraced launch need no branching."""
+        if ref is not None:
+            self.link(ref[0], ref[1], link_type)
+
+    def end(self, error: Optional[str] = None) -> None:
+        """Finalize and export.  Idempotent: the first end/abort wins,
+        so a ``finally: span.end()`` after an except-path ``abort()``
+        cannot double-export."""
+        if self._done:
+            return
+        self._done = True
+        self.dur_ms = (self._clock() - self._t0) * 1000.0
+        if error is not None:
+            self.error = error
+        sink = self._sink
+        if sink is not None:
+            sink(self.to_record())
+
+    def abort(self, exc: BaseException) -> None:
+        self.end(error=f"{exc!r:.200}")
+
+    def __enter__(self) -> "TraceSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc is not None:
+            self.abort(exc)
+        else:
+            self.end()
+        return False
+
+    def to_record(self) -> Dict[str, object]:
+        """The OTLP-shaped JSON-line body obs/export.py appends — flat
+        camelCase keys, nanosecond wall stamps, links with the fan-in
+        type in their attributes (obs/assemble.py is the reader)."""
+        start_ns = int(self.start_unix * 1e9)
+        dur_ns = int((self.dur_ms or 0.0) * 1e6)
+        return {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentSpanId": self.parent_id,
+            "name": self.name,
+            "kind": self.kind,
+            "startTimeUnixNano": start_ns,
+            "endTimeUnixNano": start_ns + dur_ns,
+            "durMs": round(self.dur_ms or 0.0, 3),
+            "status": (
+                {"code": "ERROR", "message": self.error}
+                if self.error is not None else {"code": "OK"}
+            ),
+            "attributes": dict(self.attrs),
+            "links": list(self.links),
+        }
+
+
+class ClientTraceOp:
+    """Client side of one logical RPC (ISSUE 14): ONE trace id, a root
+    op span, and one child span per ATTEMPT — so a retried-then-shed-
+    then-served request is one trace with one span per attempt.  Used
+    by bridge/client.py; lives here so the id/record shapes have one
+    home."""
+
+    __slots__ = ("trace_id", "root", "attempts", "_sink")
+
+    def __init__(self, name: str, sink=None):
+        self._sink = sink
+        self.trace_id = mint_trace_id()
+        self.attempts = 0
+        self.root = TraceSpan(
+            name, self.trace_id, mint_span_id(), kind="client", sink=sink,
+        )
+
+    def attempt(self, target: str = "") -> TraceSpan:
+        """A child span for the next attempt; the caller stamps its id
+        as the request's ``parent_span`` and must end/abort it."""
+        self.attempts += 1
+        span = TraceSpan(
+            f"{self.root.name}.attempt", self.trace_id, mint_span_id(),
+            parent_id=self.root.span_id, kind="client", sink=self._sink,
+            attrs={"attempt": self.attempts},
+        )
+        if target:
+            span.set_attr("target", target)
+        return span
+
+    def finish(self, error: Optional[BaseException] = None) -> None:
+        self.root.set_attr("attempts", self.attempts)
+        if error is not None:
+            self.root.abort(error)
+        else:
+            self.root.end()
 
 
 class CycleSpans:
@@ -53,14 +236,20 @@ class CycleSpans:
     the owner (ScorerServicer) already serializes RPC bodies."""
 
     __slots__ = (
-        "cycle_id", "snapshot_id", "started_unix", "_t0", "_clock",
-        "spans", "notes", "error", "overflow",
+        "cycle_id", "snapshot_id", "trace_id", "started_unix", "_t0",
+        "_clock", "spans", "notes", "error", "overflow",
     )
 
     def __init__(self, cycle_id: str, clock=time.perf_counter,
                  wall_clock=time.time):
         self.cycle_id = cycle_id
         self.snapshot_id: Optional[str] = None
+        # distributed-trace correlation (ISSUE 14): the trace id of the
+        # request this cycle served, when the client sent one — the
+        # flight-recorder record carries it so a bad cycle found in a
+        # dump is addressable in the assembled trace tree (and vice
+        # versa)
+        self.trace_id: Optional[str] = None
         self.started_unix = wall_clock()
         self._clock = clock
         self._t0 = clock()
@@ -112,6 +301,7 @@ class CycleSpans:
         return {
             "cycle_id": self.cycle_id,
             "snapshot_id": self.snapshot_id,
+            "trace_id": self.trace_id,
             "started_unix": self.started_unix,
             "spans": [
                 {
@@ -184,6 +374,10 @@ class CycleScope:
     def snapshot_id(self) -> Optional[str]:
         return self._cycle.snapshot_id
 
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self._cycle.trace_id
+
     def begin_span(self, name: str) -> int:
         with self._lock:
             return self._cycle.begin(name)
@@ -220,6 +414,12 @@ class SpanRecorder:
         self._clock = clock
         self._wall_clock = wall_clock
         self._seq = 0
+        # distributed-trace span ids (ISSUE 14): counter-based and
+        # epoch-prefixed like cycle ids, so a pinned epoch makes them
+        # deterministic (the golden-fixture regen contract); the sink
+        # is the process's exporter, wired by CycleTelemetry
+        self._span_seq = 0
+        self.trace_sink = None
         self._cycle: Optional[CycleSpans] = None
         # reentrant: commit() calls current(); the lock makes each call
         # atomic against the coalescer's concurrent batch leaders
@@ -231,6 +431,37 @@ class SpanRecorder:
         (e.g. a delta-Sync waiting for the Assign that correlates it)."""
         with self._lock:
             return self._cycle is not None
+
+    # -- distributed-trace spans (ISSUE 14) --
+    def mint_span_id(self) -> str:
+        """Deterministic under a pinned epoch: "sp<epoch>-<n>" (the
+        cycle-id convention), so golden-fixture regens stay
+        byte-identical."""
+        with self._lock:
+            self._span_seq += 1
+            return f"sp{self.epoch}-{self._span_seq}"
+
+    def start_trace_span(
+        self,
+        name: str,
+        trace_id: str,
+        parent_id: Optional[str] = None,
+        kind: str = "server",
+        attrs: Optional[Dict[str, object]] = None,
+    ) -> Optional[TraceSpan]:
+        """Open one exportable distributed-trace span, or None when
+        ``trace_id`` is empty (tracing off for this request: the
+        untraced path pays one truthiness check and nothing else).
+        The caller MUST end or abort the returned span on every exit
+        path — koordlint's ``span-leak`` rule enforces the try/finally
+        (or with-block) shape statically."""
+        if not trace_id:
+            return None
+        return TraceSpan(
+            name, trace_id, self.mint_span_id(), parent_id=parent_id,
+            kind=kind, sink=self.trace_sink, attrs=attrs,
+            clock=self._clock, wall_clock=self._wall_clock,
+        )
 
     def current(self, snapshot_id: Optional[str] = None,
                 cycle_id: Optional[str] = None) -> CycleSpans:
@@ -267,6 +498,7 @@ class SpanRecorder:
         snapshot_id: Optional[str] = None,
         cycle_id: Optional[str] = None,
         adopt_pending: bool = True,
+        trace_id: Optional[str] = None,
     ) -> CycleScope:
         """Detach a cycle into a private :class:`CycleScope`.
 
@@ -291,6 +523,8 @@ class SpanRecorder:
                 )
             if snapshot_id is not None:
                 cycle.snapshot_id = snapshot_id
+            if trace_id:
+                cycle.trace_id = trace_id
             return CycleScope(cycle)
 
     # -- span API --

@@ -63,19 +63,90 @@ failure feed additionally ignores request-level rejections
 
 from __future__ import annotations
 
+import os
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Mapping, Optional
 
 # the shed ladder: fraction of max_inflight each band may fill before
 # ITS new requests shed.  Unknown/empty bands get prod treatment (shed
 # last) so legacy clients keep the exact pre-band gate behavior.
+# These are the DEFAULTS — tunable per deployment since ISSUE 14
+# (ROADMAP 6(b) follow-on) via the ``--shed-fraction-<band>`` daemon
+# flags / ``KOORD_SHED_FRACTION_{FREE,BATCH,MID,PROD}`` envs, validated
+# by :func:`validate_shed_fractions` (each in (0, 1], monotone
+# free <= batch <= mid <= prod — an inverted ladder would shed prod
+# FIRST, the exact opposite of the contract).
 BAND_SHED_FRACTION = {
     "koord-free": 0.50,
     "koord-batch": 0.65,
     "koord-mid": 0.80,
     "koord-prod": 1.00,
 }
+
+# band name <-> knob suffix for the flags/envs
+_BAND_KNOBS = (
+    ("koord-free", "FREE"),
+    ("koord-batch", "BATCH"),
+    ("koord-mid", "MID"),
+    ("koord-prod", "PROD"),
+)
+
+
+def validate_shed_fractions(
+    overrides: Optional[Mapping[str, float]],
+) -> Dict[str, float]:
+    """Merge ``overrides`` (band -> fraction; partial is fine) over the
+    defaults and validate the result: every fraction in (0, 1], and
+    monotone non-decreasing up the ladder (free <= batch <= mid <=
+    prod) — the whole point of the ladder is that LOWER bands shed
+    first, so an inverted configuration is an operator error, refused
+    at startup rather than discovered in a prod-band shed storm.
+    Returns the merged table; raises ValueError on a bad knob."""
+    merged = dict(BAND_SHED_FRACTION)
+    for band, value in (overrides or {}).items():
+        if band not in merged:
+            raise ValueError(
+                f"unknown shed-fraction band {band!r} "
+                f"(expected one of {sorted(merged)})"
+            )
+        value = float(value)
+        if not 0.0 < value <= 1.0:
+            raise ValueError(
+                f"shed fraction for {band} must be in (0, 1], "
+                f"got {value}"
+            )
+        merged[band] = value
+    order = [band for band, _ in _BAND_KNOBS]
+    for lo, hi in zip(order, order[1:]):
+        if merged[lo] > merged[hi]:
+            raise ValueError(
+                "shed fractions must be monotone non-decreasing up the "
+                f"ladder (free <= batch <= mid <= prod): {lo}="
+                f"{merged[lo]} > {hi}={merged[hi]} would shed the "
+                "higher band first"
+            )
+    return merged
+
+
+def shed_fractions_from_env(env=None) -> Optional[Dict[str, float]]:
+    """The ``KOORD_SHED_FRACTION_*`` overrides, or None when none is
+    set (empty values mean unset — the KOORD_* convention).  Raises
+    ValueError on an unparsable value: a typo'd fraction must fail the
+    daemon at startup, never silently run the default ladder."""
+    env = os.environ if env is None else env
+    overrides: Dict[str, float] = {}
+    for band, suffix in _BAND_KNOBS:
+        raw = env.get(f"KOORD_SHED_FRACTION_{suffix}") or ""
+        if raw:
+            try:
+                overrides[band] = float(raw)
+            except ValueError:
+                raise ValueError(
+                    f"KOORD_SHED_FRACTION_{suffix}={raw!r} is not a "
+                    "number"
+                ) from None
+    return overrides or None
 # retry-after hint multiplier per band: shed low-priority clients back
 # off harder, leaving the recovering capacity to the bands above them
 BAND_HINT_SCALE = {
@@ -139,9 +210,16 @@ class AdmissionGate:
     _MAX_HINT_MS = 30_000.0
 
     def __init__(self, max_inflight: int = 0, alpha: float = 0.2,
-                 clock=None):
+                 clock=None, shed_fractions=None):
+        """``shed_fractions``: per-band ladder overrides (partial dict
+        band -> fraction), merged over :data:`BAND_SHED_FRACTION` and
+        validated (ISSUE 14 satellite); None reads the
+        ``KOORD_SHED_FRACTION_*`` envs."""
         self.max_inflight = max(0, int(max_inflight))
         self.alpha = float(alpha)
+        if shed_fractions is None:
+            shed_fractions = shed_fractions_from_env()
+        self.shed_fractions = validate_shed_fractions(shed_fractions)
         self._clock = clock or time.perf_counter
         self._lock = threading.Lock()
         self._inflight = 0
@@ -163,7 +241,7 @@ class AdmissionGate:
         """The ladder rung: admitted-but-unfinished reads at or past
         which a NEW request of ``band`` sheds.  Unknown bands get prod
         treatment (the full depth) — never a surprise shed."""
-        frac = BAND_SHED_FRACTION.get(band, 1.0)
+        frac = self.shed_fractions.get(band, 1.0)
         return max(1, int(self.max_inflight * frac))
 
     def retry_after_ms(self, band: str = "") -> float:

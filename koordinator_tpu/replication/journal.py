@@ -374,7 +374,9 @@ class FrameJournal:
         for off, rec_len, frame in records:
             if frame.kind == codec.KIND_FULL:
                 try:
-                    servicer.apply_replica_frame(frame)
+                    servicer.apply_replica_frame(
+                        frame, origin="journal_replay"
+                    )
                 except Exception:  # koordlint: disable=broad-except(a frame that fails validation ends the usable prefix — the documented truncate-and-recover path; state is untouched by stage-then-commit)
                     logger.exception(
                         "journal full frame %s failed to apply; "
@@ -402,7 +404,12 @@ class FrameJournal:
                     )
                     continue
                 try:
-                    servicer.apply_replica_frame(frame)
+                    # origin names the span a traced frame opens
+                    # (ISSUE 14): a replay-on-boot joins the SAME trace
+                    # as the leader commit it re-applies
+                    servicer.apply_replica_frame(
+                        frame, origin="journal_replay"
+                    )
                 except Exception:  # koordlint: disable=broad-except(same truncate-and-recover contract as the full-frame apply above)
                     logger.exception(
                         "journal delta frame %s failed to apply; "

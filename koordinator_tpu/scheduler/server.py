@@ -92,6 +92,8 @@ class SchedulerServer:
         breaker_threshold: Optional[int] = None,
         breaker_cooldown_ms: Optional[float] = None,
         brownout_max_lag: Optional[int] = None,
+        trace_export: Optional[str] = None,
+        shed_fractions: Optional[dict] = None,
     ):
         # persistent compile cache under the daemon's state dir: a
         # restarted sidecar skips the multi-second (16.5s on TPU,
@@ -205,6 +207,14 @@ class SchedulerServer:
             servicer_kw["breaker_cooldown_ms"] = float(breaker_cooldown_ms)
         if brownout_max_lag is not None:
             servicer_kw["brownout_max_lag"] = int(brownout_max_lag)
+        # distributed tracing (ISSUE 14): --trace-export turns on the
+        # span exporter (OTLP-shaped JSON lines; bare flag / "1" =
+        # <state-dir>/traces).  Shed-fraction overrides validate at
+        # construction — a bad ladder fails the daemon at startup.
+        if trace_export is not None:
+            servicer_kw["trace_export"] = trace_export
+        if shed_fractions is not None:
+            servicer_kw["shed_fractions"] = shed_fractions
         # replication role (ISSUE 8, koordinator_tpu/replication/):
         # --replicate-from makes this daemon a READ FOLLOWER — it
         # subscribes to the named leader's replication socket, applies
@@ -743,6 +753,35 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "KOORD_BROWNOUT_MAX_LAG).  Assign never serves stale",
     )
     ap.add_argument(
+        "--trace-export", nargs="?", const="1",
+        default=os.environ.get("KOORD_TRACE_EXPORT") or None,
+        help="distributed tracing (docs/OBSERVABILITY.md \"Distributed "
+        "tracing\"): export completed spans as OTLP-shaped JSON lines "
+        "to this directory (bare flag or '1' = <state-dir>/traces); "
+        "requests carrying a trace_id get server spans either way, "
+        "coalesced batches fan-in link to their one launch span, and "
+        "`python -m koordinator_tpu.obs.assemble` merges the "
+        "per-process exports into whole-request trees (env: "
+        "KOORD_TRACE_EXPORT)",
+    )
+    for band, suffix in (("free", "FREE"), ("batch", "BATCH"),
+                         ("mid", "MID"), ("prod", "PROD")):
+        ap.add_argument(
+            f"--shed-fraction-{band}", type=float,
+            dest=f"shed_fraction_{band}",
+            default=(
+                float(os.environ[f"KOORD_SHED_FRACTION_{suffix}"])
+                if os.environ.get(f"KOORD_SHED_FRACTION_{suffix}")
+                else None
+            ),
+            help=f"admission shed ladder rung for the koord-{band} "
+            "band: fraction of --max-inflight this band may fill "
+            "before ITS new requests shed (must be in (0, 1] and "
+            "monotone free <= batch <= mid <= prod; defaults "
+            "0.50/0.65/0.80/1.00; env: "
+            f"KOORD_SHED_FRACTION_{suffix})",
+        )
+    ap.add_argument(
         "--state-dir", default=None,
         help="daemon state directory (default: $XDG_STATE_HOME/"
         "koord-scheduler, per-user); the persistent XLA compile cache "
@@ -754,6 +793,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
+    shed_fractions = {
+        f"koord-{band}": value
+        for band in ("free", "batch", "mid", "prod")
+        if (value := getattr(args, f"shed_fraction_{band}")) is not None
+    } or None
     server = SchedulerServer(
         config_path=args.config,
         lease_path=args.lease,
@@ -775,6 +819,8 @@ def main(argv=None) -> int:
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_ms=args.breaker_cooldown_ms,
         brownout_max_lag=args.brownout_max_lag,
+        trace_export=args.trace_export,
+        shed_fractions=shed_fractions,
     ).start()
     try:
         threading.Event().wait()  # koordlint: disable=unbounded-wait(main thread parks forever by design; the server threads own the work and KeyboardInterrupt unparks)

@@ -378,6 +378,32 @@ class TestTraceArtifactFields:
             self._line(trace_slo=[self._verdict(observed_ms=float("nan"))])
         )
 
+    def test_trace_overhead_and_assembly_fields(self):
+        """ISSUE 14: the tracing-overhead delta and the assembly counts
+        are schema-validated artifact fields — the overhead may be
+        NEGATIVE (run noise) but never below -100 or non-finite, and
+        the counts are plain non-negative ints."""
+        assert bench._validate_artifact(self._line(
+            trace_overhead_p99_pct=2.4,
+            assembled_traces=97,
+            orphan_spans=0,
+        )) == []
+        assert bench._validate_artifact(self._line(
+            trace_overhead_p99_pct=-3.1,  # traced run won the noise
+        )) == []
+        assert bench._validate_artifact(
+            self._line(trace_overhead_p99_pct=float("nan"))
+        )
+        assert bench._validate_artifact(
+            self._line(trace_overhead_p99_pct=-250.0)
+        )
+        assert bench._validate_artifact(
+            self._line(trace_overhead_p99_pct="small")
+        )
+        assert bench._validate_artifact(self._line(assembled_traces=-1))
+        assert bench._validate_artifact(self._line(assembled_traces=True))
+        assert bench._validate_artifact(self._line(orphan_spans=0.5))
+
     def test_deadline_killed_trace_replay_flushes_truncated_artifact(self):
         """The _ArtifactDeadline flush path covers --config trace: a
         replay hanging past the budget (a wedged UDS server, a compile

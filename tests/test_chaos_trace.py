@@ -34,15 +34,25 @@ def _gate(report, verdicts) -> bool:
 
 class TestChaosTraceGate:
     @pytest.fixture(scope="class")
-    def report(self, tmp_path_factory):
+    def replay(self, tmp_path_factory):
         """ONE full chaos replay shared by the assertions below (the
         replay is the expensive part: warm-up pass + faulted pass +
-        kill/recovery)."""
+        kill/recovery).  Runs WITH DISTRIBUTED TRACING ON (ISSUE 14):
+        the tracing acceptance below assembles the very same run's
+        span exports, and tracing must not perturb any of the existing
+        gate invariants (parity, zero retraces, recovery SLO)."""
         trace = _trace()
         td = tmp_path_factory.mktemp("chaos-trace")
-        return ChaosTraceReplay(
+        trace_dir = str(td / "traces")
+        report = ChaosTraceReplay(
             trace, str(td), fail_at=5, fail_n=4, kill_at=12,
+            trace_export=trace_dir,
         ).run()
+        return report, trace_dir
+
+    @pytest.fixture(scope="class")
+    def report(self, replay):
+        return replay[0]
 
     def test_breaker_tripped_and_brownout_served(self, report):
         assert report.breaker_trips >= 1, (
@@ -75,6 +85,53 @@ class TestChaosTraceGate:
         assert _gate(report, verdicts), "\n".join(
             f"{v.spec.name}: {v.reason}" for v in verdicts if not v.ok
         )
+
+    def test_every_client_rpc_assembles_into_a_complete_tree(
+        self, replay
+    ):
+        """The ISSUE-14 acceptance: 100% of client-observed RPCs —
+        retried, shed, brownout-degraded, and across the mid-replay
+        leader kill — assemble into complete cross-process trees via
+        ``obs.assemble`` with ZERO orphan client spans.  Server spans
+        from BOTH leader incarnations (pre-kill and warm-restarted)
+        must join the same per-request trees."""
+        report, trace_dir = replay
+        from koordinator_tpu.obs import assemble as assemble_mod
+
+        assembly = assemble_mod.assemble([trace_dir])
+        assert assembly.traces, "the traced replay exported no traces"
+        assert assembly.malformed_lines == 0
+        assert not assembly.client_orphans, [
+            (s.get("name"), s.get("spanId"))
+            for s in assembly.client_orphans
+        ]
+        incomplete = assembly.incomplete
+        assert not incomplete, [
+            (t.trace_id, len(t.orphans), len(t.unresolved))
+            for t in incomplete
+        ]
+        kinds = {
+            s.get("kind") for s in assembly.spans_by_id.values()
+        }
+        # the whole tier participated: client shim spans, server RPC
+        # spans, and the coalesced launch spans all exported
+        assert {"client", "server", "internal"} <= kinds
+        # every logical client RPC (root op span) made it into a tree
+        ops = [
+            s for s in assembly.spans_by_id.values()
+            if s.get("kind") == "client" and not s.get("parentSpanId")
+        ]
+        assert len(ops) == len(assembly.traces)
+        # the brownout window happened under tracing: at least one
+        # server span carries the degraded mark, and its fan-in link
+        # to the producing launch resolves (complete-trace assertion
+        # above already proved resolution)
+        degraded = [
+            s for s in assembly.spans_by_id.values()
+            if (s.get("attributes") or {}).get("degraded")
+            or "brownout_lag" in (s.get("attributes") or {})
+        ]
+        assert report.degraded_replies == 0 or degraded
 
 
 class TestInverseControl:

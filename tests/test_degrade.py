@@ -144,6 +144,109 @@ class TestBandLadder:
         assert "koord-free" in str(ei.value)
 
 
+class TestShedFractionKnobs:
+    """ISSUE 14 satellite (ROADMAP 6(b) follow-on): the band ladder's
+    constants become flags/env knobs, validated at startup — each in
+    (0, 1], monotone free <= batch <= mid <= prod."""
+
+    def test_defaults_pass_validation_unchanged(self):
+        from koordinator_tpu.replication.admission import (
+            BAND_SHED_FRACTION,
+            validate_shed_fractions,
+        )
+
+        assert validate_shed_fractions(None) == BAND_SHED_FRACTION
+        assert validate_shed_fractions({}) == BAND_SHED_FRACTION
+
+    def test_partial_override_merges_over_defaults(self):
+        from koordinator_tpu.replication.admission import (
+            validate_shed_fractions,
+        )
+
+        merged = validate_shed_fractions({"koord-free": 0.25})
+        assert merged["koord-free"] == 0.25
+        assert merged["koord-batch"] == 0.65  # default kept
+
+    def test_out_of_range_rejected(self):
+        from koordinator_tpu.replication.admission import (
+            validate_shed_fractions,
+        )
+
+        for bad in (0.0, -0.1, 1.5):
+            with pytest.raises(ValueError, match=r"\(0, 1\]"):
+                validate_shed_fractions({"koord-free": bad})
+
+    def test_inverted_ladder_rejected(self):
+        from koordinator_tpu.replication.admission import (
+            validate_shed_fractions,
+        )
+
+        # free past batch would shed the HIGHER band first
+        with pytest.raises(ValueError, match="monotone"):
+            validate_shed_fractions({"koord-free": 0.9})
+        with pytest.raises(ValueError, match="monotone"):
+            validate_shed_fractions({"koord-prod": 0.7})
+
+    def test_unknown_band_rejected(self):
+        from koordinator_tpu.replication.admission import (
+            validate_shed_fractions,
+        )
+
+        with pytest.raises(ValueError, match="unknown"):
+            validate_shed_fractions({"koord-spot": 0.5})
+
+    def test_env_parse_and_unset(self):
+        from koordinator_tpu.replication.admission import (
+            shed_fractions_from_env,
+        )
+
+        assert shed_fractions_from_env(env={}) is None
+        # empty value means unset (the KOORD_* convention)
+        assert shed_fractions_from_env(
+            env={"KOORD_SHED_FRACTION_FREE": ""}
+        ) is None
+        got = shed_fractions_from_env(env={
+            "KOORD_SHED_FRACTION_FREE": "0.3",
+            "KOORD_SHED_FRACTION_MID": "0.9",
+        })
+        assert got == {"koord-free": 0.3, "koord-mid": 0.9}
+        with pytest.raises(ValueError, match="not a number"):
+            shed_fractions_from_env(
+                env={"KOORD_SHED_FRACTION_PROD": "lots"}
+            )
+
+    def test_gate_uses_overridden_rungs(self):
+        gate = AdmissionGate(
+            max_inflight=10,
+            shed_fractions={"koord-free": 0.2, "koord-batch": 0.2},
+        )
+        assert gate.band_limit("koord-free") == 2
+        assert gate.band_limit("koord-batch") == 2
+        assert gate.band_limit("koord-mid") == 8
+        assert gate.band_limit("koord-prod") == 10
+
+    def test_servicer_threads_fractions_to_the_gate(self):
+        from koordinator_tpu.bridge.server import ScorerServicer
+
+        sv = ScorerServicer(
+            max_inflight=10,
+            shed_fractions={"koord-free": 0.1},
+            trace_export=False,
+        )
+        assert sv.admission.band_limit("koord-free") == 1
+
+    def test_daemon_flags_parse_into_the_ladder(self):
+        from koordinator_tpu.scheduler.server import build_arg_parser
+
+        args = build_arg_parser().parse_args([
+            "--shed-fraction-free", "0.4",
+            "--shed-fraction-prod", "1.0",
+        ])
+        assert args.shed_fraction_free == 0.4
+        assert args.shed_fraction_prod == 1.0
+        assert args.shed_fraction_mid is None
+
+
 class TestDeadlinePropagation:
     def test_expired_on_arrival_is_rejected_at_queue_stage(self, servicer):
         with pytest.raises(DeadlineExpired) as ei:

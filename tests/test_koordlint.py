@@ -646,6 +646,130 @@ class TestSpanLeak:
         """) == []
 
 
+class TestSpanLeakTraceAPI:
+    """ISSUE 14: the rule extends over the distributed-tracing surface
+    — a ``start_trace_span`` must end/abort on all exits (a leaked
+    TraceSpan never exports, so the assembled tree silently loses the
+    RPC) and a ``SpanExporter`` handle must be closed."""
+
+    def test_leaked_trace_span_caught(self):
+        got = lint("""
+        def rpc(spans, trace_id):
+            span = spans.start_trace_span("score", trace_id)
+            reply = serve()
+            span.end()
+            return reply
+        """)
+        assert [(v.rule, v.line) for v in got] == [("span-leak", 3)]
+        assert "TraceSpan" in got[0].message
+
+    def test_abort_in_handler_plus_end_is_clean(self):
+        # the server wrapper shape (bridge/server.py sync/score/assign)
+        assert lint("""
+        def rpc(spans, trace_id):
+            span = spans.start_trace_span("score", trace_id)
+            try:
+                reply = serve()
+            except BaseException as exc:
+                span.abort(exc)
+                raise
+            span.end()
+            return reply
+        """) == []
+
+    def test_end_in_finally_is_clean(self):
+        assert lint("""
+        def rpc(spans, trace_id):
+            span = spans.start_trace_span("score", trace_id)
+            try:
+                return serve()
+            finally:
+                span.end()
+        """) == []
+
+    def test_with_block_is_clean(self):
+        assert lint("""
+        def rpc(spans, trace_id):
+            with spans.start_trace_span("score", trace_id) as span:
+                return serve(span)
+        """) == []
+
+    def test_factory_return_is_clean(self):
+        # ScorerServicer._start_rpc_span: ownership moves to the caller
+        assert lint("""
+        def _start_rpc_span(self, name, req):
+            return self.spans.start_trace_span(name, req.trace_id)
+        """) == []
+
+    def test_end_without_error_path_caught(self):
+        # an end() with no abort/finally anywhere: the exception path
+        # leaks the span
+        got = lint("""
+        def rpc(spans, trace_id):
+            span = spans.start_trace_span("score", trace_id)
+            reply = serve()
+            if reply.ok:
+                span.end()
+            return reply
+        """)
+        assert [(v.rule, v.line) for v in got] == [("span-leak", 3)]
+
+    def test_unclosed_exporter_caught(self):
+        got = lint("""
+        def export_all(records, path):
+            exporter = SpanExporter(path)
+            for record in records:
+                exporter.export(record)
+        """)
+        assert [(v.rule, v.line) for v in got] == [("span-leak", 3)]
+        assert "closed" in got[0].message
+
+    def test_exporter_with_block_is_clean(self):
+        assert lint("""
+        def export_all(records, path):
+            with SpanExporter(path) as exporter:
+                for record in records:
+                    exporter.export(record)
+        """) == []
+
+    def test_exporter_close_in_finally_is_clean(self):
+        assert lint("""
+        def export_all(records, path):
+            exporter = SpanExporter(path)
+            try:
+                for record in records:
+                    exporter.export(record)
+            finally:
+                exporter.close()
+        """) == []
+
+    def test_exporter_held_on_self_with_close_is_clean(self):
+        # the CycleTelemetry / ScorerClient lifetime shape
+        assert lint("""
+        class Telemetry:
+            def __init__(self, path):
+                self.exporter = SpanExporter(path)
+
+            def close(self):
+                self.exporter.close()
+        """) == []
+
+    def test_exporter_on_self_without_close_method_caught(self):
+        got = lint("""
+        class Telemetry:
+            def __init__(self, path):
+                self.exporter = SpanExporter(path)
+        """)
+        assert [(v.rule, v.line) for v in got] == [("span-leak", 4)]
+
+    def test_trace_span_suppression_tag(self):
+        assert lint("""
+        def launch(spans, trace_id):
+            span = spans.start_trace_span("launch", trace_id)  # koordlint: disable=span-leak(ends in the readback closure)
+            return span
+        """) == []
+
+
 class TestHostSyncObsAPI:
     """The obs API inside jitted code is the print() trap plus a
     potential tracer concretization — the host-sync rule covers it."""
