@@ -1,6 +1,6 @@
 """retrace-hazard: things that silently recompile the hot cycle.
 
-Three statically detectable shapes of the PR-1 name-tuple retrace:
+Five statically detectable shapes of the PR-1 name-tuple retrace:
 
 1. Python control flow (``if``/``while``/``assert``) on a TRACED
    parameter inside a jitted function.  Branching on a tracer either
@@ -33,6 +33,14 @@ Three statically detectable shapes of the PR-1 name-tuple retrace:
    names as a parameter receives it as a traced per-shard operand —
    the mesh belongs in the ``shard_map(..., mesh=)`` binding or the
    closure, never in the operand list.
+5. UNHASHABLE / UNFROZEN CycleConfig term configs (ISSUE 15): the
+   config rides jit as a static argument, so every dataclass reachable
+   from CycleConfig's field annotations (the fused scoring-term
+   configs, the LoadAware args, ...) must be ``frozen=True``, must not
+   carry a mutable field default, and every mapping-typed field must
+   go through ``_freeze`` in ``__post_init__`` — a raw dict field
+   either raises at the first jit call (unhashable) or, frozen into an
+   arbitrary-order tuple by a caller, mints one retrace per ordering.
 """
 
 from __future__ import annotations
@@ -352,6 +360,163 @@ def _shard_map_body_knobs(source: SourceFile) -> List[Violation]:
     return out
 
 
+# annotation identifiers that mean "this field is a mapping and must be
+# frozen to a sorted tuple before it can be a static jit argument"
+_MAPPINGY_TYPES = {"ResMap", "Mapping", "MutableMapping", "Dict", "dict"}
+
+
+def _annotation_names(ann) -> Set[str]:
+    """Identifier names mentioned by a field annotation — handles
+    Name/Attribute/Subscript forms and string annotations ("X | None")."""
+    import re as _re
+
+    if ann is None:
+        return set()
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return set(_re.findall(r"[A-Za-z_][A-Za-z0-9_]*", ann.value))
+    names: Set[str] = set()
+    for node in ast.walk(ann):
+        if isinstance(node, ast.Name):
+            names.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.add(node.attr)
+    return names
+
+
+def _dataclass_frozen(cls: ast.ClassDef):
+    """(is_dataclass, frozen) from the decorator list."""
+    for dec in cls.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = target.attr if isinstance(target, ast.Attribute) else (
+            target.id if isinstance(target, ast.Name) else ""
+        )
+        if name != "dataclass":
+            continue
+        frozen = False
+        if isinstance(dec, ast.Call):
+            for kw in dec.keywords:
+                if kw.arg == "frozen" and isinstance(kw.value, ast.Constant):
+                    frozen = bool(kw.value.value)
+        return True, frozen
+    return False, False
+
+
+def _frozen_fields(cls: ast.ClassDef) -> Set[str]:
+    """Field names ``__post_init__`` re-binds through ``_freeze``:
+    ``object.__setattr__(self, "field", _freeze(...))``."""
+    out: Set[str] = set()
+    for node in cls.body:
+        if not (
+            isinstance(node, ast.FunctionDef)
+            and node.name == "__post_init__"
+        ):
+            continue
+        for call in ast.walk(node):
+            if not (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == "__setattr__"
+                and len(call.args) == 3
+                and isinstance(call.args[1], ast.Constant)
+                and isinstance(call.args[1].value, str)
+            ):
+                continue
+            value = call.args[2]
+            if (
+                isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id == "_freeze"
+            ):
+                out.add(call.args[1].value)
+    return out
+
+
+def _term_config_classes(source: SourceFile):
+    """The CycleConfig dataclass plus every module-local dataclass
+    reachable from its field annotations (the term configs of ISSUE 15,
+    LoadAwareArgs, ...).  Empty when the file defines no CycleConfig."""
+    classes = {
+        n.name: n for n in ast.walk(source.tree)
+        if isinstance(n, ast.ClassDef)
+    }
+    if "CycleConfig" not in classes:
+        return {}
+    reach = {}
+    queue = ["CycleConfig"]
+    while queue:
+        name = queue.pop()
+        if name in reach:
+            continue
+        cls = classes.get(name)
+        if cls is None:
+            continue
+        reach[name] = cls
+        for node in cls.body:
+            if isinstance(node, ast.AnnAssign):
+                for ref in _annotation_names(node.annotation):
+                    if ref in classes and ref not in reach:
+                        queue.append(ref)
+    return reach
+
+
+def _term_config_fields(source: SourceFile) -> List[Violation]:
+    """Rule shape 5 (ISSUE 15): CycleConfig and its term configs are
+    STATIC jit arguments — unfrozen dataclasses, mutable field
+    defaults, and mapping-typed fields that never pass through
+    ``_freeze`` in ``__post_init__`` all fail lint."""
+    out: List[Violation] = []
+    for name, cls in _term_config_classes(source).items():
+        is_dc, frozen = _dataclass_frozen(cls)
+        if not is_dc:
+            continue  # a plain class is not a config dataclass
+        if not frozen:
+            out.append(Violation(
+                rule=RULE, path=source.path, line=cls.lineno,
+                message=(
+                    f"config dataclass {name} reachable from CycleConfig "
+                    "is not frozen=True: CycleConfig rides jit as a "
+                    "static argument, so every nested config must be "
+                    "immutable and hashable"
+                ),
+            ))
+        freezes = _frozen_fields(cls)
+        for node in cls.body:
+            if not isinstance(node, ast.AnnAssign) or not isinstance(
+                node.target, ast.Name
+            ):
+                continue
+            field = node.target.id
+            if isinstance(node.value, (ast.List, ast.Dict, ast.Set,
+                                       ast.ListComp, ast.DictComp,
+                                       ast.SetComp)):
+                out.append(Violation(
+                    rule=RULE, path=source.path, line=node.lineno,
+                    message=(
+                        f"{name}.{field} has a mutable "
+                        f"{type(node.value).__name__.lower()} default: "
+                        "term-config fields must be hashable (freeze "
+                        "mappings to sorted tuples via _freeze)"
+                    ),
+                ))
+            ann_names = _annotation_names(node.annotation)
+            if ann_names & _MAPPINGY_TYPES and field not in freezes:
+                # a default that is already a _freeze(...) call AND
+                # never reassigned is equally safe only if callers
+                # cannot pass a raw dict — they can, so the
+                # __post_init__ freeze is required regardless
+                out.append(Violation(
+                    rule=RULE, path=source.path, line=node.lineno,
+                    message=(
+                        f"{name}.{field} is mapping-typed but "
+                        "__post_init__ never passes it through "
+                        "_freeze: a caller-supplied dict makes the "
+                        "config unhashable at the jit boundary "
+                        "(mappings must go through _freeze)"
+                    ),
+                ))
+    return out
+
+
 def _pytree_metadata(source: SourceFile) -> List[Violation]:
     out: List[Violation] = []
     for node in ast.walk(source.tree):
@@ -395,4 +560,5 @@ def check(source: SourceFile) -> List[Violation]:
     out.extend(_shard_map_body_knobs(source))
     out.extend(_static_call_args(source))
     out.extend(_pytree_metadata(source))
+    out.extend(_term_config_fields(source))
     return out

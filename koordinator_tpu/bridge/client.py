@@ -503,6 +503,10 @@ class ScorerClient:
         quota_limited: Optional[np.ndarray] = None,
         node_bucket: int = 0,
         pod_bucket: int = 0,
+        node_accel_type: Optional[Sequence[int]] = None,
+        workload_class: Optional[Sequence[int]] = None,
+        pod_sensitivity: Optional[np.ndarray] = None,
+        throughput: Optional[np.ndarray] = None,
     ) -> "pb2.SyncReply":
         tensors = {
             "nalloc": node_allocatable,
@@ -513,6 +517,11 @@ class ScorerClient:
             "qrt": quota_runtime,
             "quse": quota_used,
             "qlim": quota_limited,
+            # fused-term tensors (ISSUE 15): the Synergy sensitivity
+            # profile and the Gavel throughput matrix ride the same
+            # delta-encoding path as every snapshot tensor
+            "psens": pod_sensitivity,
+            "tput": throughput,
         }
         scalars = {
             "node_names": tuple(node_names),
@@ -526,6 +535,16 @@ class ScorerClient:
             "gang_id": tuple(gang_id) if gang_id is not None else None,
             "quota_id": tuple(quota_id) if quota_id is not None else None,
             "gang_min": tuple(gang_min_member),
+            "accel_type": (
+                tuple(int(v) for v in node_accel_type)
+                if node_accel_type is not None
+                else None
+            ),
+            "workload_class": (
+                tuple(int(v) for v in workload_class)
+                if workload_class is not None
+                else None
+            ),
         }
 
         staged: Dict[str, np.ndarray] = {}
@@ -580,6 +599,14 @@ class ScorerClient:
             req.quotas.runtime.CopyFrom(tensor("qrt"))
             req.quotas.used.CopyFrom(tensor("quse"))
             req.quotas.limited.CopyFrom(tensor("qlim"))
+            accel = scalar("accel_type")
+            if accel is not None:
+                req.nodes.accel_type.extend(accel)
+            wclass = scalar("workload_class")
+            if wclass is not None:
+                req.pods.workload_class.extend(wclass)
+            req.pods.sensitivity.CopyFrom(tensor("psens"))
+            req.terms.throughput.CopyFrom(tensor("tput"))
             return req
 
         # the lock is held across the RPCs: a pooled Score thread's

@@ -336,6 +336,23 @@ class ScorerServicer:
             on_transition=self._breaker_transition,
         )
         self._brownout_max_lag = max(0, int(brownout_max_lag))
+        # ROADMAP 6(a): cache the launch's FULL [P, N] scores readback
+        # alongside the padded top-k when the tensor is small enough
+        # (cells <= KOORD_BROWNOUT_FULL_CELLS, default 4M = 32 MiB of
+        # host i64) — a breaker-open Score wanting a WIDER top-k than
+        # the cached launch computed is then ranked on host
+        # (solver/topk.py masked_top_k_host, bit-identical) instead of
+        # refused.  Past the gate only the padded top-k caches and the
+        # wider-k refusal stands — the hot-path transfer cost must not
+        # scale with P x N at headline scale.
+        self._brownout_full_cells = int(
+            os.environ.get("KOORD_BROWNOUT_FULL_CELLS") or str(1 << 22)
+        )
+        # fused scoring terms (ISSUE 15): enabled term names, counted
+        # per device launch on koord_scorer_term_total{term}
+        from koordinator_tpu.solver.terms import term_names
+
+        self._term_names = term_names(cfg)
         # host-side brownout cache: the last Score launch's padded
         # top-k readback plus the (epoch, generation, cfg, geometry)
         # it certified.  Unlike the ScoreMemo it deliberately SURVIVES
@@ -908,10 +925,34 @@ class ScorerServicer:
             ):
                 return None  # geometry moved: the cached rows misalign
             k = min(int(req.top_k) or cache["N"], cache["N"])
-            if k > cache["kb"]:
-                return None  # wider top-k than the cached launch holds
+            # snapshot ts/ti/kb UNDER the lock: the wide path below
+            # decides on this consistent read, never on a re-read — a
+            # concurrent widener bumping cache["kb"] mid-flight must
+            # not make this thread skip the re-rank while still
+            # holding the pre-widen narrow columns
+            ts, ti, kb = cache["ts"], cache["ti"], cache["kb"]
+            if k > kb and cache.get("scores") is None:
+                # wider top-k than the cached launch computed and no
+                # full [P, N] scores cached (past the cell gate): the
+                # refusal stands — the cache cannot invent columns
+                return None
+        if k > kb:
+            # ROADMAP 6(a): rank the cached full scores on host —
+            # bit-identical to the launch that would have run.  The
+            # inputs are immutable on the entry, so a concurrent
+            # widener computes the identical result; memoization is
+            # idempotent and only the SAME entry widens (a newer
+            # launch's cache never inherits a stale ranking).
+            from koordinator_tpu.solver.topk import masked_top_k_host
+
+            ts, ti = masked_top_k_host(
+                cache["scores"], cache["feasible"], k
+            )
+            with self._state_lock:
+                if self._brownout is cache and k > cache["kb"]:
+                    cache["ts"], cache["ti"], cache["kb"] = ts, ti, k
         reply = self._assemble_score_reply(
-            req, k, cache["ts"], cache["ti"], cache["feasible"],
+            req, k, ts, ti, cache["feasible"],
             cache["valid"], cache["P"], degraded=True,
         )
         if tspan is not None:
@@ -1085,6 +1126,17 @@ class ScorerServicer:
                 scores, feasible, k=k_launch,
                 hi=score_upper_bound(self.cfg),
             )
+            # brownout full cache (ROADMAP 6(a)): a defensive device
+            # COPY under the cell gate — the stored residency tensor is
+            # DONATED by a subsequent pipelined incremental launch (the
+            # very reason feasible is never donated), so the buffer
+            # this readback will device_get must be its own
+            cache_full = P * N <= self._brownout_full_cells
+            scores_cache = None
+            if cache_full:
+                import jax.numpy as jnp
+
+                scores_cache = jnp.copy(scores)
             # launch phase ends with the program ENQUEUED (async
             # dispatch); everything below blocks, so it lives in the
             # readback closure the dispatcher runs off the launch lock
@@ -1102,9 +1154,16 @@ class ScorerServicer:
                 # one stacked device->host transfer for the whole batch
                 # (the serialized daemon paid one blocking readback per
                 # request), overlapped with the NEXT batch's launch by
-                # the pipelined dispatcher
-                ts, ti, feasible_np, valid_np = jax.device_get(
-                    (top_scores, top_idx, feasible, snap.pods.valid)
+                # the pipelined dispatcher.  Small tensors also fetch
+                # the full [P, N] scores (the launch-section copy) for
+                # the brownout cache (ROADMAP 6(a)): a breaker-open
+                # wider-k request is then ranked on host instead of
+                # refused; past the cell gate the extra transfer is
+                # skipped — the hot path must not pay O(P x N)
+                # readback at headline scale.
+                ts, ti, feasible_np, valid_np, scores_np = jax.device_get(
+                    (top_scores, top_idx, feasible, snap.pods.valid,
+                     scores_cache)
                 )
                 readback_s = time.perf_counter() - t0
                 # device work is done: the launch span closes HERE (off
@@ -1160,6 +1219,7 @@ class ScorerServicer:
                             nodes=mirror_rows[0], pods=mirror_rows[1],
                             ts=ts, ti=ti, feasible=feasible_np,
                             valid=valid, launch_span=launch_ref,
+                            scores=scores_np,
                         )
                 # host-side assembly failures are per-entry: the launch
                 # served everyone else, so one bad demux must not fail
@@ -1373,6 +1433,12 @@ class ScorerServicer:
                 tel.metrics.count_score_incr(incr_result)
                 if incr_result == "incr":
                     tel.metrics.observe_incr_cols(incr_cols)
+            if assembled or n_failed:
+                # fused scoring terms (ISSUE 15): one count per DEVICE
+                # launch per enabled term — the fused engine's "all
+                # terms, one launch" claim made countable
+                for term in self._term_names:
+                    tel.metrics.count_term(term)
             if not assembled:
                 return
             tel.flush_backlog()
@@ -1745,7 +1811,13 @@ class ScorerServicer:
         result = None
         rounds = None
         eff_wave = self.cfg.wave
-        if self.mesh is not None:
+        # fused scoring terms (ISSUE 15): the multi-chip wave cycle has
+        # no extras seam, so a term-enabled config serves Assign through
+        # the single-chip run_cycle below (which folds the term tensors
+        # into extra_mask/extra_scores) — bit-identical placements, the
+        # reply's path field shows what ran.  Score keeps its full mesh
+        # path either way: the terms live INSIDE score_all.
+        if self.mesh is not None and not self._term_names:
             from koordinator_tpu.parallel import greedy_assign_waves
             from koordinator_tpu.solver import (
                 _demoted,

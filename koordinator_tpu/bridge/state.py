@@ -188,6 +188,10 @@ _DELTA_TENSORS = (
     "quota_runtime",
     "quota_used",
     "quota_limited",
+    # fused-term tensors (ISSUE 15): the Synergy sensitivity profile and
+    # the Gavel throughput matrix delta-sync like every snapshot tensor
+    "pod_sensitivity",
+    "term_throughput",
 )
 
 # score-relevant tensors (ISSUE 9): which resident mirrors feed the
@@ -198,7 +202,12 @@ _DELTA_TENSORS = (
 # the resident score tensors exactly valid — zero columns to rescore.
 _SCORE_NODE_TENSORS = ("node_alloc", "node_requested", "node_usage",
                        "node_agg", "node_agg_fresh", "node_prod")
-_SCORE_POD_TENSORS = ("pod_requests", "pod_estimated")
+_SCORE_POD_TENSORS = ("pod_requests", "pod_estimated", "pod_sensitivity")
+# the throughput matrix is neither node- nor pod-major: a delta to cell
+# (c, a) invalidates the score COLUMNS of every node whose (clipped)
+# accelerator type is ``a`` — _score_dirty_rows attributes it through
+# the accel mirror, so a one-type matrix update rescores only that
+# type's node columns (O(dirty), the ISSUE-15 acceptance)
 
 
 class ScoreResidency:
@@ -237,13 +246,14 @@ class ScoreResidency:
 # build — silently wrong data, or a broadcast error under a smaller
 # explicit bucket
 _NODE_COMPANIONS = ("node_fresh", "node_names", "node_agg", "node_agg_fresh",
-                    "node_prod", "node_requested", "node_usage")
+                    "node_prod", "node_requested", "node_usage", "node_accel")
 # NOTE: gang_min is deliberately NOT a pod companion — the gang table's
 # shape is per-gang, not per-pod (like the quota tables), so resetting it
 # on a pod resize would wipe gang gating while the new pod table's
 # gang_id column still references the gangs
 _POD_COMPANIONS = ("pod_priority", "pod_priority_class", "pod_gang",
-                   "pod_quota", "pod_names", "pod_estimated")
+                   "pod_quota", "pod_names", "pod_estimated",
+                   "pod_workload", "pod_sensitivity")
 _COMPANION_DEFAULTS = {"node_names": (), "pod_names": ()}
 
 
@@ -292,6 +302,13 @@ class ResidentState:
         self.quota_runtime: Optional[np.ndarray] = None
         self.quota_used: Optional[np.ndarray] = None
         self.quota_limited: Optional[np.ndarray] = None
+        # fused-term mirrors (ISSUE 15): accel/workload columns plus the
+        # sensitivity and throughput tensors; None = never synced (the
+        # terms are inert for the missing data)
+        self.node_accel: Optional[np.ndarray] = None
+        self.pod_workload: Optional[np.ndarray] = None
+        self.pod_sensitivity: Optional[np.ndarray] = None
+        self.term_throughput: Optional[np.ndarray] = None
         self.node_bucket = 0
         self.pod_bucket = 0
         self._snapshot: Optional[ClusterSnapshot] = None
@@ -454,9 +471,11 @@ class ResidentState:
             (req.nodes.prod_usage, self.node_prod),
             (req.pods.requests, self.pod_requests),
             (req.pods.estimated, self.pod_estimated),
+            (req.pods.sensitivity, self.pod_sensitivity),
             (req.quotas.runtime, self.quota_runtime),
             (req.quotas.used, self.quota_used),
             (req.quotas.limited, self.quota_limited),
+            (req.terms.throughput, self.term_throughput),
         ):
             if _present(arr):
                 # prev=None: always the full payload, never a delta —
@@ -474,6 +493,8 @@ class ResidentState:
             (req.pods.gang_id, self.pod_gang),
             (req.pods.quota_id, self.pod_quota),
             (req.gangs.min_member, self.gang_min),
+            (req.nodes.accel_type, self.node_accel),
+            (req.pods.workload_class, self.pod_workload),
         ):
             if arr is not None and len(arr):
                 target.extend(int(v) for v in arr)
@@ -497,6 +518,8 @@ class ResidentState:
             "quota_runtime": reqmsg.quotas.runtime,
             "quota_used": reqmsg.quotas.used,
             "quota_limited": reqmsg.quotas.limited,
+            "pod_sensitivity": p.sensitivity,
+            "term_throughput": reqmsg.terms.throughput,
         }
         staged: Dict[str, object] = {}
         tinfo: Dict[str, tuple] = {}
@@ -526,6 +549,12 @@ class ResidentState:
         if reqmsg.gangs.min_member:
             staged["gang_min"] = np.asarray(
                 list(reqmsg.gangs.min_member), np.int32
+            )
+        if n.accel_type:
+            staged["node_accel"] = np.asarray(list(n.accel_type), np.int32)
+        if p.workload_class:
+            staged["pod_workload"] = np.asarray(
+                list(p.workload_class), np.int32
             )
         # explicit wire buckets win; otherwise a warm frame that omits
         # them INHERITS the resident bucket (sticky-grow) instead of
@@ -641,9 +670,18 @@ class ResidentState:
                 "pod_requests"
             ]
 
+        # first appearance of a term COLUMN (ISSUE 15): the resident
+        # snapshot gains a leaf (None -> array), which changes the
+        # pytree structure every downstream jit keys on — one cold
+        # rebuild, exactly like a tensor appearing in _DELTA_TENSORS
+        for key in ("node_accel", "pod_workload"):
+            if staged.get(key) is not None and getattr(self, key) is None:
+                return None
+
         derived = set()
         for key in ("node_fresh", "pod_priority", "pod_priority_class",
-                    "pod_gang", "pod_quota", "gang_min"):
+                    "pod_gang", "pod_quota", "gang_min",
+                    "node_accel", "pod_workload"):
             if key not in staged:
                 continue
             old = getattr(self, key)
@@ -705,6 +743,8 @@ class ResidentState:
                 node_patch[field] = new if new is not None else builder()
         if "node_fresh" in derived:
             node_patch["metric_fresh"] = self._dev_metric_fresh()
+        if "node_accel" in derived:
+            node_patch["accel_type"] = self._dev_accel_type()
 
         pod_patch = {}
         if "pod_requests" in tensor_updates:
@@ -722,6 +762,12 @@ class ResidentState:
             pod_patch["estimated"] = (
                 new if new is not None else self._dev_estimated()
             )
+        if "pod_sensitivity" in tensor_updates:
+            new = updated(pods.sensitivity, "pod_sensitivity",
+                          tensor_updates["pod_sensitivity"])
+            pod_patch["sensitivity"] = (
+                new if new is not None else self._dev_sensitivity()
+            )
         if "pod_priority" in derived:
             pod_patch["priority"] = self._dev_priority()
         if "pod_priority_class" in derived:
@@ -730,6 +776,8 @@ class ResidentState:
             pod_patch["gang_id"] = self._dev_gang_id()
         if "pod_quota" in derived:
             pod_patch["quota_id"] = self._dev_quota_id()
+        if "pod_workload" in derived:
+            pod_patch["workload_class"] = self._dev_workload_class()
 
         quota_patch = {}
         for key, field in (
@@ -746,6 +794,14 @@ class ResidentState:
                     )
                 quota_patch[field] = new
 
+        throughput = snap.throughput
+        if "term_throughput" in tensor_updates:
+            # replicated side table: the scatter runs on every device
+            # with identical values, like the pod/quota tensors
+            new = updated(snap.throughput, "term_throughput",
+                          tensor_updates["term_throughput"])
+            throughput = new if new is not None else self._dev_throughput()
+
         if node_patch:
             nodes = dataclasses.replace(nodes, **node_patch)
         if pod_patch:
@@ -754,7 +810,8 @@ class ResidentState:
             quotas = dataclasses.replace(quotas, **quota_patch)
         gangs = self._dev_gangs() if "gang_min" in derived else snap.gangs
         return ClusterSnapshot(
-            nodes=nodes, pods=pods, gangs=gangs, quotas=quotas
+            nodes=nodes, pods=pods, gangs=gangs, quotas=quotas,
+            throughput=throughput,
         )
 
     # -- resident score tensors (ISSUE 9) --
@@ -828,6 +885,42 @@ class ResidentState:
         for key, update in tensor_updates.items():
             if key == "pod_estimated_from_requests":
                 continue  # rides pod_requests' indices, counted there
+            if key == "term_throughput":
+                # a change to matrix cell (c, a) invalidates the score
+                # columns of every node whose CLIPPED accel type is a
+                # (the gather clips, so out-of-range types alias the
+                # edge rows) — matched against the post-commit accel
+                # column, since that is what the next gather reads; an
+                # accel flip in the SAME frame dirties its own rows
+                # through the derived diff below.  Unlike the row-major
+                # snapshot tensors, a FULL re-upload stays attributable:
+                # the matrix is tiny ([C, A]) and warm-plan geometry is
+                # unchanged, so the exact changed-cell set is one cheap
+                # mirror diff (the delta ratio gate routinely ships
+                # small matrices full — dropping residency for that
+                # would make every trace-realistic throughput event a
+                # full rescore).
+                tput = np.asarray(self.term_throughput, np.int64)
+                if update[0] == "delta":
+                    changed = np.asarray(update[1], np.int64)
+                else:
+                    changed = np.flatnonzero(
+                        tput.reshape(-1)
+                        != np.asarray(staged[key], np.int64).reshape(-1)
+                    )
+                A = int(tput.shape[-1]) if tput.ndim > 1 else 1
+                touched = set((changed % A).tolist())
+                N = self.node_alloc.shape[0]
+                accel_new = staged.get("node_accel", self.node_accel)
+                accel = (
+                    np.asarray(accel_new, np.int64)
+                    if accel_new is not None
+                    else np.zeros(N, np.int64)
+                )
+                accel = np.clip(accel[:N], 0, A - 1)
+                for a in touched:
+                    dirty_nodes.update(np.flatnonzero(accel == a).tolist())
+                continue
             if key not in _SCORE_NODE_TENSORS and key not in _SCORE_POD_TENSORS:
                 continue
             if update[0] != "delta":
@@ -874,6 +967,24 @@ class ResidentState:
                 staged.get("pod_priority", self.pod_priority),
             )
             dirty_pods.update(np.flatnonzero(old_cls != new_cls).tolist())
+        # term columns (ISSUE 15): an accel-type flip moves that node's
+        # heterogeneity gather (dirty column), a workload-class flip
+        # moves that pod's row.  First appearance went cold in
+        # _warm_plan, so old is always an array here; length moved
+        # without a resize = stay safe, like the freshness rule.
+        for key, rows in (("node_accel", dirty_nodes),
+                          ("pod_workload", dirty_pods)):
+            if key not in derived:
+                continue
+            new_col = staged.get(key)
+            old_col = getattr(self, key)
+            if new_col is None or old_col is None:
+                return None
+            new_col = np.asarray(new_col, np.int64)
+            old_col = np.asarray(old_col, np.int64)
+            if len(new_col) != len(old_col):
+                return None
+            rows.update(np.flatnonzero(old_col != new_col).tolist())
         return dirty_nodes, dirty_pods
 
     def i32_fits(self) -> bool:
@@ -992,6 +1103,37 @@ class ResidentState:
             _pad_rows_to(np.asarray(self.node_prod, np.int64), self.node_bucket)
         )
 
+    def _dev_accel_type(self) -> jnp.ndarray:
+        """Node accel-type column padded to the bucket (pad rows type 0
+        — padded nodes are masked by ``valid`` everywhere, and the term
+        gather clips, so the pad value is inert)."""
+        N = self.node_alloc.shape[0]
+        col = np.zeros(self.node_bucket, np.int32)
+        if self.node_accel is not None:
+            src = np.asarray(self.node_accel, np.int32)
+            col[: min(N, len(src))] = src[:N]
+        return self._place_node(col)
+
+    def _dev_workload_class(self) -> jnp.ndarray:
+        P = self.pod_requests.shape[0]
+        col = np.zeros(self.pod_bucket, np.int32)
+        if self.pod_workload is not None:
+            src = np.asarray(self.pod_workload, np.int32)
+            col[: min(P, len(src))] = src[:P]
+        return self._place_rep(col)
+
+    def _dev_sensitivity(self) -> jnp.ndarray:
+        return self._place_rep(
+            self._pad2(
+                np.asarray(self.pod_sensitivity, np.int64), self.pod_bucket
+            )
+        )
+
+    def _dev_throughput(self) -> jnp.ndarray:
+        """The [C, A] throughput matrix: replicated, never padded (its
+        geometry is per-(class, accel), not per-row)."""
+        return self._place_rep(np.asarray(self.term_throughput, np.int64))
+
     def _dev_estimated(self) -> jnp.ndarray:
         est = (
             self.pod_estimated
@@ -1096,6 +1238,11 @@ class ResidentState:
                 agg_usage=self._dev_agg_usage(),
                 agg_fresh=self._dev_agg_fresh(),
                 prod_usage=self._dev_prod_usage(),
+                accel_type=(
+                    self._dev_accel_type()
+                    if self.node_accel is not None
+                    else None
+                ),
                 names=(),
             ),
             pods=PodBatch(
@@ -1107,6 +1254,16 @@ class ResidentState:
                 gang_id=self._dev_gang_id(),
                 quota_id=self._dev_quota_id(),
                 valid=self._place_rep(pvalid),
+                workload_class=(
+                    self._dev_workload_class()
+                    if self.pod_workload is not None
+                    else None
+                ),
+                sensitivity=(
+                    self._dev_sensitivity()
+                    if _present(self.pod_sensitivity)
+                    else None
+                ),
                 names=(),
             ),
             gangs=self._dev_gangs(),
@@ -1116,6 +1273,11 @@ class ResidentState:
                 limited=self._place_rep(qlim),
                 valid=self._place_rep(qvalid),
                 names=(),
+            ),
+            throughput=(
+                self._dev_throughput()
+                if _present(self.term_throughput)
+                else None
             ),
         )
         return self._snapshot

@@ -86,6 +86,79 @@ class LoadAwareArgs:
         )
 
 
+# ---------------------------------------------------------------------------
+# Fused scoring-term configs (ISSUE 15).  Each term is a cellwise
+# (pod row, node row) contribution fused into the ONE score_cycle launch
+# (solver/terms.py holds the registry + math; docs/KERNEL.md "Scoring
+# terms" has the contract).  Term configs ride CycleConfig as STATIC jit
+# arguments, so every field must be hashable and every mapping must go
+# through ``_freeze`` — the koordlint retrace-hazard rule checks this
+# statically (an unhashable term-config field would raise at the first
+# jit call; a mutable one would silently key the cache on object id).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class HeterogeneityTermArgs:
+    """Gavel-style heterogeneity-aware scoring (PAPERS.md 2008.09213):
+    a per-(workload class, accelerator type) throughput matrix rides the
+    snapshot (``SyncRequest.terms.throughput``, [C, A] i64 normalized to
+    [0, MAX_NODE_SCORE]); the term gathers
+    ``throughput[workload_class[p], accel_type[n]]`` as a cellwise score
+    so pods land where their job class runs fastest.  Device values are
+    clamped to [0, MAX_NODE_SCORE] so the term's bound stays a CONFIG
+    property (``weight * MAX_NODE_SCORE``) — the f32-exact serving
+    top-k fast path depends on that (solver/topk.py)."""
+
+    weight: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SensitivityTermArgs:
+    """Synergy-style resource-sensitivity scoring (PAPERS.md
+    2110.06073): per-pod CPU/mem sensitivity profiles
+    (``PodTable.sensitivity``, [P, R] i64 in [0, 100]) replace
+    GPU-proportional shares — a pod's score on a node drops with the
+    node's occupancy on exactly the resources the pod is sensitive to:
+    ``score = weight * (MAX_NODE_SCORE - sum_r(sens*occ)//sum_r(sens))``
+    with occupancy in [0, 100] permille-free integer math.  Clamped to
+    [0, weight * MAX_NODE_SCORE]."""
+
+    weight: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class PackingTermArgs:
+    """Constraint-based bin packing (PAPERS.md 2511.08373): a
+    MostAllocated-style objective over post-placement utilization
+    (prefer filling nodes) plus an optional feasibility mask —
+    ``headroom`` maps resource name -> max post-placement utilization
+    PERCENT; a placement pushing a listed resource past its headroom is
+    masked infeasible.  Both halves are cellwise in (pod, node): the
+    mask reads only (requested[n] + req[p]) vs allocatable[n]."""
+
+    weight: int = 1
+    resource_weights: ResMap = _freeze({res.CPU: 1, res.MEMORY: 1})
+    headroom: ResMap = ()  # resource -> max utilization percent; () = no mask
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "resource_weights", _freeze(self.resource_weights)
+        )
+        object.__setattr__(self, "headroom", _freeze(self.headroom))
+
+    def weights_arr(self) -> jnp.ndarray:
+        return jnp.asarray(
+            res.weights_vector(dict(self.resource_weights)), jnp.int64
+        )
+
+    def headroom_arr(self) -> jnp.ndarray:
+        """Per-resource headroom percent; 0 = unconstrained dimension."""
+        return jnp.asarray(
+            res.weights_vector(dict(self.headroom)), jnp.int64
+        )
+
+
 @dataclasses.dataclass(frozen=True)
 class CycleConfig:
     """One scheduling cycle's plugin set and weights.
@@ -113,6 +186,14 @@ class CycleConfig:
     enable_fit_score: bool = True
     wave: int = 1
     top_m: int = 4
+    # fused scoring terms (ISSUE 15; solver/terms.py registry): None =
+    # term disabled.  Frozen hashable dataclasses — the configs are
+    # static jit arguments, and the registry derives each term's score
+    # upper bound from them (solver/topk.py score_upper_bound), so the
+    # jit cache and the serving top-k path never key on data.
+    heterogeneity: "HeterogeneityTermArgs | None" = None
+    sensitivity: "SensitivityTermArgs | None" = None
+    packing: "PackingTermArgs | None" = None
 
     def __post_init__(self):
         object.__setattr__(

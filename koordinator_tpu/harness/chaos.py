@@ -621,6 +621,8 @@ class ChaosTraceReplay:
             # bucket/derived-column shape the trace touches compiles
             # BEFORE the measured chaos pass, so the post-recovery
             # tail can be held at zero jit cache misses
+            # TraceReplay defaults the oracle's cfg from engine_kw, so
+            # a term-enabled servicer_kw warms up parity-consistent
             TraceReplay(
                 self.trace, engine_kw=self.servicer_kw, warmup=False
             )._replay_once(record=False)
@@ -642,7 +644,13 @@ class ChaosTraceReplay:
             sock = os.path.join(tmp, "engine.sock")
             osock = os.path.join(tmp, "oracle.sock")
             leader, journal, server = self._start_leader(sock)
-            oracle_sv = ScorerServicer(trace_export=False, **ORACLE_KW)
+            # the oracle must score under the ENGINE's CycleConfig
+            # (fused scoring terms included, ISSUE 15) or a term-enabled
+            # chaos replay would fail parity by construction
+            oracle_kw = dict(ORACLE_KW)
+            if "cfg" in self.servicer_kw:
+                oracle_kw["cfg"] = self.servicer_kw["cfg"]
+            oracle_sv = ScorerServicer(trace_export=False, **oracle_kw)
             oracle_server = make_server(servicer=oracle_sv)
             oracle_server.add_insecure_port(f"unix://{osock}")
             oracle_server.start()
@@ -671,6 +679,16 @@ class ChaosTraceReplay:
                     quota_used=model.quse,
                     quota_limited=model.qlim,
                 )
+                if model.tput is not None:
+                    # fused-term state (ISSUE 15): the chaos gate
+                    # exercises throughput/sensitivity drift on the
+                    # warm delta path like any other event
+                    full_kw.update(
+                        node_accel_type=list(model.accel),
+                        workload_class=list(model.wclass),
+                        pod_sensitivity=model.sens,
+                        throughput=model.tput,
+                    )
                 k = trace.config.top_k
                 engine.sync(**full_kw)
                 oracle.sync(**full_kw)

@@ -71,6 +71,25 @@ _BAND_BASE_PRIORITY = {
 }
 RPCS = ("sync", "score", "assign", "cycle")
 
+# the default event mix with the fused-term kinds folded in (ISSUE 15):
+# a trace generated with TraceConfig(mix=TERM_MIX, accel_types=...,
+# workload_classes=...) drifts throughput rows and sensitivity profiles
+# on the warm delta path like any other event
+TERM_MIX = (
+    ("gang_arrival", 0.10),
+    ("gang_partial", 0.04),
+    ("pod_arrival", 0.20),
+    ("pod_departure", 0.14),
+    ("priority_churn", 0.10),
+    ("quota_wave", 0.10),
+    ("usage_tick", 0.10),
+    ("node_drain", 0.04),
+    ("node_restore", 0.03),
+    ("node_resize", 0.03),
+    ("throughput_update", 0.06),
+    ("sensitivity_drift", 0.06),
+)
+
 
 class TraceParityError(AssertionError):
     """The engine servicer's reply bytes diverged from the serial
@@ -107,6 +126,15 @@ class TraceConfig:
     )
     # arrival probability per band, aligned with BANDS
     band_mix: Tuple[float, ...] = (0.35, 0.20, 0.30, 0.15)
+    # fused scoring-term state (ISSUE 15): >0 gives every node an
+    # accelerator type in [0, accel_types), every pod a workload class
+    # in [0, workload_classes) plus a sensitivity profile, and the init
+    # a [workload_classes, accel_types] throughput matrix — enabling
+    # the throughput_update / sensitivity_drift event kinds (TERM_MIX
+    # is the default mix with both folded in).  0 = terms off, init
+    # unchanged.
+    accel_types: int = 0
+    workload_classes: int = 0
 
     def to_doc(self) -> Dict[str, object]:
         doc = dataclasses.asdict(self)
@@ -250,6 +278,21 @@ class ClusterModel:
         self.qrt = np.asarray(init["qrt"], np.int64).copy()
         self.quse = np.asarray(init["quse"], np.int64).copy()
         self.qlim = np.asarray(init["qlim"], np.int64).copy()
+        # fused-term state (ISSUE 15); absent keys = terms off
+        self.accel = (
+            [int(v) for v in init["accel"]] if "accel" in init else None
+        )
+        self.wclass = (
+            [int(v) for v in init["wclass"]] if "wclass" in init else None
+        )
+        self.sens = (
+            np.asarray(init["sens"], np.int64).copy()
+            if "sens" in init else None
+        )
+        self.tput = (
+            np.asarray(init["tput"], np.int64).copy()
+            if "tput" in init else None
+        )
 
     def apply(self, event: TraceEvent) -> Set[str]:
         """Apply one event's concrete payload; returns the changed
@@ -280,6 +323,14 @@ class ClusterModel:
                 self.nuse[node] = p["usage"][i]
                 self.fresh[node] = bool(p["fresh"][i])
             return {"nuse", "fresh"}
+        if kind == "throughput_update":
+            for i, row in enumerate(p["rows"]):
+                self.tput[row] = p["values"][i]
+            return {"tput"}
+        if kind == "sensitivity_drift":
+            for i, slot in enumerate(p["slots"]):
+                self.sens[slot] = p["profiles"][i]
+            return {"sens"}
         raise ValueError(f"unknown trace event kind {kind!r}")
 
 
@@ -443,6 +494,34 @@ def _next_event(cfg: TraceConfig, rng, model: ClusterModel,
         return TraceEvent(kind, INFRA_BAND, {
             "node": int(node), "allocatable": [int(v) for v in row],
         })
+    if kind == "throughput_update" and model.tput is not None:
+        # one workload class's measured throughput moved (a profiling
+        # round finished, a kernel regressed): concrete new row values,
+        # normalized to [0, 100] like the wire contract
+        row = int(rng.integers(0, model.tput.shape[0]))
+        values = [
+            int(v) for v in rng.integers(0, 101, model.tput.shape[1])
+        ]
+        return TraceEvent(kind, INFRA_BAND, {
+            "rows": [row], "values": [values],
+        })
+    if kind == "sensitivity_drift" and model.sens is not None:
+        # a few pods' CPU/mem sensitivity profiles re-estimated
+        count = min(model.sens.shape[0], int(rng.integers(1, 5)))
+        slots = sorted(
+            int(s) for s in rng.choice(
+                model.sens.shape[0], count, replace=False
+            )
+        )
+        profiles = []
+        for _ in slots:
+            prof = [0] * R
+            prof[_CPU] = int(rng.integers(0, 101))
+            prof[_MEM] = int(rng.integers(0, 101))
+            profiles.append(prof)
+        return TraceEvent(kind, INFRA_BAND, {
+            "slots": slots, "profiles": profiles,
+        })
     if kind == "usage_tick":
         count = max(1, model.nuse.shape[0] // 4)
         nodes = sorted(
@@ -511,7 +590,7 @@ def _build_init(cfg: TraceConfig, rng) -> Dict[str, object]:
         qrt[t, _CPU] = total_cpu * 6 // 10 // Q
         qrt[t, _MEM] = total_mem * 6 // 10 // Q
         qlim[t, _CPU] = qlim[t, _MEM] = 1
-    return {
+    init = {
         "nalloc": nalloc.tolist(), "nreq": nreq.tolist(),
         "nuse": nuse.tolist(), "fresh": fresh,
         "preq": preq.tolist(), "pest": pest.tolist(),
@@ -519,6 +598,22 @@ def _build_init(cfg: TraceConfig, rng) -> Dict[str, object]:
         "gang_min": [cfg.gang_min_member] * G,
         "qrt": qrt.tolist(), "quse": quse.tolist(), "qlim": qlim.tolist(),
     }
+    if cfg.accel_types > 0 and cfg.workload_classes > 0:
+        # fused-term state (ISSUE 15): heterogeneous accelerator fleet,
+        # per-pod workload classes + sensitivity profiles, and the
+        # [C, A] throughput matrix — all concrete, digest-pinned like
+        # every other init key
+        A_, C_ = cfg.accel_types, cfg.workload_classes
+        init["accel"] = [int(rng.integers(0, A_)) for _ in range(N)]
+        init["wclass"] = [int(rng.integers(0, C_)) for _ in range(P)]
+        sens = np.zeros((P, R), np.int64)
+        sens[:, _CPU] = rng.integers(0, 101, P)
+        sens[:, _MEM] = rng.integers(0, 101, P)
+        init["sens"] = sens.tolist()
+        init["tput"] = rng.integers(0, 101, (C_, A_)).astype(
+            np.int64
+        ).tolist()
+    return init
 
 
 def generate_trace(cfg: TraceConfig) -> Trace:
@@ -692,6 +787,11 @@ class TraceReplay:
         self.trace = trace
         self.engine_kw = dict(engine_kw or {})
         self.oracle_kw = dict(oracle_kw or ORACLE_KW)
+        # the oracle must score under the ENGINE's CycleConfig (fused
+        # scoring terms included, ISSUE 15) or a term-enabled replay
+        # fails parity by construction; explicit oracle_kw cfg wins
+        if "cfg" in self.engine_kw and "cfg" not in self.oracle_kw:
+            self.oracle_kw["cfg"] = self.engine_kw["cfg"]
         self.slow_score_ms = float(slow_score_ms)
         self.retrace_budget = int(retrace_budget)
         self.warmup = bool(warmup)
@@ -785,6 +885,13 @@ class TraceReplay:
             quota_used=model.quse,
             quota_limited=model.qlim,
         )
+        if model.tput is not None:
+            full_kw.update(
+                node_accel_type=list(model.accel),
+                workload_class=list(model.wclass),
+                pod_sensitivity=model.sens,
+                throughput=model.tput,
+            )
         k = trace.config.top_k
         engine.sync(**full_kw)
         oracle.sync(**full_kw)
@@ -900,6 +1007,10 @@ class TraceReplay:
             kw["quota_runtime"] = model.qrt
         if "quse" in changed:
             kw["quota_used"] = model.quse
+        if "tput" in changed:
+            kw["throughput"] = model.tput
+        if "sens" in changed:
+            kw["pod_sensitivity"] = model.sens
         return kw
 
     @staticmethod

@@ -154,6 +154,10 @@ class NodeBatch:
     # nil getTargetAggregatedUsage (filter passes, score uses plain usage)
     agg_fresh: "jnp.ndarray | None" = None  # bool[N, A]
     prod_usage: "jnp.ndarray | None" = None  # i64[N, R] sum of prod pods' usage
+    # accelerator type per node (ISSUE 15 heterogeneity term): index
+    # into the throughput matrix's accelerator axis; None = never
+    # synced (the term treats every node as type 0)
+    accel_type: "jnp.ndarray | None" = None  # i32[N]
     names: Tuple[str, ...] = ()
 
     @property
@@ -173,6 +177,12 @@ class PodBatch:
     gang_id: jnp.ndarray  # i32[P] index into GangTable, -1 = no gang
     quota_id: jnp.ndarray  # i32[P] index into QuotaTable, -1 = no quota
     valid: jnp.ndarray  # bool[P] padding mask
+    # fused-term pod columns (ISSUE 15): workload class indexes the
+    # throughput matrix's class axis (heterogeneity); sensitivity is the
+    # Synergy-style per-resource profile in [0, 100].  None = never
+    # synced — the terms are inert for the missing half.
+    workload_class: "jnp.ndarray | None" = None  # i32[P]
+    sensitivity: "jnp.ndarray | None" = None  # i64[P, R]
     names: Tuple[str, ...] = ()
 
     @property
@@ -217,6 +227,11 @@ class ClusterSnapshot:
     pods: PodBatch
     gangs: GangTable
     quotas: QuotaTable
+    # per-(workload class, accelerator type) throughput matrix
+    # (ISSUE 15 heterogeneity term, Gavel 2008.09213): [C, A] i64 with
+    # values normalized to [0, MAX_NODE_SCORE]; replicated over the
+    # cluster mesh.  None = the term has no data and contributes nothing.
+    throughput: "jnp.ndarray | None" = None
 
     @property
     def num_nodes(self) -> int:
@@ -241,6 +256,7 @@ for _cls, _data in (
             "agg_usage",
             "agg_fresh",
             "prod_usage",
+            "accel_type",
         ],
     ),
     (
@@ -254,6 +270,8 @@ for _cls, _data in (
             "gang_id",
             "quota_id",
             "valid",
+            "workload_class",
+            "sensitivity",
         ],
     ),
     (GangTable, ["min_member", "valid"]),
@@ -267,7 +285,9 @@ for _cls, _data in (
         _cls, data_fields=_data, meta_fields=["names"]
     )
 jax.tree_util.register_dataclass(
-    ClusterSnapshot, data_fields=["nodes", "pods", "gangs", "quotas"], meta_fields=[]
+    ClusterSnapshot,
+    data_fields=["nodes", "pods", "gangs", "quotas", "throughput"],
+    meta_fields=[],
 )
 
 
@@ -344,6 +364,7 @@ def encode_snapshot(
     scaling_factors: Mapping[str, int] = DEFAULT_ESTIMATED_SCALING_FACTORS,
     node_bucket: Optional[int] = None,
     pod_bucket: Optional[int] = None,
+    throughput: Optional[Sequence[Sequence[int]]] = None,
 ) -> ClusterSnapshot:
     """Encode plain-dict cluster state into a padded ClusterSnapshot.
 
@@ -355,6 +376,12 @@ def encode_snapshot(
     Gang dict: ``{"name", "min_member": int}``.
     Quota dict: ``{"name", "runtime": {...}, "used": {...}}`` (runtime from
     ``constraints.quota.refresh_runtime``).
+
+    Fused-term data (ISSUE 15; all optional — the resulting leaves stay
+    None when no input mentions them, so existing callers' snapshot
+    structure is unchanged): node ``"accel_type"`` (int), pod
+    ``"workload_class"`` (int) and ``"sensitivity"`` ({res: 0..100}),
+    and the ``throughput`` [C, A] matrix keyword.
     """
     n_bucket = node_bucket or pad_bucket(len(nodes))
     p_bucket = pod_bucket or pad_bucket(len(pods))
@@ -374,6 +401,8 @@ def encode_snapshot(
     node_agg = np.zeros((n_bucket, n_pct, R), np.int64)
     node_agg_fresh = np.zeros((n_bucket, n_pct), bool)
     node_prod = np.zeros((n_bucket, R), np.int64)
+    node_accel = np.zeros((n_bucket,), np.int32)
+    any_accel = any("accel_type" in nd for nd in nodes)
     for i, nd in enumerate(nodes):
         node_alloc[i] = res.resource_vector(nd.get("allocatable", {}))
         node_req[i] = res.resource_vector(nd.get("requested", {}))
@@ -390,6 +419,8 @@ def encode_snapshot(
                     node_agg_fresh[i, a] = True
         if nd.get("prod_usage") is not None:
             node_prod[i] = res.resource_vector(nd["prod_usage"])
+        if nd.get("accel_type") is not None:
+            node_accel[i] = int(nd["accel_type"])
 
     pod_req = np.zeros((p_bucket, R), np.int64)
     pod_est = np.zeros((p_bucket, R), np.int64)
@@ -399,6 +430,10 @@ def encode_snapshot(
     pod_gang = np.full((p_bucket,), -1, np.int32)
     pod_quota = np.full((p_bucket,), -1, np.int32)
     pod_valid = np.zeros((p_bucket,), bool)
+    pod_wclass = np.zeros((p_bucket,), np.int32)
+    pod_sens = np.zeros((p_bucket, R), np.int64)
+    any_wclass = any("workload_class" in pd for pd in pods)
+    any_sens = any("sensitivity" in pd for pd in pods)
     for i, pd in enumerate(pods):
         req_vec = res.resource_vector(pd.get("requests", {}))
         lim_vec = res.resource_vector(pd.get("limits", {}))
@@ -420,6 +455,10 @@ def encode_snapshot(
             pod_gang[i] = gang_index.get(pd["gang"], -1)
         if pd.get("quota") is not None:
             pod_quota[i] = quota_index.get(pd["quota"], -1)
+        if pd.get("workload_class") is not None:
+            pod_wclass[i] = int(pd["workload_class"])
+        if pd.get("sensitivity") is not None:
+            pod_sens[i] = res.resource_vector(pd["sensitivity"])
         pod_valid[i] = True
 
     gang_min = np.zeros((g_bucket,), np.int32)
@@ -457,6 +496,7 @@ def encode_snapshot(
             agg_usage=jnp.asarray(node_agg),
             agg_fresh=jnp.asarray(node_agg_fresh),
             prod_usage=jnp.asarray(node_prod),
+            accel_type=jnp.asarray(node_accel) if any_accel else None,
             names=tuple(nd.get("name", f"node-{i}") for i, nd in enumerate(nodes)),
         ),
         pods=PodBatch(
@@ -468,6 +508,8 @@ def encode_snapshot(
             gang_id=jnp.asarray(pod_gang),
             quota_id=jnp.asarray(pod_quota),
             valid=jnp.asarray(pod_valid),
+            workload_class=jnp.asarray(pod_wclass) if any_wclass else None,
+            sensitivity=jnp.asarray(pod_sens) if any_sens else None,
             names=tuple(pd.get("name", f"pod-{i}") for i, pd in enumerate(pods)),
         ),
         gangs=GangTable(
@@ -481,5 +523,10 @@ def encode_snapshot(
             limited=jnp.asarray(quota_limited),
             valid=jnp.asarray(quota_valid),
             names=tuple(q["name"] for q in quotas),
+        ),
+        throughput=(
+            jnp.asarray(np.asarray(throughput, np.int64))
+            if throughput is not None
+            else None
         ),
     )

@@ -32,6 +32,7 @@ koord_scorer_assign_memo_total         counter   result (hit|miss)
 koord_scorer_score_memo_total          counter   result (hit|miss)
 koord_scorer_score_incr_total          counter   result (incr|full|fallback)
 koord_scorer_incr_cols                 histogram —
+koord_scorer_term_total                counter   term (heterogeneity|sensitivity|packing)
 koord_scorer_shed_total                counter   method (score|assign)
 koord_scorer_shed_band_total           counter   band (koord-prod|mid|batch|free|none)
 koord_scorer_deadline_expired_total    counter   stage (queue|gather)
@@ -125,6 +126,7 @@ ASSIGN_MEMO = "koord_scorer_assign_memo_total"
 SCORE_MEMO = "koord_scorer_score_memo_total"
 SCORE_INCR = "koord_scorer_score_incr_total"
 INCR_COLS = "koord_scorer_incr_cols"
+TERM_TOTAL = "koord_scorer_term_total"
 SHED_TOTAL = "koord_scorer_shed_total"
 SHED_BAND = "koord_scorer_shed_band_total"
 DEADLINE_EXPIRED = "koord_scorer_deadline_expired_total"
@@ -222,6 +224,10 @@ _FAMILIES = (
     (INCR_COLS, "histogram",
      "dirty node columns recomputed per incremental Score launch "
      "(O(P x d) of the O(P x N) a full rescore pays)"),
+    (TERM_TOTAL, "counter",
+     "fused scoring-term activations by term name, one per device "
+     "Score launch with the term enabled (ISSUE 15: heterogeneity/"
+     "sensitivity/packing ride the ONE pods x nodes launch)"),
     (SHED_TOTAL, "counter",
      "read RPCs the admission gate refused with RESOURCE_EXHAUSTED "
      "(queue depth at the band's rung of --max-inflight), by method; "
@@ -445,6 +451,13 @@ class ScorerMetrics:
 
     def observe_incr_cols(self, cols: int) -> None:
         self.registry.histogram_observe(INCR_COLS, float(cols))
+
+    def count_term(self, term: str, n: int = 1) -> None:
+        """One fused scoring term's activation on a device Score launch
+        (ISSUE 15) — per launch per enabled term, so the series ratio
+        term_total / score launches proves the terms rode the ONE
+        launch instead of extra per-plugin passes."""
+        self.registry.counter_add(TERM_TOTAL, n, {"term": term})
 
     # -- replicated serving tier (ISSUE 8) --
     def count_shed(self, method: str, band: str = "") -> None:

@@ -150,10 +150,18 @@ def _snapshot_spec_tree(snap: ClusterSnapshot, node, rep):
             prod_usage=(
                 None if nodes.prod_usage is None else node(nodes.prod_usage)
             ),
+            accel_type=(
+                None if nodes.accel_type is None else node(nodes.accel_type)
+            ),
         ),
         pods=jax.tree_util.tree_map(rep, snap.pods),
         gangs=jax.tree_util.tree_map(rep, snap.gangs),
         quotas=jax.tree_util.tree_map(rep, snap.quotas),
+        # the throughput matrix (ISSUE 15) is a small [C, A] side table
+        # every shard's gather reads: replicated, like the pod rows
+        throughput=(
+            None if snap.throughput is None else rep(snap.throughput)
+        ),
     )
 
 
@@ -211,6 +219,10 @@ def shard_snapshot_for_scoring(snap: ClusterSnapshot, mesh: Mesh) -> ClusterSnap
             usage=jax.device_put(nodes.usage, node2),
             metric_fresh=jax.device_put(nodes.metric_fresh, node1),
             valid=jax.device_put(nodes.valid, node1),
+            accel_type=(
+                None if nodes.accel_type is None
+                else jax.device_put(nodes.accel_type, node1)
+            ),
         ),
         pods=dataclass_replace(
             pods,
@@ -225,6 +237,10 @@ def shard_snapshot_for_scoring(snap: ClusterSnapshot, mesh: Mesh) -> ClusterSnap
         ),
         gangs=jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), snap.gangs),
         quotas=jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), snap.quotas),
+        throughput=(
+            None if snap.throughput is None
+            else jax.device_put(snap.throughput, rep)
+        ),
     )
 
 
@@ -248,10 +264,18 @@ def shard_snapshot_for_assign(snap: ClusterSnapshot, mesh: Mesh) -> ClusterSnaps
             usage=jax.device_put(nodes.usage, node2),
             metric_fresh=jax.device_put(nodes.metric_fresh, node1),
             valid=jax.device_put(nodes.valid, node1),
+            accel_type=(
+                None if nodes.accel_type is None
+                else jax.device_put(nodes.accel_type, node1)
+            ),
         ),
         pods=jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), snap.pods),
         gangs=jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), snap.gangs),
         quotas=jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), snap.quotas),
+        throughput=(
+            None if snap.throughput is None
+            else jax.device_put(snap.throughput, rep)
+        ),
     )
 
 

@@ -185,6 +185,15 @@ def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None, i32_ok=Non
     ``i32_ok``: callers that already know whether the snapshot fits the
     kernel's i32 arithmetic (e.g. the bridge server, which checks host-side
     numpy mirrors at Sync time) pass it to skip the per-cycle device check.
+
+    Fused scoring terms (ISSUE 15): a ``cfg`` with term configs set
+    materializes the registry's cellwise [P, N] tensors ONCE
+    (solver/terms.py ``term_extras``, one async launch, no readback) and
+    folds them into ``extra_mask``/``extra_scores`` — the scan, the wave
+    path and the Pallas kernels all consume the fused total through the
+    seam they already had.  A terms-only extra needs NO device
+    reduction for its magnitude bound: the registry's bound is a config
+    property (``terms_upper_bound``).
     """
     import jax
 
@@ -193,6 +202,16 @@ def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None, i32_ok=Non
     if cfg is None:
         cfg = DEFAULT_CYCLE_CONFIG
     backend = jax.default_backend()
+    caller_scores = extra_scores
+    from koordinator_tpu.solver.terms import term_extras, terms_upper_bound
+
+    t_scores, t_mask = term_extras(snapshot, cfg)
+    if t_scores is not None:
+        extra_scores = (
+            t_scores if extra_scores is None else extra_scores + t_scores
+        )
+    if t_mask is not None:
+        extra_mask = t_mask if extra_mask is None else extra_mask & t_mask
     has_extras = extra_mask is not None or extra_scores is not None
     shape_key = (
         backend,
@@ -207,11 +226,19 @@ def run_cycle(snapshot, cfg=None, extra_mask=None, extra_scores=None, i32_ok=Non
     extras_ok = True
     scores_hi = None
     if extra_scores is not None:
-        import jax.numpy as jnp
+        # magnitude bound for the kernel's i32 accumulation headroom and
+        # the wave path's packed-key range.  Terms-only extras take the
+        # STATIC registry bound (no device sync on the warm Assign
+        # path); caller extras still need the one device reduction, and
+        # a composed total is bounded by the sum of the two bounds.
+        if caller_scores is None:
+            scores_hi = terms_upper_bound(cfg)
+        else:
+            import jax.numpy as jnp
 
-        # ONE device reduction serves both bounds: the kernel's i32
-        # accumulation headroom and the wave path's packed-key range
-        scores_hi = int(jnp.max(jnp.abs(extra_scores)))
+            scores_hi = int(jnp.max(jnp.abs(caller_scores)))
+            if t_scores is not None:
+                scores_hi += terms_upper_bound(cfg)
         extras_ok = scores_hi < 2**29
     if (
         backend != "cpu"

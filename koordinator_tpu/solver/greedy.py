@@ -197,6 +197,12 @@ def score_all(snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG
     cellwise in (pod row, node row), which is exactly what makes
     "gather rows, score, scatter back" bit-identical to a full rescore,
     and sharing the body is what keeps the two engines from drifting.
+
+    The fused scoring-term registry (ISSUE 15, solver/terms.py) rides
+    the same body: heterogeneity / sensitivity / packing contributions
+    are added INSIDE this one tensor program — cellwise by contract, so
+    the incremental exactness argument extends to them unchanged and a
+    three-term Score still costs exactly one launch.
     """
     pods, nodes = snapshot.pods, snapshot.nodes
     feasible = fit_mask(
@@ -219,7 +225,9 @@ def score_all(snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG
         _fit_score_requests(pods.requests),
         pods.estimated,
     )
-    return scores, feasible
+    from koordinator_tpu.solver.terms import apply_terms
+
+    return apply_terms(snapshot, cfg, scores, feasible)
 
 
 @partial(jax.jit, static_argnames=("cfg",))

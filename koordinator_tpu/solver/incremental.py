@@ -76,11 +76,13 @@ def _take_nodes(nodes, idx):
         agg_usage=take(nodes.agg_usage),
         agg_fresh=take(nodes.agg_fresh),
         prod_usage=take(nodes.prod_usage),
+        accel_type=take(nodes.accel_type),
     )
 
 
 def _take_pods(pods, idx):
     take = lambda a: jnp.take(a, idx, axis=0)
+    opt = lambda a: None if a is None else take(a)
     return dataclasses.replace(
         pods,
         requests=take(pods.requests),
@@ -91,6 +93,8 @@ def _take_pods(pods, idx):
         gang_id=take(pods.gang_id),
         quota_id=take(pods.quota_id),
         valid=take(pods.valid),
+        workload_class=opt(pods.workload_class),
+        sensitivity=opt(pods.sensitivity),
     )
 
 

@@ -51,6 +51,8 @@ from __future__ import annotations
 
 from functools import partial
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -64,13 +66,21 @@ _F32_EXACT = 1 << 24
 
 def score_upper_bound(cfg) -> int:
     """Static upper bound of ``score_cycle``'s combined scores under
-    ``cfg`` (scores are >= 0: every term clamps at zero)."""
+    ``cfg`` (scores are >= 0: every term clamps at zero).  Term-aware
+    (ISSUE 15): every fused scoring term's registry entry declares its
+    own config-derived bound (solver/terms.py ``terms_upper_bound`` —
+    each term clamps its device contribution to
+    ``[0, weight * MAX_NODE_SCORE]``), so the f32-exact fast path keeps
+    running with terms enabled instead of silently picking the wrong
+    rank path."""
+    from koordinator_tpu.solver.terms import terms_upper_bound
+
     hi = 0
     if cfg.enable_fit_score:
         hi += MAX_NODE_SCORE * int(cfg.fit_plugin_weight)
     if cfg.enable_loadaware:
         hi += MAX_NODE_SCORE * int(cfg.loadaware_plugin_weight)
-    return hi
+    return hi + terms_upper_bound(cfg)
 
 
 @partial(jax.jit, static_argnames=("k", "hi"))
@@ -101,3 +111,32 @@ def masked_top_k(scores, feasible, *, k, hi):
         return ts, ti  # normalized: top_k's multi-result is a list
 
     return lax.cond(in_bound, _fast, _exact, (masked, feasible, scores))
+
+
+def masked_top_k_host(scores_np, feasible_np, k: int):
+    """Host-numpy twin of :func:`masked_top_k` — bit-identical values,
+    indices and tie-breaks, no device involved.
+
+    Used by the brownout cache (ISSUE 13 / ROADMAP 6(a)): while the
+    circuit breaker is open the server answers from the last launch's
+    cached [P, N] readback, and a request wanting a WIDER top-k than
+    that launch computed must be ranked on host — touching the failing
+    device is the one thing the brownout path must never do.
+
+    Exactness: ``lax.top_k`` orders descending with ties broken toward
+    the LOWER index.  A descending stable sort with that tie-break is an
+    ASCENDING stable argsort of the order-reversed key; i64 negation
+    overflows at i64.min (the masked infeasible sentinel), so the key is
+    built order-preservingly in uint64 (``x ^ 2^63``) and reversed
+    bitwise (``~``) — no overflow, exact total order.  Returned values
+    are gathered from the masked tensor, exactly like the device paths.
+    """
+    scores_np = np.asarray(scores_np, np.int64)
+    feasible_np = np.asarray(feasible_np, bool)
+    masked = np.where(
+        feasible_np, scores_np, np.iinfo(np.int64).min
+    )
+    biased = masked.view(np.uint64) ^ np.uint64(1 << 63)
+    ti = np.argsort(~biased, axis=-1, kind="stable")[..., :k]
+    ts = np.take_along_axis(masked, ti, axis=-1)
+    return ts, ti.astype(np.int32)

@@ -245,6 +245,56 @@ class TestArtifactSchemaTruncatedAndCoalesce:
         assert bench._extrapolate_serial(70.0, 200, 192) == 70.0
 
 
+class TestArtifactSchemaPluginFields:
+    """ISSUE 15: the --config plugins fields — fused-vs-sequential
+    term speedup, its wall components, and the term-enabled warm
+    Score cost."""
+
+    def _line(self, **extra):
+        doc = {"metric": "plugins", "value": 1.0, "unit": "x"}
+        doc.update(extra)
+        return json.dumps(doc)
+
+    def test_valid_plugin_fields_pass(self):
+        assert bench._validate_artifact(self._line(
+            plugin_terms=3,
+            plugin_fused_speedup=2.1,
+            plugin_fused_ms=5000.0,
+            plugin_oracle_ms=7000.0,
+            plugin_base_ms=3200.0,
+            plugin_warm_score_ms=450.0,
+        )) == []
+
+    def test_malformed_plugin_fields_fail(self):
+        assert bench._validate_artifact(self._line(plugin_terms=0))
+        assert bench._validate_artifact(self._line(plugin_terms=True))
+        assert bench._validate_artifact(self._line(plugin_terms=2.5))
+        assert bench._validate_artifact(
+            self._line(plugin_fused_speedup=-1.0)
+        )
+        assert bench._validate_artifact(
+            self._line(plugin_fused_speedup=float("nan"))
+        )
+        assert bench._validate_artifact(self._line(plugin_fused_ms=-2))
+        assert bench._validate_artifact(self._line(plugin_oracle_ms="x"))
+        assert bench._validate_artifact(self._line(plugin_base_ms=-0.1))
+        assert bench._validate_artifact(
+            self._line(plugin_warm_score_ms=float("inf"))
+        )
+
+    def test_deadline_flush_covers_the_plugins_leg(self):
+        # a deadline-flushed plugins artifact must validate: rc=124 on
+        # the new config can never again mean "no artifact"
+        emitted = []
+        d = bench._ArtifactDeadline(
+            1000.0, emit=emitted.append, metric="plugins"
+        )
+        line = d.artifact_line("timeout")
+        assert json.loads(line)["metric"] == "plugins"
+        assert json.loads(line)["truncated"] is True
+        assert bench._validate_artifact(line) == []
+
+
 class TestArtifactSchemaWaveFields:
     def _line(self, **extra):
         doc = {"metric": "m", "value": 1.0, "unit": "ms"}

@@ -356,6 +356,89 @@ class TestCircuitBreaker:
         # equal the stale launch's bytes (same geometry, bounded lag)
         assert reply.flat.SerializeToString() == fresh
 
+    def test_brownout_serves_wider_k_from_full_cache(self):
+        # ROADMAP 6(a): the cache holds the launch's FULL [P, N] scores
+        # (under the cell gate), so a breaker-open request wanting a
+        # WIDER top-k than the cached launch computed is ranked on host
+        # (masked_top_k_host, bit-identical) instead of refused
+        sv = ScorerServicer(breaker_cooldown_ms=60.0, brownout_max_lag=2)
+        sv.sync(_full_sync_request(nodes=24))
+        twin = ScorerServicer()
+        twin.sync(_full_sync_request(nodes=24))
+        want = twin.score(pb2.ScoreRequest(
+            snapshot_id=twin.snapshot_id(), top_k=16, flat=True
+        )).flat.SerializeToString()
+        # cached launch computes only the k=4 bucket (kb=8 < 16)
+        _score(sv)
+        with fail_next_launch(sv, n=3):
+            for _ in range(3):
+                with pytest.raises(RuntimeError):
+                    _score(sv)
+        reply = sv.score(pb2.ScoreRequest(
+            snapshot_id=sv.snapshot_id(), top_k=16, flat=True
+        ))
+        assert reply.degraded
+        assert reply.flat.SerializeToString() == want
+
+    def test_brownout_wider_k_concurrent_serves_identical(self):
+        # the widen memoization is decided on a LOCKED snapshot of the
+        # entry: concurrent wide requests racing the first widen must
+        # all serve the full bit-identical wide reply, never a
+        # truncated pre-widen prefix
+        sv = ScorerServicer(breaker_cooldown_ms=60_000.0,
+                            brownout_max_lag=2)
+        sv.sync(_full_sync_request(nodes=24))
+        twin = ScorerServicer()
+        twin.sync(_full_sync_request(nodes=24))
+        want = twin.score(pb2.ScoreRequest(
+            snapshot_id=twin.snapshot_id(), top_k=16, flat=True
+        )).flat.SerializeToString()
+        _score(sv)  # cached launch kb=8
+        with fail_next_launch(sv, n=3):
+            for _ in range(3):
+                with pytest.raises(RuntimeError):
+                    _score(sv)
+        replies = [None] * 8
+
+        def wide(i):
+            replies[i] = sv.score(pb2.ScoreRequest(
+                snapshot_id=sv.snapshot_id(), top_k=16, flat=True
+            ))
+
+        threads = [
+            threading.Thread(target=wide, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for reply in replies:
+            assert reply.degraded
+            assert reply.flat.SerializeToString() == want
+
+    def test_brownout_wider_k_still_refused_past_the_cell_gate(
+        self, monkeypatch
+    ):
+        # with the full-scores cache gated off (KOORD_BROWNOUT_FULL_
+        # CELLS=0) the pre-ROADMAP-6(a) behavior stands: a wider-k
+        # degraded request is refused, never invented
+        monkeypatch.setenv("KOORD_BROWNOUT_FULL_CELLS", "0")
+        sv = ScorerServicer(breaker_cooldown_ms=60.0, brownout_max_lag=2)
+        sv.sync(_full_sync_request(nodes=24))
+        _score(sv)
+        with fail_next_launch(sv, n=3):
+            for _ in range(3):
+                with pytest.raises(RuntimeError):
+                    _score(sv)
+        with pytest.raises(BreakerOpen):
+            sv.score(pb2.ScoreRequest(
+                snapshot_id=sv.snapshot_id(), top_k=16, flat=True
+            ))
+        # ...while a k within the cached bucket still serves degraded
+        assert sv.score(pb2.ScoreRequest(
+            snapshot_id=sv.snapshot_id(), top_k=4, flat=True
+        )).degraded
+
     def test_brownout_refuses_past_the_staleness_bound(self, servicer):
         with fail_next_launch(servicer, n=3):
             for _ in range(3):

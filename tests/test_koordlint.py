@@ -503,6 +503,109 @@ class TestRetraceHazard:
         assert len(got) == 1 and "PR-1" in got[0].message
 
 
+class TestTermConfigRule:
+    """retrace-hazard shape 5 (ISSUE 15): CycleConfig term configs must
+    be frozen/hashable, mappings through _freeze."""
+
+    CLEAN = """
+    import dataclasses
+
+    def _freeze(m):
+        return tuple(sorted(m.items())) if not isinstance(m, tuple) else m
+
+    @dataclasses.dataclass(frozen=True)
+    class PackingTermArgs:
+        weight: int = 1
+        headroom: ResMap = ()
+
+        def __post_init__(self):
+            object.__setattr__(self, "headroom", _freeze(self.headroom))
+
+    @dataclasses.dataclass(frozen=True)
+    class CycleConfig:
+        packing: "PackingTermArgs | None" = None
+        wave: int = 1
+    """
+
+    def test_compliant_term_config_is_clean(self):
+        assert lint(self.CLEAN, rules=["retrace-hazard"]) == []
+
+    def test_unfrozen_term_config_flagged(self):
+        got = lint("""
+        import dataclasses
+
+        @dataclasses.dataclass
+        class SensitivityTermArgs:
+            weight: int = 1
+
+        @dataclasses.dataclass(frozen=True)
+        class CycleConfig:
+            sensitivity: "SensitivityTermArgs | None" = None
+        """, rules=["retrace-hazard"])
+        assert [v.rule for v in got] == ["retrace-hazard"]
+        assert "frozen=True" in got[0].message
+
+    def test_unfrozen_mapping_field_flagged(self):
+        got = lint("""
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class PackingTermArgs:
+            headroom: ResMap = ()
+
+        @dataclasses.dataclass(frozen=True)
+        class CycleConfig:
+            packing: "PackingTermArgs | None" = None
+        """, rules=["retrace-hazard"])
+        assert len(got) == 1
+        assert "_freeze" in got[0].message
+
+    def test_mutable_default_flagged(self):
+        got = lint("""
+        import dataclasses
+
+        def _freeze(m):
+            return tuple(m)
+
+        @dataclasses.dataclass(frozen=True)
+        class HetTermArgs:
+            table: list = []
+
+        @dataclasses.dataclass(frozen=True)
+        class CycleConfig:
+            heterogeneity: "HetTermArgs | None" = None
+        """, rules=["retrace-hazard"])
+        assert any("mutable" in v.message for v in got)
+
+    def test_transitive_reach_through_nested_config(self):
+        # a mapping two hops from CycleConfig is still checked
+        got = lint("""
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class AggArgs:
+            thresholds: ResMap = ()
+
+        @dataclasses.dataclass(frozen=True)
+        class LoadArgs:
+            aggregated: "AggArgs | None" = None
+
+        @dataclasses.dataclass(frozen=True)
+        class CycleConfig:
+            loadaware: LoadArgs = LoadArgs()
+        """, rules=["retrace-hazard"])
+        assert len(got) == 1 and "AggArgs.thresholds" in got[0].message
+
+    def test_no_cycleconfig_means_no_checks(self):
+        assert lint("""
+        import dataclasses
+
+        @dataclasses.dataclass
+        class Whatever:
+            stuff: dict = None
+        """, rules=["retrace-hazard"]) == []
+
+
 class TestHostSyncInJit:
     def test_all_four_sync_shapes(self):
         got = lint("""
