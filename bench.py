@@ -268,6 +268,30 @@ def _validate_artifact(line: Optional[str]) -> list:
     _finite_nonneg("plugin_oracle_ms")
     _finite_nonneg("plugin_base_ms")
     _finite_nonneg("plugin_warm_score_ms")
+    # sparse candidate-set scoring probe fields (ISSUE 16): the [P, C]
+    # serving wall at a pods x nodes scale the dense path cannot even
+    # allocate — there "OOM" is the legitimate (and expected) dense
+    # outcome, but it must be the literal string, never a fabricated
+    # number; the speedup comes from a medium scale where both fit
+    _finite_nonneg("sparse_score_ms")
+    _finite_nonneg("sparse_build_ms")
+    dsm = doc.get("dense_score_ms")
+    if dsm is not None and dsm != "OOM" and _bad_finite_nonneg(dsm):
+        problems.append(
+            "'dense_score_ms' must be null, a finite number >= 0, "
+            'or the literal "OOM"'
+        )
+    _finite_nonneg("sparse_speedup")
+    cw = doc.get("candidate_width")
+    if cw is not None and (
+        isinstance(cw, bool) or not isinstance(cw, int) or cw < 1
+    ):
+        problems.append("'candidate_width' must be an int >= 1")
+    crt = doc.get("candidate_refresh_total")
+    if crt is not None and (
+        isinstance(crt, bool) or not isinstance(crt, int) or crt < 0
+    ):
+        problems.append("'candidate_refresh_total' must be an int >= 0")
     # mesh-sharded snapshot probe fields (ISSUE 7): the per-shard Sync
     # cost and the mesh-vs-single-chip cycle numbers the acceptance
     # tracks — malformed ones must not be archived
@@ -2050,6 +2074,247 @@ def child_config(platform: str, config: str) -> None:
                     "full_warm_score_ms": round(full_warm_ms, 2),
                     "incr_score_speedup": round(warm_speedup, 2),
                     "incr_cols_rescored": round(cols_mean, 2),
+                }
+            ),
+            flush=True,
+        )
+        return
+
+    if config == "sparse":
+        # ISSUE 16: sparse candidate-set scoring — break the dense
+        # [P, N] wall.  Three stages: (1) the headline scale point,
+        # pods x nodes big enough that the dense pass cannot even
+        # allocate its [P, N, R] broadcast temporaries (dense_score_ms
+        # publishes the literal "OOM" — the RAM gate refuses to hand
+        # the OS an allocation it would kill the process over), while
+        # the sparse engine builds candidates in O(P x B) memory and
+        # serves [P, C]; (2) a medium scale where BOTH engines fit, so
+        # sparse_speedup is a measured ratio over identical replies;
+        # (3) a servicer-level warm delta/Score stream with the sparse
+        # engine on, digest-compared to the dense servicer per rep
+        # under retrace_guard(0), publishing candidate_refresh_total.
+        import jax.numpy as jnp
+
+        from koordinator_tpu.config import CycleConfig
+        from koordinator_tpu.model.snapshot import (
+            ClusterSnapshot,
+            GangTable,
+            NodeBatch,
+            PodBatch,
+            QuotaTable,
+        )
+        from koordinator_tpu.solver import (
+            build_candidates,
+            masked_top_k,
+            score_candidates,
+            score_cycle,
+            score_upper_bound,
+            sparse_top_k,
+        )
+
+        R = res.NUM_RESOURCES
+        _CPU_I = res.RESOURCE_INDEX[res.CPU]
+        _MEM_I = res.RESOURCE_INDEX[res.MEMORY]
+        _PODS_I = res.RESOURCE_INDEX[res.PODS]
+        WIDTH = int(os.environ.get("KOORD_BENCH_SPARSE_WIDTH") or 256)
+        S_NODES = int(
+            os.environ.get("KOORD_BENCH_SPARSE_NODES") or (1 << 21)
+        )
+        S_PODS = int(os.environ.get("KOORD_BENCH_SPARSE_PODS") or 512)
+        k = 32
+        cfg_sparse = CycleConfig(candidate_width=WIDTH)
+        cfg_dense = CycleConfig()
+        hi = score_upper_bound(cfg_dense)
+
+        def sparse_snapshot(n, p, n_open, seed):
+            """Narrow-feasibility cluster straight from numpy arrays
+            (no per-node python dicts — the whole point is a node count
+            the dict-based generators would crawl over): exactly
+            ``n_open`` nodes have headroom for the uniform pods, the
+            rest sit requested-to-the-brim, so every pod's exact
+            feasible count is ``n_open`` — the regime the sparse
+            engine exists for."""
+            rng = np.random.default_rng(seed)
+            nalloc = np.zeros((n, R), np.int64)
+            nalloc[:, _CPU_I] = 32_000
+            nalloc[:, _MEM_I] = 128 * 1024
+            nalloc[:, _PODS_I] = 256
+            nreq = np.zeros((n, R), np.int64)
+            nreq[:, _CPU_I] = 31_800  # 200m free < the 500m ask
+            open_rows = rng.choice(n, size=n_open, replace=False)
+            nreq[open_rows, _CPU_I] = 0
+            nuse = (nalloc * 0.3).astype(np.int64)
+            preq = np.zeros((p, R), np.int64)
+            preq[:, _CPU_I], preq[:, _MEM_I] = 500, 512
+            preq[:, _PODS_I] = 1
+            return ClusterSnapshot(
+                nodes=NodeBatch(
+                    allocatable=jnp.asarray(nalloc),
+                    requested=jnp.asarray(nreq),
+                    usage=jnp.asarray(nuse),
+                    metric_fresh=jnp.ones(n, bool),
+                    valid=jnp.ones(n, bool),
+                ),
+                pods=PodBatch(
+                    requests=jnp.asarray(preq),
+                    estimated=jnp.asarray(preq),
+                    priority_class=jnp.zeros(p, np.int32),
+                    qos=jnp.zeros(p, np.int32),
+                    priority=jnp.full(p, 5000, np.int32),
+                    gang_id=jnp.full(p, -1, np.int32),
+                    quota_id=jnp.full(p, -1, np.int32),
+                    valid=jnp.ones(p, bool),
+                ),
+                gangs=GangTable(
+                    min_member=jnp.zeros(1, np.int32),
+                    valid=jnp.zeros(1, bool),
+                ),
+                quotas=QuotaTable(
+                    runtime=jnp.zeros((1, R), np.int64),
+                    used=jnp.zeros((1, R), np.int64),
+                    limited=jnp.zeros((1, R), bool),
+                    valid=jnp.zeros(1, bool),
+                ),
+            )
+
+        def dense_once(snap):
+            s, f = score_cycle(snap, cfg_dense)
+            ts, ti = masked_top_k(s, f, k=k, hi=hi)
+            return jax.device_get((ts, ti))
+
+        def sparse_once(snap, cand):
+            s, f = score_candidates(snap, cand, cfg_sparse)
+            ts, ti, _ok = sparse_top_k(s, f, cand, k=k, hi=hi)
+            return jax.device_get((ts, ti))
+
+        # -- stage 1: the scale point the dense path cannot allocate --
+        snap_big = sparse_snapshot(
+            S_NODES, S_PODS, n_open=max(1, WIDTH // 2), seed=16
+        )
+        phase("sparse_encode", nodes=S_NODES, pods=S_PODS, width=WIDTH)
+        # the dense pass materializes [P, N, R] i64 broadcast
+        # temporaries (LoadAware's usage selection) on top of a
+        # handful of [P, N] i64 tensors; refusing past 75% of free
+        # RAM records "OOM" WITHOUT attempting — handing the OS that
+        # allocation gets the bench OOM-killed, not a measurement
+        dense_peak = S_PODS * S_NODES * 8 * (R + 4)
+        avail = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_AVPHYS_PAGES")
+        if dense_peak > 0.75 * avail:
+            dense_ms = "OOM"
+            phase("sparse_dense_oom", dense_peak_gib=round(dense_peak / 2**30, 1),
+                  avail_gib=round(avail / 2**30, 1))
+        else:
+            dense_once(snap_big)
+            dense_ms = round(min(_timed(lambda: dense_once(snap_big))
+                                 for _ in range(3)), 3)
+            phase("sparse_dense_fits", dense_score_ms=dense_ms)
+        t0 = time.perf_counter()
+        cand_b, count_b = build_candidates(snap_big, cfg_sparse)
+        jax.block_until_ready(cand_b)
+        build_ms = _ms(t0)  # cold: includes the blocked sweep's compile
+        assert int(jax.device_get(count_b).max()) <= WIDTH, (
+            "bench cluster overflowed its own candidate width"
+        )
+        sparse_once(snap_big, cand_b)  # compile the serving pair
+        sparse_ms = min(
+            _timed(lambda: sparse_once(snap_big, cand_b)) for _ in range(3)
+        )
+        phase("sparse_walls", sparse_score_ms=round(sparse_ms, 3),
+              sparse_build_ms=round(build_ms, 1))
+
+        # -- stage 2: sparse vs dense where both fit, identical replies --
+        snap_mid = sparse_snapshot(4096, 512, n_open=WIDTH // 2, seed=17)
+        cand_m, _count_m = build_candidates(snap_mid, cfg_sparse)
+        d_out = dense_once(snap_mid)
+        s_out = sparse_once(snap_mid, cand_m)
+        assert np.array_equal(d_out[0], s_out[0]) and np.array_equal(
+            np.asarray(d_out[1], np.int64), np.asarray(s_out[1], np.int64)
+        ), "sparse top-k diverged from the dense oracle at C >= feasible"
+        dense_mid = min(_timed(lambda: dense_once(snap_mid))
+                        for _ in range(3))
+        sparse_mid = min(_timed(lambda: sparse_once(snap_mid, cand_m))
+                         for _ in range(3))
+        speedup = dense_mid / max(sparse_mid, 1e-6)
+        phase("sparse_speedup", dense_mid_ms=round(dense_mid, 3),
+              sparse_mid_ms=round(sparse_mid, 3),
+              speedup=round(speedup, 2))
+
+        # -- stage 3: servicer warm stream, sparse vs dense reply bytes --
+        from koordinator_tpu.analysis import retrace_guard
+        from koordinator_tpu.bridge.codegen import pb2
+        from koordinator_tpu.bridge.server import ScorerServicer
+        from koordinator_tpu.bridge.state import numpy_to_tensor
+        from koordinator_tpu.harness.golden import build_sync_request
+        from koordinator_tpu.obs.scorer_metrics import CANDIDATE_REFRESH
+
+        nl, pl, gl, ql = generators.quota_colocation(pods=128, nodes=64)
+        sync_req, _qids = build_sync_request(
+            nl, pl, gl, ql, node_bucket=64, pod_bucket=128
+        )
+        payload = sync_req.SerializeToString()
+        sp_sv = ScorerServicer(
+            cfg=CycleConfig(candidate_width=64), score_memo=False
+        )
+        dn_sv = ScorerServicer(score_memo=False, score_incr=False)
+        for sv in (sp_sv, dn_sv):
+            sv.sync(pb2.SyncRequest.FromString(payload))
+
+        def score_sv(sv):
+            reply = sv.score(pb2.ScoreRequest(
+                snapshot_id=sv.snapshot_id(), top_k=8, flat=True
+            ))
+            return reply.flat.SerializeToString()
+
+        base_req = np.asarray(sp_sv.state.node_requested, np.int64).copy()
+        rows = np.arange(0, base_req.shape[0], 9)
+
+        def delta_sv(rep):
+            prev = base_req.copy()
+            base_req[rows, 0] += 1 + rep
+            warm = pb2.SyncRequest()
+            warm.nodes.requested.CopyFrom(numpy_to_tensor(base_req, prev))
+            raw = warm.SerializeToString()
+            for sv in (sp_sv, dn_sv):
+                sv.sync(pb2.SyncRequest.FromString(raw))
+                assert sv.state.last_sync_path == "warm"
+
+        # warm-up compiles cold + dirty-bucket shapes off the guard
+        assert score_sv(sp_sv) == score_sv(dn_sv)
+        delta_sv(0)
+        assert score_sv(sp_sv) == score_sv(dn_sv)
+        with retrace_guard(budget=0):
+            for rep in range(1, 9):
+                delta_sv(rep)
+                assert score_sv(sp_sv) == score_sv(dn_sv), (
+                    "sparse servicer reply diverged from the dense "
+                    "servicer on the warm stream"
+                )
+        reg = sp_sv.telemetry.registry
+        refresh_total = sum(
+            int(reg.get(CANDIDATE_REFRESH, {"reason": r}) or 0)
+            for r in ("cold", "dirty", "stale")
+        )
+        assert refresh_total >= 9, (
+            f"warm stream refreshed candidates only {refresh_total} "
+            "times — the dirty attribution is not reaching the lists"
+        )
+        phase("sparse_warm_stream", refresh_total=refresh_total)
+
+        print(
+            json.dumps(
+                {
+                    "metric": "sparse_score_ms",
+                    "value": round(sparse_ms, 3),
+                    "unit": "ms",
+                    "backend": backend,
+                    "nodes": S_NODES,
+                    "pods": S_PODS,
+                    "sparse_score_ms": round(sparse_ms, 3),
+                    "sparse_build_ms": round(build_ms, 1),
+                    "dense_score_ms": dense_ms,
+                    "sparse_speedup": round(speedup, 3),
+                    "candidate_width": WIDTH,
+                    "candidate_refresh_total": int(refresh_total),
                 }
             ),
             flush=True,
@@ -4000,7 +4265,7 @@ def main() -> int:
         choices=[
             "spark", "loadaware", "gang", "extras", "rebalance", "smoke",
             "bridge", "mesh", "replica", "failover", "trace",
-            "chaos-trace", "plugins",
+            "chaos-trace", "plugins", "sparse",
         ],
         help="measure a secondary BASELINE config instead of the headline "
         "10k x 2k quota_colocation cycle (driver contract: no args prints "

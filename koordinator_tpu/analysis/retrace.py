@@ -1,6 +1,6 @@
 """retrace-hazard: things that silently recompile the hot cycle.
 
-Five statically detectable shapes of the PR-1 name-tuple retrace:
+Six statically detectable shapes of the PR-1 name-tuple retrace:
 
 1. Python control flow (``if``/``while``/``assert``) on a TRACED
    parameter inside a jitted function.  Branching on a tracer either
@@ -41,6 +41,14 @@ Five statically detectable shapes of the PR-1 name-tuple retrace:
    go through ``_freeze`` in ``__post_init__`` — a raw dict field
    either raises at the first jit call (unhashable) or, frozen into an
    arbitrary-order tuple by a caller, mints one retrace per ordering.
+6. TRACED candidate counts/widths at a jit boundary (ISSUE 16): the
+   sparse engine's candidate width C is configuration (it rides the
+   static CycleConfig), and per-pod candidate COUNTS vary per cycle —
+   a jitted function taking ``num_candidates``/``c_width``/... as a
+   traced argument specializes the [P, C] program per distinct value,
+   one silent retrace per feasibility change.  Pad the candidate list
+   to C with out-of-range sentinels instead (solver/candidates.py):
+   pad the candidate list, don't trace the count.
 """
 
 from __future__ import annotations
@@ -221,6 +229,18 @@ _DIRTY_STATIC_PARAMS = (
     "n_dirty_nodes", "n_dirty_pods",
 )
 
+# sparse candidate knobs (ISSUE 16): the candidate width selects the
+# [P, C] program shape (configuration — it rides the static
+# CycleConfig) and per-pod candidate counts vary with every
+# feasibility change; traced, either one mints a retrace per distinct
+# value.  The candidate list is padded to C with out-of-range
+# sentinels (solver/candidates.py) so neither ever crosses a jit
+# boundary: pad the candidate list, don't trace the count.
+_CAND_STATIC_PARAMS = (
+    "num_candidates", "n_candidates", "candidate_count",
+    "candidate_width", "cand_width", "c_width",
+)
+
 
 def _traced_wave_knobs(source: SourceFile, spec: jitscope.JitSpec) -> List[Violation]:
     if spec.func is None:
@@ -275,6 +295,24 @@ def _traced_wave_knobs(source: SourceFile, spec: jitscope.JitSpec) -> List[Viola
                         "pad the dirty-index vector to a power-of-two "
                         "bucket with out-of-range slots mode=\"drop\" "
                         "discards (solver/incremental.py)"
+                    ),
+                )
+            )
+        elif pname in _CAND_STATIC_PARAMS:
+            out.append(
+                Violation(
+                    rule=RULE,
+                    path=source.path,
+                    line=spec.line,
+                    message=(
+                        f"jit boundary {spec.name}() takes '{pname}' as a "
+                        "TRACED argument: candidate counts vary with every "
+                        "feasibility change (and the width is "
+                        "configuration, like cfg), so each distinct value "
+                        "retraces the sparse [P, C] program silently; "
+                        "pad the candidate list, don't trace the count "
+                        "(solver/candidates.py pads to C with "
+                        "out-of-range sentinels)"
                     ),
                 )
             )

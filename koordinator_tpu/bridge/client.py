@@ -115,7 +115,16 @@ class _ChannelPool:
 
     def __init__(self, target: str, size: int):
         self.channels = [
-            grpc.insecure_channel(target, options=(("koord.pool_slot", i),))
+            grpc.insecure_channel(
+                target,
+                # unbounded frames to match make_server: a sparse-scale
+                # full Sync (ISSUE 16) is far past the 4 MB default
+                options=(
+                    ("koord.pool_slot", i),
+                    ("grpc.max_receive_message_length", -1),
+                    ("grpc.max_send_message_length", -1),
+                ),
+            )
             for i in range(max(1, int(size)))
         ]
 

@@ -87,11 +87,17 @@ from koordinator_tpu.replication.admission import (
     ResourceExhausted,
 )
 from koordinator_tpu.solver import (
+    CandidateOverflow,
+    build_candidates,
     masked_top_k,
+    refresh_candidates,
     run_cycle,
+    score_candidates,
     score_cycle,
     score_upper_bound,
+    sparse_top_k,
 )
+from koordinator_tpu.solver.candidates import check_candidate_overflow
 
 
 class _AssignMemo:
@@ -389,6 +395,7 @@ class ScorerServicer:
         self.dispatch.deadline_hook = self._count_gather_expired
         self.dispatch.launch_outcome_hook = self._launch_outcome
         self.telemetry.metrics.set_breaker_state(self.breaker.state())
+        self.telemetry.metrics.set_candidate_width(self.cfg.candidate_width)
 
     # -- degradation ladder seams (ISSUE 13) --
     def _breaker_transition(self, to: str) -> None:
@@ -407,8 +414,12 @@ class ScorerServicer:
         if outcome == "ok":
             self.breaker.record_success()
         elif outcome == "error":
+            # CandidateOverflow is a config-vs-cluster-state refusal
+            # (ISSUE 16: --candidate-width too narrow for the feasible
+            # fan-out), not a device fault — tripping the breaker on it
+            # would brown out a healthy device
             if isinstance(exc, (SnapshotNotResident, DeadlineExpired,
-                                ResourceExhausted)):
+                                ResourceExhausted, CandidateOverflow)):
                 self.breaker.release_probe()
             else:
                 self.breaker.record_failure()
@@ -880,6 +891,15 @@ class ScorerServicer:
                 if ctx is not None:
                     ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(exc))
                 raise
+            except CandidateOverflow as exc:
+                # sparse engine refusal (ISSUE 16): the configured
+                # --candidate-width cannot hold every feasible node for
+                # some pod — refusing beats silently serving a
+                # truncated candidate set; the operator raises the
+                # width (or turns the sparse path off)
+                if ctx is not None:
+                    ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(exc))
+                raise
             except DeadlineExpired as exc:
                 # already counted stage="gather" by the dispatcher hook
                 if ctx is not None:
@@ -954,6 +974,10 @@ class ScorerServicer:
         reply = self._assemble_score_reply(
             req, k, ts, ti, cache["feasible"],
             cache["valid"], cache["P"], degraded=True,
+            # sparse cache entries (ISSUE 16) carry the launch's ok
+            # matrix instead of a dense feasible tensor; the wide
+            # re-rank path above never runs for them (scores is None)
+            ok_full=cache.get("ok"),
         )
         if tspan is not None:
             tspan.link_ref(cache.get("launch_span"))
@@ -1007,6 +1031,8 @@ class ScorerServicer:
                 self.state.pod_requests.shape[0]
                 if self.state.pod_requests is not None else 0,
             )
+            sparse = self.cfg.candidate_width > 0
+            cres = None
             if memo is None:
                 try:
                     snap = self.state.snapshot()
@@ -1017,7 +1043,18 @@ class ScorerServicer:
                     # Sync does)
                     self.telemetry.abort_cycle("score", exc)
                     raise
-                if self._score_incr:
+                if sparse:
+                    # sparse candidate engine (ISSUE 16): the resident
+                    # [P, C] candidate lists, if any, with the dirt the
+                    # warm commits since their build accumulated.  Same
+                    # wholesale CycleConfig invalidation as the score
+                    # residency — the lists certify one feasibility
+                    # program.
+                    cres = self.state.candidate_residency()
+                    if cres is not None and cres.cfg != self.cfg:
+                        self.state.drop_candidate_residency()
+                        cres = None
+                elif self._score_incr:
                     # incremental engine (ISSUE 9): the resident score
                     # tensors, if any, with the dirt the warm commits
                     # since their launch accumulated.  A CycleConfig
@@ -1056,6 +1093,15 @@ class ScorerServicer:
             for t in traced:
                 t.link_ref(launch_span.ref)
         launch_ref = None if launch_span is None else launch_span.ref
+        if sparse:
+            # sparse [P, C] engine (ISSUE 16): candidate build/refresh +
+            # gathered scoring replaces the dense [P, N] ladder below —
+            # same readback-closure contract, same memo/brownout/
+            # telemetry seams
+            return self._score_launch_sparse(
+                accepted, snap, cres, sid, mirror_rows,
+                launch_span, launch_ref,
+            )
         try:
             # execution clock starts HERE: the cycle-latency histogram
             # keeps the serialized daemon's semantics (device dispatch +
@@ -1256,6 +1302,164 @@ class ScorerServicer:
         return _readback
 
     @launch_section
+    def _score_launch_sparse(self, accepted, snap, cres, sid, mirror_rows,
+                             launch_span, launch_ref):
+        """Sparse candidate-set Score launch (ISSUE 16): score [P, C]
+        gathered cells instead of the dense [P, N] wall.  Caller is
+        :meth:`_score_launch_batch` (launch lock held, riders already
+        filtered, launch span already fanned in); returns the same
+        readback-closure shape the dense path returns.
+
+        Engine ladder: reuse the resident candidate lists when clean;
+        lazily merge-refresh the entries the warm commits dirtied
+        (reason "dirty"); force a full blocked rebuild past the
+        staleness bound (reason "stale") or with nothing resident
+        (reason "cold").  The gathered cells run the SAME cellwise
+        term stack as the dense launch, so wherever every pod's
+        feasible fan-out fits C the reply bytes are identical to
+        dense; when some pod's exact feasible count exceeds C the
+        readback raises :class:`CandidateOverflow` — the engine
+        refuses rather than silently degrade to a truncated list."""
+        try:
+            t_exec = time.perf_counter()
+            N = snap.nodes.capacity
+            P = snap.pods.capacity
+            C = int(self.cfg.candidate_width)
+            # a pod holds at most min(C, N) real candidates, so every
+            # caller's k (and the memoized "N") clamps there — the
+            # same derivation the dense path runs with N.  Both are
+            # powers of two, so the k bucket stays within C and the
+            # top-k shape never crosses a jit boundary traced.
+            k_cap = min(C, N)
+            ks = [min(int(e.req.top_k) or k_cap, k_cap) for e in accepted]
+            k_launch = min(pad_bucket(max(ks)), k_cap)
+            refresh_reason = None
+            merges = 0
+            if cres is None:
+                cand, count = build_candidates(snap, self.cfg)
+                refresh_reason = "cold"
+            elif cres.dirty_nodes or cres.dirty_pods:
+                if cres.merges >= self.cfg.candidate_max_stale:
+                    # merge-chain bound hit: one full rebuild resets it
+                    cand, count = build_candidates(snap, self.cfg)
+                    refresh_reason = "stale"
+                else:
+                    cand, count = refresh_candidates(
+                        snap, cres.idx, cres.count,
+                        sorted(cres.dirty_nodes), sorted(cres.dirty_pods),
+                        self.cfg,
+                    )
+                    refresh_reason = "dirty"
+                    merges = cres.merges + 1
+            else:
+                cand, count = cres.idx, cres.count
+            if refresh_reason is not None:
+                # the lists this launch certifies become the residency;
+                # accumulated dirt clears with the store
+                self.state.store_candidates(self.cfg, cand, count, merges)
+            scores, feasible = score_candidates(snap, cand, self.cfg)
+            top_scores, top_idx, top_ok = sparse_top_k(
+                scores, feasible, cand, k=k_launch,
+                hi=score_upper_bound(self.cfg),
+            )
+            dispatch_s = time.perf_counter() - t_exec
+        except Exception as exc:
+            if launch_span is not None:
+                launch_span.abort(exc)
+            with self._state_lock:
+                self.telemetry.abort_cycle("score", exc)
+            raise
+
+        def _readback():
+            try:
+                t0 = time.perf_counter()
+                # one stacked device->host transfer, like the dense
+                # readback; the exact per-pod feasible counts ride
+                # along for the overflow check
+                ts, ti, ok_np, count_np, valid_np = jax.device_get(
+                    (top_scores, top_idx, top_ok, count, snap.pods.valid)
+                )
+                readback_s = time.perf_counter() - t0
+                try:
+                    check_candidate_overflow(count_np, C)
+                except CandidateOverflow:
+                    # a truncating merge may have dropped real
+                    # candidates: the lists must never refresh — drop
+                    # them so the next sparse Score cold-rebuilds (and
+                    # refuses again until the width is raised)
+                    self.state.drop_candidate_residency()
+                    raise
+                if launch_span is not None:
+                    launch_span.set_attr("k_bucket", k_launch)
+                    launch_span.set_attr("candidate_width", C)
+                    launch_span.end()
+                ti = ti.astype(np.int32)
+                ok_np = ok_np.astype(bool)
+                valid = valid_np[:P].astype(bool)
+                with self._state_lock:
+                    if (
+                        self._score_memo is not None
+                        and sid == self.snapshot_id()
+                    ):
+                        # the precomputed ok matrix replaces the dense
+                        # entries' [P, N] feasible tensor: the sparse
+                        # feasibility is per-CELL, so take_along_axis
+                        # against real node ids would misindex it
+                        self._score_memo.put(sid, self.cfg, dict(
+                            kb=k_launch, N=k_cap, P=P, ts=ts, ti=ti,
+                            feasible=None, valid=valid, ok=ok_np,
+                            launch_span=launch_ref,
+                        ))
+                    b_epoch, _, b_gen = sid[1:].rpartition("-")
+                    try:
+                        b_gen = int(b_gen)
+                    except ValueError:
+                        b_gen = -1
+                    prev = self._brownout
+                    if b_gen >= 0 and b_epoch == self._epoch and (
+                        prev is None
+                        or prev["epoch"] != self._epoch
+                        or b_gen >= prev["gen"]
+                    ):
+                        # no full scores cached: a breaker-open
+                        # wider-k request is refused (the cache cannot
+                        # invent candidate columns this launch never
+                        # scored); prefix serves within kb still work
+                        self._brownout = dict(
+                            epoch=b_epoch, gen=b_gen, cfg=self.cfg,
+                            kb=k_launch, N=k_cap, P=P,
+                            nodes=mirror_rows[0], pods=mirror_rows[1],
+                            ts=ts, ti=ti, feasible=None, valid=valid,
+                            ok=ok_np, launch_span=launch_ref,
+                            scores=None,
+                        )
+                assembled = []
+                n_failed = 0
+                for entry, k in zip(accepted, ks):
+                    try:
+                        entry.reply = self._assemble_score_reply(
+                            entry.req, k, ts, ti, None, valid, P,
+                            ok_full=ok_np,
+                        )
+                        assembled.append(entry)
+                    except Exception as exc:  # koordlint: disable=broad-except(routed to the one caller as its RPC error; sibling replies stand)
+                        entry.error = exc
+                        n_failed += 1
+                exec_ms = (time.perf_counter() - t_exec) * 1000.0
+            except Exception as exc:
+                if launch_span is not None:
+                    launch_span.abort(exc)
+                with self._state_lock:
+                    self.telemetry.abort_cycle("score", exc)
+                raise
+            return lambda: self._score_telemetry(
+                assembled, sid, dispatch_s, readback_s, exec_ms, n_failed,
+                cand_refresh=refresh_reason, cand_width=C,
+            )
+
+        return _readback
+
+    @launch_section
     def _score_incremental(self, snap, res):
         """Advance the resident score tensors through the accumulated
         dirty columns/rows (solver/incremental.py ``rescore_dirty``) —
@@ -1315,6 +1519,7 @@ class ScorerServicer:
                 entry.reply = self._assemble_score_reply(
                     entry.req, k, memo["ts"], memo["ti"],
                     memo["feasible"], memo["valid"], memo["P"],
+                    ok_full=memo.get("ok"),
                 )
                 served.append(entry)
             except Exception as exc:  # koordlint: disable=broad-except(routed to the one caller as its RPC error; sibling replies stand)
@@ -1359,7 +1564,7 @@ class ScorerServicer:
 
     def _assemble_score_reply(
         self, req, k, top_scores, top_idx, feasible_np, valid, P,
-        degraded: bool = False,
+        degraded: bool = False, ok_full=None,
     ) -> "pb2.ScoreReply":
         """Demux one caller's reply from the shared readback: slice the
         k-prefix of the padded top-k (bit-identical with a serial
@@ -1367,10 +1572,17 @@ class ScorerServicer:
         the serialized path used.  ``degraded`` stamps the brownout
         path's explicit staleness flag (ISSUE 13) — a fresh launch
         never sets it, so reply bytes off the breaker path are
-        untouched."""
+        untouched.  ``ok_full``: the sparse engine (ISSUE 16) passes
+        its precomputed [P, k_bucket] validity matrix instead of a
+        dense [P, N] feasible tensor — sparse feasibility is per
+        gathered CELL, so indexing it by real node id would misread
+        it; the prefix slice keeps the bytes identical either way."""
         ts = top_scores[:, :k]
         ti = top_idx[:, :k]
-        ok = np.take_along_axis(feasible_np, ti, axis=1)
+        if ok_full is not None:
+            ok = ok_full[:, :k]
+        else:
+            ok = np.take_along_axis(feasible_np, ti, axis=1)
         reply = pb2.ScoreReply()
         if degraded:
             reply.degraded = True
@@ -1401,7 +1613,7 @@ class ScorerServicer:
 
     def _score_telemetry(self, assembled, sid, dispatch_s, readback_s,
                          exec_ms, n_failed=0, incr_result=None,
-                         incr_cols=0):
+                         incr_cols=0, cand_refresh=None, cand_width=0):
         """Per-batch telemetry, sequenced under the state lock.  The
         pending-cycle contract is unchanged from the serial daemon: a
         pending cycle holds Sync stages awaiting the Assign that
@@ -1433,6 +1645,13 @@ class ScorerServicer:
                 tel.metrics.count_score_incr(incr_result)
                 if incr_result == "incr":
                     tel.metrics.observe_incr_cols(incr_cols)
+            if cand_width:
+                # sparse engine (ISSUE 16): the serving width gauge and
+                # one refresh count per launch that rebuilt/re-merged
+                # (a launch reusing clean lists counts nothing)
+                tel.metrics.set_candidate_width(cand_width)
+                if cand_refresh is not None:
+                    tel.metrics.count_candidate_refresh(cand_refresh)
             if assembled or n_failed:
                 # fused scoring terms (ISSUE 15): one count per DEVICE
                 # launch per enabled term — the fused engine's "all
@@ -1921,7 +2140,17 @@ def make_server(
     ``channels=N`` to ScorerClient so the burst actually arrives over
     parallel HTTP/2 connections — see bridge/client.py.)"""
     servicer = servicer or ScorerServicer(cfg, mesh=mesh)
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        # unbounded frames: a sparse-scale cluster's first full Sync
+        # (ISSUE 16 — node counts past the dense allocator's reach)
+        # ships hundreds of MB of node tensors in one request, far
+        # past gRPC's 4 MB default receive cap
+        options=(
+            ("grpc.max_receive_message_length", -1),
+            ("grpc.max_send_message_length", -1),
+        ),
+    )
     handlers = {
         "Sync": _handler(servicer.sync, pb2.SyncRequest),
         "Score": _handler(servicer.score, pb2.ScoreRequest),

@@ -238,6 +238,42 @@ class ScoreResidency:
         self.dirty_pods: set = set()
 
 
+class CandidateResidency:
+    """The sparse engine's device-resident [P, C] candidate-index map
+    (ISSUE 16, solver/candidates.py) plus the exact per-pod feasible
+    counts and the dirt accumulated since the launch that built them.
+
+    The same commit seam that advances :class:`ScoreResidency` advances
+    this: a warm commit unions its invalidated rows into
+    ``dirty_nodes``/``dirty_pods`` (a dirty node invalidates only the
+    candidate lists containing it — the next Score's lazy
+    merge-refresh evicts and re-merges just those entries), and an
+    attribution-losing commit (full re-upload) or a geometry move
+    drops the residency for a cold rebuild.  The dirty sets are a
+    conservative superset of what feasibility actually read: a
+    score-only delta (e.g. sensitivity) forces a harmless re-merge,
+    never a wrong list.
+
+    ``merges`` counts exact merge-refreshes since the last full build —
+    the staleness bound (``cfg.candidate_max_stale``) forces a full
+    rebuild (refresh reason "stale") once the chain grows past it.
+    ``count`` is the EXACT per-pod feasible total, maintained through
+    every merge; the serving path refuses (``CandidateOverflow``)
+    whenever it exceeds C rather than serve a truncated list.
+    """
+
+    __slots__ = ("cfg", "idx", "count", "dirty_nodes", "dirty_pods",
+                 "merges")
+
+    def __init__(self, cfg, idx, count, merges: int = 0):
+        self.cfg = cfg
+        self.idx = idx
+        self.count = count
+        self.dirty_nodes: set = set()
+        self.dirty_pods: set = set()
+        self.merges = int(merges)
+
+
 # companions reset to defaults when a full tensor changes the node table
 # size (ADVICE r5: a stale differently-shaped column must not linger to
 # fail later at snapshot build).  node_requested/node_usage are included:
@@ -316,6 +352,10 @@ class ResidentState:
         # (ISSUE 9); populated by the servicer's Score launches via
         # store_score_result, advanced by warm commits, dropped cold
         self._score_res: Optional[ScoreResidency] = None
+        # resident [P, C] sparse candidate lists + exact feasible
+        # counts (ISSUE 16); populated by sparse Score launches via
+        # store_candidates, advanced by warm commits, dropped cold
+        self._cand_res: Optional[CandidateResidency] = None
         self._i32_ok: Optional[bool] = None
         # observability: how the last Sync landed on the device
         # ("cold" = residency dropped, rebuild at next snapshot();
@@ -422,6 +462,7 @@ class ResidentState:
         if plan is None:
             self._snapshot = None  # cold: rebuilt lazily at snapshot()
             self._score_res = None  # geometry moved: nothing to advance
+            self._cand_res = None
             self.last_sync_path = "cold"
         else:
             try:
@@ -429,6 +470,7 @@ class ResidentState:
                     self._snapshot = self._apply_warm(plan)
                 self.last_sync_path = "warm"
                 self._note_score_dirty(score_dirty)
+                self._note_candidate_dirty(score_dirty)
             except Exception:
                 # a torn device update may have donated buffers out of the
                 # old snapshot: drop residency, the mirrors stay truthful
@@ -438,6 +480,7 @@ class ResidentState:
                 )
                 self._snapshot = None
                 self._score_res = None
+                self._cand_res = None
                 self.last_sync_path = "cold"
         self._i32_ok = None
         kinds = [kind for kind, _, _ in tinfo.values()]
@@ -854,6 +897,47 @@ class ResidentState:
             return
         if score_dirty is None:
             self._score_res = None
+            return
+        dirty_nodes, dirty_pods = score_dirty
+        res.dirty_nodes |= dirty_nodes
+        res.dirty_pods |= dirty_pods
+
+    # -- resident sparse candidate lists (ISSUE 16) --
+    def candidate_residency(self) -> Optional[CandidateResidency]:
+        """The resident [P, C] candidate-index map with its exact
+        per-pod feasible counts and accumulated dirt, or None (never
+        built, or dropped).  Same serialization contract as
+        :meth:`score_residency`: commits mutate the dirt under the
+        dispatch launch lock and sparse Score launches read/advance
+        under it."""
+        return self._cand_res
+
+    def drop_candidate_residency(self) -> None:
+        self._cand_res = None
+
+    def store_candidates(self, cfg, idx, count, merges: int = 0) -> None:
+        """Adopt the candidate lists a sparse Score launch just built
+        or refreshed: the dirt clears (the launch incorporated it) and
+        ``merges`` records how deep the merge-refresh chain has grown
+        since the last full build (0 after a cold/stale rebuild).
+        Stored unsharded: the serving path runs the GSPMD-compatible
+        unsharded functions regardless of node-mesh residency — the
+        pod-mesh shard_map variants are exercised through
+        solver/candidates.py's explicit ``mesh=`` parameter."""
+        self._cand_res = CandidateResidency(cfg, idx, count, merges=merges)
+
+    def _note_candidate_dirty(self, score_dirty) -> None:
+        """Advance the candidate residency past a warm commit with the
+        SAME row attribution the score residency uses — a conservative
+        superset for feasibility (which reads fewer tensors than
+        scoring), so the extra merge-refreshes are harmless and the
+        lists stay exact.  None = attribution lost: drop, the next
+        sparse Score cold-rebuilds."""
+        res = self._cand_res
+        if res is None:
+            return
+        if score_dirty is None:
+            self._cand_res = None
             return
         dirty_nodes, dirty_pods = score_dirty
         res.dirty_nodes |= dirty_nodes

@@ -194,11 +194,37 @@ class CycleConfig:
     heterogeneity: "HeterogeneityTermArgs | None" = None
     sensitivity: "SensitivityTermArgs | None" = None
     packing: "PackingTermArgs | None" = None
+    # Sparse candidate-set scoring (ISSUE 16; solver/candidates.py).
+    # ``candidate_width`` > 0 turns the sparse [P, C] serving path on:
+    # each pod is scored only against its C-wide candidate list instead
+    # of every node.  The width is a POWER OF TWO and rides the config
+    # as a static jit argument — the candidate list is padded to C, so
+    # C never crosses a jit boundary traced (the koordlint
+    # retrace-hazard rule shape 6 rejects traced candidate counts).
+    # 0 = dense engines only.  256 is the recommended serving width.
+    candidate_width: int = 0
+    # How many exact lazy merge-refreshes a candidate residency may
+    # accumulate before the engine forces a full rebuild (refresh
+    # reason "stale" on koord_scorer_candidate_refresh_total).  Bounds
+    # merge-chain length so a long warm stream cannot degrade into an
+    # unbounded sequence of incremental sorts.
+    candidate_max_stale: int = 8
 
     def __post_init__(self):
         object.__setattr__(
             self, "fit_resource_weights", _freeze(self.fit_resource_weights)
         )
+        cw = int(self.candidate_width)
+        if cw < 0 or (cw & (cw - 1)) != 0:
+            raise ValueError(
+                "candidate_width must be 0 (sparse off) or a power of "
+                f"two, got {self.candidate_width!r}"
+            )
+        if int(self.candidate_max_stale) < 1:
+            raise ValueError(
+                "candidate_max_stale must be >= 1, got "
+                f"{self.candidate_max_stale!r}"
+            )
 
     # Dense device-side encodings (constant-folded under jit)
     def loadaware_weights_arr(self) -> jnp.ndarray:

@@ -90,6 +90,21 @@ TERM_MIX = (
     ("sensitivity_drift", 0.06),
 )
 
+# sparse-regime event mix (ISSUE 16, pair with TraceConfig.open_nodes
+# and gangs=0): only kinds that keep every pod slot OCCUPIED.  A
+# zero-request slot is feasible on every node — the sparse engine
+# correctly refuses it at C < N rather than truncate — and arrivals/
+# departures need (or create) empty slots, so a sparse trace churns
+# priorities, quotas and nodes instead.
+SPARSE_MIX = (
+    ("priority_churn", 0.30),
+    ("quota_wave", 0.20),
+    ("usage_tick", 0.20),
+    ("node_drain", 0.10),
+    ("node_restore", 0.10),
+    ("node_resize", 0.10),
+)
+
 
 class TraceParityError(AssertionError):
     """The engine servicer's reply bytes diverged from the serial
@@ -135,6 +150,17 @@ class TraceConfig:
     # unchanged.
     accel_types: int = 0
     workload_classes: int = 0
+    # sparse-feasibility regime (ISSUE 16): >0 leaves only this many
+    # nodes with pod-sized headroom — the rest start requested-to-the-
+    # brim (free cpu/mem below the smallest pod ask), so every pod's
+    # exact feasible count stays near ``open_nodes`` and a sparse-
+    # engine replay (CycleConfig.candidate_width) serves without
+    # overflow at node counts the dense oracle cannot even allocate.
+    # node_resize (x1.25) can re-open a closed node mid-trace, so
+    # leave width slack: open_nodes <= candidate_width / 2 is
+    # comfortable.  0 = every node keeps the dense generator's 2-30%
+    # load (feasibility ~N, the dense engines' regime).
+    open_nodes: int = 0
 
     def to_doc(self) -> Dict[str, object]:
         doc = dataclasses.asdict(self)
@@ -166,7 +192,7 @@ class Trace:
     def to_doc(self) -> Dict[str, object]:
         return {
             "config": self.config.to_doc(),
-            "init": self.init,
+            "init": _jsonable_init(self.init),
             "events": [e.to_doc() for e in self.events],
         }
 
@@ -190,6 +216,18 @@ class Trace:
         return seen
 
 
+def _jsonable_init(init: Dict[str, object]) -> Dict[str, object]:
+    """Init tensors are held as numpy at sparse scale (ISSUE 16:
+    ``TraceConfig.nodes`` accepts node counts past the dense
+    allocator's reach, and a million-row ``.tolist()`` is both slow
+    and several GB of python ints); JSON surfaces — export, digest —
+    convert at the edge, so small traces serialize exactly as before."""
+    return {
+        k: (v.tolist() if isinstance(v, np.ndarray) else v)
+        for k, v in init.items()
+    }
+
+
 def export_trace(trace: Trace) -> List[str]:
     """Serialize a trace as concrete JSON audit lines (ISSUE 14
     satellite / ROADMAP 5(a)): one ``trace_header`` line carrying the
@@ -199,7 +237,7 @@ def export_trace(trace: Trace) -> List[str]:
     streams."""
     lines = [json.dumps(
         {"event": "trace_header", "config": trace.config.to_doc(),
-         "init": trace.init},
+         "init": _jsonable_init(trace.init)},
         sort_keys=True,
     )]
     lines.extend(
@@ -361,6 +399,18 @@ def _pick_band(rng, cfg: TraceConfig) -> str:
     return BANDS[int(rng.choice(len(BANDS), p=mix / mix.sum()))]
 
 
+def _undrained_node(rng, model: ClusterModel, st: "_GenState"):
+    """A uniform-ish undrained node id WITHOUT building the O(N)
+    undrained list; None after 8 drained draws (the caller skips the
+    event — the generator's mix loop retries with another kind)."""
+    n = model.nalloc.shape[0]
+    for _ in range(8):
+        node = int(rng.integers(0, n))
+        if node not in st.drained:
+            return node
+    return None
+
+
 class _GenState:
     """Generator-side occupancy bookkeeping (slots, gangs, drains)."""
 
@@ -465,12 +515,13 @@ def _next_event(cfg: TraceConfig, rng, model: ClusterModel,
             "used": [[int(v) for v in used]],
         })
     if kind == "node_drain":
-        candidates = [
-            n for n in range(model.nalloc.shape[0]) if n not in st.drained
-        ]
-        if not candidates:
+        # rejection-sample instead of materializing the undrained list
+        # (O(N) per event is minutes of generation at sparse-scale node
+        # counts); a draw landing on a drained node 8 times in a row
+        # just skips the event, which the mix loop already tolerates
+        node = _undrained_node(rng, model, st)
+        if node is None:
             return None
-        node = candidates[int(rng.integers(0, len(candidates)))]
         st.drained[node] = [int(v) for v in model.nalloc[node]]
         return TraceEvent(kind, INFRA_BAND, {
             "node": int(node), "allocatable": [0] * R,
@@ -482,12 +533,9 @@ def _next_event(cfg: TraceConfig, rng, model: ClusterModel,
             "node": int(node), "allocatable": row,
         })
     if kind == "node_resize":
-        candidates = [
-            n for n in range(model.nalloc.shape[0]) if n not in st.drained
-        ]
-        if not candidates:
+        node = _undrained_node(rng, model, st)
+        if node is None:
             return None
-        node = candidates[int(rng.integers(0, len(candidates)))]
         factor = float(rng.choice([0.75, 1.25]))
         row = (model.nalloc[node].astype(float) * factor).astype(np.int64)
         row[_PODS] = model.nalloc[node][_PODS]  # pod slots don't scale
@@ -523,7 +571,11 @@ def _next_event(cfg: TraceConfig, rng, model: ClusterModel,
             "slots": slots, "profiles": profiles,
         })
     if kind == "usage_tick":
-        count = max(1, model.nuse.shape[0] // 4)
+        # capped at 256 rows: an uncapped N/4 tick at sparse-scale node
+        # counts would put hundreds of thousands of rows in ONE event
+        # payload (and its JSON line) — a usage tick is a churn sample,
+        # not a full-cluster rescan
+        count = max(1, min(model.nuse.shape[0] // 4, 256))
         nodes = sorted(
             int(n) for n in rng.choice(
                 model.nuse.shape[0], count, replace=False
@@ -546,17 +598,28 @@ def _next_event(cfg: TraceConfig, rng, model: ClusterModel,
 
 def _build_init(cfg: TraceConfig, rng) -> Dict[str, object]:
     N, P, Q, G = cfg.nodes, cfg.pod_slots, cfg.tenants, cfg.gangs
+    # vectorized over the node axis (ISSUE 16: a per-node python loop
+    # makes sparse-scale node counts — the whole point of the knob —
+    # take minutes before the first event is even drawn)
     nalloc = np.zeros((N, R), np.int64)
     nreq = np.zeros((N, R), np.int64)
     nuse = np.zeros((N, R), np.int64)
-    for n in range(N):
-        cpu = int(rng.choice([16000, 32000, 64000]))
-        mem = (cpu // 1000) * 4 * 1024  # MiB axis
-        nalloc[n, _CPU], nalloc[n, _MEM], nalloc[n, _PODS] = cpu, mem, 256
-        nreq[n, _CPU] = int(cpu * rng.uniform(0.02, 0.3))
-        nreq[n, _MEM] = int(mem * rng.uniform(0.02, 0.3))
-        nuse[n, _CPU] = int(cpu * rng.uniform(0.05, 0.5))
-        nuse[n, _MEM] = int(mem * rng.uniform(0.05, 0.5))
+    cpu = rng.choice(np.asarray([16000, 32000, 64000], np.int64), size=N)
+    mem = (cpu // 1000) * 4 * 1024  # MiB axis
+    nalloc[:, _CPU], nalloc[:, _MEM], nalloc[:, _PODS] = cpu, mem, 256
+    nreq[:, _CPU] = (cpu * rng.uniform(0.02, 0.3, N)).astype(np.int64)
+    nreq[:, _MEM] = (mem * rng.uniform(0.02, 0.3, N)).astype(np.int64)
+    nuse[:, _CPU] = (cpu * rng.uniform(0.05, 0.5, N)).astype(np.int64)
+    nuse[:, _MEM] = (mem * rng.uniform(0.05, 0.5, N)).astype(np.int64)
+    if cfg.open_nodes > 0:
+        # sparse-feasibility regime (see TraceConfig.open_nodes): close
+        # every node but the chosen few — free cpu below the 250m
+        # minimum ask, free mem below the 256 MiB minimum
+        closed = np.ones(N, bool)
+        closed[rng.choice(N, size=min(cfg.open_nodes, N),
+                          replace=False)] = False
+        nreq[closed, _CPU] = nalloc[closed, _CPU] - 100
+        nreq[closed, _MEM] = nalloc[closed, _MEM] - 128
     fresh = [True] * N
 
     gang_region = G * cfg.gang_min_member
@@ -574,9 +637,12 @@ def _build_init(cfg: TraceConfig, rng) -> Dict[str, object]:
             gang_id[s] = g
     quota_id = [s % Q for s in range(P)]
     # ~40% of the single slots start occupied so departures have
-    # something to drain from step one
+    # something to drain from step one; in the sparse regime EVERY
+    # slot is occupied instead — an empty (zero-request) slot is
+    # feasible on all N nodes, which the sparse engine refuses at
+    # C < N (pair open_nodes with SPARSE_MIX and gangs=0)
     for s in range(gang_region, P):
-        if rng.random() < 0.4:
+        if cfg.open_nodes > 0 or rng.random() < 0.4:
             band = _pick_band(rng, cfg)
             reqs, ests, prios = _pod_rows(rng, band, 1)
             preq[s], pest[s], priority[s] = reqs[0], ests[0], prios[0]
@@ -590,13 +656,16 @@ def _build_init(cfg: TraceConfig, rng) -> Dict[str, object]:
         qrt[t, _CPU] = total_cpu * 6 // 10 // Q
         qrt[t, _MEM] = total_mem * 6 // 10 // Q
         qlim[t, _CPU] = qlim[t, _MEM] = 1
+    # tensor keys stay numpy (see _jsonable_init: sparse-scale node
+    # counts make .tolist() the bottleneck); ClusterModel np.asarray's
+    # either representation, so imported JSON traces replay unchanged
     init = {
-        "nalloc": nalloc.tolist(), "nreq": nreq.tolist(),
-        "nuse": nuse.tolist(), "fresh": fresh,
-        "preq": preq.tolist(), "pest": pest.tolist(),
+        "nalloc": nalloc, "nreq": nreq,
+        "nuse": nuse, "fresh": fresh,
+        "preq": preq, "pest": pest,
         "priority": priority, "gang_id": gang_id, "quota_id": quota_id,
         "gang_min": [cfg.gang_min_member] * G,
-        "qrt": qrt.tolist(), "quse": quse.tolist(), "qlim": qlim.tolist(),
+        "qrt": qrt, "quse": quse, "qlim": qlim,
     }
     if cfg.accel_types > 0 and cfg.workload_classes > 0:
         # fused-term state (ISSUE 15): heterogeneous accelerator fleet,
@@ -778,6 +847,7 @@ class TraceReplay:
         retrace_budget: int = 0,
         warmup: bool = True,
         trace_export: Optional[str] = None,
+        oracle: bool = True,
     ):
         """``trace_export`` (ISSUE 14): directory the ENGINE side —
         servicer and client both — exports its distributed-trace spans
@@ -796,6 +866,14 @@ class TraceReplay:
         self.retrace_budget = int(retrace_budget)
         self.warmup = bool(warmup)
         self.trace_export = trace_export
+        # oracle=False drops the serial-oracle servicer entirely —
+        # parity_checks stays 0 and only the engine replays.  This is
+        # the sparse-scale mode (ISSUE 16): at node counts past the
+        # dense allocator's reach the oracle cannot even hold its
+        # [P, N] tensors, so the replay measures the sparse engine
+        # alone (parity is owned by tests/test_candidates.py at scales
+        # where both engines fit).
+        self.oracle = bool(oracle)
 
     def run(self) -> TraceReport:
         from koordinator_tpu.analysis import retrace_guard
@@ -823,11 +901,12 @@ class TraceReplay:
         oracle_kw.setdefault("trace_export", False)
         with tempfile.TemporaryDirectory(prefix="koord-trace-") as tmp:
             engine_sv = ScorerServicer(**engine_kw)
-            oracle_sv = ScorerServicer(**oracle_kw)
+            sides = [("engine", engine_sv)]
+            if self.oracle:
+                sides.append(("oracle", ScorerServicer(**oracle_kw)))
             servers, clients = [], []
             try:
-                for name, sv in (("engine", engine_sv),
-                                 ("oracle", oracle_sv)):
+                for name, sv in sides:
                     sock = os.path.join(tmp, f"{name}.sock")
                     server = make_server(servicer=sv)
                     server.add_insecure_port(f"unix://{sock}")
@@ -844,8 +923,11 @@ class TraceReplay:
                             else False
                         ),
                     ))
-                return self._drive(engine_sv, clients[0], clients[1],
-                                   record=record)
+                return self._drive(
+                    engine_sv, clients[0],
+                    clients[1] if self.oracle else None,
+                    record=record,
+                )
             finally:
                 for client in clients:
                     client.close()
@@ -856,7 +938,7 @@ class TraceReplay:
                 # the export directory IMMEDIATELY after run(), so the
                 # servicer's tail spans must be on disk by now — and a
                 # replay must not leak a writer thread per pass
-                for sv in (engine_sv, oracle_sv):
+                for _name, sv in sides:
                     sv.telemetry.close()
 
     def _drive(self, engine_sv, engine, oracle,
@@ -894,17 +976,19 @@ class TraceReplay:
             )
         k = trace.config.top_k
         engine.sync(**full_kw)
-        oracle.sync(**full_kw)
+        if oracle is not None:
+            oracle.sync(**full_kw)
         # cold Score/Assign: compiles the cold paths in the warm-up
         # pass; in the measured pass both hit the jit cache
         d_e = self._digest(engine.score_flat(top_k=k), engine.assign())
-        d_o = self._digest(oracle.score_flat(top_k=k), oracle.assign())
-        parity_checks += 1
-        if d_e != d_o:
-            raise TraceParityError(
-                "cold step: engine reply digest diverged from the "
-                "serial oracle"
-            )
+        if oracle is not None:
+            d_o = self._digest(oracle.score_flat(top_k=k), oracle.assign())
+            parity_checks += 1
+            if d_e != d_o:
+                raise TraceParityError(
+                    "cold step: engine reply digest diverged from the "
+                    "serial oracle"
+                )
 
         maybe_slow = (
             slow_stage(engine_sv, self.slow_score_ms)
@@ -926,18 +1010,19 @@ class TraceReplay:
                 t_score = time.perf_counter()
                 e_assign = engine.assign()
                 t_assign = time.perf_counter()
-                oracle.sync(**kw)
-                digest_e = self._digest(e_score, e_assign)
-                digest_o = self._digest(
-                    oracle.score_flat(top_k=k), oracle.assign()
-                )
-                parity_checks += 1
-                if digest_e != digest_o:
-                    raise TraceParityError(
-                        f"step {i} ({event.kind}, band {event.band}): "
-                        f"engine reply digest {digest_e[:16]} != serial "
-                        f"oracle {digest_o[:16]}"
+                if oracle is not None:
+                    oracle.sync(**kw)
+                    digest_e = self._digest(e_score, e_assign)
+                    digest_o = self._digest(
+                        oracle.score_flat(top_k=k), oracle.assign()
                     )
+                    parity_checks += 1
+                    if digest_e != digest_o:
+                        raise TraceParityError(
+                            f"step {i} ({event.kind}, band {event.band}): "
+                            f"engine reply digest {digest_e[:16]} != "
+                            f"serial oracle {digest_o[:16]}"
+                        )
                 if not record:
                     continue
                 sync_ms = (t_sync - t0) * 1000.0

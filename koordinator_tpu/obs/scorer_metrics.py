@@ -55,6 +55,8 @@ koord_scorer_retry_total               counter   op (subscribe|resume)
 koord_scorer_trace_cycle_ms            histogram band, rpc
 koord_scorer_trace_spans_total         counter   kind (client|server|internal|consumer)
 koord_scorer_trace_export_dropped_total counter  reason (closed|rate|bytes|encode|io)
+koord_scorer_candidate_refresh_total   counter   reason (dirty|stale|cold)
+koord_scorer_candidate_width           gauge     — (configured C; 0 = dense)
 ====================================== ========= ==========================
 
 The ``koord_scorer_coalesce_*`` families observe the coalescing
@@ -149,6 +151,8 @@ RETRY_TOTAL = "koord_scorer_retry_total"
 TRACE_CYCLE = "koord_scorer_trace_cycle_ms"
 TRACE_SPANS = "koord_scorer_trace_spans_total"
 TRACE_EXPORT_DROPPED = "koord_scorer_trace_export_dropped_total"
+CANDIDATE_REFRESH = "koord_scorer_candidate_refresh_total"
+CANDIDATE_WIDTH = "koord_scorer_candidate_width"
 
 # occupancy is a count-of-requests-per-launch, not a latency: its own
 # power-of-two buckets (the dispatcher caps batches at 16 by default;
@@ -314,6 +318,16 @@ _FAMILIES = (
      "reason (closed|rate|bytes|encode|io); any nonzero rate means "
      "assembled traces are INCOMPLETE — widen the bound or stop the "
      "span storm before trusting a tree"),
+    (CANDIDATE_REFRESH, "counter",
+     "sparse candidate-list builds/refreshes (ISSUE 16), by reason: "
+     "cold = no resident lists (full blocked build), dirty = lazy "
+     "merge-refresh of the entries a warm commit invalidated, stale = "
+     "forced full rebuild after --candidate-max-stale merges; a warm "
+     "delta stream should run mostly dirty with a bounded stale rate — "
+     "a climbing cold rate means commits keep losing row attribution"),
+    (CANDIDATE_WIDTH, "gauge",
+     "configured sparse candidate width C (the [P, C] serving shape); "
+     "0 while the dense engines serve"),
 )
 
 # journal appends are MICROsecond-scale (a header pack + one buffered
@@ -458,6 +472,19 @@ class ScorerMetrics:
         term_total / score launches proves the terms rode the ONE
         launch instead of extra per-plugin passes."""
         self.registry.counter_add(TERM_TOTAL, n, {"term": term})
+
+    # -- sparse candidate engine (ISSUE 16) --
+    def count_candidate_refresh(self, reason: str, n: int = 1) -> None:
+        """One sparse candidate-list build/refresh, by reason
+        (cold|dirty|stale) — per launch that rebuilt or re-merged, not
+        per coalesced request; a launch that reused clean resident
+        lists counts nothing."""
+        self.registry.counter_add(
+            CANDIDATE_REFRESH, int(n), {"reason": reason}
+        )
+
+    def set_candidate_width(self, width: int) -> None:
+        self.registry.gauge_set(CANDIDATE_WIDTH, int(width))
 
     # -- replicated serving tier (ISSUE 8) --
     def count_shed(self, method: str, band: str = "") -> None:

@@ -35,6 +35,14 @@ from koordinator_tpu.model.snapshot import ClusterSnapshot
 # 100k x 10k fp32 cost tensor is ~4 GB; the node tables scale with it).
 CLUSTER_AXIS = "nodes"
 
+# the POD mesh axis of the sparse candidate engine (ISSUE 16,
+# solver/candidates.py): the [P, C] candidate-index and candidate-score
+# tensors split over POD rows — each device builds and scores its own
+# pods' candidate lists against a REPLICATED node table, so the sparse
+# pipeline runs with zero collectives.  Orthogonal to CLUSTER_AXIS: the
+# dense residency scales the node axis, the sparse engine scales pods.
+POD_AXIS = "pods"
+
 
 def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
     """Version-compat shard_map: ``jax.shard_map`` (with its ``check_vma``
@@ -180,6 +188,64 @@ def shard_cluster_snapshot(snap: ClusterSnapshot, mesh: Mesh) -> ClusterSnapshot
         lambda spec, leaf: jax.device_put(leaf, spec),
         snapshot_shardings(snap, mesh),
         snap,
+    )
+
+
+def pod_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """The 1-D pod-axis mesh of the sparse candidate engine (ISSUE 16):
+    every device owns a pod-row slice of the [P, C] candidate tensors.
+    ``devices`` defaults to all visible devices; pass a power-of-two
+    prefix (``jax.devices()[:pow2_device_count(n)]``) so the pod bucket
+    (always a power of two) divides evenly."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.asarray(devices), (POD_AXIS,))
+
+
+def sparse_score_sharding(mesh: Mesh) -> NamedSharding:
+    """NamedSharding of the sparse [P, C] candidate-index / score /
+    feasible tensors: the POD axis (axis 0) splits over the pod mesh —
+    the transpose of the dense residency's :func:`score_sharding`
+    (``P(None, "nodes")``), because the sparse engine's scale axis is
+    pods (C is a small static width, never worth splitting)."""
+    return NamedSharding(mesh, P(POD_AXIS, None))
+
+
+def snapshot_pod_partition_specs(snap: ClusterSnapshot):
+    """Bare ``PartitionSpec``s placing ``snap`` for the sparse engine's
+    pod-parallel shard_map: POD rows split over :data:`POD_AXIS`, node
+    tables and the gang/quota/throughput side tables replicated (every
+    device gathers arbitrary node rows for its own pods' candidate
+    lists, so the node table must be whole on every device).  The
+    mirror-image classification of :func:`snapshot_partition_specs`."""
+    pod = lambda a: P(POD_AXIS, *([None] * (np.ndim(a) - 1)))
+    rep = lambda a: P()
+    nodes = snap.nodes
+    return ClusterSnapshot(
+        nodes=jax.tree_util.tree_map(rep, nodes),
+        pods=dataclass_replace(
+            snap.pods,
+            requests=pod(snap.pods.requests),
+            estimated=pod(snap.pods.estimated),
+            priority_class=pod(snap.pods.priority_class),
+            qos=pod(snap.pods.qos),
+            priority=pod(snap.pods.priority),
+            gang_id=pod(snap.pods.gang_id),
+            quota_id=pod(snap.pods.quota_id),
+            valid=pod(snap.pods.valid),
+            workload_class=(
+                None if snap.pods.workload_class is None
+                else pod(snap.pods.workload_class)
+            ),
+            sensitivity=(
+                None if snap.pods.sensitivity is None
+                else pod(snap.pods.sensitivity)
+            ),
+        ),
+        gangs=jax.tree_util.tree_map(rep, snap.gangs),
+        quotas=jax.tree_util.tree_map(rep, snap.quotas),
+        throughput=(
+            None if snap.throughput is None else rep(snap.throughput)
+        ),
     )
 
 

@@ -618,10 +618,21 @@ def greedy_assign_waves(
     wave: int = 32,
     top_m: int = 4,
     spans=None,
+    candidates: Optional[jnp.ndarray] = None,
 ):
     """Round-based sharded assignment (see _assign_waves): bit-identical
     with greedy_assign, one all_gather per round instead of one pmax per
     pod.  Returns (CycleResult, collective_round_count).
+
+    ``candidates``: an optional [P, C] sparse candidate-index map
+    (ISSUE 16, solver/candidates.py — ascending real node ids, pad
+    slots >= N).  Expanded host-side into a [P, N] membership mask and
+    ANDed into ``extra_mask`` BEFORE the node padding, so the wave
+    rounds only ever pick a pod's candidate nodes while the
+    cross-shard gang/quota reduction rides the existing top-M merge
+    unchanged — no new traced parameters, no new compiled shapes.
+    Exact whenever the lists are non-overflowed (every feasible node
+    is a member; see ``check_candidate_overflow``).
 
     Both fit strategies certify exactly: LeastAllocated through the
     frozen k_M lower bound (scores non-increasing in committed load),
@@ -648,6 +659,15 @@ def greedy_assign_waves(
     with maybe_span(spans, "shard_prep"):
         n_dev = mesh.size
         orig_n = snapshot.nodes.allocatable.shape[0]
+        if candidates is not None:
+            from koordinator_tpu.solver.candidates import (
+                candidate_membership_mask,
+            )
+
+            member = candidate_membership_mask(candidates, orig_n)
+            extra_mask = (
+                member if extra_mask is None else extra_mask & member
+            )
         snapshot = _pad_nodes_to(snapshot, n_dev)
         padded_n = snapshot.nodes.allocatable.shape[0]
         if extra_mask is not None and extra_mask.shape[1] != padded_n:

@@ -86,6 +86,7 @@ class SchedulerServer:
         max_inflight: Optional[int] = None,
         replicate_from: Optional[str] = None,
         score_incr_max_ratio: Optional[float] = None,
+        candidate_width: Optional[int] = None,
         journal: bool = False,
         journal_compact_every: Optional[int] = None,
         journal_fsync: bool = False,
@@ -131,6 +132,16 @@ class SchedulerServer:
                 self.profiles = load_config(fh.read())
             if self.profiles:
                 cfg = self.profiles[0].cycle
+        if candidate_width is not None:
+            # sparse candidate engine (ISSUE 16): the width rides the
+            # CycleConfig (a static jit argument), so the override must
+            # land before any servicer compiles — CycleConfig validates
+            # the power-of-two contract at construction
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                cfg, candidate_width=int(candidate_width)
+            )
         self.cfg = cfg
         self.elector = LeaderElector(
             lease_path,
@@ -690,6 +701,22 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "KOORD_SCORE_INCR_MAX_RATIO)",
     )
     ap.add_argument(
+        "--candidate-width", type=int,
+        dest="candidate_width",
+        default=(
+            int(os.environ["KOORD_CANDIDATE_WIDTH"])
+            if os.environ.get("KOORD_CANDIDATE_WIDTH") else None
+        ),
+        help="sparse candidate-set scoring (docs/KERNEL.md \"Sparse "
+        "candidate scoring\"): score each pod against only its C "
+        "lowest-indexed feasible nodes ([P, C] cells instead of the "
+        "dense [P, N] wall).  Power of two; 0 (default) keeps the "
+        "dense engines; 256 is the recommended serving width.  A pod "
+        "whose exact feasible fan-out exceeds C makes Score refuse "
+        "with FAILED_PRECONDITION rather than serve a truncated list "
+        "(env: KOORD_CANDIDATE_WIDTH)",
+    )
+    ap.add_argument(
         "--journal", action="store_true",
         default=bool(os.environ.get("KOORD_JOURNAL")),
         help="crash tolerance (docs/REPLICATION.md): append every "
@@ -813,6 +840,7 @@ def main(argv=None) -> int:
         max_inflight=args.max_inflight,
         replicate_from=args.replicate_from,
         score_incr_max_ratio=args.score_incr_max_ratio,
+        candidate_width=args.candidate_width,
         journal=args.journal,
         journal_compact_every=args.journal_compact_every,
         journal_fsync=args.journal_fsync,

@@ -7,6 +7,15 @@ from koordinator_tpu.solver.greedy import (  # noqa: F401
     score_cycle,
     greedy_assign,
 )
+from koordinator_tpu.solver.candidates import (  # noqa: F401
+    CandidateOverflow,
+    build_candidates,
+    candidate_membership_mask,
+    check_candidate_overflow,
+    refresh_candidates,
+    score_candidates,
+    sparse_top_k,
+)
 from koordinator_tpu.solver.incremental import rescore_dirty  # noqa: F401
 from koordinator_tpu.solver.topk import (  # noqa: F401
     masked_top_k,

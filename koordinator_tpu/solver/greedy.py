@@ -188,6 +188,40 @@ def step_feasible_scores(
     return feasible, total
 
 
+def feasibility_mask(
+    snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG
+) -> jnp.ndarray:
+    """The MASK half of :func:`score_all`, standalone (ISSUE 16).
+
+    Requests-fit + node-validity + loadaware freshness/threshold masks +
+    every enabled term's feasibility mask, with zero scoring arithmetic
+    — the cheap feasibility pre-mask the sparse candidate engine
+    (solver/candidates.py) evaluates blockwise to pick each pod's
+    candidate set without ever materializing the [P, N] score tensor.
+    Cellwise in (pod row, node row) like everything else in the body,
+    so it is shape-polymorphic over gathered sub-snapshots.
+
+    Exactness: ``score_all`` composes this mask with the score half;
+    masks only AND together and scores only add, so factoring changes
+    no bits — the bool this returns at (p, n) is the very ``feasible``
+    bit a full ``score_cycle`` would produce.
+    """
+    pods, nodes = snapshot.pods, snapshot.nodes
+    feasible = fit_mask(
+        pods.requests, nodes.requested, nodes.allocatable, nodes.valid, pods.valid
+    )
+    if cfg.enable_loadaware:
+        mask_default, mask_prod = loadaware_node_masks(nodes, cfg)
+        is_prod = pods.priority_class == int(PriorityClass.PROD)
+        la_mask = jnp.where(
+            is_prod[:, None], mask_prod[None, :], mask_default[None, :]
+        )
+        feasible = feasible & la_mask
+    from koordinator_tpu.solver.terms import apply_term_masks
+
+    return apply_term_masks(snapshot, cfg, feasible)
+
+
 def score_all(snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG):
     """The scoring math of :func:`score_cycle`, un-jitted.
 
@@ -203,18 +237,13 @@ def score_all(snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG
     are added INSIDE this one tensor program — cellwise by contract, so
     the incremental exactness argument extends to them unchanged and a
     three-term Score still costs exactly one launch.
+
+    Composed (ISSUE 16) from :func:`feasibility_mask` (the mask half —
+    the sparse engine's standalone pre-mask) and the score half; the
+    halves commute, so the factoring is bitwise free.
     """
     pods, nodes = snapshot.pods, snapshot.nodes
-    feasible = fit_mask(
-        pods.requests, nodes.requested, nodes.allocatable, nodes.valid, pods.valid
-    )
-    if cfg.enable_loadaware:
-        mask_default, mask_prod = loadaware_node_masks(nodes, cfg)
-        is_prod = pods.priority_class == int(PriorityClass.PROD)
-        la_mask = jnp.where(
-            is_prod[:, None], mask_prod[None, :], mask_default[None, :]
-        )
-        feasible = feasible & la_mask
+    feasible = feasibility_mask(snapshot, cfg)
     zero_nr = jnp.zeros_like(nodes.requested)
     scores = _combined_scores(
         snapshot,
@@ -225,9 +254,9 @@ def score_all(snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG
         _fit_score_requests(pods.requests),
         pods.estimated,
     )
-    from koordinator_tpu.solver.terms import apply_terms
+    from koordinator_tpu.solver.terms import apply_term_scores
 
-    return apply_terms(snapshot, cfg, scores, feasible)
+    return apply_term_scores(snapshot, cfg, scores), feasible
 
 
 @partial(jax.jit, static_argnames=("cfg",))

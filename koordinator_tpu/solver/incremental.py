@@ -46,6 +46,11 @@ is a ``shard_map`` where each device rebases the global dirty-column
 indices against its own shard, recomputes with its LOCAL node rows,
 and scatters only the columns it owns.  NO collective runs; in/out
 specs are equal, so no resharding program is ever minted.
+
+The sparse candidate engine (ISSUE 16, solver/candidates.py) reuses
+this module's gather/pad helpers (``_take_nodes``/``_take_pods``/
+``_pad_rows``) for its own dirty-row refreshes — same bucketing, same
+OOB-sentinel drop semantics, same retrace-free contract.
 """
 
 from __future__ import annotations

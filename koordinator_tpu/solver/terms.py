@@ -217,6 +217,33 @@ def terms_upper_bound(cfg) -> int:
     return sum(t.upper_bound(cfg) for t in enabled_terms(cfg))
 
 
+def apply_term_scores(snapshot, cfg, scores):
+    """The SCORE half of the term stack: fold every enabled term's
+    cellwise score contribution into ``scores``.  Factored out of
+    :func:`apply_terms` (ISSUE 16) so the sparse candidate engine can
+    run the mask half standalone (feasibility pre-mask) and the score
+    half over gathered [P, C] cells; additions commute, so the split
+    is bitwise identical to the fused loop."""
+    for term in enabled_terms(cfg):
+        s = term.score(snapshot, cfg)
+        if s is not None:
+            scores = scores + s
+    return scores
+
+
+def apply_term_masks(snapshot, cfg, feasible):
+    """The MASK half of the term stack: AND every enabled term's
+    cellwise feasibility mask into ``feasible`` — the term piece of the
+    standalone feasibility pre-mask (solver/greedy.py
+    ``feasibility_mask``, ISSUE 16).  ANDs commute, so running this
+    apart from the score half changes no bits."""
+    for term in enabled_terms(cfg):
+        m = term.mask(snapshot, cfg)
+        if m is not None:
+            feasible = feasible & m
+    return feasible
+
+
 def apply_terms(snapshot, cfg, scores, feasible):
     """Fuse every enabled term's cellwise contribution into the
     (scores, feasible) pair INSIDE the one tensor program — called from
@@ -224,15 +251,13 @@ def apply_terms(snapshot, cfg, scores, feasible):
     column/row rescore and the sharded rescore all carry the terms with
     zero extra launches.  Shape-polymorphic over gathered sub-snapshots
     (the incremental engine scores [P, d] and [d_p, N] blocks through
-    the same body)."""
-    for term in enabled_terms(cfg):
-        s = term.score(snapshot, cfg)
-        if s is not None:
-            scores = scores + s
-        m = term.mask(snapshot, cfg)
-        if m is not None:
-            feasible = feasible & m
-    return scores, feasible
+    the same body).  Composed from the score/mask halves: the halves
+    commute (adds with adds, ANDs with ANDs), so the sparse engine's
+    standalone mask pass stays bit-identical to this fused loop."""
+    return (
+        apply_term_scores(snapshot, cfg, scores),
+        apply_term_masks(snapshot, cfg, feasible),
+    )
 
 
 @partial(jax.jit, static_argnames=("cfg",))

@@ -598,3 +598,101 @@ class TestChaosTraceArtifactFields:
         assert doc["truncated"] is True
         assert doc["metric"] == "chaos-trace"
         assert "config_chaos-trace_cpu" in doc["error"]
+
+
+class TestSparseArtifactFields:
+    """ISSUE 16 satellite: the sparse candidate-scoring probe fields
+    archive well-formed or not at all — including the one non-numeric
+    legal value, the literal ``"OOM"`` that records the dense engine
+    REFUSING to allocate its [P, N] tensors at the headline scale."""
+
+    def _line(self, **extra):
+        doc = {"metric": "sparse_score_ms", "value": 59.5, "unit": "ms"}
+        doc.update(extra)
+        return json.dumps(doc)
+
+    def test_full_sparse_artifact_with_dense_oom_is_valid(self):
+        assert bench._validate_artifact(self._line(
+            sparse_score_ms=59.5, sparse_build_ms=36900.0,
+            dense_score_ms="OOM", sparse_speedup=6.4,
+            candidate_width=256, candidate_refresh_total=10,
+        )) == []
+
+    def test_both_engines_measured_is_valid(self):
+        assert bench._validate_artifact(self._line(
+            sparse_score_ms=19.7, dense_score_ms=17217.0,
+            sparse_speedup=873.9, candidate_width=256,
+            candidate_refresh_total=9,
+        )) == []
+
+    def test_dense_score_ms_rejects_everything_but_oom_or_number(self):
+        assert bench._validate_artifact(self._line(dense_score_ms=None)) == []
+        assert bench._validate_artifact(self._line(dense_score_ms=0)) == []
+        assert bench._validate_artifact(self._line(dense_score_ms="oom"))
+        assert bench._validate_artifact(self._line(dense_score_ms="fast"))
+        assert bench._validate_artifact(self._line(dense_score_ms=-1))
+        assert bench._validate_artifact(
+            self._line(dense_score_ms=float("nan"))
+        )
+
+    def test_sparse_timings_must_be_finite_nonneg(self):
+        assert bench._validate_artifact(self._line(sparse_score_ms=-0.1))
+        assert bench._validate_artifact(
+            self._line(sparse_build_ms=float("inf"))
+        )
+        assert bench._validate_artifact(self._line(sparse_speedup=-2.0))
+        assert bench._validate_artifact(
+            self._line(sparse_speedup=float("nan"))
+        )
+
+    def test_candidate_width_must_be_a_positive_int(self):
+        assert bench._validate_artifact(self._line(candidate_width=256)) == []
+        assert bench._validate_artifact(self._line(candidate_width=0))
+        assert bench._validate_artifact(self._line(candidate_width=True))
+        assert bench._validate_artifact(self._line(candidate_width=64.0))
+
+    def test_candidate_refresh_total_must_be_a_nonneg_int(self):
+        assert bench._validate_artifact(
+            self._line(candidate_refresh_total=0)
+        ) == []
+        assert bench._validate_artifact(
+            self._line(candidate_refresh_total=-1)
+        )
+        assert bench._validate_artifact(
+            self._line(candidate_refresh_total=True)
+        )
+        assert bench._validate_artifact(
+            self._line(candidate_refresh_total=9.5)
+        )
+
+    def test_deadline_killed_sparse_run_flushes_truncated_artifact(self):
+        """A sparse run wedged mid-build (the blocked sweep at the
+        headline node count is the slow stage) still puts ONE
+        schema-valid truncated artifact on stdout stamped with the
+        stage it died in."""
+        emitted, fired = [], []
+        now = [0.0]
+
+        def sleep(s):
+            now[0] += s
+
+        d = bench._ArtifactDeadline(
+            100.0,
+            emit=lambda line: emitted.append(line) or True,
+            clock=lambda: now[0],
+            sleep=sleep,
+            on_fire=lambda rc: fired.append(rc),
+            metric="sparse",  # main() arms it with args.config
+        )
+        old_stage = bench._PROGRESS["stage"]
+        try:
+            bench._PROGRESS["stage"] = "config_sparse_cpu"
+            d.watch()
+        finally:
+            bench._PROGRESS["stage"] = old_stage
+        assert fired == [1] and len(emitted) == 1
+        assert bench._validate_artifact(emitted[0]) == []
+        doc = json.loads(emitted[0])
+        assert doc["truncated"] is True
+        assert doc["metric"] == "sparse"
+        assert "config_sparse_cpu" in doc["error"]

@@ -1,0 +1,471 @@
+"""Sparse candidate-set scoring (ISSUE 16): the [P, C] exactness suite.
+
+The contract under test: wherever the configured candidate width C can
+hold every feasible node (``count <= C`` for all pods), the sparse
+engine is BIT-IDENTICAL to the dense [P, N] engine — same scores, same
+winners, same tie-breaks — at the solver layer, through the pod-axis
+mesh, and through server reply bytes; wherever it cannot, the engine
+REFUSES (``CandidateOverflow`` -> FAILED_PRECONDITION) rather than
+serve a silently truncated candidate set.  Plus the two properties the
+warm path leans on: ``refresh_candidates`` after any dirty set equals
+a from-scratch rebuild (merge exactness keeps overflow detection
+truthful across delta streams), and a steady warm delta/Score stream
+through the sparse servicer holds ZERO jit cache misses.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from koordinator_tpu.bridge.codegen import pb2
+from koordinator_tpu.bridge.server import ScorerServicer
+from koordinator_tpu.bridge.state import numpy_to_tensor
+from koordinator_tpu.config import CycleConfig, PackingTermArgs
+from koordinator_tpu.harness import generators
+from koordinator_tpu.harness.golden import build_sync_request
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.model.snapshot import (
+    ClusterSnapshot,
+    GangTable,
+    NodeBatch,
+    PodBatch,
+    QuotaTable,
+)
+from koordinator_tpu.solver import masked_top_k, score_cycle, score_upper_bound
+from koordinator_tpu.solver.candidates import (
+    CandidateOverflow,
+    build_candidates,
+    candidate_membership_mask,
+    check_candidate_overflow,
+    refresh_candidates,
+    score_candidates,
+    sparse_top_k,
+)
+
+R = res.NUM_RESOURCES
+_CPU = res.RESOURCE_INDEX[res.CPU]
+_MEM = res.RESOURCE_INDEX[res.MEMORY]
+_PODS = res.RESOURCE_INDEX[res.PODS]
+
+# both engines take the SAME static cfg (score_cycle ignores the width
+# knob), so any divergence is the sparse path's fault — not a term-
+# stack mismatch.  "terms" adds the packing term WITH a headroom mask
+# so the feasibility pre-mask carries a term-mask component too.
+CFGS = {
+    "default": CycleConfig(candidate_width=64),
+    "terms": CycleConfig(
+        candidate_width=64,
+        packing=PackingTermArgs(weight=2, headroom={res.CPU: 97}),
+    ),
+}
+
+
+def _snapshot_from(generator, **kw):
+    """A padded, device-resident snapshot the servicer itself would
+    serve (gangs + quota active): generator dict lists -> SyncRequest
+    -> resident snapshot.  Buckets pin N=64 (so C=64 >= any feasible
+    count) and P=128 (divisible over the 8-device pod mesh)."""
+    nl, pl, gl, ql = generator(**kw)
+    req, _qids = build_sync_request(nl, pl, gl, ql,
+                                    node_bucket=64, pod_bucket=128)
+    sv = ScorerServicer()
+    sv.sync(req)
+    return sv.state.snapshot()
+
+
+def _pod_mesh_or_skip():
+    from koordinator_tpu.parallel.mesh import pod_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return pod_mesh(jax.devices())
+
+
+def _narrow_snapshot(n, p, n_open, seed=16, extra_nodes=()):
+    """The sparse regime straight from numpy: exactly ``n_open`` nodes
+    have headroom for the uniform 500m/512Mi pods, the rest sit
+    requested-to-the-brim (200m free), so every pod's exact feasible
+    count is ``n_open``.  ``extra_nodes`` rows are forced open too."""
+    rng = np.random.default_rng(seed)
+    nalloc = np.zeros((n, R), np.int64)
+    nalloc[:, _CPU] = 32_000
+    nalloc[:, _MEM] = 128 * 1024
+    nalloc[:, _PODS] = 256
+    nreq = np.zeros((n, R), np.int64)
+    nreq[:, _CPU] = 31_800
+    open_rows = rng.choice(n, size=n_open, replace=False)
+    nreq[open_rows, _CPU] = 0
+    nreq[list(extra_nodes), _CPU] = 0
+    preq = np.zeros((p, R), np.int64)
+    preq[:, _CPU], preq[:, _MEM] = 500, 512
+    preq[:, _PODS] = 1
+    return ClusterSnapshot(
+        nodes=NodeBatch(
+            allocatable=jnp.asarray(nalloc),
+            requested=jnp.asarray(nreq),
+            usage=jnp.asarray((nalloc * 0.3).astype(np.int64)),
+            metric_fresh=jnp.ones(n, bool),
+            valid=jnp.ones(n, bool),
+        ),
+        pods=PodBatch(
+            requests=jnp.asarray(preq),
+            estimated=jnp.asarray(preq),
+            priority_class=jnp.zeros(p, np.int32),
+            qos=jnp.zeros(p, np.int32),
+            priority=jnp.full(p, 5000, np.int32),
+            gang_id=jnp.full(p, -1, np.int32),
+            quota_id=jnp.full(p, -1, np.int32),
+            valid=jnp.ones(p, bool),
+        ),
+        gangs=GangTable(
+            min_member=jnp.zeros(1, np.int32),
+            valid=jnp.zeros(1, bool),
+        ),
+        quotas=QuotaTable(
+            runtime=jnp.zeros((1, R), np.int64),
+            used=jnp.zeros((1, R), np.int64),
+            limited=jnp.zeros((1, R), bool),
+            valid=jnp.zeros(1, bool),
+        ),
+    )
+
+
+def _assert_sparse_equals_dense(snap, cfg, mesh=None, k=8):
+    """The whole parity contract in one sweep: exact counts, exact
+    candidate membership, bit-equal cell scores, and identical top-k
+    winners after the index-map-back."""
+    n = snap.nodes.allocatable.shape[0]
+    p = snap.pods.requests.shape[0]
+    cand, count = build_candidates(snap, cfg, mesh=mesh)
+    count_np = np.asarray(count)
+    check_candidate_overflow(count_np, cfg.candidate_width)
+
+    s_d, f_d = score_cycle(snap, cfg)
+    s_d, f_d = np.asarray(s_d), np.asarray(f_d)
+    # counts are the dense feasible row sums, exactly
+    np.testing.assert_array_equal(count_np, f_d.sum(axis=1))
+    # the lists hold EVERY feasible node and nothing else: membership
+    # mask == the dense feasibility tensor (feasibility pre-mask ==
+    # the mask half of score_all, the factoring under test)
+    np.testing.assert_array_equal(
+        np.asarray(candidate_membership_mask(cand, n)), f_d
+    )
+    # ... ascending with the sentinel N in pads
+    cand_np = np.asarray(cand)
+    assert (np.diff(cand_np.astype(np.int64), axis=1) >= 0).all()
+    assert (cand_np[count_np[:, None] <= np.arange(cand_np.shape[1])]
+            == n).all()
+
+    # gathered cells score bit-identically to the dense cells
+    s_sp, f_sp = score_candidates(snap, cand, cfg, mesh=mesh)
+    s_sp, f_sp = np.asarray(s_sp), np.asarray(f_sp)
+    real = cand_np < n
+    rows = np.nonzero(real)[0]
+    np.testing.assert_array_equal(f_sp[real], f_d[rows, cand_np[real]])
+    np.testing.assert_array_equal(s_sp[real], s_d[rows, cand_np[real]])
+    assert not f_sp[~real].any()
+
+    # serving top-k: same scores, same ok bits, same node ids at ok
+    hi = score_upper_bound(cfg)
+    ts_sp, ti_sp, ok_sp = sparse_top_k(s_sp, f_sp, cand, k=k, hi=hi)
+    ts_d, ti_d = masked_top_k(
+        jnp.asarray(s_d), jnp.asarray(f_d), k=k, hi=hi
+    )
+    ts_sp, ti_sp, ok_sp = map(np.asarray, (ts_sp, ti_sp, ok_sp))
+    ts_d, ti_d = np.asarray(ts_d), np.asarray(ti_d)
+    ok_d = f_d[np.arange(p)[:, None], ti_d]
+    np.testing.assert_array_equal(ts_sp, ts_d)
+    np.testing.assert_array_equal(ok_sp, ok_d)
+    np.testing.assert_array_equal(
+        np.where(ok_sp, ti_sp, -1), np.where(ok_d, ti_d, -1)
+    )
+
+
+class TestDenseParity:
+    """C >= N: the candidate lists can hold every feasible node, so the
+    sparse engine must be indistinguishable from the dense one."""
+
+    @pytest.mark.parametrize("cfg_name", sorted(CFGS))
+    def test_quota_cluster_parity(self, cfg_name):
+        snap = _snapshot_from(
+            generators.quota_colocation, pods=96, nodes=48, tenants=4
+        )
+        _assert_sparse_equals_dense(snap, CFGS[cfg_name])
+
+    def test_gang_cluster_parity(self):
+        snap = _snapshot_from(
+            generators.gang_batch, pods=96, nodes=48, min_member=8
+        )
+        _assert_sparse_equals_dense(snap, CFGS["default"])
+
+    def test_pod_mesh_parity(self):
+        """The pod-axis shard_map variants (build/score over 8 devices)
+        hold the same bit-parity as the unsharded functions."""
+        mesh = _pod_mesh_or_skip()
+        snap = _snapshot_from(
+            generators.quota_colocation, pods=96, nodes=48, tenants=4
+        )
+        _assert_sparse_equals_dense(snap, CFGS["default"], mesh=mesh)
+
+    def test_server_reply_bytes_match_dense_servicer(self):
+        """Through the whole serving stack: a sparse servicer's flat
+        Score reply bytes equal a dense servicer's, cold and after a
+        warm delta."""
+        nl, pl, gl, ql = generators.quota_colocation(pods=96, nodes=48)
+        req, _ = build_sync_request(nl, pl, gl, ql,
+                                    node_bucket=64, pod_bucket=128)
+        payload = req.SerializeToString()
+        sp = ScorerServicer(
+            cfg=CycleConfig(candidate_width=64), score_memo=False
+        )
+        dn = ScorerServicer(score_memo=False, score_incr=False)
+        for sv in (sp, dn):
+            sv.sync(pb2.SyncRequest.FromString(payload))
+
+        def flat(sv):
+            return sv.score(pb2.ScoreRequest(
+                snapshot_id=sv.snapshot_id(), top_k=8, flat=True
+            )).flat.SerializeToString()
+
+        assert flat(sp) == flat(dn)
+        base = np.asarray(sp.state.node_requested, np.int64).copy()
+        prev = base.copy()
+        base[::7, _CPU] += 50
+        warm = pb2.SyncRequest()
+        warm.nodes.requested.CopyFrom(numpy_to_tensor(base, prev))
+        raw = warm.SerializeToString()
+        for sv in (sp, dn):
+            sv.sync(pb2.SyncRequest.FromString(raw))
+            assert sv.state.last_sync_path == "warm"
+        assert flat(sp) == flat(dn)
+
+
+class TestDirtyRefreshExactness:
+    """refresh_candidates == build_candidates on the post-delta
+    snapshot, bit for bit — the merge exactness the resident lists
+    (and their overflow detection) depend on."""
+
+    def _dirty_pair(self, snap):
+        """One realistic delta: close two open nodes, open one closed
+        node, double two pods' asks.  Returns (snap2, node_rows,
+        pod_rows)."""
+        nreq = np.asarray(snap.nodes.requested, np.int64).copy()
+        preq = np.asarray(snap.pods.requests, np.int64).copy()
+        node_rows = np.asarray([0, 3, 17], np.int64)
+        nreq[0] = np.asarray(snap.nodes.allocatable)[0]  # now full
+        nreq[3] = np.asarray(snap.nodes.allocatable)[3]
+        nreq[17] = 0  # wide open
+        pod_rows = np.asarray([5, 9], np.int64)
+        preq[pod_rows] *= 2
+        snap2 = dataclasses.replace(
+            snap,
+            nodes=dataclasses.replace(
+                snap.nodes, requested=jnp.asarray(nreq)
+            ),
+            pods=dataclasses.replace(
+                snap.pods, requests=jnp.asarray(preq)
+            ),
+        )
+        return snap2, node_rows, pod_rows
+
+    @pytest.mark.parametrize("use_mesh", (False, True))
+    def test_refresh_equals_cold_rebuild(self, use_mesh):
+        mesh = _pod_mesh_or_skip() if use_mesh else None
+        cfg = CFGS["default"]
+        snap = _snapshot_from(
+            generators.quota_colocation, pods=96, nodes=48, tenants=4
+        )
+        cand, count = build_candidates(snap, cfg, mesh=mesh)
+        snap2, node_rows, pod_rows = self._dirty_pair(snap)
+        got_c, got_n = refresh_candidates(
+            snap2, cand, count, node_rows, pod_rows, cfg, mesh=mesh
+        )
+        want_c, want_n = build_candidates(snap2, cfg, mesh=mesh)
+        np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
+        np.testing.assert_array_equal(np.asarray(got_n), np.asarray(want_n))
+        # and the refreshed lists still carry full dense parity
+        _assert_sparse_equals_dense(snap2, cfg, mesh=mesh)
+
+    def test_refresh_detects_overflow_created_by_the_delta(self):
+        """A delta that opens more nodes than C can hold must surface
+        in the refreshed COUNTS — exact counts through the merge are
+        what keep the refusal truthful on warm streams."""
+        cfg = CycleConfig(candidate_width=8)
+        snap = _narrow_snapshot(n=64, p=16, n_open=4, seed=3)
+        cand, count = build_candidates(snap, cfg)
+        check_candidate_overflow(np.asarray(count), 8)  # 4 <= 8: fine
+        nreq = np.asarray(snap.nodes.requested, np.int64).copy()
+        opened = np.arange(24)  # far past C=8
+        nreq[opened, _CPU] = 0
+        snap2 = dataclasses.replace(
+            snap,
+            nodes=dataclasses.replace(
+                snap.nodes, requested=jnp.asarray(nreq)
+            ),
+        )
+        _c2, count2 = refresh_candidates(
+            snap2, cand, count, opened, np.asarray([], np.int64), cfg
+        )
+        count2 = np.asarray(count2)
+        np.testing.assert_array_equal(
+            count2, np.asarray(build_candidates(snap2, cfg)[1])
+        )
+        with pytest.raises(CandidateOverflow):
+            check_candidate_overflow(count2, 8)
+
+
+class TestWarmStreamRetraceFree:
+    """The sparse servicer's compile economics: after warm-up, a
+    steady delta-Sync/Score stream holds ZERO jit cache misses while
+    staying byte-identical to the dense servicer — and the stream
+    actually exercises the merge-refresh (the counter proves it)."""
+
+    def test_warm_sparse_stream_zero_misses_and_parity(self):
+        from koordinator_tpu.analysis import retrace_guard
+        from koordinator_tpu.obs.scorer_metrics import (
+            CANDIDATE_REFRESH,
+            CANDIDATE_WIDTH,
+        )
+
+        nl, pl, gl, ql = generators.quota_colocation(pods=96, nodes=48)
+        req, _ = build_sync_request(nl, pl, gl, ql,
+                                    node_bucket=64, pod_bucket=128)
+        payload = req.SerializeToString()
+        sp = ScorerServicer(
+            cfg=CycleConfig(candidate_width=64), score_memo=False
+        )
+        dn = ScorerServicer(score_memo=False, score_incr=False)
+        for sv in (sp, dn):
+            sv.sync(pb2.SyncRequest.FromString(payload))
+
+        def flat(sv):
+            return sv.score(pb2.ScoreRequest(
+                snapshot_id=sv.snapshot_id(), top_k=8, flat=True
+            )).flat.SerializeToString()
+
+        base = np.asarray(sp.state.node_requested, np.int64).copy()
+        rows = np.arange(0, base.shape[0], 9)
+
+        def delta(rep):
+            prev = base.copy()
+            base[rows, _CPU] += 1 + rep
+            warm = pb2.SyncRequest()
+            warm.nodes.requested.CopyFrom(numpy_to_tensor(base, prev))
+            raw = warm.SerializeToString()
+            for sv in (sp, dn):
+                sv.sync(pb2.SyncRequest.FromString(raw))
+                assert sv.state.last_sync_path == "warm"
+
+        # warm-up: the cold build + the dirty-bucket refresh shapes
+        assert flat(sp) == flat(dn)
+        delta(0)
+        assert flat(sp) == flat(dn)
+        with retrace_guard(budget=0) as counter:
+            for rep in range(1, 5):
+                delta(rep)
+                assert flat(sp) == flat(dn)
+        assert counter.traces == 0 and counter.compiles == 0
+
+        reg = sp.telemetry.registry
+        assert (reg.get(CANDIDATE_REFRESH, {"reason": "cold"}) or 0) >= 1
+        assert (reg.get(CANDIDATE_REFRESH, {"reason": "dirty"}) or 0) >= 4
+        assert reg.get(CANDIDATE_WIDTH) == 64
+
+
+class TestOverflowRefusal:
+    """count > C: refuse, never truncate — and stay refusing until the
+    operator widens C (no flapping through the cold-rebuild path)."""
+
+    def test_build_overflow_raises_with_sizing_advice(self):
+        cfg = CycleConfig(candidate_width=8)
+        snap = _narrow_snapshot(n=64, p=16, n_open=24, seed=7)
+        _cand, count = build_candidates(snap, cfg)
+        with pytest.raises(CandidateOverflow) as ei:
+            check_candidate_overflow(np.asarray(count), 8)
+        assert ei.value.width == 8
+        assert ei.value.max_feasible == 24
+        assert ei.value.pods == 16
+        assert "--candidate-width" in str(ei.value)
+
+    def test_servicer_refuses_and_keeps_refusing(self):
+        """The servicer path: overflow drops the residency (the lists
+        must never merge-refresh past a refusal) and the NEXT Score
+        cold-rebuilds into the same refusal; widening C serves the
+        same cluster dense-identically."""
+        nl, pl, gl, ql = generators.quota_colocation(pods=96, nodes=48)
+        req, _ = build_sync_request(nl, pl, gl, ql,
+                                    node_bucket=64, pod_bucket=128)
+        payload = req.SerializeToString()
+        sv = ScorerServicer(
+            cfg=CycleConfig(candidate_width=8), score_memo=False
+        )
+        sv.sync(pb2.SyncRequest.FromString(payload))
+        score_req = pb2.ScoreRequest(
+            snapshot_id=sv.snapshot_id(), top_k=8, flat=True
+        )
+        with pytest.raises(CandidateOverflow):
+            sv.score(score_req)
+        assert sv.state.candidate_residency() is None
+        with pytest.raises(CandidateOverflow):
+            sv.score(score_req)
+        assert sv.state.candidate_residency() is None
+
+        wide = ScorerServicer(
+            cfg=CycleConfig(candidate_width=64), score_memo=False
+        )
+        dn = ScorerServicer(score_memo=False, score_incr=False)
+        for s in (wide, dn):
+            s.sync(pb2.SyncRequest.FromString(payload))
+        assert wide.score(pb2.ScoreRequest(
+            snapshot_id=wide.snapshot_id(), top_k=8, flat=True
+        )).flat.SerializeToString() == dn.score(pb2.ScoreRequest(
+            snapshot_id=dn.snapshot_id(), top_k=8, flat=True
+        )).flat.SerializeToString()
+
+    def test_overflow_is_failed_precondition_on_the_wire(self, tmp_path):
+        """Over real gRPC the refusal lands as FAILED_PRECONDITION with
+        the sizing advice in the details — the status koordinator's
+        plugin maps to Unschedulable, not a retryable fault."""
+        import grpc
+
+        from koordinator_tpu.bridge.codegen import method_path
+        from koordinator_tpu.bridge.server import make_server
+
+        nl, pl, gl, ql = generators.quota_colocation(pods=96, nodes=48)
+        req, _ = build_sync_request(nl, pl, gl, ql,
+                                    node_bucket=64, pod_bucket=128)
+        sv = ScorerServicer(
+            cfg=CycleConfig(candidate_width=8), score_memo=False
+        )
+        server = make_server(servicer=sv)
+        sock = os.path.join(str(tmp_path), "s.sock")
+        server.add_insecure_port(f"unix://{sock}")
+        server.start()
+        try:
+            ch = grpc.insecure_channel(f"unix://{sock}")
+            sync = ch.unary_unary(
+                method_path("Sync"),
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb2.SyncReply.FromString,
+            )
+            score = ch.unary_unary(
+                method_path("Score"),
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb2.ScoreReply.FromString,
+            )
+            sid = sync(req).snapshot_id
+            with pytest.raises(grpc.RpcError) as ei:
+                score(pb2.ScoreRequest(
+                    snapshot_id=sid, top_k=8, flat=True
+                ))
+            assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+            assert "--candidate-width" in ei.value.details()
+            ch.close()
+        finally:
+            sv.telemetry.close()
+            server.stop(0)

@@ -424,6 +424,54 @@ class TestRetraceHazard:
         sized = jax.jit(_inner, static_argnames=("n_dirty",))
         """) == []
 
+    def test_traced_candidate_knobs_caught(self):
+        """ISSUE 16: a jit boundary taking a candidate width/count
+        traced is the same silent retrace class — the width is
+        configuration (it rides the static CycleConfig) and per-pod
+        feasible counts vary with every delta, so each distinct value
+        re-specializes the sparse [P, C] program; decorator and
+        call-form spellings both caught."""
+        got = lint("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def sparse_score(snapshot, cand, cfg, num_candidates):
+            return cand
+
+        def _inner(cand, c_width):
+            return cand
+
+        gather = jax.jit(_inner)
+        """)
+        msgs = [(v.rule, v.message) for v in got]
+        assert len(msgs) == 2, msgs
+        assert all(r == "retrace-hazard" for r, _ in msgs)
+        assert sum("'num_candidates'" in m for _, m in msgs) == 1
+        assert sum("'c_width'" in m for _, m in msgs) == 1
+        assert all(
+            "pad the candidate list, don't trace the count" in m
+            for _, m in msgs
+        )
+
+    def test_static_or_padded_candidate_params_are_clean(self):
+        # the shipped spelling: C rides the static cfg and the list is
+        # padded to C with sentinels — no count at any boundary; an
+        # explicitly-static width is also accepted
+        assert lint("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def sparse_score(snapshot, cand, count, cfg):
+            return cand
+
+        def _inner(cand, candidate_width):
+            return cand
+
+        sized = jax.jit(_inner, static_argnames=("candidate_width",))
+        """) == []
+
     def test_mesh_knob_in_shard_map_body_caught(self):
         """A shard_map body taking a mesh knob as a PARAMETER receives
         it as a traced per-shard operand; the mesh belongs in the
