@@ -56,9 +56,34 @@ Rules (each suppressible per line with ``# koordlint: disable=<rule>``):
   table in docs/OBSERVABILITY.md, both directions plus the declared
   kind: an undocumented metric or a documented-but-never-exported one
   fails lint like a one-sided wire edit.
+* ``lock-order-cycle``  — the whole-program lock graph
+  (analysis/lockgraph.py): every ``threading.Lock/RLock/Condition``
+  creation site becomes a canonical identity, nested acquisitions
+  (lexical ``with`` nesting, calls resolved through the cross-module
+  method table, the ``@launch_section``/``run_exclusive`` dispatch
+  seams, ``Condition.wait`` re-acquires) become order edges, and any
+  cycle in the derived order — a deadlock two threads can close —
+  fails lint.
+* ``lockorder-doc-drift`` — the derived lock order IS
+  ``docs/LOCKORDER.md`` (generated; ``--write-lockorder``): a lock or
+  edge missing from the doc, a doc row nothing derives, or a witness
+  factory name disagreeing with the derived identity fails lint, both
+  directions (the metrics-doc-drift pattern).
+* ``unguarded-shared-state`` — guarded-state inference
+  (analysis/guards.py): an attribute a class writes under its lock is
+  presumed lock-protected, so a lock-free write elsewhere (or a
+  lock-free read of a structure mutated in place under the lock)
+  fails; ``__init__``/``*_locked`` methods and rebind-only atomic
+  reads are exempt, everything else takes a REASONED suppression.
 
-The runtime companion ``analysis.retrace_guard`` locks the warm path's
-compile economics in at test time (tests/test_resident_warm.py).
+The runtime companions: ``analysis.retrace_guard`` locks the warm
+path's compile economics in at test time (tests/test_resident_warm.py),
+and ``obs.lockwitness`` (``KOORD_LOCK_WITNESS=1``) validates the
+derived lock order against real interleavings — the chaos-trace and
+replication-storm replays run witness-enabled in tier-1.  The
+suppression ledger is auditable: ``--suppressions`` lists every live
+disable tag and fails on stale tags or reason-required rules
+suppressed without a reason.
 """
 
 from koordinator_tpu.analysis.core import (  # noqa: F401
@@ -83,4 +108,7 @@ RULES = (
     "unbounded-wait",
     "wire-contract",
     "metrics-doc-drift",
+    "lock-order-cycle",
+    "lockorder-doc-drift",
+    "unguarded-shared-state",
 )

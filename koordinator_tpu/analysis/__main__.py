@@ -33,11 +33,35 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--list-rules", action="store_true", help="print rule names and exit"
     )
+    ap.add_argument(
+        "--suppressions", action="store_true",
+        help="audit every live 'koordlint: disable=' tag: list them "
+        "with reasons; stale tags and reason-required rules suppressed "
+        "without a reason fail the run",
+    )
+    ap.add_argument(
+        "--write-lockorder", action="store_true",
+        help="regenerate docs/LOCKORDER.md from the derived lock graph "
+        "and exit",
+    )
     args = ap.parse_args(argv)
     if args.list_rules:
         for rule in RULES:
             print(rule)
         return 0
+    if args.write_lockorder:
+        from koordinator_tpu.analysis import lockgraph
+        from koordinator_tpu.analysis.core import find_repo_root
+
+        path = lockgraph.write_lockorder(args.root or find_repo_root())
+        print(f"wrote {path}")
+        return 0
+    if args.suppressions:
+        from koordinator_tpu.analysis import suppressions
+
+        tags, problems = suppressions.audit(args.root)
+        print(suppressions.format_report(tags, problems))
+        return 1 if problems else 0
     rules = None
     if args.rules:
         rules = [r.strip() for r in args.rules.split(",") if r.strip()]

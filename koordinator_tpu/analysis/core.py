@@ -115,14 +115,19 @@ def _filter(source: SourceFile, violations: Iterable[Violation]) -> List[Violati
 
 
 def run_rules_on_source(
-    path: str, text: str, rules: Optional[Sequence[str]] = None
+    path: str, text: str, rules: Optional[Sequence[str]] = None,
+    honor_suppressions: bool = True,
 ) -> List[Violation]:
     """Run the AST rules over one file's source text (the unit-test seam:
-    seeded-regression fixtures feed synthetic sources through here)."""
+    seeded-regression fixtures feed synthetic sources through here).
+    ``honor_suppressions=False`` returns the RAW findings — the
+    suppression audit diffs them against the live tags to spot stale
+    annotations."""
     from koordinator_tpu.analysis import (
         bareretry,
         donation,
         excepts,
+        guards,
         hostsync,
         lockdispatch,
         retrace,
@@ -151,11 +156,14 @@ def run_rules_on_source(
         "lock-held-dispatch": lockdispatch.check,
         "bare-retry": bareretry.check,
         "unbounded-wait": unboundedwait.check,
+        "unguarded-shared-state": guards.check,
     }
     for rule, fn in table.items():
         if rules is not None and rule not in rules:
             continue
-        out.extend(_filter(source, fn(source)))
+        found = fn(source)
+        out.extend(found if not honor_suppressions
+                   else _filter(source, found))
     out.sort(key=lambda v: (v.path, v.line, v.rule))
     return out
 
@@ -188,11 +196,13 @@ def run_repo(
     root: Optional[str] = None,
     rules: Optional[Sequence[str]] = None,
     wire: bool = True,
+    honor_suppressions: bool = True,
 ) -> List[Violation]:
     """The full pass: AST rules over every repo Python file plus the
-    cross-language wire-contract diff and the metrics-vs-doc table
-    diff.  Returns sorted violations."""
-    from koordinator_tpu.analysis import metricsdoc, wire_contract
+    cross-language wire-contract diff, the metrics-vs-doc table diff
+    and the whole-program lock-order graph (cycles + LOCKORDER.md
+    drift).  Returns sorted violations."""
+    from koordinator_tpu.analysis import lockgraph, metricsdoc, wire_contract
 
     root = root or find_repo_root(os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__)))))
@@ -203,36 +213,50 @@ def run_repo(
         if not os.path.isdir(scan_root):
             continue
         for path in iter_python_files(scan_root):
-            out.extend(_run_file(path, root, rules))
+            out.extend(_run_file(path, root, rules, honor_suppressions))
     for path in extra_files:
         if os.path.exists(path):
-            out.extend(_run_file(path, root, rules))
+            out.extend(_run_file(path, root, rules, honor_suppressions))
     if wire and (rules is None or "wire-contract" in rules):
-        out.extend(_filter_file_comments(root, wire_contract.check_repo(root)))
+        out.extend(_filter_file_comments(
+            root, wire_contract.check_repo(root), honor_suppressions))
     if rules is None or "metrics-doc-drift" in rules:
-        out.extend(_filter_file_comments(root, metricsdoc.check_repo(root)))
+        out.extend(_filter_file_comments(
+            root, metricsdoc.check_repo(root), honor_suppressions))
+    if rules is None or {lockgraph.CYCLE_RULE, lockgraph.DRIFT_RULE} & set(rules):
+        found = [
+            v for v in lockgraph.check_repo(root)
+            if rules is None or v.rule in rules
+        ]
+        out.extend(_filter_file_comments(root, found, honor_suppressions))
     out.sort(key=lambda v: (v.path, v.line, v.rule))
     return out
 
 
 def _filter_file_comments(
-    root: str, violations: Iterable[Violation]
+    root: str, violations: Iterable[Violation],
+    honor_suppressions: bool = True,
 ) -> List[Violation]:
-    """Line-suppression for non-AST rules (wire-contract points at Go
-    sources): honor ``// koordlint: disable=<rule>`` on the flagged line
-    or the line above.  Line-0 violations (message-level drift like a
-    never-emitted field or a stale pb2 regen) are deliberately NOT
-    suppressible — the fix there is the wire edit or a regen, and the
-    ``_ALLOWED_UNDECODED`` allowlist covers legitimate one-sided reads."""
+    """Line-suppression for the repo-wide rules (wire-contract points at
+    Go sources, the lock-graph rules at Python ones): honor
+    ``// koordlint: disable=<rule>`` / ``# koordlint: ...`` on the
+    flagged line or the line above.  Line-0 violations (message-level
+    drift like a never-emitted field, a stale pb2 regen or a stale
+    generated doc) are deliberately NOT suppressible — the fix there is
+    the wire edit or a regen, and the ``_ALLOWED_UNDECODED`` allowlist
+    covers legitimate one-sided reads."""
+    if not honor_suppressions:
+        return list(violations)
     cache: Dict[str, Dict[int, Set[str]]] = {}
     out: List[Violation] = []
     for v in violations:
         if v.line > 0:
             if v.path not in cache:
                 path = os.path.join(root, v.path)
+                lang = "python" if v.path.endswith(".py") else "go"
                 try:
                     with open(path, "r", encoding="utf-8") as f:
-                        cache[v.path] = parse_suppressions(f.read(), lang="go")
+                        cache[v.path] = parse_suppressions(f.read(), lang=lang)
                 except OSError:
                     cache[v.path] = {}
             sups = cache[v.path]
@@ -244,8 +268,9 @@ def _filter_file_comments(
     return out
 
 
-def _run_file(path: str, root: str, rules: Optional[Sequence[str]]) -> List[Violation]:
+def _run_file(path: str, root: str, rules: Optional[Sequence[str]],
+              honor_suppressions: bool = True) -> List[Violation]:
     with open(path, "r", encoding="utf-8") as f:
         text = f.read()
     rel = os.path.relpath(path, root)
-    return run_rules_on_source(rel, text, rules)
+    return run_rules_on_source(rel, text, rules, honor_suppressions)

@@ -22,6 +22,7 @@ import grpc
 from koordinator_tpu.bridge.codegen import method_path, pb2
 from koordinator_tpu.bridge.state import numpy_to_tensor
 from koordinator_tpu.obs.export import SpanExporter, resolve_export_dir
+from koordinator_tpu.obs.lockwitness import witness_lock, witness_rlock
 from koordinator_tpu.obs.spans import ClientTraceOp
 from koordinator_tpu.replication.retry import BackoffPolicy
 
@@ -260,7 +261,7 @@ class ScorerClient:
         # keeps its follower round-robin either way.
         self._leader_idx = -1
         self._rr = itertools.count()
-        self._rr_lock = threading.Lock()
+        self._rr_lock = witness_lock("bridge.client.ScorerClient._rr_lock")
         # previous-ACKED-sync mirrors (tensor + scalar columns) for delta
         # encoding and full re-sync.  New values are staged per request and
         # promoted only after the server confirms the Sync, so a failed RPC
@@ -270,7 +271,8 @@ class ScorerClient:
         # Score's FAILED_PRECONDITION): an unlocked clear mid-sync would
         # both corrupt the delta encode and null _generation, silently
         # disabling the displaced-baseline continuity check.
-        self._baseline_lock = threading.RLock()
+        self._baseline_lock = witness_rlock(
+            "bridge.client.ScorerClient._baseline_lock")
         self._prev: Dict[str, np.ndarray] = {}
         self._prev_scalars: Dict[str, tuple] = {}
         self._generation: Optional[int] = None

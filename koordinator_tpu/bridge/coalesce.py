@@ -75,6 +75,8 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from koordinator_tpu.obs.lockwitness import witness_condition, witness_lock
+
 # One launch serves at most this many stacked Score requests; the Go
 # scheduler dispatches 16 parallel Score workers, so a full worker burst
 # coalesces into a single device program.
@@ -309,11 +311,13 @@ class CoalescingDispatcher:
         # the launch critical section: snapshot capture + async device
         # dispatch only — blocking readbacks run off it (lock-held-
         # dispatch rejects them inside @launch_section code statically)
-        self._launch_lock = threading.Lock()
+        self._launch_lock = witness_lock(
+            "bridge.coalesce.CoalescingDispatcher._launch_lock")
         # one condition guards the queue, the in-flight count, entry
         # ``done`` flips and the lifetime stats; EVERY transition
         # notifies it, so followers wait, never poll
-        self._cond = threading.Condition()
+        self._cond = witness_condition(
+            "bridge.coalesce.CoalescingDispatcher._cond")
         self._queue: List[PendingRequest] = []
         self._inflight = 0
         # device-idle bookkeeping: wall time where work was queued but
@@ -647,7 +651,7 @@ class CoalescingDispatcher:
             return
         try:
             hook(outcome, exc)
-        except Exception:  # koordlint: disable=broad-except(an observability/breaker hook failing must not fail callers whose launch already resolved)
+        except Exception:  # an observability/breaker hook failing must not fail callers whose launch already resolved
             import logging
 
             logging.getLogger(__name__).exception(
@@ -733,7 +737,7 @@ class CoalescingDispatcher:
             return
         try:
             hook()
-        except Exception:  # koordlint: disable=broad-except(post-batch bookkeeping must not fail callers whose replies already succeeded)
+        except Exception:  # post-batch bookkeeping must not fail callers whose replies already succeeded
             import logging
 
             logging.getLogger(__name__).exception("post-batch hook failed")

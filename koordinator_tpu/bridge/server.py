@@ -80,6 +80,8 @@ from koordinator_tpu.bridge.state import ResidentState
 from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG
 from koordinator_tpu.model.snapshot import pad_bucket
 from koordinator_tpu.obs import CycleTelemetry
+from koordinator_tpu.obs import lockwitness
+from koordinator_tpu.obs.lockwitness import witness_lock
 from koordinator_tpu.replication.admission import (
     AdmissionGate,
     BreakerOpen,
@@ -284,14 +286,20 @@ class ScorerServicer:
             epoch=self._epoch, cfg=cfg, state_dir=state_dir,
             trace_export=trace_export,
         )
+        if lockwitness.enabled():
+            # witness mode: distinct observed/inversion edges feed
+            # koord_scorer_lock_witness_edges_total (late attach replays)
+            lockwitness.attach_metrics(self.telemetry.metrics)
         # the lock split (module docstring): _sync_lock serializes Sync
         # decodes against the mirror baseline; _state_lock guards mirror
         # commits, the generation counter, the Assign memo and telemetry
         # sequencing — and is NEVER held across a device dispatch or
         # blocking readback; the dispatcher's launch lock serializes
         # launches.  Lock order where nesting happens: launch -> state.
-        self._sync_lock = threading.Lock()
-        self._state_lock = threading.Lock()
+        self._sync_lock = witness_lock(
+            "bridge.server.ScorerServicer._sync_lock")
+        self._state_lock = witness_lock(
+            "bridge.server.ScorerServicer._state_lock")
         # Assign result memo: (snapshot id, CycleConfig) -> _AssignMemo,
         # cleared atomically with every generation bump
         self._assign_memo = {}
@@ -642,7 +650,7 @@ class ScorerServicer:
             if jhook is not None:
                 try:
                     jhook(req, reply.snapshot_id, wire_bytes)
-                except Exception:  # koordlint: disable=broad-except(the Sync IS committed in memory — a full disk must degrade durability, not fail the acked write; the journal logs and counts the miss)
+                except Exception:  # the Sync IS committed in memory — a full disk must degrade durability, not fail the acked write; the journal logs and counts the miss
                     import logging
 
                     logging.getLogger(__name__).exception(
@@ -653,7 +661,7 @@ class ScorerServicer:
             if hook is not None:
                 try:
                     hook(req, reply.snapshot_id, wire_bytes)
-                except Exception:  # koordlint: disable=broad-except(the Sync IS committed — a publisher fault must not fail the client's acked write; followers detect the gap and resync)
+                except Exception:  # the Sync IS committed — a publisher fault must not fail the client's acked write; followers detect the gap and resync
                     import logging
 
                     logging.getLogger(__name__).exception(
@@ -1085,7 +1093,7 @@ class ScorerServicer:
         launch_span = None
         if traced:
             lead = traced[0]
-            launch_span = self.telemetry.spans.start_trace_span(  # koordlint: disable=span-leak(ends in the readback closure the dispatcher always runs off the launch lock; both failure paths abort it explicitly)
+            launch_span = self.telemetry.spans.start_trace_span(  # ends in the readback closure the dispatcher always runs off the launch lock; both failure paths abort it explicitly
                 "score_launch", lead.trace_id, parent_id=lead.span_id,
                 kind="internal",
                 attrs={"batch": len(accepted), "snapshot_id": sid},
@@ -1139,7 +1147,7 @@ class ScorerServicer:
                                 snap, incr
                             )
                             incr_result = "incr"
-                        except Exception:  # koordlint: disable=broad-except(owner failure on the incremental launch: the full rescore below is the documented fallback; the residency was dropped so the torn tensor can never serve)
+                        except Exception:  # owner failure on the incremental launch: the full rescore below is the documented fallback; the residency was dropped so the torn tensor can never serve
                             # the kernel may have consumed the donated
                             # scores buffer mid-failure: the residency
                             # is poison — drop it and full-rescore;
@@ -1281,7 +1289,7 @@ class ScorerServicer:
                             entry.req, k, ts, ti, feasible_np, valid, P,
                         )
                         assembled.append(entry)
-                    except Exception as exc:  # koordlint: disable=broad-except(routed to the one caller as its RPC error; sibling replies stand)
+                    except Exception as exc:  # routed to the one caller as its RPC error; sibling replies stand
                         entry.error = exc
                         n_failed += 1
                 exec_ms = (time.perf_counter() - t_exec) * 1000.0
@@ -1442,7 +1450,7 @@ class ScorerServicer:
                             ok_full=ok_np,
                         )
                         assembled.append(entry)
-                    except Exception as exc:  # koordlint: disable=broad-except(routed to the one caller as its RPC error; sibling replies stand)
+                    except Exception as exc:  # routed to the one caller as its RPC error; sibling replies stand
                         entry.error = exc
                         n_failed += 1
                 exec_ms = (time.perf_counter() - t_exec) * 1000.0
@@ -1522,7 +1530,7 @@ class ScorerServicer:
                     ok_full=memo.get("ok"),
                 )
                 served.append(entry)
-            except Exception as exc:  # koordlint: disable=broad-except(routed to the one caller as its RPC error; sibling replies stand)
+            except Exception as exc:  # routed to the one caller as its RPC error; sibling replies stand
                 entry.error = exc
                 n_failed += 1
         exec_ms = (time.perf_counter() - t_exec) * 1000.0

@@ -55,6 +55,7 @@ import numpy as np
 
 from koordinator_tpu.bridge.codegen import pb2
 from koordinator_tpu.bridge.server import ScorerServicer
+from koordinator_tpu.obs.lockwitness import witness_lock
 from koordinator_tpu.replication import codec
 from koordinator_tpu.replication.follower import (
     APPLIED,
@@ -833,7 +834,7 @@ class ChaosTraceReplay:
                             f"post-convergence digest {d_e[:16]} != "
                             f"oracle {d_o[:16]}"
                         )
-                except Exception as exc:  # koordlint: disable=broad-except(an unconverged engine IS the failing-parity outcome this control measures)
+                except Exception as exc:  # an unconverged engine IS the failing-parity outcome this control measures
                     parity_ok = False
                     parity_detail = f"convergence probe failed: {exc!r:.200}"
             finally:
@@ -937,7 +938,8 @@ def overload_band_storm(
     )
     metrics = ScorerMetrics()
     results = {"ok": {}, "shed": {}, "errors": 0}
-    lock = threading.Lock()
+    results_lock = witness_lock(
+        "harness.chaos.overload_band_storm.results_lock")
 
     with tempfile.TemporaryDirectory(prefix="koord-band-storm-") as tmp:
         sock = os.path.join(tmp, "storm.sock")
@@ -981,8 +983,8 @@ def overload_band_storm(
                         t0 = time.perf_counter()
                         try:
                             client.score_flat(top_k=top_k)
-                        except Exception as exc:  # koordlint: disable=broad-except(shed replies are the measured outcome; anything else counts as an error tally)
-                            with lock:
+                        except Exception as exc:  # shed replies are the measured outcome; anything else counts as an error tally
+                            with results_lock:
                                 if "RESOURCE_EXHAUSTED" in str(exc):
                                     results["shed"][band] = (
                                         results["shed"].get(band, 0) + 1
@@ -991,7 +993,7 @@ def overload_band_storm(
                                     results["errors"] += 1
                             continue
                         ms = (time.perf_counter() - t0) * 1000.0
-                        with lock:
+                        with results_lock:
                             results["ok"][band] = (
                                 results["ok"].get(band, 0) + 1
                             )

@@ -87,7 +87,7 @@ class MetricsRegistry:
         self._families: Dict[str, _Family] = {}
 
     # -- registration (idempotent; the duplicate-family fix) --
-    def _family(self, name: str, kind: str,
+    def _family_locked(self, name: str, kind: str,
                 buckets: Optional[Sequence[float]] = None) -> _Family:
         fam = self._families.get(name)
         if fam is None:
@@ -113,7 +113,7 @@ class MetricsRegistry:
         if kind not in ("counter", "gauge", "histogram"):
             raise ValueError(f"unknown metric kind {kind!r}")
         with self._lock:
-            fam = self._family(
+            fam = self._family_locked(
                 name, kind,
                 buckets=buckets if kind == "histogram" else None,
             )
@@ -141,15 +141,15 @@ class MetricsRegistry:
                 # remembered until the first write binds a kind
                 self._families[name] = _Family("", help_text)
 
-    def _bind(self, name: str, kind: str) -> _Family:
-        return self._family(name, kind)
+    def _bind_locked(self, name: str, kind: str) -> _Family:
+        return self._family_locked(name, kind)
 
     # -- writes --
     def counter_add(
         self, name: str, value: float, labels: Optional[Mapping[str, str]] = None
     ) -> None:
         with self._lock:
-            fam = self._bind(name, "counter")
+            fam = self._bind_locked(name, "counter")
             k = _key({**self.common, **(labels or {})})
             fam.series[k] = fam.series.get(k, 0.0) + value
 
@@ -157,7 +157,7 @@ class MetricsRegistry:
         self, name: str, value: float, labels: Optional[Mapping[str, str]] = None
     ) -> None:
         with self._lock:
-            self._bind(name, "gauge").series[
+            self._bind_locked(name, "gauge").series[
                 _key({**self.common, **(labels or {})})
             ] = value
 
@@ -165,7 +165,7 @@ class MetricsRegistry:
         self, name: str, value: float, labels: Optional[Mapping[str, str]] = None
     ) -> None:
         with self._lock:
-            fam = self._bind(name, "histogram")
+            fam = self._bind_locked(name, "histogram")
             if fam.buckets is None:
                 fam.buckets = DEFAULT_BUCKETS_MS
             k = _key({**self.common, **(labels or {})})

@@ -133,7 +133,7 @@ class CycleTelemetry:
 
             cell["unhook"] = watch_cache_misses(_on_miss)
             self._unhooks.append(lambda: cell.pop("unhook", lambda: None)())
-        except Exception:  # koordlint: disable=broad-except(jax private monitoring API may drift; telemetry must degrade, not fail the server)
+        except Exception:  # jax private monitoring API may drift; telemetry must degrade, not fail the server
             logger.warning(
                 "jit cache-miss feed unavailable; "
                 "koord_scorer_jit_cache_miss_total will not populate",
@@ -151,7 +151,7 @@ class CycleTelemetry:
         for unhook in self._unhooks:
             try:
                 unhook()
-            except Exception:  # koordlint: disable=broad-except(best-effort teardown; one failed unhook must not keep the rest hooked)
+            except Exception:  # best-effort teardown; one failed unhook must not keep the rest hooked
                 logger.warning("telemetry unhook failed", exc_info=True)
         self._unhooks = []
         if self.exporter is not None:

@@ -46,6 +46,8 @@ import time
 import uuid
 from typing import Dict, Optional
 
+from koordinator_tpu.obs.lockwitness import witness_condition
+
 logger = logging.getLogger(__name__)
 
 EXPORT_VERSION = 1
@@ -142,7 +144,7 @@ class SpanExporter:
         self.on_export = on_export
         self.on_drop = on_drop
         self._clock = clock
-        self._cond = threading.Condition()
+        self._cond = witness_condition("obs.export.SpanExporter._cond")
         self._queue: collections.deque = collections.deque()
         self._writer: Optional[threading.Thread] = None
         self._closed = False
@@ -167,7 +169,7 @@ class SpanExporter:
         if self.on_drop is not None:
             try:
                 self.on_drop(reason)
-            except Exception:  # koordlint: disable=broad-except(a metrics hook must never fail the span path)
+            except Exception:  # a metrics hook must never fail the span path
                 logger.warning("span-export drop hook failed", exc_info=True)
         return False
 
@@ -204,7 +206,7 @@ class SpanExporter:
             if self.on_export is not None:
                 try:
                     self.on_export(str(record.get("kind") or "unknown"))
-                except Exception:  # koordlint: disable=broad-except(a metrics hook must never fail the span path)
+                except Exception:  # a metrics hook must never fail the span path
                     logger.warning(
                         "span-export count hook failed", exc_info=True
                     )

@@ -23,9 +23,10 @@ import json
 import logging
 import os
 import signal
-import threading
 import time
 from typing import Dict, List, Optional
+
+from koordinator_tpu.obs.lockwitness import witness_rlock
 
 logger = logging.getLogger(__name__)
 
@@ -161,7 +162,7 @@ class FlightRecorder:
         # RLock, not Lock: the SIGUSR1 handler runs on the main thread
         # between bytecodes and may interrupt record() while it holds
         # the lock — a non-reentrant lock would deadlock the dump
-        self._lock = threading.RLock()
+        self._lock = witness_rlock("obs.flight.FlightRecorder._lock")
         self._dump_seq = 0
         self.dropped = 0  # cycles that fell off the ring, for the dump
         # per-reason dump rate limit: a flood of one trigger (a client

@@ -57,6 +57,7 @@ koord_scorer_trace_spans_total         counter   kind (client|server|internal|co
 koord_scorer_trace_export_dropped_total counter  reason (closed|rate|bytes|encode|io)
 koord_scorer_candidate_refresh_total   counter   reason (dirty|stale|cold)
 koord_scorer_candidate_width           gauge     — (configured C; 0 = dense)
+koord_scorer_lock_witness_edges_total  counter   result (observed|inversion)
 ====================================== ========= ==========================
 
 The ``koord_scorer_coalesce_*`` families observe the coalescing
@@ -153,6 +154,7 @@ TRACE_SPANS = "koord_scorer_trace_spans_total"
 TRACE_EXPORT_DROPPED = "koord_scorer_trace_export_dropped_total"
 CANDIDATE_REFRESH = "koord_scorer_candidate_refresh_total"
 CANDIDATE_WIDTH = "koord_scorer_candidate_width"
+LOCK_WITNESS_EDGES = "koord_scorer_lock_witness_edges_total"
 
 # occupancy is a count-of-requests-per-launch, not a latency: its own
 # power-of-two buckets (the dispatcher caps batches at 16 by default;
@@ -328,6 +330,13 @@ _FAMILIES = (
     (CANDIDATE_WIDTH, "gauge",
      "configured sparse candidate width C (the [P, C] serving shape); "
      "0 while the dense engines serve"),
+    (LOCK_WITNESS_EDGES, "counter",
+     "distinct lock-acquisition edges the runtime witness "
+     "(KOORD_LOCK_WITNESS=1, obs/lockwitness.py) recorded, by result: "
+     "observed = consistent with the derived order in "
+     "docs/LOCKORDER.md, inversion = closed a cycle against it (a "
+     "schedulable deadlock; the witness also raises); 0 when witness "
+     "mode is off"),
 )
 
 # journal appends are MICROsecond-scale (a header pack + one buffered
@@ -485,6 +494,11 @@ class ScorerMetrics:
 
     def set_candidate_width(self, width: int) -> None:
         self.registry.gauge_set(CANDIDATE_WIDTH, int(width))
+
+    def count_lock_witness_edge(self, result: str) -> None:
+        """One distinct witness edge; ``result`` is ``observed`` or
+        ``inversion`` (obs/lockwitness.py)."""
+        self.registry.counter_add(LOCK_WITNESS_EDGES, 1, {"result": result})
 
     # -- replicated serving tier (ISSUE 8) --
     def count_shed(self, method: str, band: str = "") -> None:

@@ -39,10 +39,11 @@ Design constraints (the acceptance criteria of ISSUE 4):
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 import uuid
 from typing import Dict, List, Optional, Tuple
+
+from koordinator_tpu.obs.lockwitness import witness_rlock
 
 # hard cap on spans buffered per cycle: a runaway instrumentation loop
 # must cost a counter bump, not memory
@@ -364,7 +365,7 @@ class CycleScope:
 
     def __init__(self, cycle: CycleSpans):
         self._cycle = cycle
-        self._lock = threading.RLock()
+        self._lock = witness_rlock("obs.spans.CycleScope._lock")
 
     @property
     def cycle_id(self) -> str:
@@ -423,7 +424,7 @@ class SpanRecorder:
         self._cycle: Optional[CycleSpans] = None
         # reentrant: commit() calls current(); the lock makes each call
         # atomic against the coalescer's concurrent batch leaders
-        self._lock = threading.RLock()
+        self._lock = witness_rlock("obs.spans.SpanRecorder._lock")
 
     # -- cycle lifecycle --
     def has_pending(self) -> bool:

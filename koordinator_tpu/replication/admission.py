@@ -64,9 +64,10 @@ failure feed additionally ignores request-level rejections
 from __future__ import annotations
 
 import os
-import threading
 import time
 from typing import Dict, Mapping, Optional
+
+from koordinator_tpu.obs.lockwitness import witness_lock
 
 # the shed ladder: fraction of max_inflight each band may fill before
 # ITS new requests shed.  Unknown/empty bands get prod treatment (shed
@@ -221,7 +222,7 @@ class AdmissionGate:
             shed_fractions = shed_fractions_from_env()
         self.shed_fractions = validate_shed_fractions(shed_fractions)
         self._clock = clock or time.perf_counter
-        self._lock = threading.Lock()
+        self._lock = witness_lock("replication.admission.AdmissionGate._lock")
         self._inflight = 0
         self._ewma_ms: Optional[float] = None
         # lifetime stats (bench + /metrics feed)
@@ -365,7 +366,8 @@ class CircuitBreaker:
         self.threshold = max(0, int(threshold))
         self.cooldown_ms = max(1.0, float(cooldown_ms))
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = witness_lock(
+            "replication.admission.CircuitBreaker._lock")
         self._consecutive = 0
         self._state = "closed"
         self._opened_at: Optional[float] = None
@@ -475,7 +477,7 @@ class CircuitBreaker:
         if transition is not None and self.on_transition is not None:
             try:
                 self.on_transition(transition)
-            except Exception:  # koordlint: disable=broad-except(an observability hook must never fail the launch path; the transition itself already happened)
+            except Exception:  # an observability hook must never fail the launch path; the transition itself already happened
                 import logging
 
                 logging.getLogger(__name__).exception(

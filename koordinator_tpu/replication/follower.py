@@ -38,6 +38,7 @@ from typing import Optional
 import grpc
 
 from koordinator_tpu.bridge.server import ScorerServicer
+from koordinator_tpu.obs.lockwitness import witness_lock
 from koordinator_tpu.replication import codec
 from koordinator_tpu.replication.retry import BackoffPolicy
 
@@ -102,7 +103,7 @@ class ReplicaApplier:
     def _apply(self, frame, metrics) -> str:
         try:
             self.servicer.apply_replica_frame(frame)
-        except Exception:  # koordlint: disable=broad-except(a bad frame must demote to the documented full resync, never crash the follower; state is untouched by stage-then-commit)
+        except Exception:  # a bad frame must demote to the documented full resync, never crash the follower; state is untouched by stage-then-commit
             logger.exception(
                 "replica frame s%s-%d failed to apply; forcing full "
                 "resync (resident state untouched)",
@@ -179,7 +180,8 @@ class ReplicationSubscriber:
         self.hello = bool(hello)
         self.on_frame = on_frame
         self._stop = threading.Event()
-        self._conn_lock = threading.Lock()
+        self._conn_lock = witness_lock(
+            "replication.follower.ReplicationSubscriber._conn_lock")
         self._conn: Optional[socket.socket] = None
         self._thread = threading.Thread(target=self._run, daemon=True)
         # set when the LAST stream ended in a detected discontinuity
@@ -299,7 +301,7 @@ class ReplicationSubscriber:
             if self.on_frame is not None:
                 try:
                     self.on_frame(result, frame)
-                except Exception:  # koordlint: disable=broad-except(status callbacks are observability; they must not kill the stream)
+                except Exception:  # status callbacks are observability; they must not kill the stream
                     logger.exception("replication on_frame callback failed")
             if result == RESYNC:
                 # reconnect -> the leader must reopen with a FULL

@@ -45,6 +45,7 @@ import time
 import zlib
 from typing import Dict, List, Optional, Tuple
 
+from koordinator_tpu.obs.lockwitness import witness_lock
 from koordinator_tpu.replication import codec
 
 logger = logging.getLogger(__name__)
@@ -90,7 +91,7 @@ class FrameJournal:
         self.compact_every = max(1, int(compact_every))
         self.fsync = bool(fsync)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = witness_lock("replication.journal.FrameJournal._lock")
         self._fh = None
         self._metrics = None
         self._exporter = None
@@ -221,7 +222,7 @@ class FrameJournal:
         try:
             epoch, gen, payload = self._exporter()
             self.write_base(epoch, gen, payload)
-        except Exception:  # koordlint: disable=broad-except(compaction is an optimization of journal SIZE; a failed compaction must cost disk, never the acked write it rides behind)
+        except Exception:  # compaction is an optimization of journal SIZE; a failed compaction must cost disk, never the acked write it rides behind
             logger.exception("journal compaction failed; appends continue")
 
     def _track_locked(self, kind: int, epoch: str, gen: int, off: int,
@@ -377,7 +378,7 @@ class FrameJournal:
                     servicer.apply_replica_frame(
                         frame, origin="journal_replay"
                     )
-                except Exception:  # koordlint: disable=broad-except(a frame that fails validation ends the usable prefix — the documented truncate-and-recover path; state is untouched by stage-then-commit)
+                except Exception:  # a frame that fails validation ends the usable prefix — the documented truncate-and-recover path; state is untouched by stage-then-commit
                     logger.exception(
                         "journal full frame %s failed to apply; "
                         "truncating", frame.snapshot_id,
@@ -410,7 +411,7 @@ class FrameJournal:
                     servicer.apply_replica_frame(
                         frame, origin="journal_replay"
                     )
-                except Exception:  # koordlint: disable=broad-except(same truncate-and-recover contract as the full-frame apply above)
+                except Exception:  # same truncate-and-recover contract as the full-frame apply above
                     logger.exception(
                         "journal delta frame %s failed to apply; "
                         "truncating", frame.snapshot_id,

@@ -42,6 +42,7 @@ import socket
 import threading
 import time
 
+from koordinator_tpu.obs.lockwitness import witness_condition, witness_rlock
 from koordinator_tpu.replication import codec
 
 logger = logging.getLogger(__name__)
@@ -65,7 +66,7 @@ class _Subscriber:
         self.max_frames = max_frames
         self._on_drop = on_drop
         self._frames = collections.deque()
-        self._cond = threading.Condition()
+        self._cond = witness_condition("replication.leader._Subscriber._cond")
         self._dead = False
         self._thread = threading.Thread(target=self._drain, daemon=True)
 
@@ -171,7 +172,8 @@ class ReplicationPublisher:
         self._clock = clock
         # RLock: an enqueue overflow inside the fan-out (lock held)
         # drops the subscriber, and the drop re-enters to unregister
-        self._lock = threading.RLock()
+        self._lock = witness_rlock(
+            "replication.leader.ReplicationPublisher._lock")
         self._subs = []
         self._stop = threading.Event()
         if os.path.exists(path):
@@ -249,7 +251,7 @@ class ReplicationPublisher:
                 return  # listener closed by stop()
             try:
                 self._register(conn)
-            except Exception:  # koordlint: disable=broad-except(one bad subscription must not kill the accept loop for every other follower)
+            except Exception:  # one bad subscription must not kill the accept loop for every other follower
                 logger.exception("replication subscription failed")
                 try:
                     conn.close()

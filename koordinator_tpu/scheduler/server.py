@@ -34,6 +34,7 @@ from koordinator_tpu.httpserving import (
 from koordinator_tpu.bridge.udsserver import RawUdsServer
 from koordinator_tpu.config import DEFAULT_CYCLE_CONFIG
 from koordinator_tpu.leaderelection import LeaderElector
+from koordinator_tpu.obs.lockwitness import witness_lock
 from koordinator_tpu.scheduler.config_api import load_config
 from koordinator_tpu.scheduler.services import APIService
 from koordinator_tpu.solver import pallas_demotions
@@ -249,7 +250,8 @@ class SchedulerServer:
         self._journal_enabled = bool(journal)
         self._journal_compact_every = journal_compact_every
         self._journal_fsync = bool(journal_fsync)
-        self._promote_lock = threading.Lock()
+        self._promote_lock = witness_lock(
+            "scheduler.server.SchedulerServer._promote_lock")
         self._promoted = False
         if self._journal_enabled and not state_dir:
             import logging
@@ -288,7 +290,8 @@ class SchedulerServer:
         from koordinator_tpu.obs.scorer_metrics import CYCLE_LATENCY
 
         self._slo_window = SloWindow(families=(CYCLE_LATENCY,))
-        self._slo_lock = threading.Lock()
+        self._slo_lock = witness_lock(
+            "scheduler.server.SchedulerServer._slo_lock")
         self.uds_path = uds_path
         self.enable_grpc = enable_grpc
         self._raw_server: Optional[RawUdsServer] = None
@@ -471,6 +474,7 @@ class SchedulerServer:
         journal = self._open_journal()
         stats = journal.recover(self.servicer)
         journal.attach(self.servicer)
+        # koordlint: disable=unguarded-shared-state(reason: leader boot runs before any transport or elector thread starts; the competing locked writer is promote, which cannot run yet)
         self.journal = journal
         self.journal_replay = stats
         if stats["replayed_frames"]:
@@ -532,7 +536,7 @@ class SchedulerServer:
             def run():
                 try:
                     self.promote()
-                except Exception:  # koordlint: disable=broad-except(a failed promotion must be logged, never kill the daemon from a signal handler thread)
+                except Exception:  # a failed promotion must be logged, never kill the daemon from a signal handler thread
                     logging.getLogger(__name__).exception(
                         "SIGUSR2 promotion failed"
                     )
@@ -578,6 +582,7 @@ class SchedulerServer:
             )
 
             self.applier = ReplicaApplier(self.servicer)
+            # koordlint: disable=unguarded-shared-state(reason: boot runs before the elector/HTTP threads exist; promote, the locked writer, cannot race it)
             self._subscriber = ReplicationSubscriber(
                 self.replicate_from, self.applier
             ).start()
@@ -586,6 +591,7 @@ class SchedulerServer:
                 ReplicationPublisher,
             )
 
+            # koordlint: disable=unguarded-shared-state(reason: boot runs before the elector/HTTP threads exist; promote, the locked writer, cannot race it)
             self._publisher = ReplicationPublisher(
                 self.servicer, self.repl_path, journal=self.journal
             ).attach().start()

@@ -20,6 +20,7 @@ from wsgiref.simple_server import WSGIServer, make_server
 import numpy as np
 
 from koordinator_tpu.model import resources as res
+from koordinator_tpu.obs.lockwitness import witness_lock
 
 Handler = Callable[[Mapping[str, str]], Tuple[int, Any]]
 
@@ -31,7 +32,7 @@ class APIService:
     def __init__(self):
         self._routes: Dict[str, Handler] = {}
         self._snapshot = None
-        self._lock = threading.Lock()
+        self._lock = witness_lock("scheduler.services.APIService._lock")
 
     # -- registration (APIServiceProvider.RegisterEndpoints analog) --
     def register_plugin(self, plugin_name: str, path: str, handler: Handler) -> None:

@@ -1938,3 +1938,428 @@ def decode_score_request(b):
             read("koordinator_tpu", "bridge", "wirecheck.py"),
         )
         assert out == [], "\n".join(v.format() for v in out)
+
+
+# ---- ISSUE 17: whole-program lock graph + guarded-state inference ----
+
+
+def lockcheck(sources, md_text="GENERATE"):
+    """Run the lock-graph pass over synthetic sources.  By default the
+    doc is generated from the same graph, so only cycle / witness-name
+    violations surface; pass explicit md_text (or None) to exercise the
+    drift directions."""
+    from koordinator_tpu.analysis import lockgraph
+
+    srcs = {p: textwrap.dedent(s) for p, s in sources.items()}
+    if md_text == "GENERATE":
+        md_text = lockgraph.generate_lockorder_md(lockgraph.build_graph(srcs))
+    return lockgraph.check_sources(srcs, md_text)
+
+
+class TestLockOrderCycle:
+    def test_direct_nesting_cycle_caught(self):
+        got = lockcheck({"eng.py": """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def fwd(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def rev(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """})
+        assert [v.rule for v in got] == ["lock-order-cycle"]
+        assert "eng.Engine._a" in got[0].message
+        assert "eng.Engine._b" in got[0].message
+        assert "deadlock" in got[0].message
+
+    def test_cross_module_call_cycle_caught(self):
+        # neither module nests both locks lexically: the cycle only
+        # exists through the cross-module method table
+        got = lockcheck({
+            "pmod.py": """
+            import threading
+            from qmod import Q
+
+            class P:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.q = Q(self)
+
+                def outer(self):
+                    with self._lock:
+                        self.q.enter_q()
+
+                def locked_op(self):
+                    with self._lock:
+                        pass
+            """,
+            "qmod.py": """
+            import threading
+
+            class Q:
+                def __init__(self, p):
+                    self._lock = threading.Lock()
+                    self.p = p
+
+                def enter_q(self):
+                    with self._lock:
+                        pass
+
+                def back(self):
+                    with self._lock:
+                        self.p.locked_op()
+            """,
+        })
+        assert [v.rule for v in got] == ["lock-order-cycle"]
+        assert "pmod.P._lock" in got[0].message
+        assert "qmod.Q._lock" in got[0].message
+
+    def test_condition_wait_reacquire_closes_cycle(self):
+        # cond -> y from the nesting, and wait() re-acquires cond while
+        # y is STILL held (the stdlib releases only the condition):
+        # y -> cond — the hidden inversion a plain `with cond:` in
+        # another thread deadlocks against
+        got = lockcheck({"w.py": """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._y = threading.Lock()
+                self._cond = threading.Condition()
+
+            def park(self):
+                with self._cond:
+                    with self._y:
+                        self._cond.wait(timeout=1.0)
+        """})
+        assert [v.rule for v in got] == ["lock-order-cycle"]
+        assert "Condition.wait reacquire" in got[0].message
+
+    def test_lexical_acquire_holds_rest_of_block(self):
+        # .acquire() (no with) still orders later acquisitions
+        got = lockcheck({"acq.py": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def m(self):
+                self._a.acquire()
+                with self._b:
+                    pass
+                self._a.release()
+
+            def n(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """})
+        assert [v.rule for v in got] == ["lock-order-cycle"]
+
+    def test_clean_hierarchy_passes(self):
+        got = lockcheck({"clean.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._c = threading.Lock()
+
+            def m(self):
+                with self._a:
+                    with self._b:
+                        with self._c:
+                            pass
+
+            def n(self):
+                with self._b:
+                    with self._c:
+                        pass
+        """})
+        assert got == [], "\n".join(v.format() for v in got)
+
+    def test_same_identity_nesting_is_not_a_cycle(self):
+        # two instances share one identity; self-edges carry no order
+        got = lockcheck({"dup.py": """
+        import threading
+
+        class D:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def m(self, other):
+                with self._lock:
+                    with other._lock:
+                        pass
+        """})
+        assert got == [], "\n".join(v.format() for v in got)
+
+
+class TestLockOrderDocDrift:
+    TWO_LOCKS = {
+        "two.py": """
+        import threading
+
+        class T:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def m(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """,
+    }
+
+    def test_missing_doc_fails(self):
+        got = lockcheck(self.TWO_LOCKS, md_text=None)
+        assert [v.rule for v in got] == ["lockorder-doc-drift"]
+        assert "not found" in got[0].message
+
+    def test_derived_edge_missing_from_doc_fails(self):
+        from koordinator_tpu.analysis import lockgraph
+
+        srcs = {p: textwrap.dedent(s) for p, s in self.TWO_LOCKS.items()}
+        md = lockgraph.generate_lockorder_md(lockgraph.build_graph(srcs))
+        gutted = "\n".join(
+            ln for ln in md.splitlines() if " | `two.T._b` | " not in ln
+        )
+        got = lockcheck(self.TWO_LOCKS, md_text=gutted)
+        assert any("missing from" in v.message and v.rule ==
+                   "lockorder-doc-drift" for v in got)
+
+    def test_doc_edge_nothing_derives_fails(self):
+        from koordinator_tpu.analysis import lockgraph
+
+        srcs = {p: textwrap.dedent(s) for p, s in self.TWO_LOCKS.items()}
+        md = lockgraph.generate_lockorder_md(lockgraph.build_graph(srcs))
+        # a phantom reversed edge row nothing derives
+        md += "| `two.T._b` | `two.T._a` | two.py:1 | nested with |\n"
+        got = lockcheck(self.TWO_LOCKS, md_text=md)
+        assert any("no code path derives" in v.message for v in got)
+
+    def test_byte_stale_doc_fails(self):
+        from koordinator_tpu.analysis import lockgraph
+
+        srcs = {p: textwrap.dedent(s) for p, s in self.TWO_LOCKS.items()}
+        md = lockgraph.generate_lockorder_md(lockgraph.build_graph(srcs))
+        got = lockcheck(self.TWO_LOCKS, md_text=md + "\ntrailing edit\n")
+        assert [v.rule for v in got] == ["lockorder-doc-drift"]
+        assert "stale" in got[0].message
+
+    def test_witness_factory_name_mismatch_fails(self):
+        got = lockcheck({"wn.py": """
+        from koordinator_tpu.obs.lockwitness import witness_lock
+
+        class N:
+            def __init__(self):
+                self._lock = witness_lock("wrong.identity")
+        """})
+        assert any("witness factory" in v.message and v.rule ==
+                   "lockorder-doc-drift" for v in got)
+        assert any("wn.N._lock" in v.message for v in got)
+
+    def test_witness_factory_correct_name_passes(self):
+        got = lockcheck({"wn.py": """
+        from koordinator_tpu.obs.lockwitness import witness_lock
+
+        class N:
+            def __init__(self):
+                self._lock = witness_lock("wn.N._lock")
+        """})
+        assert got == [], "\n".join(v.format() for v in got)
+
+    def test_repo_doc_regenerates_byte_identical(self):
+        from koordinator_tpu.analysis import lockgraph
+
+        want = lockgraph.generate_lockorder_md(lockgraph.repo_graph(REPO))
+        assert read("docs", "LOCKORDER.md") == want, (
+            "docs/LOCKORDER.md is stale — run "
+            "`python -m koordinator_tpu.analysis --write-lockorder`"
+        )
+
+
+class TestUnguardedSharedState:
+    def test_lock_free_write_of_guarded_attr_caught(self):
+        got = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+
+            def bump(self):
+                with self._lock:
+                    self._count += 1
+
+            def reset(self):
+                self._count = 0
+        """, ["unguarded-shared-state"])
+        assert [(v.rule, v.line) for v in got] == \
+            [("unguarded-shared-state", 14)]
+        assert "two writers race" in got[0].message
+
+    def test_lock_free_read_of_mutated_structure_caught(self):
+        got = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+
+            def dump(self):
+                return list(self._items.values())
+        """, ["unguarded-shared-state"])
+        assert len(got) == 1
+        assert "mutated in place" in got[0].message
+
+    def test_init_writes_exempt(self):
+        got = lint("""
+        import threading
+
+        class S:
+            def __init__(self, seed):
+                self._lock = threading.Lock()
+                self._count = seed
+                self._count += 1
+
+            def bump(self):
+                with self._lock:
+                    self._count += 1
+        """, ["unguarded-shared-state"])
+        assert got == [], "\n".join(v.format() for v in got)
+
+    def test_rebind_only_attr_atomic_read_exempt(self):
+        # the guarded writes only REBIND (no in-place mutation): a
+        # lock-free read sees either the old or the new object — atomic
+        got = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._snapshot = None
+
+            def publish(self, snap):
+                with self._lock:
+                    self._snapshot = snap
+
+            def peek(self):
+                return self._snapshot
+        """, ["unguarded-shared-state"])
+        assert got == [], "\n".join(v.format() for v in got)
+
+    def test_locked_suffix_method_exempt(self):
+        # *_locked methods run with the lock already held by contract
+        got = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def _bump_locked(self):
+                self._count += 1
+        """, ["unguarded-shared-state"])
+        assert got == [], "\n".join(v.format() for v in got)
+
+    def test_reasoned_suppression_honored(self):
+        got = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+
+            def bump(self):
+                with self._lock:
+                    self._count += 1
+
+            def reset(self):
+                # koordlint: disable=unguarded-shared-state(reason: boot only)
+                self._count = 0
+        """, ["unguarded-shared-state"])
+        assert got == [], "\n".join(v.format() for v in got)
+
+    def test_class_without_lock_ignored(self):
+        got = lint("""
+        class Plain:
+            def __init__(self):
+                self._count = 0
+
+            def bump(self):
+                self._count += 1
+        """, ["unguarded-shared-state"])
+        assert got == [], "\n".join(v.format() for v in got)
+
+
+class TestSuppressionAudit:
+    def test_parse_tags_extracts_rules_and_reasons(self):
+        from koordinator_tpu.analysis import suppressions
+
+        tags = suppressions.parse_tags("f.py", textwrap.dedent("""
+        x = 1  # koordlint: disable=broad-except(reason: logged upstream)
+        y = 2  # koordlint: disable=bare-retry
+        # koordlint: disable=broad-except(a), unbounded-wait
+        """))
+        got = [(t.line, t.rule, t.reason) for t in tags]
+        assert got == [
+            (2, "broad-except", "reason: logged upstream"),
+            (3, "bare-retry", None),
+            (4, "broad-except", "a"),
+            (4, "unbounded-wait", None),
+        ]
+
+    def test_repo_audit_is_clean(self):
+        from koordinator_tpu.analysis import suppressions
+
+        tags, problems = suppressions.audit(REPO)
+        assert problems == [], "\n".join(p.format() for p in problems)
+        # every reason-required tag in the repo carries its reason
+        for tag in tags:
+            if tag.rule in suppressions.REASON_REQUIRED:
+                assert tag.reason, f"{tag.path}:{tag.line} missing reason"
+
+    def test_cli_suppressions_flag_exits_zero(self, capsys):
+        from koordinator_tpu.analysis.__main__ import main
+
+        assert main(["--suppressions", "--root", REPO]) == 0
+        out = capsys.readouterr().out
+        assert "live suppression(s)" in out
+        assert "audit clean" in out
+
+    def test_format_report_flags_problems(self):
+        from koordinator_tpu.analysis.core import Violation
+        from koordinator_tpu.analysis import suppressions
+
+        report = suppressions.format_report(
+            [suppressions.Tag("f.py", 3, "broad-except", None)],
+            [Violation("suppression-audit", "f.py", 3, "no reason")],
+        )
+        assert "NO REASON" in report
+        assert "AUDIT FAILED: 1 problem(s)" in report
