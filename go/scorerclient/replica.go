@@ -43,6 +43,7 @@ const (
 	ReplicaKindDelta    = 1 // sequence frame: apply onto generation-1
 	ReplicaKindFull     = 2 // reset frame: replace all resident state
 	ReplicaKindHello    = 3 // follower->leader resume offer (position)
+	ReplicaKindFullZ    = 4 // full frame, payload zlib-compressed (negotiated in hello)
 	ReplicaHeaderLen    = 34
 	// MaxReplicaFrame mirrors the transport's 64 MiB frame cap.
 	MaxReplicaFrame = 64 << 20
@@ -98,7 +99,7 @@ func ParseReplicaFrameHeader(b []byte) (*ReplicaFrameHeader, error) {
 			}
 		case "kind":
 			h.Kind = int(raw[0])
-			if h.Kind != ReplicaKindDelta && h.Kind != ReplicaKindFull && h.Kind != ReplicaKindHello {
+			if h.Kind != ReplicaKindDelta && h.Kind != ReplicaKindFull && h.Kind != ReplicaKindHello && h.Kind != ReplicaKindFullZ {
 				return nil, fmt.Errorf("bad replica frame kind %d", h.Kind)
 			}
 		case "epoch":
@@ -207,37 +208,86 @@ type ReplicaSet struct {
 	leaderSocket    string
 	followerSockets []string
 	size            int
+	// relay-tree discovery (ISSUE 18): each follower's hop distance
+	// from the root leader (1 = direct follower) and the index set of
+	// the DEEPEST layer — the leaves Score round-robins over (interior
+	// relays spend their bandwidth fanning out to children; the leaf
+	// layer is where aggregate read capacity multiplies).  A flat tier
+	// (no depth annotations) makes every follower a leaf, preserving
+	// the PR-8 behavior exactly.
+	depths []int
+	leaves []int
 	// active writer: -1 = the configured leader, >=0 = follower index
 	active  int
 	backoff Backoff
 	rr      atomic.Uint64
 }
 
+// ParseFollowerTarget splits a follower socket's optional relay-tree
+// depth annotation: "/tmp/f.sock@2" -> ("/tmp/f.sock", 2).  An
+// un-annotated target is depth 1 (a direct follower), and a trailing
+// "@<non-int>" stays part of the address (abstract sockets may contain
+// '@').  Mirrors bridge/client.py parse_follower_target.
+func ParseFollowerTarget(target string) (string, int) {
+	if i := strings.LastIndex(target, "@"); i >= 0 {
+		if d, err := strconv.Atoi(target[i+1:]); err == nil {
+			if d < 1 {
+				d = 1
+			}
+			return target[:i], d
+		}
+	}
+	return target, 1
+}
+
+// computeLeaves returns the indices at the maximum depth.
+func computeLeaves(depths []int) []int {
+	max := 0
+	for _, d := range depths {
+		if d > max {
+			max = d
+		}
+	}
+	var leaves []int
+	for i, d := range depths {
+		if d == max {
+			leaves = append(leaves, i)
+		}
+	}
+	return leaves
+}
+
 // DialReplicaSet connects a pool of size conns to the leader socket
 // and one to each follower socket.  Any dial failure closes everything
 // already opened — a silently half-dialed tier would skew the read
-// fan-out it exists to provide.
+// fan-out it exists to provide.  Follower sockets may carry relay-tree
+// depth annotations ("path@2", ISSUE 18): Score then round-robins over
+// the deepest layer only, while writer failover still probes every
+// follower.
 func DialReplicaSet(leaderSocket string, followerSockets []string, size int) (*ReplicaSet, error) {
 	leader, err := DialPool(leaderSocket, size)
 	if err != nil {
 		return nil, fmt.Errorf("replica set leader dial: %w", err)
 	}
 	rs := &ReplicaSet{
-		leader:          leader,
-		leaderSocket:    leaderSocket,
-		followerSockets: append([]string(nil), followerSockets...),
-		size:            size,
-		active:          -1,
-		backoff:         DefaultBackoff(),
+		leader:       leader,
+		leaderSocket: leaderSocket,
+		size:         size,
+		active:       -1,
+		backoff:      DefaultBackoff(),
 	}
-	for i, path := range followerSockets {
+	for i, target := range followerSockets {
+		path, depth := ParseFollowerTarget(target)
 		p, err := DialPool(path, size)
 		if err != nil {
 			rs.Close()
 			return nil, fmt.Errorf("replica set follower %d/%d dial: %w", i+1, len(followerSockets), err)
 		}
 		rs.followers = append(rs.followers, p)
+		rs.followerSockets = append(rs.followerSockets, path)
+		rs.depths = append(rs.depths, depth)
 	}
+	rs.leaves = computeLeaves(rs.depths)
 	return rs, nil
 }
 
@@ -250,12 +300,31 @@ func NewReplicaSet(leader *Pool, followers ...*Pool) *ReplicaSet {
 	if leader == nil {
 		panic("scorerclient: NewReplicaSet requires a leader pool")
 	}
+	depths := make([]int, len(followers))
+	for i := range depths {
+		depths[i] = 1 // flat tier: every follower is a leaf
+	}
 	return &ReplicaSet{
 		leader:    leader,
 		followers: followers,
+		depths:    depths,
+		leaves:    computeLeaves(depths),
 		active:    -1,
 		backoff:   DefaultBackoff(),
 	}
+}
+
+// SetDepths overrides the followers' relay-tree depths after
+// construction (test seam / NewReplicaSet callers with a tree): the
+// slice must match the follower count.  Recomputes the leaf layer.
+func (r *ReplicaSet) SetDepths(depths []int) {
+	r.mu.Lock()
+	defer r.mu.Unlock()
+	if len(depths) != len(r.followers) {
+		panic("scorerclient: SetDepths length mismatch")
+	}
+	r.depths = append([]int(nil), depths...)
+	r.leaves = computeLeaves(r.depths)
 }
 
 // SetBackoff overrides the failover retry policy (test seam / tuning).
@@ -413,10 +482,18 @@ func (r *ReplicaSet) fanOutID(id string) {
 	}
 }
 
-// next picks the follower pool for this call round-robin.
+// next picks the follower pool for this call round-robin.  When the
+// set carries relay-tree depth annotations, only the deepest layer —
+// the leaves — takes read traffic: interior relays spend their budget
+// fanning frames out to children.  A flat tier (all depth 1) makes
+// every follower a leaf, so the pre-tree behavior is unchanged.
 func (r *ReplicaSet) next() *Pool {
 	r.mu.Lock()
 	defer r.mu.Unlock()
+	if len(r.leaves) > 0 && len(r.leaves) < len(r.followers) {
+		idx := r.leaves[r.rr.Add(1)%uint64(len(r.leaves))]
+		return r.followers[idx]
+	}
 	return r.followers[r.rr.Add(1)%uint64(len(r.followers))]
 }
 

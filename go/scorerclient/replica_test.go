@@ -217,3 +217,58 @@ func TestReplicaSetActiveWriterDefaultsToLeader(t *testing.T) {
 		t.Fatal("writerPool must be the leader before any failover")
 	}
 }
+
+func TestParseReplicaFrameHeaderAcceptsCompressedFull(t *testing.T) {
+	raw := buildFrameHeader(ReplicaFrameMagic, ReplicaFrameVersion,
+		ReplicaKindFullZ, "abcdef01", 9, 1, 0)
+	h, err := ParseReplicaFrameHeader(raw)
+	if err != nil {
+		t.Fatalf("kind %d (compressed full) must parse: %v", ReplicaKindFullZ, err)
+	}
+	if h.Kind != ReplicaKindFullZ {
+		t.Fatalf("decoded kind %d, want %d", h.Kind, ReplicaKindFullZ)
+	}
+}
+
+func TestParseFollowerTarget(t *testing.T) {
+	cases := []struct {
+		in    string
+		addr  string
+		depth int
+	}{
+		{"unix:///tmp/f.sock", "unix:///tmp/f.sock", 1},
+		{"unix:///tmp/f.sock@2", "unix:///tmp/f.sock", 2},
+		{"unix:///tmp/f.sock@0", "unix:///tmp/f.sock", 1}, // clamps to >= 1
+		{"/tmp/odd@name.sock", "/tmp/odd@name.sock", 1},   // non-int suffix stays in the address
+	}
+	for _, c := range cases {
+		addr, depth := ParseFollowerTarget(c.in)
+		if addr != c.addr || depth != c.depth {
+			t.Fatalf("ParseFollowerTarget(%q) = (%q, %d), want (%q, %d)",
+				c.in, addr, depth, c.addr, c.depth)
+		}
+	}
+}
+
+func TestReplicaSetRoutesReadsToLeavesOnly(t *testing.T) {
+	leader := NewPool(NewClient(nil))
+	interior := NewPool(NewClient(nil))
+	leafA := NewPool(NewClient(nil))
+	leafB := NewPool(NewClient(nil))
+	rs := NewReplicaSet(leader, interior, leafA, leafB)
+	// Flat tier: every follower is a leaf, all three rotate in.
+	seen := map[*Pool]bool{}
+	for i := 0; i < 9; i++ {
+		seen[rs.next()] = true
+	}
+	if !seen[interior] || !seen[leafA] || !seen[leafB] {
+		t.Fatal("flat tier must round-robin over every follower")
+	}
+	// Tree tier: interior (depth 1) stops taking reads; depth-2 leaves do.
+	rs.SetDepths([]int{1, 2, 2})
+	for i := 0; i < 16; i++ {
+		if p := rs.next(); p == interior {
+			t.Fatal("interior relay must not take read traffic in a tree")
+		}
+	}
+}

@@ -96,6 +96,22 @@ def parse_snapshot_id(snapshot_id: str) -> Tuple[str, int]:
         return epoch, -1
 
 
+def parse_follower_target(target: str) -> Tuple[str, int]:
+    """Split a follower target's optional relay-tree depth annotation
+    (ISSUE 18): ``"unix:///f.sock@2"`` -> ``("unix:///f.sock", 2)``.
+    Un-annotated targets are depth 1 (a direct follower — the flat-tier
+    shape), and a trailing ``@<non-int>`` is treated as part of the
+    address, not an annotation (abstract sockets and IPv6 hosts may
+    legitimately contain ``@``)."""
+    addr, sep, depth = target.rpartition("@")
+    if sep:
+        try:
+            return addr, max(1, int(depth))
+        except ValueError:
+            pass
+    return target, 1
+
+
 class _ChannelPool:
     """Round-robin pool of independent gRPC channels (ISSUE 6).
 
@@ -162,6 +178,17 @@ class ScorerClient:
         leader for that one call — replication lag degrades to leader
         reads, never to a failed cycle or a spurious full re-sync.
         Assign stays on the leader, whose snapshot is never behind.
+
+        Tree-aware discovery (ISSUE 18, the relay tree): a follower
+        target may carry a depth annotation — ``"unix:///f.sock@2"``
+        means hop 2, i.e. behind one relay.  Score then round-robins
+        over the DEEPEST layer only (the leaves): interior relays
+        spend their bandwidth fanning the stream out to children, and
+        the leaf layer is where aggregate read capacity multiplies.
+        Un-annotated targets default to depth 1, so a flat follower
+        list behaves exactly as before; writer failover probes still
+        visit every follower regardless of depth (a promotion can land
+        anywhere in the tree).
 
         ``retry_policy`` (ISSUE 11): the shared jittered-exponential
         backoff/deadline budget (``replication.retry.BackoffPolicy``;
@@ -240,8 +267,19 @@ class ScorerClient:
             unary(ch, "Assign", pb2.AssignReply)
             for ch in self._pool.channels
         ]
+        parsed = [parse_follower_target(t) for t in followers]
+        self._follower_depths = [d for _, d in parsed]
         self._follower_pools = [
-            _ChannelPool(t, 1) for t in followers
+            _ChannelPool(t, 1) for t, _ in parsed
+        ]
+        # the leaf layer: indices at the tree's maximum depth — the
+        # Score round-robin set (see the docstring's tree-aware
+        # discovery contract); every index stays in the writer probe
+        # order
+        max_depth = max(self._follower_depths, default=0)
+        self._leaf_indices = [
+            i for i, d in enumerate(self._follower_depths)
+            if d == max_depth
         ]
         self._follower_scores = [
             unary(p.channels[0], "Score", pb2.ScoreReply)
@@ -407,12 +445,15 @@ class ScorerClient:
             time.sleep(pause / 1000.0)
 
     def _score_stub(self):
-        """Score's routing: round-robin over the follower replicas when
-        configured, else over the leader's own channel pool.  Returns
-        ``(stub, is_follower)``."""
+        """Score's routing: round-robin over the LEAF-layer follower
+        replicas when configured (the deepest annotated depth — with a
+        flat follower list that is every follower), else over the
+        leader's own channel pool.  Returns ``(stub, is_follower)``."""
         if self._follower_scores:
             with self._rr_lock:
-                i = next(self._rr) % len(self._follower_scores)
+                i = self._leaf_indices[
+                    next(self._rr) % len(self._leaf_indices)
+                ]
             return self._follower_scores[i], True
         return self._scores[self._slot()], False
 

@@ -58,6 +58,13 @@ koord_scorer_trace_export_dropped_total counter  reason (closed|rate|bytes|encod
 koord_scorer_candidate_refresh_total   counter   reason (dirty|stale|cold)
 koord_scorer_candidate_width           gauge     — (configured C; 0 = dense)
 koord_scorer_lock_witness_edges_total  counter   result (observed|inversion)
+koord_scorer_relay_position            gauge     — (hops from the root leader)
+koord_scorer_relay_forwarded_total     counter   — (frames re-published)
+koord_scorer_replica_hop_lag_ms        gauge     hop
+koord_scorer_repl_send_batch_frames    histogram —
+koord_scorer_repl_compress_total       counter   op (encode|decode)
+koord_scorer_autoscale_events_total    counter   action (scale_up|scale_down)
+koord_scorer_autoscale_replicas        gauge     — (autoscaler's target size)
 ====================================== ========= ==========================
 
 The ``koord_scorer_coalesce_*`` families observe the coalescing
@@ -155,6 +162,13 @@ TRACE_EXPORT_DROPPED = "koord_scorer_trace_export_dropped_total"
 CANDIDATE_REFRESH = "koord_scorer_candidate_refresh_total"
 CANDIDATE_WIDTH = "koord_scorer_candidate_width"
 LOCK_WITNESS_EDGES = "koord_scorer_lock_witness_edges_total"
+RELAY_POSITION = "koord_scorer_relay_position"
+RELAY_FORWARDED = "koord_scorer_relay_forwarded_total"
+REPLICA_HOP_LAG = "koord_scorer_replica_hop_lag_ms"
+SEND_BATCH_FRAMES = "koord_scorer_repl_send_batch_frames"
+REPL_COMPRESS = "koord_scorer_repl_compress_total"
+AUTOSCALE_EVENTS = "koord_scorer_autoscale_events_total"
+AUTOSCALE_REPLICAS = "koord_scorer_autoscale_replicas"
 
 # occupancy is a count-of-requests-per-launch, not a latency: its own
 # power-of-two buckets (the dispatcher caps batches at 16 by default;
@@ -337,6 +351,38 @@ _FAMILIES = (
      "docs/LOCKORDER.md, inversion = closed a cycle against it (a "
      "schedulable deadlock; the witness also raises); 0 when witness "
      "mode is off"),
+    (RELAY_POSITION, "gauge",
+     "this daemon's depth in the relay tree (ISSUE 18): 0 = the root "
+     "leader, 1 = a direct follower, 2 = behind one relay, ...; a "
+     "relay both applies its parent's stream and re-publishes it on "
+     "its own .repl socket"),
+    (RELAY_FORWARDED, "counter",
+     "replication frames this relay re-published verbatim to its own "
+     "subscribers (applied delta frames forwarded byte-identically; "
+     "full frames are served from the relay's OWN state, never "
+     "forwarded)"),
+    (REPLICA_HOP_LAG, "gauge",
+     "commit-to-apply wall delay of the last applied frame, labeled "
+     "by this replica's hop distance from the root leader — a deep "
+     "chain's lag amplification shows per level, not just in the "
+     "aggregate koord_scorer_replica_lag_ms"),
+    (SEND_BATCH_FRAMES, "histogram",
+     "queued replication frames coalesced into one sender wakeup/"
+     "syscall on the publisher (frames per wakeup; the batch is "
+     "bounded by --repl-batch-bytes, not a frame count)"),
+    (REPL_COMPRESS, "counter",
+     "full replication frames that crossed the wire zlib-compressed "
+     "(KIND_FULL_Z), by op: encode = the publisher compressed one for "
+     "a z-capable subscriber, decode = a subscriber inflated one; "
+     "journal bytes stay uncompressed"),
+    (AUTOSCALE_EVENTS, "counter",
+     "elastic replica-tier scaling decisions the autoscaler acted on "
+     "(ISSUE 18), by action (scale_up|scale_down); hysteresis and the "
+     "cooldown window keep this a step function, not a flap"),
+    (AUTOSCALE_REPLICAS, "gauge",
+     "the autoscaler's current target follower count (what it is "
+     "holding the tier at, between --autoscale-min and "
+     "--autoscale-max)"),
 )
 
 # journal appends are MICROsecond-scale (a header pack + one buffered
@@ -352,6 +398,8 @@ _BUCKET_OVERRIDES = {
     COALESCE_OCCUPANCY: _OCCUPANCY_BUCKETS,
     INCR_COLS: _INCR_COLS_BUCKETS,
     JOURNAL_APPEND_US: _JOURNAL_APPEND_BUCKETS,
+    # frames-per-wakeup is a count, like coalesce occupancy
+    SEND_BATCH_FRAMES: _OCCUPANCY_BUCKETS,
 }
 
 
@@ -546,6 +594,32 @@ class ScorerMetrics:
 
     def set_replica_followers(self, n: int) -> None:
         self.registry.gauge_set(REPLICA_FOLLOWERS, int(n))
+
+    # -- relay tree + elastic tier (ISSUE 18) --
+    def set_relay_position(self, depth: int) -> None:
+        self.registry.gauge_set(RELAY_POSITION, int(depth))
+
+    def count_relay_forwarded(self, n: int = 1) -> None:
+        self.registry.counter_add(RELAY_FORWARDED, int(n))
+
+    def set_replica_hop_lag(self, hop: int, lag_ms: float) -> None:
+        self.registry.gauge_set(
+            REPLICA_HOP_LAG, float(lag_ms), {"hop": str(int(hop))}
+        )
+
+    def observe_send_batch(self, n_frames: int) -> None:
+        """Frames one publisher sender wakeup coalesced into a single
+        syscall (1 = no coalescing happened on that wakeup)."""
+        self.registry.histogram_observe(SEND_BATCH_FRAMES, float(n_frames))
+
+    def count_replica_compress(self, op: str) -> None:
+        self.registry.counter_add(REPL_COMPRESS, 1, {"op": op})
+
+    def count_autoscale_event(self, action: str) -> None:
+        self.registry.counter_add(AUTOSCALE_EVENTS, 1, {"action": action})
+
+    def set_autoscale_replicas(self, n: int) -> None:
+        self.registry.gauge_set(AUTOSCALE_REPLICAS, int(n))
 
     # -- crash tolerance: journal / failover / retry (ISSUE 11) --
     def count_journal(self, op: str, n: int = 1) -> None:

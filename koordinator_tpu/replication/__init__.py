@@ -30,7 +30,12 @@ Modules:
   compacting frame journal under ``--state-dir`` that makes the tier
   crash-tolerant — replay-on-boot resumes the same ``s<epoch>-<gen>``
   chain, and the publisher serves reconnecting followers just the
-  missing delta frames out of it.
+  missing delta frames out of it.  RelayFrameCache (ISSUE 18) is the
+  in-memory twin a relay answers descendant hello/resume from.
+* ``autoscale``  — SLO-driven elastic replica autoscaling (ISSUE 18):
+  the hysteresis control loop that spawns/drains followers into the
+  relay tree to hold a declared read p99 (imports ``obs.slo``; like
+  leader/follower it is imported explicitly, not re-exported here).
 * ``retry``      — the ONE jittered-exponential-backoff/deadline-budget
   policy every reconnect/failover loop retries through (koordlint's
   ``bare-retry`` rule rejects hand-rolled fixed-sleep retry loops).
@@ -52,6 +57,7 @@ from koordinator_tpu.replication.codec import (  # noqa: F401
     FrameError,
     KIND_DELTA,
     KIND_FULL,
+    KIND_FULL_Z,
     KIND_HELLO,
     decode_frame,
     encode_frame,
@@ -59,6 +65,7 @@ from koordinator_tpu.replication.codec import (  # noqa: F401
 from koordinator_tpu.replication.journal import (  # noqa: F401
     FrameJournal,
     JournalError,
+    RelayFrameCache,
 )
 from koordinator_tpu.replication.retry import (  # noqa: F401
     BackoffPolicy,

@@ -19,7 +19,15 @@ Frame (all integers big-endian, matching the raw-UDS scorer framing)::
     kind         u8    1 = delta (payload applies onto gen-1),
                        2 = full  (payload replaces all resident state),
                        3 = hello (follower->leader resume offer: the
-                           follower's chain position, empty payload)
+                           follower's chain position; the payload is a
+                           capability string — empty for legacy
+                           subscribers, ``z`` = "I accept zlib full
+                           frames"),
+                       4 = full_z (a kind=full frame whose payload is
+                           level-1 zlib; only ever sent to a
+                           subscriber that advertised ``z`` in its
+                           hello — the wire stays byte-compatible with
+                           pre-compression peers)
     epoch        8s    the leader's per-boot epoch (8 hex chars — the
                        <epoch> of "s<epoch>-<gen>" snapshot ids)
     generation   u64   generation AFTER applying the payload
@@ -58,8 +66,58 @@ KIND_FULL = 2
 # frames (no full-state resync); any other leader (or no hello at all,
 # the pre-journal subscriber) gets the opening kind=full frame.
 KIND_HELLO = 3
+# compressed full frame (ISSUE 18): the same reset semantics as
+# KIND_FULL, payload zlib-compressed at level 1 on the wire ONLY — the
+# journal keeps raw KIND_FULL bytes, and a subscriber only ever sees
+# kind 4 after offering the CAP_COMPRESS capability in its hello.
+# Sparse-scale full resyncs are hundreds of MB of mostly-sentinel int64
+# tensors; level-1 zlib trades a few ms of CPU for a ~10x smaller storm.
+KIND_FULL_Z = 4
 
-_KINDS = (KIND_DELTA, KIND_FULL, KIND_HELLO)
+_KINDS = (KIND_DELTA, KIND_FULL, KIND_HELLO, KIND_FULL_Z)
+
+# hello capability bytes (the hello payload is a flat ascii capability
+# string; unknown bytes are ignored by both sides, so capabilities are
+# forward- and backward-compatible: a legacy leader drains the payload
+# unread, a legacy follower sends none)
+CAP_COMPRESS = b"z"
+
+# zlib level for KIND_FULL_Z payloads: level 1 is the latency-friendly
+# point — the full frame rides the subscription-open path, where encode
+# time is paid under the publisher lock
+COMPRESS_LEVEL = 1
+
+
+def compress_payload(payload: bytes) -> bytes:
+    """The KIND_FULL_Z wire payload for a raw full-state payload."""
+    import zlib
+
+    return zlib.compress(payload, COMPRESS_LEVEL)
+
+
+def decompress_payload(payload: bytes, max_bytes: int = 0) -> bytes:
+    """Inverse of :func:`compress_payload`; raises :class:`FrameError`
+    on corrupt input or a decompressed size past ``max_bytes`` (default
+    :data:`MAX_PAYLOAD`) — a hostile tiny frame must not balloon into
+    an unbounded allocation."""
+    import zlib
+
+    cap = max_bytes or MAX_PAYLOAD
+    try:
+        d = zlib.decompressobj()
+        out = d.decompress(payload, cap)
+        if d.unconsumed_tail:
+            raise FrameError(
+                f"compressed full frame inflates past the {cap}-byte cap"
+            )
+        out += d.flush()
+        if len(out) > cap:
+            raise FrameError(
+                f"compressed full frame inflates past the {cap}-byte cap"
+            )
+        return out
+    except zlib.error as exc:
+        raise FrameError(f"corrupt compressed full frame: {exc}") from exc
 
 # the one statement of the header layout: (field, byte width) in emit
 # order — the wire-contract rule parses this table by AST and diffs it

@@ -65,9 +65,14 @@ class ReplicaApplier:
     feeds one applier (client Score/Assign traffic runs concurrently —
     the servicer's own locks cover that side)."""
 
-    def __init__(self, servicer, clock=time.time):
+    def __init__(self, servicer, clock=time.time, hop: int = 1):
+        """``hop`` is this replica's distance from the tree root
+        (ISSUE 18: 1 = direct follower of the leader, 2 = behind one
+        relay, ...) — it labels the per-hop lag gauge so a deep chain's
+        lag amplification is visible per level, not just in aggregate."""
         self.servicer = servicer
         self._clock = clock
+        self.hop = max(1, int(hop))
         self.applied = 0
         self.resyncs = 0
         self.last_lag_ms: Optional[float] = None
@@ -85,6 +90,23 @@ class ReplicaApplier:
 
     def offer(self, frame: "codec.Frame") -> str:
         metrics = self.servicer.telemetry.metrics
+        if frame.kind == codec.KIND_FULL_Z:
+            # negotiated wire compression (ISSUE 18): inflate back to
+            # the canonical KIND_FULL before the continuity core sees
+            # it — everything downstream (stage/commit, journal,
+            # relay re-publication) handles raw bytes only
+            import dataclasses
+
+            try:
+                frame = dataclasses.replace(
+                    frame, kind=codec.KIND_FULL,
+                    payload=codec.decompress_payload(frame.payload),
+                )
+            except codec.FrameError:
+                # corrupt compressed payload: a detected discontinuity,
+                # same contract as any malformed frame
+                return self._resync("decode", metrics)
+            metrics.count_replica_compress("decode")
         if frame.kind == codec.KIND_FULL:
             return self._apply(frame, metrics)
         epoch, gen = self.position()
@@ -115,6 +137,7 @@ class ReplicaApplier:
         self.last_lag_ms = lag_ms
         metrics.count_replica_frame(APPLIED)
         metrics.set_replica_lag(lag_ms)
+        metrics.set_replica_hop_lag(self.hop, lag_ms)
         return APPLIED
 
     def _resync(self, reason: str, metrics) -> str:
@@ -155,6 +178,9 @@ class ReplicationSubscriber:
         on_frame=None,
         backoff: Optional[BackoffPolicy] = None,
         hello: bool = True,
+        fallbacks=(),
+        compress: bool = True,
+        on_raw=None,
     ):
         """``backoff`` paces the redial loop (ISSUE 11): jittered
         exponential from ``reconnect_delay_s`` up to the policy cap —
@@ -170,8 +196,31 @@ class ReplicationSubscriber:
         delta frames — a journal warm-restart costs followers NO full
         resync.  Leaders ignore unexpected bytes conservatively (a
         hello to a pre-journal leader just reads as the subscription
-        opening; the full frame still arrives)."""
+        opening; the full frame still arrives).
+
+        ``fallbacks`` (ISSUE 18, the relay tree) are ANCESTOR
+        replication sockets in preference order behind the primary
+        ``path`` (parent first, then grandparent, ... root): every dial
+        attempt walks the whole ladder primary-first, so an interior
+        relay's death re-parents this subscriber onto the nearest
+        surviving ancestor — whose stream is the SAME chain, so the
+        hello/resume handshake serves just the missing deltas (zero
+        full resyncs) — and a healed parent is preferred again on the
+        next redial.
+
+        ``compress`` advertises the ``z`` hello capability: full frames
+        may then arrive as level-1 zlib (KIND_FULL_Z), inflated before
+        the continuity core sees them.
+
+        ``on_raw(result, frame, raw_bytes)`` is the relay forwarding
+        seam: called with the frame's exact wire bytes after every
+        offer, so a relay can re-publish applied delta frames verbatim
+        (near-zero-copy) on its own ``.repl`` socket."""
         self.path = path
+        self.fallbacks = tuple(fallbacks)
+        self.paths = (path,) + self.fallbacks
+        self.compress = bool(compress)
+        self.on_raw = on_raw
         self.applier = applier
         self.reconnect_delay_s = float(reconnect_delay_s)
         self.backoff = backoff or BackoffPolicy.from_env(
@@ -194,6 +243,11 @@ class ReplicationSubscriber:
         self._force_full = False
         self.connects = 0
         self.redials = 0
+        # which ancestor currently feeds this subscriber (index into
+        # ``paths``; 0 = the primary parent) and how many times a dial
+        # landed on a non-primary ancestor (the interior-death path)
+        self.active_path: Optional[str] = None
+        self.ancestor_switches = 0
 
     def start(self) -> "ReplicationSubscriber":
         self._thread.start()
@@ -213,14 +267,46 @@ class ReplicationSubscriber:
         self._thread.join(timeout=5)
 
     # -- internals --
+    def _dial(self, metrics) -> Optional[socket.socket]:
+        """One dial pass over the ancestor ladder, primary parent
+        first.  Returns the connected socket (``active_path`` updated)
+        or None when every ancestor refused — the caller backs off.  A
+        connect that lands past index 0 is an ancestor switch: the
+        parent is dead or unreachable and a surviving ancestor now
+        feeds this subscriber (same chain, so resume still applies)."""
+        for i, path in enumerate(self.paths):
+            conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                conn.connect(path)
+            except OSError:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            if i > 0:
+                self.ancestor_switches += 1
+                try:
+                    metrics.count_retry("failover")
+                except Exception:  # koordlint: disable=broad-except(failover accounting must never abort a successful dial)
+                    pass
+                logger.warning(
+                    "replication parent %s unreachable; re-parented "
+                    "onto ancestor %s", self.path, path,
+                )
+            self.active_path = path
+            return conn
+        return None
+
     def _run(self) -> None:
         metrics = self.applier.servicer.telemetry.metrics
         attempt = 0
         while not self._stop.is_set():
             conn = None
             try:
-                conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                conn.connect(self.path)
+                conn = self._dial(metrics)
+                if conn is None:
+                    raise OSError("no ancestor reachable")
                 with self._conn_lock:
                     self._conn = conn
                 self.connects += 1
@@ -231,10 +317,11 @@ class ReplicationSubscriber:
                         # legacy/malformed id: offer a position no
                         # journal matches -> ordinary full-frame open
                         epoch = "00000000"
+                    caps = codec.CAP_COMPRESS if self.compress else b""
                     try:
                         conn.sendall(codec.encode_frame(
                             codec.KIND_HELLO, epoch, max(0, gen),
-                            0, b"",
+                            0, caps,
                         ))
                     except OSError:
                         # peer hung up mid-handshake: whatever it
@@ -296,8 +383,19 @@ class ReplicationSubscriber:
                 self._force_full = True
                 return
             result = self.applier.offer(frame)
-            if result == APPLIED and frame.kind == codec.KIND_FULL:
+            if result == APPLIED and frame.kind in (
+                codec.KIND_FULL, codec.KIND_FULL_Z
+            ):
                 self._force_full = False  # healed: resume is safe again
+            if self.on_raw is not None:
+                # relay forwarding seam: the exact wire bytes, so a
+                # relay re-publishes applied deltas verbatim with zero
+                # re-encoding (delta frames are never compressed, so
+                # the bytes are hop-invariant)
+                try:
+                    self.on_raw(result, frame, header + payload)
+                except Exception:  # the relay's descendants resync on their own; a forwarding fault must not kill THIS stream
+                    logger.exception("replication on_raw callback failed")
             if self.on_frame is not None:
                 try:
                     self.on_frame(result, frame)

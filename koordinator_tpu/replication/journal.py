@@ -530,3 +530,114 @@ class FrameJournal:
         except OSError:
             return None
         return out
+
+
+class RelayFrameCache:
+    """In-memory ``frames_since`` for a relay (ISSUE 18).
+
+    A relay re-publishes its parent's delta stream on its own ``.repl``
+    socket, and its DESCENDANTS resume through the same hello handshake
+    a leader serves from its :class:`FrameJournal`.  A relay has no
+    journal (durability lives at the root; a relay is a fan-out
+    amplifier), so this cache keeps the recent raw delta frame bytes —
+    the exact bytes forwarded, still byte-identical to the root's — in
+    a bounded in-memory window and answers :meth:`frames_since` with
+    :class:`FrameJournal`-identical semantics: ``None`` whenever the
+    window cannot bridge the offered position (the caller falls back to
+    the full-frame subscription open, served from the relay's own
+    state).
+
+    ``note_full(epoch, gen)`` rebases the window on every full frame
+    the relay APPLIES (a full resets the chain exactly as a compaction
+    base does); ``add_delta`` extends it and evicts from the front once
+    ``max_bytes`` is exceeded — an evicted position simply resumes via
+    the full-frame open.  Thread contract: the relay's one subscriber
+    pump thread writes, the relay publisher's subscription threads read
+    ``frames_since`` concurrently — one small lock covers both."""
+
+    def __init__(self, max_bytes: int = 64 << 20):
+        self.max_bytes = max(1, int(max_bytes))
+        self._lock = witness_lock(
+            "replication.journal.RelayFrameCache._lock")
+        self._epoch: Optional[str] = None
+        self._base_gen: Optional[int] = None
+        self._last_gen: Optional[int] = None
+        self._frames: Dict[int, bytes] = {}
+        self._bytes = 0
+        self.evictions = 0
+
+    def note_full(self, epoch: str, generation: int) -> None:
+        """A full frame applied at (epoch, generation): everything
+        cached belongs to a superseded prefix — rebase the window."""
+        with self._lock:
+            self._epoch = epoch
+            self._base_gen = self._last_gen = int(generation)
+            self._frames = {}
+            self._bytes = 0
+
+    def add_delta(self, epoch: str, generation: int,
+                  raw_frame: bytes) -> None:
+        """One APPLIED delta's exact wire bytes.  A frame that does not
+        extend the cached chain rebases the window onto it (the relay's
+        own applier already continuity-checked it — the cache only
+        mirrors positions the relay actually holds)."""
+        gen = int(generation)
+        with self._lock:
+            if (
+                epoch != self._epoch
+                or self._last_gen is None
+                or gen != self._last_gen + 1
+            ):
+                self._epoch = epoch
+                self._base_gen = gen - 1
+                self._frames = {}
+                self._bytes = 0
+            self._frames[gen] = raw_frame
+            self._bytes += len(raw_frame)
+            self._last_gen = gen
+            while self._bytes > self.max_bytes and self._frames:
+                first = min(self._frames)
+                self._bytes -= len(self._frames.pop(first))
+                self._base_gen = first
+                self.evictions += 1
+
+    def position(self) -> Tuple[Optional[str], Optional[int]]:
+        with self._lock:
+            return self._epoch, self._last_gen
+
+    def frames_since(self, epoch: str, generation: int,
+                     limit_bytes: int = 256 << 20) -> Optional[List[bytes]]:
+        """:meth:`FrameJournal.frames_since` over the in-memory window;
+        the signature matches so leader.py's hello/resume path takes
+        either interchangeably."""
+        with self._lock:
+            if (
+                self._epoch != epoch
+                or self._base_gen is None
+                or generation < self._base_gen
+                or generation > (self._last_gen or -1)
+            ):
+                return None
+            out: List[bytes] = []
+            total = 0
+            for g in range(generation + 1, self._last_gen + 1):
+                frame = self._frames.get(g)
+                if frame is None:
+                    return None  # window hole (should not happen)
+                total += len(frame)
+                if total > limit_bytes:
+                    return None
+                out.append(frame)
+            return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "epoch": self._epoch,
+                "base_generation": self._base_gen,
+                "generation": self._last_gen,
+                "frames": len(self._frames),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "evictions": self.evictions,
+            }

@@ -51,6 +51,17 @@ logger = logging.getLogger(__name__)
 # a full resync; bounds leader-side memory at ~queue * frame size
 DEFAULT_QUEUE_FRAMES = 64
 
+# sender-path coalescing bound (ISSUE 18): consecutive queued frames
+# are concatenated into ONE sendall per wakeup up to this many bytes —
+# bounded by BYTES, not frame count, so a burst of sparse warm deltas
+# (a few hundred bytes each) collapses hundreds of syscalls into one
+# writev-sized write while a single huge full frame still goes alone
+DEFAULT_BATCH_BYTES = 1 << 20
+
+# a hello capability payload is a short ascii string; anything larger
+# is drained and ignored (conservative: treated as capability-free)
+_MAX_HELLO_CAPS = 64
+
 
 def _parse_sid(snapshot_id: str):
     from koordinator_tpu.bridge.client import parse_snapshot_id
@@ -59,15 +70,30 @@ def _parse_sid(snapshot_id: str):
 
 
 class _Subscriber:
-    """One follower connection: bounded queue + sender thread."""
+    """One follower connection: bounded queue + sender thread.  The
+    sender drains the queue in byte-bounded batches — frame boundaries
+    are preserved by the stream framing itself, so concatenation is
+    free — and reports each batch's occupancy through ``on_batch`` for
+    the publisher's frames-per-wakeup stats."""
 
-    def __init__(self, conn: socket.socket, max_frames: int, on_drop):
+    def __init__(self, conn: socket.socket, max_frames: int, on_drop,
+                 max_batch_bytes: int = DEFAULT_BATCH_BYTES,
+                 on_batch=None):
         self.conn = conn
         self.max_frames = max_frames
+        self.max_batch_bytes = max(1, int(max_batch_bytes))
         self._on_drop = on_drop
+        self._on_batch = on_batch
+        # negotiated in the hello handshake (publisher sets it before
+        # any frame is enqueued): may this subscriber receive
+        # KIND_FULL_Z compressed full frames?
+        self.compress = False
         self._frames = collections.deque()
         self._cond = witness_condition("replication.leader._Subscriber._cond")
         self._dead = False
+        # sender-thread-only counters; read racily by stats() (ints)
+        self.sent_frames = 0
+        self.sent_batches = 0
         self._thread = threading.Thread(target=self._drain, daemon=True)
 
     def start(self) -> "_Subscriber":
@@ -132,12 +158,31 @@ class _Subscriber:
                     self._cond.wait(timeout=1.0)
                 if self._dead:
                     return
-                frame = self._frames.popleft()
+                # byte-bounded coalescing (ISSUE 18): take every
+                # consecutive queued frame that fits the batch bound
+                # in ONE wakeup; the first frame always ships even
+                # when it alone exceeds the bound
+                batch = [self._frames.popleft()]
+                size = len(batch[0])
+                while self._frames and (
+                    size + len(self._frames[0]) <= self.max_batch_bytes
+                ):
+                    nxt = self._frames.popleft()
+                    size += len(nxt)
+                    batch.append(nxt)
+            data = batch[0] if len(batch) == 1 else b"".join(batch)
             try:
-                self.conn.sendall(frame)
+                self.conn.sendall(data)
             except OSError:
                 self.close()
                 return
+            self.sent_frames += len(batch)
+            self.sent_batches += 1
+            if self._on_batch is not None:
+                try:
+                    self._on_batch(len(batch))
+                except Exception:  # koordlint: disable=broad-except(batch-occupancy accounting must never kill the sender thread)
+                    pass
 
 
 class ReplicationPublisher:
@@ -154,21 +199,36 @@ class ReplicationPublisher:
         clock=time.time,
         journal=None,
         hello_timeout_s: float = 0.25,
+        max_batch_bytes: int = DEFAULT_BATCH_BYTES,
+        compress_full: bool = True,
     ):
-        """``journal`` (ISSUE 11, a ``replication.journal.FrameJournal``)
-        lets a subscription RESUME instead of full-resyncing: a
-        follower opens with a ``kind=hello`` frame naming its chain
-        position, and when the journal's delta chain covers it the
-        subscription is served just the missing frames — after a
-        journal warm-restart, reconnecting followers observe no full
-        resync.  Followers that send no hello within
-        ``hello_timeout_s`` (pre-journal subscribers, plain taps) get
-        the PR-8 behavior: a full opening frame."""
+        """``journal`` (ISSUE 11, a ``replication.journal.FrameJournal``
+        — or any object with its ``frames_since`` shape, e.g. the
+        relay-side ``replication.journal.RelayFrameCache``) lets a
+        subscription RESUME instead of full-resyncing: a follower opens
+        with a ``kind=hello`` frame naming its chain position, and when
+        the journal's delta chain covers it the subscription is served
+        just the missing frames — after a journal warm-restart,
+        reconnecting followers observe no full resync.  Followers that
+        send no hello within ``hello_timeout_s`` (pre-journal
+        subscribers, plain taps) get the PR-8 behavior: a full opening
+        frame.
+
+        ``max_batch_bytes`` bounds the sender-path coalescing (ISSUE
+        18): each subscriber's sender concatenates consecutive queued
+        frames into one ``sendall`` up to this many bytes per wakeup.
+
+        ``compress_full`` (ISSUE 18) serves the opening full frame as
+        level-1 zlib (``KIND_FULL_Z``) to any subscriber whose hello
+        advertised the ``z`` capability; journal bytes and delta frames
+        stay uncompressed."""
         self.servicer = servicer
         self.path = path
         self.queue_frames = max(1, int(queue_frames))
         self.journal = journal
         self.hello_timeout_s = float(hello_timeout_s)
+        self.max_batch_bytes = max(1, int(max_batch_bytes))
+        self.compress_full = bool(compress_full)
         self._clock = clock
         # RLock: an enqueue overflow inside the fan-out (lock held)
         # drops the subscriber, and the drop re-enters to unregister
@@ -187,6 +247,12 @@ class ReplicationPublisher:
         self.published = 0
         self.subscriptions = 0
         self.resumed_subscriptions = 0
+        self.compressed_fulls = 0
+        # send-batch totals of DROPPED subscribers, folded in by _drop
+        # so stats() never loses a retired sender's work (live
+        # subscribers are summed on demand)
+        self._retired_frames = 0
+        self._retired_batches = 0
 
     # -- lifecycle --
     def attach(self) -> "ReplicationPublisher":
@@ -237,10 +303,18 @@ class ReplicationPublisher:
             codec.KIND_DELTA, epoch, gen,
             int(self._clock() * 1e6), payload,
         )
+        self.publish_frame(frame)
+
+    def publish_frame(self, frame_bytes: bytes) -> None:
+        """Fan one already-encoded frame out to every subscriber — the
+        relay seam (ISSUE 18): a relay follower hands the immutable
+        delta bytes it just applied straight here, so re-publication is
+        a near-zero-copy byte forward (no decode, no re-encode, same
+        epoch fencing at every hop)."""
         with self._lock:
             self.published += 1
             for sub in list(self._subs):
-                sub.enqueue(frame)
+                sub.enqueue(frame_bytes)
 
     # -- subscription plumbing --
     def _accept_loop(self) -> None:
@@ -260,39 +334,46 @@ class ReplicationPublisher:
 
     def _read_hello(self, conn: socket.socket):
         """Peek for the subscriber's opening hello frame (bounded wait).
-        Returns the decoded position frame, or None — no hello within
+        Returns ``(frame, caps)`` — the decoded position frame plus its
+        capability payload bytes — or ``(None, b"")``: no hello within
         the window, or anything unexpected, degrades to the PR-8
         full-frame open, never to a failed subscription.  The window
         is a WHOLE-handshake deadline, not per-recv: this runs on the
         one accept thread, and a peer dribbling bytes must not be able
-        to stretch one handshake past ``hello_timeout_s`` total."""
+        to stretch one handshake past ``hello_timeout_s`` total.  A
+        payload past the small capability cap is drained and ignored
+        (legacy behavior: the payload used to be spec'd empty)."""
         deadline = time.monotonic() + self.hello_timeout_s
+        caps = b""
         try:
             buf = b""
             while len(buf) < codec.HEADER_LEN:
                 left = deadline - time.monotonic()
                 if left <= 0:
-                    return None
+                    return None, b""
                 conn.settimeout(left)
                 chunk = conn.recv(codec.HEADER_LEN - len(buf))
                 if not chunk:
-                    return None
+                    return None, b""
                 buf += chunk
             frame, plen = codec.decode_header(buf)
             if frame.kind != codec.KIND_HELLO:
-                return None
-            while plen > 0:  # a hello payload is spec'd empty; drain
+                return None, b""
+            oversized = plen > _MAX_HELLO_CAPS
+            while plen > 0:
                 left = deadline - time.monotonic()
                 if left <= 0:
-                    return None
+                    return None, b""
                 conn.settimeout(left)
                 chunk = conn.recv(min(65536, plen))
                 if not chunk:
-                    return None
+                    return None, b""
                 plen -= len(chunk)
-            return frame
+                if not oversized:
+                    caps += chunk
+            return frame, (b"" if oversized else caps)
         except (socket.timeout, OSError, codec.FrameError):
-            return None
+            return None, b""
         finally:
             try:
                 conn.settimeout(None)
@@ -309,9 +390,16 @@ class ReplicationPublisher:
         module docstring; a frame journaled-but-not-yet-fanned-out can
         be enqueued twice, and the follower drops the second as
         stale)."""
-        hello = self._read_hello(conn)
-        sub = _Subscriber(conn, self.queue_frames, self._drop)
-        resumed = False
+        hello, caps = self._read_hello(conn)
+        sub = _Subscriber(
+            conn, self.queue_frames, self._drop,
+            max_batch_bytes=self.max_batch_bytes,
+            on_batch=self._observe_batch,
+        )
+        sub.compress = (
+            self.compress_full and codec.CAP_COMPRESS in caps
+        )
+        resumed = compressed = False
         with self._lock:
             if hello is not None and self.journal is not None:
                 frames = self.journal.frames_since(
@@ -332,8 +420,14 @@ class ReplicationPublisher:
                 epoch, gen, payload = (
                     self.servicer.export_replication_snapshot()
                 )
+                kind = codec.KIND_FULL
+                if sub.compress and payload:
+                    kind = codec.KIND_FULL_Z
+                    payload = codec.compress_payload(payload)
+                    self.compressed_fulls += 1
+                    compressed = True
                 full = codec.encode_frame(
-                    codec.KIND_FULL, epoch, gen,
+                    kind, epoch, gen,
                     int(self._clock() * 1e6), payload,
                 )
                 sub.enqueue(full)
@@ -345,6 +439,42 @@ class ReplicationPublisher:
         metrics.set_replica_followers(n)
         if resumed:
             metrics.count_retry("resume")
+        if compressed:
+            metrics.count_replica_compress("encode")
+
+    def _observe_batch(self, n_frames: int) -> None:
+        """Sender-thread callback: one coalesced send of ``n_frames``
+        frames (the frames-per-wakeup distribution)."""
+        try:
+            self.servicer.telemetry.metrics.observe_send_batch(n_frames)
+        except Exception:  # koordlint: disable=broad-except(send-batch accounting is observability; it must never kill a sender)
+            pass
+
+    def stats(self) -> dict:
+        """Lifetime fan-out stats, including the sender-path batching
+        picture (ISSUE 18): ``frames_per_wakeup`` is the mean coalesced
+        batch occupancy — 1.0 means the batching never fired (serial
+        traffic), climbing under bursty fan-out load as syscalls are
+        saved."""
+        with self._lock:
+            frames = self._retired_frames
+            batches = self._retired_batches
+            for sub in self._subs:
+                frames += sub.sent_frames
+                batches += sub.sent_batches
+            return {
+                "published": self.published,
+                "subscriptions": self.subscriptions,
+                "resumed_subscriptions": self.resumed_subscriptions,
+                "followers": len(self._subs),
+                "compressed_fulls": self.compressed_fulls,
+                "sent_frames": frames,
+                "sent_batches": batches,
+                "frames_per_wakeup": (
+                    frames / batches if batches else 0.0
+                ),
+                "max_batch_bytes": self.max_batch_bytes,
+            }
 
     def _drop(self, sub: "_Subscriber") -> None:
         # from the sender thread (no lock) or re-entrantly from an
@@ -354,6 +484,8 @@ class ReplicationPublisher:
                 self._subs.remove(sub)
             except ValueError:
                 return
+            self._retired_frames += sub.sent_frames
+            self._retired_batches += sub.sent_batches
             n = len(self._subs)
         try:
             self.servicer.telemetry.metrics.set_replica_followers(n)

@@ -86,6 +86,15 @@ class SchedulerServer:
         coalesce_cap_ms: Optional[float] = None,
         max_inflight: Optional[int] = None,
         replicate_from: Optional[str] = None,
+        relay_from: Optional[str] = None,
+        tree_depth: Optional[int] = None,
+        repl_batch_bytes: Optional[int] = None,
+        repl_compress: bool = True,
+        autoscale: bool = False,
+        autoscale_min: Optional[int] = None,
+        autoscale_max: Optional[int] = None,
+        read_slo_p99_ms: Optional[float] = None,
+        autoscale_interval_s: Optional[float] = None,
         score_incr_max_ratio: Optional[float] = None,
         candidate_width: Optional[int] = None,
         journal: bool = False,
@@ -234,8 +243,56 @@ class SchedulerServer:
         # serves Score/Assign locally and refuses client Syncs.  The
         # default role is leader: every committed Sync streams out on
         # <uds>.repl for any follower that dials it.
+        #
+        # --relay-from (ISSUE 18, the relay tree) is the follower role
+        # PLUS re-publication: the value is this daemon's ANCESTOR
+        # ladder (parent.repl first, then grandparent, ... root) — it
+        # subscribes to the first entry with the rest as failover
+        # fallbacks, forwards every applied delta's exact wire bytes on
+        # its own <uds>.repl, and answers descendant hello/resume from
+        # an in-memory frame cache — so fan-out bandwidth multiplies
+        # with tree width and an interior relay's death re-parents its
+        # children onto a surviving ancestor with zero full resyncs.
+        self.relay_from = relay_from
+        self._ancestors: tuple = ()
+        self._relay = False
+        if relay_from and not replicate_from:
+            parts = [p.strip() for p in relay_from.split(",") if p.strip()]
+            if not parts:
+                raise ValueError(
+                    "--relay-from needs at least one ancestor socket path"
+                )
+            replicate_from = parts[0]
+            self._ancestors = tuple(parts[1:])
+            self._relay = True
+        # hop = distance from the tree's root leader (0 = the root
+        # itself); --tree-depth pins it for topologies the ladder
+        # length cannot infer (e.g. a relay dialed through one shared
+        # ancestor path)
+        if tree_depth is not None:
+            self.hop = max(0, int(tree_depth))
+        elif self._relay:
+            self.hop = 1 + len(self._ancestors)
+        else:
+            self.hop = 1 if replicate_from else 0
         self.replicate_from = replicate_from
         self.repl_path = uds_path + ".repl"
+        self.repl_batch_bytes = repl_batch_bytes
+        self.repl_compress = bool(repl_compress)
+        self._relay_cache = None
+        # elastic tier (ISSUE 18, replication/autoscale.py): the
+        # control loop runs in-daemon against this registry's read
+        # signals; the capacity LEVERS are injectable — an orchestrator
+        # (or the trace harness) overrides autoscale_spawn/drain before
+        # start(), the defaults just log the decision
+        self._autoscale_enabled = bool(autoscale)
+        self._autoscale_min = autoscale_min
+        self._autoscale_max = autoscale_max
+        self._read_slo_p99_ms = read_slo_p99_ms
+        self._autoscale_interval_s = autoscale_interval_s
+        self._autoscaler = None
+        self.autoscale_spawn = self._default_scale_lever("spawn")
+        self.autoscale_drain = self._default_scale_lever("drain")
         self._publisher = None
         self._subscriber = None
         self.applier = None
@@ -379,6 +436,23 @@ class SchedulerServer:
     def http_port(self) -> int:
         return self._httpd.server_address[1]
 
+    @staticmethod
+    def _default_scale_lever(action: str):
+        """The no-op capacity lever: the in-daemon autoscaler DECIDES;
+        starting/stopping replica processes is the orchestrator's job
+        (the trace harness injects real levers).  Logging keeps a
+        lever-less deployment's decisions visible."""
+        import logging
+
+        def lever():
+            logging.getLogger(__name__).warning(
+                "autoscale %s decided but no capacity lever is wired "
+                "(set server.autoscale_%s before start())",
+                action, action,
+            )
+
+        return lever
+
     def replica_health(self) -> dict:
         """The /healthz replication block: role, chain position, the
         journal's durable position/compaction stamp and replay outcome
@@ -405,6 +479,26 @@ class SchedulerServer:
             out["resumed_subscriptions"] = (
                 self._publisher.resumed_subscriptions
             )
+            out["publish"] = self._publisher.stats()
+        if self._relay:
+            out["relay"] = {
+                "hop": self.hop,
+                "ancestors": list(self._ancestors),
+                "active_path": (
+                    self._subscriber.active_path
+                    if self._subscriber is not None else None
+                ),
+                "ancestor_switches": (
+                    self._subscriber.ancestor_switches
+                    if self._subscriber is not None else 0
+                ),
+                "cache": (
+                    self._relay_cache.stats()
+                    if self._relay_cache is not None else None
+                ),
+            }
+        if self._autoscaler is not None:
+            out["autoscale"] = self._autoscaler.stats()
         if self.journal is not None:
             st = self.journal.stats()
             out["journal"] = {
@@ -519,9 +613,17 @@ class SchedulerServer:
                 ReplicationPublisher,
             )
 
-            self._publisher = ReplicationPublisher(
-                self.servicer, self.repl_path, journal=self.journal
-            ).attach().start()
+            if self._publisher is not None:
+                # a promoted RELAY already publishes on its own .repl:
+                # hook the local Sync commit path into it and point the
+                # hello/resume seam at the durable journal (the relay
+                # cache's window ended with the parent's chain)
+                self._publisher.journal = self.journal
+                self._publisher.attach()
+            else:
+                self._publisher = ReplicationPublisher(
+                    self.servicer, self.repl_path, journal=self.journal
+                ).attach().start()
             self._promoted = True
             return sid
 
@@ -575,16 +677,66 @@ class SchedulerServer:
             self._grpc_server = make_server(servicer=self.servicer)
             self._grpc_server.add_insecure_port(f"unix://{self.uds_path}")
             self._grpc_server.start()
+        repl_kw = {}
+        if self.repl_batch_bytes is not None:
+            repl_kw["max_batch_bytes"] = int(self.repl_batch_bytes)
+        repl_kw["compress_full"] = self.repl_compress
+        metrics = self.servicer.telemetry.metrics
         if self.replicate_from:
             from koordinator_tpu.replication.follower import (
+                APPLIED,
                 ReplicaApplier,
                 ReplicationSubscriber,
             )
 
-            self.applier = ReplicaApplier(self.servicer)
+            self.applier = ReplicaApplier(self.servicer, hop=self.hop)
+            on_raw = None
+            if self._relay:
+                # relay role (ISSUE 18): re-publish the applied stream
+                # on this daemon's own .repl.  The publisher is NOT
+                # attach()ed — there is no local Sync commit to hook;
+                # frames arrive through the on_raw forwarding seam as
+                # the exact wire bytes the parent sent, and descendant
+                # hello/resume is answered from the in-memory cache
+                from koordinator_tpu.replication import codec
+                from koordinator_tpu.replication.journal import (
+                    RelayFrameCache,
+                )
+                from koordinator_tpu.replication.leader import (
+                    ReplicationPublisher,
+                )
+
+                self._relay_cache = RelayFrameCache()
+                # koordlint: disable=unguarded-shared-state(reason: boot runs before the elector/HTTP threads exist; promote, the locked writer, cannot race it)
+                self._publisher = ReplicationPublisher(
+                    self.servicer, self.repl_path,
+                    journal=self._relay_cache, **repl_kw,
+                ).start()
+                publisher = self._publisher
+                cache = self._relay_cache
+
+                def on_raw(result, frame, raw):
+                    if result != APPLIED:
+                        return
+                    if frame.kind == codec.KIND_DELTA:
+                        # forward-then-cache would race a descendant's
+                        # hello between the two; cache-first keeps
+                        # frames_since ahead of the fan-out
+                        cache.add_delta(
+                            frame.epoch, frame.generation, raw
+                        )
+                        publisher.publish_frame(raw)
+                        metrics.count_relay_forwarded()
+                    else:
+                        # an applied full rebases this relay's chain;
+                        # descendants are never forwarded the full —
+                        # each relay serves opens from its OWN export
+                        cache.note_full(frame.epoch, frame.generation)
+
             # koordlint: disable=unguarded-shared-state(reason: boot runs before the elector/HTTP threads exist; promote, the locked writer, cannot race it)
             self._subscriber = ReplicationSubscriber(
-                self.replicate_from, self.applier
+                self.replicate_from, self.applier,
+                fallbacks=self._ancestors, on_raw=on_raw,
             ).start()
         else:
             from koordinator_tpu.replication.leader import (
@@ -593,8 +745,36 @@ class SchedulerServer:
 
             # koordlint: disable=unguarded-shared-state(reason: boot runs before the elector/HTTP threads exist; promote, the locked writer, cannot race it)
             self._publisher = ReplicationPublisher(
-                self.servicer, self.repl_path, journal=self.journal
+                self.servicer, self.repl_path, journal=self.journal,
+                **repl_kw,
             ).attach().start()
+        metrics.set_relay_position(self.hop)
+        if self._autoscale_enabled:
+            from koordinator_tpu.replication.autoscale import (
+                AutoscalePolicy,
+                RegistrySignals,
+                ReplicaAutoscaler,
+            )
+
+            policy_kw = {}
+            if self._autoscale_min is not None:
+                policy_kw["min_replicas"] = int(self._autoscale_min)
+            if self._autoscale_max is not None:
+                policy_kw["max_replicas"] = int(self._autoscale_max)
+            if self._read_slo_p99_ms is not None:
+                policy_kw["p99_high_ms"] = float(self._read_slo_p99_ms)
+            signals = RegistrySignals(self.servicer.telemetry.registry)
+            self._autoscaler = ReplicaAutoscaler(
+                AutoscalePolicy(**policy_kw),
+                signals.collect,
+                spawn=lambda: self.autoscale_spawn(),
+                drain=lambda: self.autoscale_drain(),
+                metrics=metrics,
+                interval_s=(
+                    float(self._autoscale_interval_s)
+                    if self._autoscale_interval_s is not None else 1.0
+                ),
+            ).start()
         self._http.start()
         self._elector_thread = threading.Thread(
             target=self.elector.run, daemon=True
@@ -606,6 +786,8 @@ class SchedulerServer:
         self.elector.stop()
         if self._elector_thread:
             self._elector_thread.join(timeout=5)
+        if self._autoscaler:
+            self._autoscaler.stop()
         if self._subscriber:
             self._subscriber.stop()
         if self._publisher:
@@ -690,6 +872,99 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "device-resident snapshot copy, serve Score/Assign locally, "
         "refuse client Syncs (env: KOORD_REPLICATE_FROM; "
         "docs/REPLICATION.md)",
+    )
+    ap.add_argument(
+        "--relay-from", dest="relay_from",
+        default=os.environ.get("KOORD_RELAY_FROM") or None,
+        help="run as a RELAY follower (docs/REPLICATION.md \"Relay "
+        "tree & autoscaling\"): comma-separated ancestor ladder of "
+        "replication sockets, nearest parent first (e.g. "
+        "'relay1.sock.repl,root.sock.repl').  Subscribes to the first "
+        "entry with the rest as failover fallbacks, re-publishes every "
+        "applied delta frame byte-identically on this daemon's own "
+        "<uds>.repl for its children, and answers their hello/resume "
+        "from an in-memory frame cache — fan-out bandwidth multiplies "
+        "with tree width (env: KOORD_RELAY_FROM)",
+    )
+    ap.add_argument(
+        "--tree-depth", type=int, dest="tree_depth",
+        default=(
+            int(os.environ["KOORD_TREE_DEPTH"])
+            if os.environ.get("KOORD_TREE_DEPTH") else None
+        ),
+        help="pin this daemon's hop distance from the relay tree's "
+        "root (labels the per-hop lag gauge); default inferred from "
+        "the --relay-from ladder length (env: KOORD_TREE_DEPTH)",
+    )
+    ap.add_argument(
+        "--repl-batch-bytes", type=int, dest="repl_batch_bytes",
+        default=(
+            int(os.environ["KOORD_REPL_BATCH_BYTES"])
+            if os.environ.get("KOORD_REPL_BATCH_BYTES") else None
+        ),
+        help="byte bound of the replication sender's frame coalescing: "
+        "consecutive queued frames concatenate into ONE send syscall "
+        "up to this many bytes per wakeup (default 1 MiB; frames-per-"
+        "wakeup publishes on koord_scorer_repl_send_batch_frames; "
+        "env: KOORD_REPL_BATCH_BYTES)",
+    )
+    ap.add_argument(
+        "--repl-no-compress", action="store_true",
+        default=bool(os.environ.get("KOORD_REPL_NO_COMPRESS")),
+        help="disable zlib compression of full replication frames on "
+        "the wire (compression is negotiated per subscriber in the "
+        "hello handshake and never touches journal bytes or delta "
+        "frames; env: KOORD_REPL_NO_COMPRESS=1)",
+    )
+    ap.add_argument(
+        "--autoscale", action="store_true",
+        default=bool(os.environ.get("KOORD_AUTOSCALE")),
+        help="run the SLO-driven elastic-tier control loop "
+        "(docs/REPLICATION.md \"Relay tree & autoscaling\"): watch the "
+        "windowed read p99, replication lag and admission sheds, and "
+        "call the wired spawn/drain capacity levers to hold "
+        "--read-slo-p99-ms; decisions publish on "
+        "koord_scorer_autoscale_* either way (env: KOORD_AUTOSCALE=1)",
+    )
+    ap.add_argument(
+        "--autoscale-min", type=int, dest="autoscale_min",
+        default=(
+            int(os.environ["KOORD_AUTOSCALE_MIN"])
+            if os.environ.get("KOORD_AUTOSCALE_MIN") else None
+        ),
+        help="floor of the autoscaler's follower count (default 1; "
+        "env: KOORD_AUTOSCALE_MIN)",
+    )
+    ap.add_argument(
+        "--autoscale-max", type=int, dest="autoscale_max",
+        default=(
+            int(os.environ["KOORD_AUTOSCALE_MAX"])
+            if os.environ.get("KOORD_AUTOSCALE_MAX") else None
+        ),
+        help="ceiling of the autoscaler's follower count (default 8; "
+        "env: KOORD_AUTOSCALE_MAX)",
+    )
+    ap.add_argument(
+        "--read-slo-p99-ms", type=float, dest="read_slo_p99_ms",
+        default=(
+            float(os.environ["KOORD_READ_SLO_P99_MS"])
+            if os.environ.get("KOORD_READ_SLO_P99_MS") else None
+        ),
+        help="the declared read SLO the autoscaler defends: windowed "
+        "read p99 above this scales up (after the hysteresis streak), "
+        "comfortably below scales down (default 50.0; env: "
+        "KOORD_READ_SLO_P99_MS)",
+    )
+    ap.add_argument(
+        "--autoscale-interval-s", type=float, dest="autoscale_interval_s",
+        default=(
+            float(os.environ["KOORD_AUTOSCALE_INTERVAL_S"])
+            if os.environ.get("KOORD_AUTOSCALE_INTERVAL_S") else None
+        ),
+        help="seconds between autoscaler ticks (default 1.0; the "
+        "hysteresis streaks and cooldown are counted in ticks, so "
+        "this also scales the tier's reaction time; env: "
+        "KOORD_AUTOSCALE_INTERVAL_S)",
     )
     ap.add_argument(
         "--score-incr-max-ratio", type=float,
@@ -845,6 +1120,15 @@ def main(argv=None) -> int:
         coalesce_cap_ms=args.coalesce_cap_ms,
         max_inflight=args.max_inflight,
         replicate_from=args.replicate_from,
+        relay_from=args.relay_from,
+        tree_depth=args.tree_depth,
+        repl_batch_bytes=args.repl_batch_bytes,
+        repl_compress=not args.repl_no_compress,
+        autoscale=args.autoscale,
+        autoscale_min=args.autoscale_min,
+        autoscale_max=args.autoscale_max,
+        read_slo_p99_ms=args.read_slo_p99_ms,
+        autoscale_interval_s=args.autoscale_interval_s,
         score_incr_max_ratio=args.score_incr_max_ratio,
         candidate_width=args.candidate_width,
         journal=args.journal,

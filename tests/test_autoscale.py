@@ -1,0 +1,261 @@
+"""SLO-driven elastic replica autoscaling (ISSUE 18): the hysteresis
+decision machine (scale-up, slower scale-down, the dead band, cooldown,
+bound clamps, broken-lever tolerance), the RegistrySignals delta
+windows over real cumulative histogram buckets, the autoscale metric
+families, and the harness's end-to-end traffic wave."""
+
+import pytest
+
+import koordinator_tpu.obs  # noqa: F401  (before replication: import cycle)
+from koordinator_tpu.obs.scorer_metrics import ScorerMetrics
+from koordinator_tpu.replication.autoscale import (
+    HOLD,
+    SCALE_DOWN,
+    SCALE_UP,
+    AutoscalePolicy,
+    AutoscaleSignals,
+    RegistrySignals,
+    ReplicaAutoscaler,
+)
+
+
+def _policy(**kw):
+    defaults = dict(
+        min_replicas=1, max_replicas=8, p99_high_ms=50.0,
+        p99_low_ratio=0.5, min_count=4, up_after=2, down_after=3,
+        cooldown_ticks=0,
+    )
+    defaults.update(kw)
+    return AutoscalePolicy(**defaults)
+
+
+def _scaler(policy, replicas=None):
+    return ReplicaAutoscaler(
+        policy, signals=lambda: AutoscaleSignals(),
+        spawn=lambda: None, drain=lambda: None, replicas=replicas,
+    )
+
+
+BREACH = AutoscaleSignals(read_p99_ms=120.0, read_count=100)
+CALM = AutoscaleSignals(read_p99_ms=10.0, read_count=100)
+BAND = AutoscaleSignals(read_p99_ms=40.0, read_count=100)  # under SLO, over calm ceiling
+IDLE = AutoscaleSignals()
+
+
+class TestPolicy:
+    def test_rejects_inverted_bounds(self):
+        with pytest.raises(ValueError):
+            AutoscalePolicy(min_replicas=4, max_replicas=2)
+
+    def test_rejects_bad_low_ratio(self):
+        with pytest.raises(ValueError):
+            AutoscalePolicy(p99_low_ratio=0.0)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(p99_low_ratio=1.5)
+
+
+class TestDecision:
+    def test_scale_up_needs_consecutive_breaches(self):
+        sc = _scaler(_policy(up_after=3))
+        assert sc.decide(BREACH) == HOLD
+        assert sc.decide(BREACH) == HOLD
+        assert sc.decide(BREACH) == SCALE_UP
+
+    def test_breach_streak_resets_on_calm_tick(self):
+        sc = _scaler(_policy(up_after=2))
+        assert sc.decide(BREACH) == HOLD
+        assert sc.decide(CALM) == HOLD
+        assert sc.decide(BREACH) == HOLD  # streak restarted, not resumed
+        assert sc.decide(BREACH) == SCALE_UP
+
+    def test_scale_down_is_deliberately_slower(self):
+        policy = _policy(up_after=1, down_after=3)
+        sc = _scaler(policy, replicas=3)
+        assert sc.decide(BREACH) == SCALE_UP
+        for _ in range(policy.down_after - 1):
+            assert sc.decide(CALM) == HOLD
+        assert sc.decide(CALM) == SCALE_DOWN
+
+    def test_dead_band_resets_both_streaks(self):
+        sc = _scaler(_policy(up_after=2, down_after=2), replicas=4)
+        assert sc.decide(BREACH) == HOLD
+        assert sc.decide(BAND) == HOLD
+        assert sc.decide(BREACH) == HOLD  # up streak was wiped
+        assert sc.decide(BAND) == HOLD
+        assert sc.decide(CALM) == HOLD
+        assert sc.decide(BAND) == HOLD
+        assert sc.decide(CALM) == HOLD  # down streak was wiped too
+
+    def test_oscillating_signal_never_saws(self):
+        """The anti-flap acceptance: a signal hopping between breach
+        and calm every tick (the worst flap driver) must move the
+        replica count as a STEP function — with up_after=2/down_after=3
+        no single-tick alternation ever completes a streak, so the
+        count never moves at all."""
+        sc = ReplicaAutoscaler(
+            _policy(up_after=2, down_after=3, cooldown_ticks=1),
+            signals=iter(
+                [BREACH, CALM] * 20
+            ).__next__,
+            spawn=lambda: None, drain=lambda: None, replicas=2,
+        )
+        for _ in range(40):
+            sc.tick()
+        assert sc.scale_ups == 0 and sc.scale_downs == 0
+        assert sc.replicas == 2
+
+    def test_cooldown_freezes_decisions(self):
+        sc = _scaler(_policy(up_after=1, cooldown_ticks=2))
+        assert sc.decide(BREACH) == SCALE_UP
+        sc.replicas += 1  # decide() alone does not apply the action
+        assert sc.decide(BREACH) == HOLD  # cooldown 2
+        assert sc.decide(BREACH) == HOLD  # cooldown 1
+        assert sc.decide(BREACH) == SCALE_UP
+
+    def test_bounds_clamp_both_directions(self):
+        sc = _scaler(_policy(up_after=1, max_replicas=2), replicas=2)
+        assert sc.decide(BREACH) == HOLD  # already at max
+        sc2 = _scaler(_policy(down_after=1, min_replicas=1), replicas=1)
+        assert sc2.decide(CALM) == HOLD  # already at min
+
+    def test_idle_tier_counts_as_calm(self):
+        sc = _scaler(_policy(down_after=2), replicas=3)
+        assert sc.decide(IDLE) == HOLD
+        assert sc.decide(IDLE) == SCALE_DOWN
+
+    def test_untrusted_p99_cannot_breach(self):
+        # 2 samples under min_count=4: the window p99 is noise
+        thin = AutoscaleSignals(read_p99_ms=500.0, read_count=2)
+        sc = _scaler(_policy(up_after=1))
+        assert sc.decide(thin) == HOLD
+
+    def test_lag_and_shed_breach_without_p99(self):
+        sc = _scaler(_policy(up_after=1, lag_high_ms=100.0))
+        assert sc.decide(AutoscaleSignals(lag_ms=500.0)) == SCALE_UP
+        sc2 = _scaler(_policy(up_after=1))
+        assert sc2.decide(AutoscaleSignals(shed_delta=3)) == SCALE_UP
+
+    def test_authoritative_replica_count_wins(self):
+        sc = _scaler(_policy(), replicas=1)
+        sc.decide(AutoscaleSignals(replicas=5))
+        assert sc.replicas == 5
+
+
+class TestTick:
+    def test_tick_applies_levers_and_logs_events(self):
+        calls = []
+        sc = ReplicaAutoscaler(
+            _policy(up_after=1, down_after=1),
+            signals=iter([BREACH, CALM, CALM]).__next__,
+            spawn=lambda: calls.append("spawn"),
+            drain=lambda: calls.append("drain"),
+            replicas=1,
+        )
+        rec = sc.tick()
+        assert rec["action"] == SCALE_UP and sc.replicas == 2
+        sc.tick()
+        assert sc.replicas == 1
+        assert calls == ["spawn", "drain"]
+        assert [e["action"] for e in sc.events] == [SCALE_UP, SCALE_DOWN]
+
+    def test_broken_spawn_does_not_kill_the_loop(self):
+        def bad_spawn():
+            raise RuntimeError("no capacity")
+
+        sc = ReplicaAutoscaler(
+            _policy(up_after=1, cooldown_ticks=2),
+            signals=lambda: BREACH,
+            spawn=bad_spawn, drain=lambda: None, replicas=1,
+        )
+        sc.tick()  # must not raise
+        assert sc.replicas == 2  # the decision stands
+        sc.tick()
+        sc.tick()
+        assert sc._cooldown == 0  # cooldown gated the retry rate
+
+    def test_autoscale_metric_families(self):
+        metrics = ScorerMetrics()
+        sc = ReplicaAutoscaler(
+            _policy(up_after=1),
+            signals=iter([BREACH, CALM]).__next__,
+            spawn=lambda: None, drain=lambda: None,
+            metrics=metrics, replicas=1,
+        )
+        sc.tick()
+        sc.tick()
+        reg = metrics.registry
+        assert reg.get(
+            "koord_scorer_autoscale_events_total", {"action": SCALE_UP}
+        ) == 1
+        assert reg.get("koord_scorer_autoscale_replicas") == 2
+
+
+class TestRegistrySignals:
+    def test_delta_windows_over_cumulative_buckets(self):
+        """Cumulative histogram buckets never calm down — the signal
+        source must window them per collect() so a past storm stops
+        breaching once traffic recovers."""
+        metrics = ScorerMetrics()
+        sig = RegistrySignals(metrics.registry)
+        for _ in range(50):
+            metrics.observe_trace_cycle("t", "score", 200.0)
+        s1 = sig.collect()
+        assert s1.read_count == 50
+        assert s1.read_p99_ms is not None and s1.read_p99_ms > 50.0
+        for _ in range(50):
+            metrics.observe_trace_cycle("t", "score", 1.0)
+        s2 = sig.collect()
+        assert s2.read_count == 50  # the WINDOW, not the lifetime 100
+        assert s2.read_p99_ms is not None and s2.read_p99_ms <= 10.0
+
+    def test_empty_window_has_no_p99(self):
+        metrics = ScorerMetrics()
+        sig = RegistrySignals(metrics.registry)
+        metrics.observe_trace_cycle("t", "score", 5.0)
+        sig.collect()
+        s = sig.collect()  # nothing new observed
+        assert s.read_count == 0
+
+    def test_shed_delta_and_lag_gauge(self):
+        metrics = ScorerMetrics()
+        sig = RegistrySignals(metrics.registry)
+        metrics.count_shed("score")
+        metrics.count_shed("assign")
+        metrics.set_replica_lag(123.0)
+        s1 = sig.collect()
+        assert s1.shed_delta == 2
+        assert s1.lag_ms == 123.0
+        s2 = sig.collect()
+        assert s2.shed_delta == 0  # windowed, not cumulative
+
+
+class TestAutoscaleWave:
+    def test_wave_holds_the_slo_with_scale_events(self):
+        from koordinator_tpu.harness.relay import autoscale_wave
+
+        spawned, drained = [], []
+        report = autoscale_wave(
+            ticks=32, peak=10.0,
+            spawn=lambda: spawned.append(1),
+            drain=lambda: drained.append(1),
+        )
+        assert report["scale_ups"] >= 1
+        assert report["peak_replicas"] > 1
+        assert report["plateau_ticks_judged"] > 0
+        assert report["slo_held"] is True
+        assert len(spawned) == report["scale_ups"]
+        assert len(drained) == report["scale_downs"]
+        # every decision record names its action and the tick p99 the
+        # bench artifact graphs
+        assert all(
+            "action" in r and "tick_p99_ms" in r for r in report["records"]
+        )
+
+    def test_wave_profile_shape(self):
+        from koordinator_tpu.harness.relay import wave_profile
+
+        prof = wave_profile(16, peak=10.0)
+        assert len(prof) == 16
+        assert prof[0] == 1.0
+        assert max(prof) == 10.0
+        assert prof[4:12] == [10.0] * 8  # the plateau

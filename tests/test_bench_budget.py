@@ -314,6 +314,69 @@ class TestArtifactSchemaWaveFields:
         assert bench._validate_artifact(self._line(rounds=1.5))
 
 
+class TestTreeArtifactFields:
+    """ISSUE 18: the relay-tree config's artifact fields — depth,
+    fan-out amplification, leaf-storm speedup, the zero-resync chaos
+    counters and the autoscale verdict — must be archived
+    schema-valid; malformed ones must not pass as measurements."""
+
+    def _line(self, **extra):
+        doc = {"metric": "tree_converge_wall_ms", "value": 1.0,
+               "unit": "ms"}
+        doc.update(extra)
+        return json.dumps(doc)
+
+    def test_valid_tree_fields_pass(self):
+        assert bench._validate_artifact(self._line(
+            tree_depth=3, tree_fanout_amplification=2.0,
+            tree_read_speedup=0.47, frames_per_wakeup=1.0,
+            resyncs_during_failover=0, full_opens_during_failover=0,
+            ancestor_switches=1, compressed_fulls=4,
+            autoscale_scale_ups=3, autoscale_scale_downs=2,
+            autoscale_peak_replicas=4, autoscale_slo_held=True,
+        )) == []
+        # a truncated (deadline-flushed) artifact may carry nulls
+        assert bench._validate_artifact(self._line(
+            tree_depth=None, tree_read_speedup=None,
+            autoscale_slo_held=None,
+        )) == []
+
+    def test_malformed_tree_depth_fails(self):
+        assert bench._validate_artifact(self._line(tree_depth=0))
+        assert bench._validate_artifact(self._line(tree_depth=True))
+        assert bench._validate_artifact(self._line(tree_depth="3"))
+
+    def test_malformed_ratios_fail(self):
+        assert bench._validate_artifact(
+            self._line(tree_fanout_amplification=-1.0)
+        )
+        assert bench._validate_artifact(
+            self._line(tree_read_speedup=float("nan"))
+        )
+        assert bench._validate_artifact(
+            self._line(frames_per_wakeup=float("inf"))
+        )
+
+    def test_malformed_counts_and_verdict_fail(self):
+        assert bench._validate_artifact(self._line(ancestor_switches=-1))
+        assert bench._validate_artifact(
+            self._line(full_opens_during_failover=1.5)
+        )
+        assert bench._validate_artifact(self._line(compressed_fulls=True))
+        assert bench._validate_artifact(
+            self._line(autoscale_peak_replicas="4")
+        )
+        assert bench._validate_artifact(
+            self._line(autoscale_slo_held="yes")
+        )
+
+    def test_tree_is_a_known_config(self):
+        import inspect
+
+        src = inspect.getsource(bench.child_config)
+        assert 'config == "tree"' in src
+
+
 class TestArtifactSchemaSpans:
     """ISSUE 4: BENCH_*.json trajectories carry per-stage span
     summaries; a stage that measured nothing publishes null, and a

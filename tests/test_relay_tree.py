@@ -1,0 +1,483 @@
+"""Chainable follower relay tree (ISSUE 18): depth-3 byte parity,
+interior-relay death resumed through an ancestor with zero full
+resyncs, the zombie-ancestor epoch fence, hello-negotiated full-frame
+compression (journal bytes stay raw), byte-bounded sender batching,
+the relay frame cache, and the warm relay stream's zero-retrace
+guarantee."""
+
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import koordinator_tpu.obs  # noqa: F401  (before replication: import cycle)
+from koordinator_tpu.bridge.client import parse_snapshot_id
+from koordinator_tpu.bridge.codegen import pb2
+from koordinator_tpu.bridge.server import ScorerServicer
+from koordinator_tpu.bridge.state import numpy_to_tensor
+from koordinator_tpu.harness import generators
+from koordinator_tpu.harness.golden import build_sync_request
+from koordinator_tpu.harness.relay import RelayTier, wait_until
+from koordinator_tpu.model import resources as res
+from koordinator_tpu.replication import codec
+from koordinator_tpu.replication.follower import (
+    APPLIED,
+    FollowerServicer,
+    RESYNC,
+    ReplicaApplier,
+    ReplicationSubscriber,
+    STALE,
+)
+from koordinator_tpu.replication.journal import RelayFrameCache
+from koordinator_tpu.replication.leader import ReplicationPublisher
+
+
+def _tiny_sync(pods=32, nodes=8, seed=3):
+    nodes_l, pods_l, gangs, quotas = generators.quota_colocation(
+        seed=seed, pods=pods, nodes=nodes, tenants=2
+    )
+    req, _ = build_sync_request(nodes_l, pods_l, gangs, quotas)
+    return req, nodes_l
+
+
+def _flat_score_bytes(sv, sid, top_k=8):
+    reply = sv.score(pb2.ScoreRequest(snapshot_id=sid, top_k=top_k,
+                                      flat=True))
+    return reply.flat.SerializeToString()
+
+
+def _capture_raw(leader_sv, clock=lambda: 0):
+    """Record each committed delta's ENCODED wire bytes, the exact
+    bytes a relay forwards."""
+    raw = []
+
+    def hook(req, snapshot_id, wire_bytes=None):
+        epoch, gen = parse_snapshot_id(snapshot_id)
+        raw.append(codec.encode_frame(
+            codec.KIND_DELTA, epoch, gen, int(clock()),
+            wire_bytes if wire_bytes is not None
+            else req.SerializeToString(),
+        ))
+
+    leader_sv.replication_hook = hook
+    return raw
+
+
+def _full_frame(sv):
+    epoch, gen, payload = sv.export_replication_snapshot()
+    return codec.Frame(kind=codec.KIND_FULL, epoch=epoch,
+                       generation=gen, stamp_us=0, payload=payload)
+
+
+# ---- the relay frame cache (a relay's hello/resume answer) ----
+
+class TestRelayFrameCache:
+    def _frames(self, n, epoch="aaaaaaaa", start=2):
+        return [
+            (epoch, g, codec.encode_frame(codec.KIND_DELTA, epoch, g, 0,
+                                          b"x" * 16))
+            for g in range(start, start + n)
+        ]
+
+    def test_resume_serves_exact_forwarded_bytes(self):
+        cache = RelayFrameCache()
+        cache.note_full("aaaaaaaa", 1)
+        frames = self._frames(4)
+        for epoch, gen, raw in frames:
+            cache.add_delta(epoch, gen, raw)
+        got = cache.frames_since("aaaaaaaa", 3)
+        assert got == [raw for _, g, raw in frames if g > 3]
+        # at the tip: an empty resume, not a miss
+        assert cache.frames_since("aaaaaaaa", 5) == []
+
+    def test_uncovered_positions_fall_back_to_full(self):
+        cache = RelayFrameCache()
+        cache.note_full("aaaaaaaa", 5)
+        for epoch, gen, raw in self._frames(2, start=6):
+            cache.add_delta(epoch, gen, raw)
+        assert cache.frames_since("aaaaaaaa", 2) is None  # before base
+        assert cache.frames_since("aaaaaaaa", 9) is None  # past the tip
+        assert cache.frames_since("bbbbbbbb", 6) is None  # wrong epoch
+
+    def test_eviction_moves_the_base(self):
+        frame = codec.encode_frame(codec.KIND_DELTA, "aaaaaaaa", 2, 0,
+                                   b"y" * 64)
+        cache = RelayFrameCache(max_bytes=len(frame) * 2)
+        cache.note_full("aaaaaaaa", 1)
+        for gen in range(2, 7):
+            cache.add_delta("aaaaaaaa", gen, codec.encode_frame(
+                codec.KIND_DELTA, "aaaaaaaa", gen, 0, b"y" * 64))
+        assert cache.evictions > 0
+        assert cache.frames_since("aaaaaaaa", 1) is None  # evicted
+        tail = cache.frames_since("aaaaaaaa", 5)
+        assert tail is not None and len(tail) == 1
+
+    def test_discontinuous_delta_rebases_the_window(self):
+        cache = RelayFrameCache()
+        cache.note_full("aaaaaaaa", 1)
+        cache.add_delta("aaaaaaaa", 2, b"f2")
+        # the relay's applier full-resynced and re-applied at gen 9:
+        # the cache mirrors only positions the relay actually holds
+        cache.add_delta("aaaaaaaa", 9, b"f9")
+        assert cache.frames_since("aaaaaaaa", 1) is None
+        assert cache.frames_since("aaaaaaaa", 8) == [b"f9"]
+
+
+# ---- depth-3 chain of real daemons ----
+
+class TestRelayChain:
+    def test_depth3_chain_byte_parity_with_flat_tier(self):
+        """The tentpole acceptance: a depth-3 relay chain converges to
+        REPLY bytes identical to the root's and to a flat follower's at
+        every converge point, fulls are never forwarded hop-to-hop
+        (each relay serves opens from its own export), and the
+        journal's bytes stay uncompressed even while the wire
+        negotiates KIND_FULL_Z."""
+        with tempfile.TemporaryDirectory() as tmp:
+            tier = RelayTier(tmp, chain=3, flat=1)
+            try:
+                sid = tier.sync(_tiny_sync(seed=0)[0])
+                assert tier.wait(sid, timeout_s=120.0)
+                for seed in (1, 2):
+                    sid = tier.sync(_tiny_sync(seed=seed)[0])
+                    assert tier.wait(sid, timeout_s=60.0)
+                    want = _flat_score_bytes(tier.leader.servicer, sid)
+                    for srv in tier.followers():
+                        assert _flat_score_bytes(srv.servicer, sid) == want
+                # each hop knows its depth, and the relays forwarded
+                for depth, srv in enumerate(tier.chain, start=1):
+                    reg = srv.servicer.telemetry.registry
+                    assert reg.get("koord_scorer_relay_position") == depth
+                interior = tier.chain[:-1]
+                assert all(
+                    s.servicer.telemetry.registry.get(
+                        "koord_scorer_relay_forwarded_total"
+                    ) >= 2
+                    for s in interior
+                )
+                # journal bytes: raw delta frames only, never FULL_Z
+                epoch, gen = parse_snapshot_id(sid)
+                stored = tier.leader.journal.frames_since(epoch, 1)
+                assert stored, "the root journal must cover the chain"
+                assert all(
+                    codec.decode_frame(raw).kind == codec.KIND_DELTA
+                    for raw in stored
+                )
+                # a follower opening onto REAL state negotiates the
+                # compressed full (the build-time opens rode the empty
+                # export: nothing to compress)
+                leaf = tier.spawn_leaf()
+                assert wait_until(
+                    lambda: leaf.servicer.snapshot_id() == sid,
+                    timeout_s=60.0,
+                )
+                assert sum(
+                    srv._publisher.compressed_fulls
+                    for srv in [tier.leader] + tier.followers()
+                    if getattr(srv, "_publisher", None) is not None
+                ) >= 1
+            finally:
+                tier.stop()
+
+    def test_interior_relay_death_resumes_through_ancestor(self):
+        """Interior death mid-storm: descendants redial a surviving
+        ancestor via hello and resume with ZERO full-frame opens and
+        ZERO applier resyncs — the relay tree's whole reason to
+        exist."""
+        with tempfile.TemporaryDirectory() as tmp:
+            tier = RelayTier(tmp, chain=3)
+            try:
+                sid = tier.sync(_tiny_sync(seed=0)[0])
+                assert tier.wait(sid, timeout_s=120.0)
+                victim = tier.chain[1]
+                opens0 = sum(
+                    srv._publisher.subscriptions
+                    - srv._publisher.resumed_subscriptions
+                    for srv in [tier.leader] + tier.followers()
+                    if srv is not victim
+                    and getattr(srv, "_publisher", None) is not None
+                )
+                resyncs0 = sum(
+                    s.applier.resyncs for s in tier.followers()
+                    if s is not victim
+                )
+                for seed in (1, 2):
+                    sid = tier.sync(_tiny_sync(seed=seed)[0])
+                tier.kill(1)
+                for seed in (3, 4):
+                    sid = tier.sync(_tiny_sync(seed=seed)[0])
+                assert tier.wait(sid, timeout_s=120.0)
+                assert tier.resyncs() - resyncs0 == 0
+                assert tier.full_opens() - opens0 == 0
+                assert sum(
+                    s._subscriber.ancestor_switches
+                    for s in tier.followers()
+                ) >= 1
+                want = _flat_score_bytes(tier.leader.servicer, sid)
+                for srv in tier.followers():
+                    assert _flat_score_bytes(srv.servicer, sid) == want
+            finally:
+                tier.stop()
+
+
+# ---- the zombie-ancestor epoch fence ----
+
+class TestZombieAncestorFence:
+    def test_promoted_epoch_fences_stale_ancestor_deltas(self):
+        """After a promotion bumps the epoch, a zombie ancestor still
+        replaying the OLD chain must be refused at every hop: its
+        deltas fail the epoch fence (a counted resync, state untouched)
+        and a relay's cache refuses to splice the new epoch."""
+        req, _ = _tiny_sync()
+        leader = ScorerServicer(score_memo=False)
+        raw_frames = _capture_raw(leader)
+        leader.sync(req)
+        follower = FollowerServicer(score_memo=False)
+        applier = ReplicaApplier(follower)
+        assert applier.offer(_full_frame(leader)) == APPLIED
+        old_epoch, old_gen = applier.position()
+
+        # the zombie relay's window, caught up to the old chain
+        cache = RelayFrameCache()
+        cache.note_full(old_epoch, old_gen)
+        leader.sync(_tiny_sync(seed=5)[0])
+        zombie_raw = raw_frames[-1]
+        zombie_frame = codec.decode_frame(zombie_raw)
+        cache.add_delta(zombie_frame.epoch, zombie_frame.generation,
+                        zombie_raw)
+        assert applier.offer(zombie_frame) == APPLIED  # pre-promotion
+
+        sid = follower.promote()
+        new_epoch, new_gen = applier.position()
+        assert new_epoch != old_epoch and sid.startswith(f"s{new_epoch}")
+
+        # the zombie keeps publishing the dead chain
+        leader.sync(_tiny_sync(seed=6)[0])
+        stale = codec.decode_frame(raw_frames[-1])
+        before = follower.snapshot_id()
+        assert applier.offer(stale) == RESYNC
+        assert applier.resyncs == 1
+        assert follower.snapshot_id() == before  # state untouched
+        # a LATE duplicate of the dead chain is stale even at the same
+        # generation numbers — the epoch, not the gen, is the fence
+        assert applier.offer(zombie_frame) == RESYNC
+
+        # and the zombie's cache cannot answer a new-epoch hello: the
+        # descendant falls back to a full open instead of splicing
+        # onto the dead chain
+        assert cache.frames_since(new_epoch, new_gen) is None
+
+
+# ---- hello-negotiated full-frame compression ----
+
+class TestCompression:
+    def test_payload_roundtrip_and_corruption(self):
+        payload = b"\x00" * 100_000 + b"tail"
+        z = codec.compress_payload(payload)
+        assert len(z) < len(payload) // 10
+        assert codec.decompress_payload(z) == payload
+        with pytest.raises(codec.FrameError):
+            codec.decompress_payload(b"not zlib at all")
+        # a hostile tiny frame must not balloon unboundedly
+        with pytest.raises(codec.FrameError):
+            codec.decompress_payload(z, max_bytes=1024)
+
+    def _converged_pair(self, tmp, sub_compress, pub_compress=True):
+        req, _ = _tiny_sync()
+        leader = ScorerServicer(score_memo=False)
+        pub = ReplicationPublisher(
+            leader, os.path.join(tmp, "l.repl"),
+            compress_full=pub_compress,
+        ).attach().start()
+        leader.sync(req)
+        follower = FollowerServicer(score_memo=False)
+        applier = ReplicaApplier(follower)
+        sub = ReplicationSubscriber(
+            pub.path, applier, compress=sub_compress
+        ).start()
+        assert wait_until(
+            lambda: follower.snapshot_id() == leader.snapshot_id()
+        )
+        return leader, pub, follower, sub
+
+    def test_capable_subscriber_gets_compressed_full(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            leader, pub, follower, sub = self._converged_pair(tmp, True)
+            try:
+                assert pub.compressed_fulls == 1
+                assert pub.stats()["compressed_fulls"] == 1
+                reg = follower.telemetry.registry
+                assert reg.get(
+                    "koord_scorer_repl_compress_total", {"op": "decode"}
+                ) == 1
+                sid = leader.snapshot_id()
+                assert _flat_score_bytes(follower, sid) == \
+                    _flat_score_bytes(leader, sid)
+            finally:
+                sub.stop()
+                pub.stop()
+
+    def test_legacy_subscriber_gets_raw_full(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            leader, pub, follower, sub = self._converged_pair(tmp, False)
+            try:
+                assert pub.compressed_fulls == 0
+                sid = leader.snapshot_id()
+                assert _flat_score_bytes(follower, sid) == \
+                    _flat_score_bytes(leader, sid)
+            finally:
+                sub.stop()
+                pub.stop()
+
+    def test_publisher_flag_off_never_compresses(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            leader, pub, follower, sub = self._converged_pair(
+                tmp, True, pub_compress=False
+            )
+            try:
+                assert pub.compressed_fulls == 0
+            finally:
+                sub.stop()
+                pub.stop()
+
+    def test_corrupt_compressed_full_resyncs_not_crashes(self):
+        follower = FollowerServicer(score_memo=False)
+        applier = ReplicaApplier(follower)
+        frame = codec.Frame(kind=codec.KIND_FULL_Z, epoch="aaaaaaaa",
+                            generation=1, stamp_us=0,
+                            payload=b"garbage, not zlib")
+        assert applier.offer(frame) == RESYNC
+        assert applier.resyncs == 1
+
+
+# ---- byte-bounded sender batching ----
+
+class TestSenderBatching:
+    def _resume_tier(self, tmp, max_batch_bytes, n_deltas=6):
+        """A publisher resuming a follower from a primed cache: the
+        resume frames are enqueued BEFORE the sender thread starts, so
+        the batching observed is deterministic."""
+        req, _ = _tiny_sync()
+        leader = ScorerServicer(score_memo=False)
+        raw_frames = _capture_raw(leader)
+        leader.sync(req)
+        follower = FollowerServicer(score_memo=False)
+        applier = ReplicaApplier(follower)
+        assert applier.offer(_full_frame(leader)) == APPLIED
+        epoch, gen = applier.position()
+        cache = RelayFrameCache()
+        cache.note_full(epoch, gen)
+        for seed in range(n_deltas):
+            leader.sync(_tiny_sync(seed=10 + seed)[0])
+            f = codec.decode_frame(raw_frames[-1])
+            cache.add_delta(f.epoch, f.generation, raw_frames[-1])
+        pub = ReplicationPublisher(
+            leader, os.path.join(tmp, "l.repl"), journal=cache,
+            max_batch_bytes=max_batch_bytes,
+        ).start()
+        sub = ReplicationSubscriber(pub.path, applier).start()
+        assert wait_until(
+            lambda: follower.snapshot_id() == leader.snapshot_id()
+        )
+        return leader, follower, pub, sub, len(raw_frames[-1])
+
+    def test_queued_resume_coalesces_into_one_wakeup(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            leader, follower, pub, sub, _ = self._resume_tier(
+                tmp, max_batch_bytes=1 << 20
+            )
+            try:
+                stats = pub.stats()
+                assert stats["resumed_subscriptions"] == 1
+                assert stats["sent_frames"] == 6
+                # all six queued frames fit one byte budget: the sender
+                # coalesced them into a single sendall wakeup
+                assert stats["sent_batches"] == 1
+                assert stats["frames_per_wakeup"] == 6.0
+                sid = leader.snapshot_id()
+                assert _flat_score_bytes(follower, sid) == \
+                    _flat_score_bytes(leader, sid)
+            finally:
+                sub.stop()
+                pub.stop()
+
+    def test_byte_bound_splits_batches(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            # a budget of ~1.5 frames: every wakeup carries exactly one
+            # frame (the bound is bytes, not frame count)
+            leader, follower, pub, sub, frame_len = self._resume_tier(
+                tmp, max_batch_bytes=1
+            )
+            try:
+                stats = pub.stats()
+                assert stats["sent_frames"] == 6
+                assert stats["sent_batches"] == 6
+                assert stats["frames_per_wakeup"] == 1.0
+            finally:
+                sub.stop()
+                pub.stop()
+
+
+# ---- warm relay stream: zero retraces across the hop ----
+
+class TestRelayWarmStream:
+    def test_warm_two_hop_stream_is_retrace_free(self):
+        """The relay forwards the exact encoded bytes it applied, so a
+        warm usage-only delta stream must land on BOTH the relay and
+        its descendant with zero jit cache misses — the relay seam
+        adds no compilation, no re-encoding, no shape drift."""
+        from koordinator_tpu.analysis import retrace_guard
+
+        req, nodes_l = _tiny_sync()
+        leader = ScorerServicer(score_memo=False)
+        raw_frames = _capture_raw(leader)
+        leader.sync(req)
+        relay = FollowerServicer(score_memo=False)
+        relay_applier = ReplicaApplier(relay)
+        assert relay_applier.offer(_full_frame(leader)) == APPLIED
+        cache = RelayFrameCache()
+        cache.note_full(*relay_applier.position())
+        # the descendant opens from the RELAY's own export (fulls are
+        # never forwarded hop-to-hop)
+        leaf = FollowerServicer(score_memo=False)
+        leaf_applier = ReplicaApplier(leaf, hop=2)
+        assert leaf_applier.offer(_full_frame(relay)) == APPLIED
+
+        prev = np.asarray(
+            [res.resource_vector(n.get("usage", {})) for n in nodes_l],
+            dtype=np.int64,
+        )
+        sid = leader.snapshot_id()
+        for sv in (leader, relay, leaf):
+            sv.score(pb2.ScoreRequest(snapshot_id=sid, top_k=4,
+                                      flat=True))
+
+        def warm_step(i):
+            nonlocal prev, sid
+            cur = prev.copy()
+            cur.flat[i % cur.size] += 1 + i
+            warm = pb2.SyncRequest()
+            warm.nodes.usage.CopyFrom(numpy_to_tensor(cur, prev))
+            prev = cur
+            leader.sync(warm)
+            raw = raw_frames[-1]
+            frame = codec.decode_frame(raw)
+            # the relay seam: apply, cache-first, forward the raw bytes
+            assert relay_applier.offer(frame) == APPLIED
+            cache.add_delta(frame.epoch, frame.generation, raw)
+            assert leaf_applier.offer(codec.decode_frame(raw)) == APPLIED
+            sid = leaf.snapshot_id()
+            assert sid == leader.snapshot_id()
+            leaf.score(pb2.ScoreRequest(snapshot_id=sid, top_k=4,
+                                        flat=True))
+
+        warm_step(0)
+        with retrace_guard(budget=0) as counter:
+            for i in range(1, 4):
+                warm_step(i)
+        assert counter.traces == 0 and counter.compiles == 0
+        # the cache can answer a descendant resume for the whole run
+        epoch, gen = relay_applier.position()
+        assert cache.frames_since(epoch, gen - 2) is not None

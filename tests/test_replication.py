@@ -936,6 +936,74 @@ class TestReplicaAwareClient:
                 fsrv.stop(0)
 
 
+class TestFollowerTargetParsing:
+    """Tree-aware replica discovery (ISSUE 18): the @depth annotation
+    on follower targets and the leaf-layer Score routing it drives.
+    Mirrored in Go by scorerclient.ParseFollowerTarget."""
+
+    def test_annotation_splits_address_and_depth(self):
+        from koordinator_tpu.bridge.client import parse_follower_target
+
+        assert parse_follower_target("unix:///f.sock@2") == (
+            "unix:///f.sock", 2,
+        )
+        assert parse_follower_target("unix:///f.sock") == (
+            "unix:///f.sock", 1,
+        )
+
+    def test_non_integer_suffix_stays_part_of_the_address(self):
+        from koordinator_tpu.bridge.client import parse_follower_target
+
+        # abstract sockets / IPv6 userinfo may legitimately contain @
+        assert parse_follower_target("unix-abstract:@koord") == (
+            "unix-abstract:@koord", 1,
+        )
+        assert parse_follower_target("user@host:50051") == (
+            "user@host:50051", 1,
+        )
+
+    def test_depth_clamps_to_one(self):
+        from koordinator_tpu.bridge.client import parse_follower_target
+
+        assert parse_follower_target("unix:///f.sock@0")[1] == 1
+        assert parse_follower_target("unix:///f.sock@-3")[1] == 1
+
+    def test_score_round_robins_over_the_deepest_layer_only(self, tmp_path):
+        from koordinator_tpu.bridge.client import ScorerClient
+
+        # gRPC channels dial lazily, so no servers are needed to
+        # observe the routing sets the constructor derives
+        client = ScorerClient(
+            f"unix://{tmp_path}/l.sock",
+            followers=[
+                f"unix://{tmp_path}/relay.sock@1",
+                f"unix://{tmp_path}/leaf1.sock@2",
+                f"unix://{tmp_path}/leaf2.sock@2",
+            ],
+        )
+        try:
+            assert client._follower_depths == [1, 2, 2]
+            # Score's round-robin set: the hop-2 leaves, never the
+            # interior relay
+            assert client._leaf_indices == [1, 2]
+        finally:
+            client.close()
+
+    def test_flat_list_keeps_every_follower_a_leaf(self, tmp_path):
+        from koordinator_tpu.bridge.client import ScorerClient
+
+        client = ScorerClient(
+            f"unix://{tmp_path}/l.sock",
+            followers=[
+                f"unix://{tmp_path}/f{i}.sock" for i in range(3)
+            ],
+        )
+        try:
+            assert client._leaf_indices == [0, 1, 2]
+        finally:
+            client.close()
+
+
 # ---- client retry policy: baseline survival + leader failover ----
 
 class TestClientRetryAndFailover:
