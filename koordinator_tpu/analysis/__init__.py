@@ -69,6 +69,14 @@ Rules (each suppressible per line with ``# koordlint: disable=<rule>``):
   edge missing from the doc, a doc row nothing derives, or a witness
   factory name disagreeing with the derived identity fails lint, both
   directions (the metrics-doc-drift pattern).
+* ``unregistered-jit-boundary`` — device-time truth (ISSUE 19,
+  analysis/devbound.py): every jitted def under ``solver/``,
+  ``parallel/`` or ``bridge/`` must register with the XLA launch
+  ledger via ``@devprof.boundary("<name>")`` (stacked ABOVE the jit
+  decorator, name a string literal); ``jax.jit(fn)`` call-form
+  assignments and ``shard_map`` launches outside any jitted def are
+  flagged — an unregistered boundary's compiles and device time
+  silently escape the ledger, /metrics and /healthz.
 * ``unguarded-shared-state`` — guarded-state inference
   (analysis/guards.py): an attribute a class writes under its lock is
   presumed lock-protected, so a lock-free write elsewhere (or a
@@ -111,4 +119,5 @@ RULES = (
     "lock-order-cycle",
     "lockorder-doc-drift",
     "unguarded-shared-state",
+    "unregistered-jit-boundary",
 )

@@ -125,6 +125,7 @@ def run_rules_on_source(
     annotations."""
     from koordinator_tpu.analysis import (
         bareretry,
+        devbound,
         donation,
         excepts,
         guards,
@@ -157,6 +158,7 @@ def run_rules_on_source(
         "bare-retry": bareretry.check,
         "unbounded-wait": unboundedwait.check,
         "unguarded-shared-state": guards.check,
+        "unregistered-jit-boundary": devbound.check,
     }
     for rule, fn in table.items():
         if rules is not None and rule not in rules:

@@ -7,7 +7,9 @@ with file:line, rule and reason.  Two conditions fail the audit:
 * **missing reason** — rules in ``REASON_REQUIRED`` (broad-except by
   long-standing review convention, unguarded-shared-state by ISSUE-17
   design: both suppress *races/eaten errors*, so the annotation must
-  say why the hazard is not real) carry a parenthesised reason;
+  say why the hazard is not real; unregistered-jit-boundary by ISSUE-19
+  design: the tag must say why a launch site legitimately escapes the
+  device-time ledger) carry a parenthesised reason;
 * **stale** — the suppressed rule no longer fires on the annotated
   line (the raw, unsuppressed pass finds nothing there): the code
   moved or was fixed, and a tag pinned to nothing will silently
@@ -35,7 +37,9 @@ from koordinator_tpu.analysis.core import (
 RULE = "suppression-audit"
 
 # rules whose suppressions MUST carry a reason
-REASON_REQUIRED = frozenset(("broad-except", "unguarded-shared-state"))
+REASON_REQUIRED = frozenset((
+    "broad-except", "unguarded-shared-state", "unregistered-jit-boundary",
+))
 
 
 @dataclasses.dataclass(frozen=True)

@@ -80,6 +80,7 @@ from koordinator_tpu.bridge.state import ResidentState
 from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG
 from koordinator_tpu.model.snapshot import pad_bucket
 from koordinator_tpu.obs import CycleTelemetry
+from koordinator_tpu.obs import devprof
 from koordinator_tpu.obs import lockwitness
 from koordinator_tpu.obs.lockwitness import witness_lock
 from koordinator_tpu.replication.admission import (
@@ -100,6 +101,29 @@ from koordinator_tpu.solver import (
     sparse_top_k,
 )
 from koordinator_tpu.solver.candidates import check_candidate_overflow
+
+
+def _devprof_span_attrs(span, notes) -> None:
+    """Attach the launch ledger's notes (obs/devprof.py, drained on the
+    thread that ran the jit boundaries) to a launch/RPC span: sampled
+    device time, whether any boundary compiled (and its wall cost), and
+    the launch's XLA-estimated flops — the host/device split the
+    assemble waterfall renders.  No notes (devprof off, or an unsampled
+    launch) = no attrs, so traces stay byte-identical to today."""
+    if not notes:
+        return
+    dev = [n["device_us"] for n in notes if n.get("device_us") is not None]
+    if dev:
+        span.set_attr("device_us", round(sum(dev), 1))
+    if any(n.get("compiled") for n in notes):
+        span.set_attr("compiled", True)
+        cms = [n["compile_ms"] for n in notes
+               if n.get("compile_ms") is not None]
+        if cms:
+            span.set_attr("compile_ms", round(sum(cms), 2))
+    fl = [n["flops"] for n in notes if n.get("flops") is not None]
+    if fl:
+        span.set_attr("flops", float(sum(fl)))
 
 
 class _AssignMemo:
@@ -149,6 +173,7 @@ class ScorerServicer:
         brownout_max_lag: Optional[int] = None,
         trace_export: Optional[str] = None,
         shed_fractions=None,
+        devprof_sample: Optional[int] = None,
     ):
         """``mesh``: a ``jax.sharding.Mesh`` turns the ASSIGN RPC into
         the round-based multi-chip cycle (parallel/shard_assign.py
@@ -404,6 +429,17 @@ class ScorerServicer:
         self.dispatch.launch_outcome_hook = self._launch_outcome
         self.telemetry.metrics.set_breaker_state(self.breaker.state())
         self.telemetry.metrics.set_candidate_width(self.cfg.candidate_width)
+        # device-time truth (ISSUE 19): configure the process-global
+        # launch ledger.  None = leave the ledger as-is (library
+        # embedders/tests own it); the daemon forwards its
+        # --devprof-sample.  The metrics sink is a weakref inside
+        # devprof, so this servicer's lifetime is never extended.
+        if devprof_sample is not None:
+            devprof.configure(
+                sample=devprof_sample,
+                metrics=self.telemetry.metrics,
+                state_dir=state_dir,
+            )
 
     # -- degradation ladder seams (ISSUE 13) --
     def _breaker_transition(self, to: str) -> None:
@@ -1116,6 +1152,7 @@ class ScorerServicer:
             # readback + assembly, no queue wait — queue wait has its
             # own koord_scorer_coalesce_queue_delay_ms family)
             t_exec = time.perf_counter()
+            devprof.drain_notes()  # discard notes a prior stage left on this thread
             N = snap.nodes.capacity
             P = snap.pods.capacity
             ks = [
@@ -1195,6 +1232,9 @@ class ScorerServicer:
             # dispatch); everything below blocks, so it lives in the
             # readback closure the dispatcher runs off the launch lock
             dispatch_s = time.perf_counter() - t_exec
+            # this thread ran the registered jit boundaries above: the
+            # ledger's launch notes attach to the span in the readback
+            devprof_notes = devprof.drain_notes()
         except Exception as exc:
             if launch_span is not None:
                 launch_span.abort(exc)
@@ -1226,6 +1266,7 @@ class ScorerServicer:
                 # the individual RPC spans' errors, not the launch's
                 if launch_span is not None:
                     launch_span.set_attr("k_bucket", k_launch)
+                    _devprof_span_attrs(launch_span, devprof_notes)
                     launch_span.end()
                 ti = ti.astype(np.int32)
                 valid = valid_np[:P].astype(bool)
@@ -1330,6 +1371,7 @@ class ScorerServicer:
         refuses rather than silently degrade to a truncated list."""
         try:
             t_exec = time.perf_counter()
+            devprof.drain_notes()  # discard notes a prior stage left on this thread
             N = snap.nodes.capacity
             P = snap.pods.capacity
             C = int(self.cfg.candidate_width)
@@ -1371,6 +1413,7 @@ class ScorerServicer:
                 hi=score_upper_bound(self.cfg),
             )
             dispatch_s = time.perf_counter() - t_exec
+            devprof_notes = devprof.drain_notes()
         except Exception as exc:
             if launch_span is not None:
                 launch_span.abort(exc)
@@ -1400,6 +1443,7 @@ class ScorerServicer:
                 if launch_span is not None:
                     launch_span.set_attr("k_bucket", k_launch)
                     launch_span.set_attr("candidate_width", C)
+                    _devprof_span_attrs(launch_span, devprof_notes)
                     launch_span.end()
                 ti = ti.astype(np.int32)
                 ok_np = ok_np.astype(bool)
@@ -1826,6 +1870,7 @@ class ScorerServicer:
             reply = self._assign_compute(
                 req, ctx, scope, memo=entry,
                 deadline_at=deadline_at, budget_ms=budget_ms,
+                tspan=tspan,
             )
         except BaseException as exc:
             if owner:
@@ -1893,6 +1938,7 @@ class ScorerServicer:
         self, req: "pb2.AssignRequest", ctx, scope,
         memo: Optional[_AssignMemo] = None,
         deadline_at: Optional[float] = None, budget_ms: float = 0.0,
+        tspan=None,
     ) -> "pb2.AssignReply":
         """Run one real device cycle through the pipelined dispatcher
         and (as memo owner) publish its certified result.  ``memo`` is
@@ -1907,6 +1953,7 @@ class ScorerServicer:
         # queued behind other launches (the coalesce families carry
         # queueing)
         t0 = [0.0]
+        devprof_notes: list = []
 
         @launch_section
         def launch():
@@ -1922,6 +1969,7 @@ class ScorerServicer:
             # in-flight slot keeps a donating Sync OUT (run_exclusive
             # drains) until the readback below completes.
             t0[0] = time.perf_counter()
+            devprof.drain_notes()  # discard notes a prior stage left on this thread
             # gather-stage deadline check (ISSUE 13): the budget may
             # have drained while this RPC waited for pipeline headroom
             # and the launch lock — an expired Assign must fail HERE,
@@ -1935,6 +1983,7 @@ class ScorerServicer:
             result, rounds, eff_wave = self._assign_cycle(
                 snap, scope, i32_ok
             )
+            devprof_notes.extend(devprof.drain_notes())
 
             def _readback():
                 # blocking stacked transfer — OFF the launch lock, so a
@@ -1996,6 +2045,10 @@ class ScorerServicer:
                 self.telemetry.abort_scope(scope, "assign", exc)
             raise
         ms = (time.perf_counter() - t0[0]) * 1000.0
+        if tspan is not None:
+            # device-time truth on the assign RPC span: the ledger's
+            # notes for the cycle this RPC's thread launched
+            _devprof_span_attrs(tspan, devprof_notes)
         with self._state_lock:
             reply = pb2.AssignReply(
                 cycle_ms=ms,

@@ -42,8 +42,15 @@ METHOD_ASSIGN = 3
 # Registered through RawUdsServer(admin_handlers=...), never the
 # servicer method table, so the scorer wire contract is untouched.
 METHOD_PROMOTE = 4
+# admin plane (ISSUE 19): method 5 = Profile — request payload is an
+# optional ASCII window in milliseconds, reply payload is the capture
+# directory path (UTF-8) where jax.profiler wrote the on-demand trace.
+# Same seam as Promote: RawUdsServer(admin_handlers=...), never the
+# protobuf wire contract.
+METHOD_PROFILE = 5
 _METHOD_NAMES = {METHOD_SYNC: "sync", METHOD_SCORE: "score",
-                 METHOD_ASSIGN: "assign", METHOD_PROMOTE: "promote"}
+                 METHOD_ASSIGN: "assign", METHOD_PROMOTE: "promote",
+                 METHOD_PROFILE: "profile"}
 
 # Sized to the largest realistic SyncRequest (10k pods x 2k nodes of i64
 # request/capacity vectors serializes to a few MB); anything larger is a

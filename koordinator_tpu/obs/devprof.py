@@ -1,0 +1,752 @@
+"""Device-time truth: the XLA launch ledger (ISSUE 19).
+
+Every latency the system publishes elsewhere is host wall-clock —
+``time.perf_counter`` around dispatch/readback in ``bridge/server.py``.
+This module makes the DEVICE side first-class: every jit boundary in
+the serving path registers here with :func:`boundary`, and the ledger
+captures, per (boundary, static shape signature):
+
+* **compile truth** — at first-compile time via the AOT path
+  (``fn.lower(*args).compile()``): compile wall-time, XLA
+  ``cost_analysis()`` (flops, bytes accessed) and
+  ``memory_analysis()`` (temp/argument/output bytes), labeled by
+  backend platform.  A retrace is therefore no longer just a counter
+  bump (``koord_scorer_jit_cache_miss_total``) but an **attributed
+  event** naming the boundary and the shape signature that minted it.
+* **execution truth** — per-launch device time, sampled at a bounded
+  rate (``--devprof-sample N`` = time 1 launch in N;  0 = off) by
+  blocking on the launch's own outputs, so the sample is the real
+  dispatch→ready wall for exactly that program.
+
+The ledger feeds four consumers: new ``koord_scorer_devprof_*``
+metric families on /metrics, ``device_us``/``compiled``/``flops``
+attributes on the ``score_launch``/assign spans (the
+``obs/assemble.py`` waterfall renders the host/device split), the
+/healthz ``device`` block, and the report CLI::
+
+    python -m koordinator_tpu.obs.devprof <state-dir>
+
+which prints the compile ledger and a top-N-by-device-time table with
+flops/bytes — the roofline-style per-backend constant factors ROADMAP
+item 4's flag-sweep campaign consumes.
+
+The hard contract, inherited from the warm path's compile economics
+(docs/ANALYSIS.md "instrumentation never enters jitted code"):
+
+* ``sample == 0`` (the default; oracles pin it) is **bit-inert**: the
+  wrapper short-circuits to ``fn(*args, **kwargs)`` before touching
+  anything — no signature hashing, no notes, no AOT, zero retraces.
+* A boundary invoked while a jax trace is live (nested jits: the
+  Pallas cycle calling ``score_cycle``, term extras fused inside
+  ``score_all``) bypasses ALL instrumentation — only outermost,
+  host-invoked launches are measured.
+* Capture is exception-gated everywhere: ``cost_analysis`` /
+  ``memory_analysis`` availability drifts across jax versions and
+  backends, and a telemetry failure must degrade, never break a
+  launch.
+
+Costs, stated honestly: with sampling ON, a cold signature compiles
+twice (once for the AOT capture, once through jit's own cache) — the
+warm path never pays this; a sampled warm launch pays one
+``block_until_ready`` (it serializes that one launch against the
+pipeline, which is exactly why sampling is bounded-rate).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = [
+    "boundary",
+    "boundaries",
+    "configure",
+    "reset",
+    "enabled",
+    "drain_notes",
+    "summary",
+    "health_block",
+    "dump",
+    "capture_profile",
+    "DEFAULT_SAMPLE",
+    "LEDGER_FILENAME",
+]
+
+# the recommended sampling rate when the operator turns devprof on
+# without choosing one: time 1 launch in 16
+DEFAULT_SAMPLE = 16
+
+LEDGER_FILENAME = "devprof.json"
+
+# flush the on-disk ledger every this many sampled launches (compile
+# events always flush immediately — they are rare and load-bearing)
+_FLUSH_EVERY = 32
+
+# signature strings are labels on events and ledger rows; a pathological
+# static repr must not bloat them
+_SIG_MAX = 160
+
+
+def _now() -> float:
+    return time.perf_counter()
+
+
+class _Entry:
+    """One (boundary, signature) row of the compile ledger."""
+
+    __slots__ = (
+        "boundary", "sig", "backend", "compile_ms", "flops",
+        "bytes_accessed", "temp_bytes", "argument_bytes", "output_bytes",
+        "first_seen_s",
+    )
+
+    def __init__(self, boundary: str, sig: str):
+        self.boundary = boundary
+        self.sig = sig
+        self.backend: Optional[str] = None
+        self.compile_ms: Optional[float] = None
+        self.flops: Optional[float] = None
+        self.bytes_accessed: Optional[float] = None
+        self.temp_bytes: Optional[int] = None
+        self.argument_bytes: Optional[int] = None
+        self.output_bytes: Optional[int] = None
+        self.first_seen_s = time.time()
+
+    def to_dict(self) -> dict:
+        return {
+            "boundary": self.boundary,
+            "sig": self.sig,
+            "backend": self.backend,
+            "compile_ms": self.compile_ms,
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "temp_bytes": self.temp_bytes,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "first_seen_s": self.first_seen_s,
+        }
+
+
+class _BoundaryStats:
+    """Cumulative per-boundary launch/device-time accounting."""
+
+    __slots__ = ("launches", "sampled", "device_us_total", "compiles")
+
+    def __init__(self):
+        self.launches = 0
+        self.sampled = 0
+        self.device_us_total = 0.0
+        self.compiles = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "launches": self.launches,
+            "sampled": self.sampled,
+            "device_us_total": self.device_us_total,
+            "compiles": self.compiles,
+        }
+
+
+class LaunchLedger:
+    """Process-global registry of jit boundaries + their capture state.
+
+    One instance lives at module scope (like the retrace-guard hook and
+    the kernel demotion listeners); tests get a fresh one via
+    :func:`reset`.  All mutation happens under one lock — boundaries
+    fire from the bridge worker threads AND the pipelined readback
+    threads concurrently.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._boundaries: Dict[str, _BoundaryStats] = {}
+        self._entries: Dict[tuple, _Entry] = {}  # (boundary, sig) -> row
+        self._retraces: List[dict] = []  # attributed retrace events
+        self.sample = 0
+        self._metrics_ref: Optional[Callable[[], Any]] = None
+        self.state_dir: Optional[str] = None
+        self._counter = 0  # global launch counter driving 1-in-N
+        self._unflushed = 0
+        self._tls = threading.local()
+
+    # -- registration ------------------------------------------------
+    def register(self, name: str) -> None:
+        with self._lock:
+            self._boundaries.setdefault(name, _BoundaryStats())
+
+    def boundaries(self) -> List[str]:
+        with self._lock:
+            return sorted(self._boundaries)
+
+    # -- configuration -----------------------------------------------
+    def configure(self, sample: Optional[int] = None, metrics=None,
+                  state_dir: Optional[str] = None) -> None:
+        import weakref
+
+        with self._lock:
+            if sample is not None:
+                self.sample = max(0, int(sample))
+            if metrics is not None:
+                # weakref, CycleTelemetry-feed style: the ledger is
+                # process-global and must never pin a servicer's
+                # metrics object past its lifetime
+                self._metrics_ref = weakref.ref(metrics)
+            if state_dir is not None:
+                self.state_dir = str(state_dir)
+
+    def _metrics(self):
+        ref = self._metrics_ref
+        if ref is None:
+            return None
+        return ref()
+
+    # -- the wrapper's accounting primitives -------------------------
+    def should_sample(self) -> bool:
+        """1-in-N gate over the global launch counter (all boundaries
+        share one counter so a quiet boundary still gets samples)."""
+        with self._lock:
+            self._counter += 1
+            return self.sample > 0 and self._counter % self.sample == 0
+
+    def note_launch(self, name: str) -> None:
+        with self._lock:
+            st = self._boundaries.setdefault(name, _BoundaryStats())
+            st.launches += 1
+
+    def seen_sig(self, name: str, sig: str) -> bool:
+        with self._lock:
+            return (name, sig) in self._entries
+
+    def record_compile(self, name: str, sig: str, backend: str,
+                       compile_ms: float, cost: Optional[dict],
+                       mem: Optional[dict]) -> None:
+        with self._lock:
+            st = self._boundaries.setdefault(name, _BoundaryStats())
+            prior_sigs = st.compiles
+            st.compiles += 1
+            e = self._entries.setdefault((name, sig), _Entry(name, sig))
+            e.backend = backend
+            e.compile_ms = compile_ms
+            if cost:
+                e.flops = cost.get("flops")
+                e.bytes_accessed = cost.get("bytes accessed")
+            if mem:
+                e.temp_bytes = mem.get("temp")
+                e.argument_bytes = mem.get("argument")
+                e.output_bytes = mem.get("output")
+            retrace = prior_sigs > 0
+            if retrace:
+                # the attributed event the ISSUE asks for: not "a
+                # cache miss happened" but "THIS boundary minted a new
+                # program for THIS shape"
+                self._retraces.append({
+                    "boundary": name,
+                    "sig": sig,
+                    "backend": backend,
+                    "compile_ms": compile_ms,
+                    "at_s": time.time(),
+                })
+        m = self._metrics()
+        if m is not None:
+            try:
+                m.devprof_compile(name, backend, compile_ms)
+                if retrace:
+                    m.devprof_retrace(name)
+            except Exception:  # koordlint: disable=broad-except(reason: telemetry sink drift must not break a launch; the ledger itself already recorded the compile)
+                pass
+        self._flush(force=True)
+
+    def record_device_time(self, name: str, device_us: float) -> None:
+        with self._lock:
+            st = self._boundaries.setdefault(name, _BoundaryStats())
+            st.sampled += 1
+            st.device_us_total += device_us
+            self._unflushed += 1
+            flush = self._unflushed >= _FLUSH_EVERY
+        m = self._metrics()
+        if m is not None:
+            try:
+                m.devprof_device_us(name, device_us)
+            except Exception:  # koordlint: disable=broad-except(reason: telemetry sink drift must not break a launch; the ledger itself already recorded the sample)
+                pass
+        if flush:
+            self._flush(force=True)
+
+    # -- per-thread launch notes (span attribution seam) -------------
+    def push_note(self, note: dict) -> None:
+        notes = getattr(self._tls, "notes", None)
+        if notes is None:
+            notes = self._tls.notes = []
+        notes.append(note)
+
+    def drain_notes(self) -> List[dict]:
+        notes = getattr(self._tls, "notes", None)
+        if not notes:
+            return []
+        out = list(notes)
+        notes.clear()
+        return out
+
+    # -- views -------------------------------------------------------
+    def summary(self) -> dict:
+        """The bench/report view: compile ledger + per-boundary
+        cumulative device time + attributed retraces."""
+        with self._lock:
+            entries = [e.to_dict() for e in self._entries.values()]
+            bounds = {
+                n: st.to_dict() for n, st in self._boundaries.items()
+            }
+            retraces = list(self._retraces)
+            sample = self.sample
+        entries.sort(key=lambda d: (d["boundary"], d["sig"]))
+        return {
+            "sample": sample,
+            "backend": _backend_platform(),
+            "boundaries": bounds,
+            "entries": entries,
+            "retraces": retraces,
+        }
+
+    def health_block(self, top: int = 3) -> dict:
+        """The /healthz ``device`` block: platform, device count, the
+        compile ledger summary, and the top boundaries by cumulative
+        device time."""
+        with self._lock:
+            compiles = sum(st.compiles for st in self._boundaries.values())
+            compile_ms = sum(
+                e.compile_ms or 0.0 for e in self._entries.values()
+            )
+            ranked = sorted(
+                (
+                    (n, st) for n, st in self._boundaries.items()
+                    if st.device_us_total > 0
+                ),
+                key=lambda kv: kv[1].device_us_total,
+                reverse=True,
+            )[:top]
+            retraces = len(self._retraces)
+            sample = self.sample
+            registered = len(self._boundaries)
+        return {
+            "platform": _backend_platform(),
+            "device_count": _device_count(),
+            "sample": sample,
+            "registered_boundaries": registered,
+            "compiles": compiles,
+            "compile_ms_total": round(compile_ms, 3),
+            "retraces": retraces,
+            "top": [
+                {
+                    "boundary": n,
+                    "device_us_total": round(st.device_us_total, 1),
+                    "sampled": st.sampled,
+                    "launches": st.launches,
+                }
+                for n, st in ranked
+            ],
+        }
+
+    # -- persistence -------------------------------------------------
+    def dump(self, state_dir: Optional[str] = None) -> Optional[str]:
+        """Write the ledger as ``<state-dir>/devprof.json`` (the report
+        CLI's input).  Returns the path, or None without a state dir."""
+        target = state_dir or self.state_dir
+        if not target:
+            return None
+        path = os.path.join(target, LEDGER_FILENAME)
+        doc = self.summary()
+        tmp = path + ".tmp"
+        try:
+            os.makedirs(target, exist_ok=True)
+            with open(tmp, "w") as fh:
+                json.dump(doc, fh, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            return None
+        with self._lock:
+            self._unflushed = 0
+        return path
+
+    def _flush(self, force: bool = False) -> None:
+        if self.state_dir:
+            self.dump()
+
+
+# -- module-level singleton ------------------------------------------
+
+_LEDGER = LaunchLedger()
+
+
+def _ledger() -> LaunchLedger:
+    return _LEDGER
+
+
+def reset() -> None:
+    """Fresh ledger (tests).  Boundaries re-register lazily on their
+    next launch; already-wrapped callables keep working because the
+    wrapper resolves the singleton per call."""
+    global _LEDGER
+    _LEDGER = LaunchLedger()
+
+
+def configure(sample: Optional[int] = None, metrics=None,
+              state_dir: Optional[str] = None) -> None:
+    _LEDGER.configure(sample=sample, metrics=metrics, state_dir=state_dir)
+
+
+def enabled() -> bool:
+    return _LEDGER.sample > 0
+
+
+def boundaries() -> List[str]:
+    return _LEDGER.boundaries()
+
+
+def drain_notes() -> List[dict]:
+    """Pop this thread's launch notes (bridge span attribution).  Cheap
+    no-op when devprof is off — the wrapper never pushes then."""
+    return _LEDGER.drain_notes()
+
+
+def summary() -> dict:
+    return _LEDGER.summary()
+
+
+def health_block(top: int = 3) -> dict:
+    return _LEDGER.health_block(top=top)
+
+
+def dump(state_dir: Optional[str] = None) -> Optional[str]:
+    return _LEDGER.dump(state_dir)
+
+
+# -- environment probes (exception-gated; jax import stays lazy) -----
+
+def _backend_platform() -> Optional[str]:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # koordlint: disable=broad-except(reason: environment probe — no jax / no backend means no platform to report, never an error)
+        return None
+
+
+def _device_count() -> Optional[int]:
+    try:
+        import jax
+
+        return jax.device_count()
+    except Exception:  # koordlint: disable=broad-except(reason: environment probe — no jax / no backend means no device count to report, never an error)
+        return None
+
+
+def _trace_state_clean() -> bool:
+    """True when no jax trace is live on this thread.  Drift-tolerant:
+    when the probe is unavailable we claim clean and rely on the
+    exception gates (a tracer poisons perf_counter math, not
+    correctness — the wrapper still returns fn's result)."""
+    try:
+        import jax
+
+        return bool(jax.core.trace_state_clean())
+    except Exception:  # koordlint: disable=broad-except(reason: version-drift probe; claiming clean only risks a harmless timing sample, never correctness)
+        return True
+
+
+# -- signatures ------------------------------------------------------
+
+def _leaf_sig(leaf: Any) -> str:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(str(d) for d in shape)}]"
+    r = repr(leaf)
+    if len(r) > 40:
+        r = r[:37] + "..."
+    return r
+
+
+def shape_signature(args: tuple, kwargs: dict) -> str:
+    """The static shape signature keying the compile ledger: dtype[shape]
+    per array leaf (pytrees flattened), short reprs for statics — the
+    same partition jit's own cache keys on, rendered human-readable so a
+    retrace event names the shape that minted it."""
+    from jax.tree_util import tree_leaves
+
+    parts = [_leaf_sig(leaf) for leaf in tree_leaves((args, kwargs))]
+    sig = ";".join(parts)
+    if len(sig) > _SIG_MAX:
+        import hashlib
+
+        digest = hashlib.sha1(sig.encode()).hexdigest()[:8]
+        sig = sig[: _SIG_MAX - 12] + "...#" + digest
+    return sig
+
+
+# -- AOT capture -----------------------------------------------------
+
+def _cost_dict(compiled) -> Optional[dict]:
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # koordlint: disable=broad-except(reason: cost_analysis availability drifts across jax versions/backends; attribution degrades to None, the launch is unaffected)
+        return None
+    if isinstance(ca, (list, tuple)):  # per-device list on some versions
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out = {}
+    for key in ("flops", "bytes accessed"):
+        v = ca.get(key)
+        if isinstance(v, (int, float)):
+            out[key] = float(v)
+    return out
+
+
+def _mem_dict(compiled) -> Optional[dict]:
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # koordlint: disable=broad-except(reason: memory_analysis availability drifts across jax versions/backends; attribution degrades to None, the launch is unaffected)
+        return None
+    out = {}
+    for key, attr in (
+        ("temp", "temp_size_in_bytes"),
+        ("argument", "argument_size_in_bytes"),
+        ("output", "output_size_in_bytes"),
+    ):
+        v = getattr(ma, attr, None)
+        if isinstance(v, (int, float)):
+            out[key] = int(v)
+    return out or None
+
+
+def _aot_capture(led: LaunchLedger, name: str, sig: str, fn,
+                 args: tuple, kwargs: dict) -> Optional[float]:
+    """First-compile capture through the AOT path.  Returns compile
+    wall-time ms, or None when the boundary refuses AOT (abstract
+    tracing can reject what the concrete call accepts — e.g. a
+    non-hashable static); the launch itself is never at risk."""
+    try:
+        t0 = _now()
+        compiled = fn.lower(*args, **kwargs).compile()
+        compile_ms = (_now() - t0) * 1e3
+    except Exception:  # koordlint: disable=broad-except(reason: AOT lowering can reject what the concrete call accepts (non-hashable statics); the boundary then runs unattributed rather than failing the launch)
+        return None
+    led.record_compile(
+        name, sig, _backend_platform() or "unknown", compile_ms,
+        _cost_dict(compiled), _mem_dict(compiled),
+    )
+    return compile_ms
+
+
+# -- the decorator ---------------------------------------------------
+
+def boundary(name: str):
+    """Register a jit boundary with the launch ledger.
+
+    Stacks ABOVE the jit application (decorators apply bottom-up), so
+    the wrapper holds the jitted callable and its ``.lower`` AOT seam::
+
+        @devprof.boundary("solver.greedy.score_cycle")
+        @partial(jax.jit, static_argnames=("cfg",))
+        def score_cycle(snapshot, *, cfg): ...
+
+    Off (``sample == 0``): one integer compare then tail-call — the
+    warm stream is bit-identical with zero retraces (the tier-1
+    retrace-guard oracles run this path).  Inside a live jax trace the
+    wrapper also steps aside: nested boundaries (``score_cycle`` under
+    the Pallas cycle, term extras inside ``score_all``) measure at
+    their outermost host callsite only.
+    """
+
+    def deco(fn):
+        _LEDGER.register(name)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            led = _LEDGER
+            if led.sample <= 0:
+                return fn(*args, **kwargs)  # bit-inert fast path
+            if not _trace_state_clean():
+                return fn(*args, **kwargs)  # nested under another jit
+            led.note_launch(name)
+            compile_ms = None
+            try:
+                sig = shape_signature(args, kwargs)
+                cold = not led.seen_sig(name, sig)
+            except Exception:  # koordlint: disable=broad-except(reason: an unhashable/exotic pytree must cost attribution, never the launch — fall through to the plain call)
+                return fn(*args, **kwargs)
+            if cold:
+                # AOT capture; this signature's launch is NOT
+                # device-sampled — the jit-cache compile it pays next
+                # would contaminate the sample
+                compile_ms = _aot_capture(led, name, sig, fn, args, kwargs)
+                out = fn(*args, **kwargs)
+                led.push_note({
+                    "boundary": name, "sig": sig, "compiled": True,
+                    "compile_ms": compile_ms, "device_us": None,
+                    "flops": _entry_flops(led, name, sig),
+                })
+                return out
+            if led.should_sample():
+                import jax
+
+                t0 = _now()
+                out = fn(*args, **kwargs)
+                try:
+                    jax.block_until_ready(out)
+                except Exception:  # koordlint: disable=broad-except(reason: non-array outputs or backend drift make the barrier best-effort; the sample degrades to dispatch time, the result is returned untouched)
+                    pass
+                device_us = (_now() - t0) * 1e6
+                led.record_device_time(name, device_us)
+                led.push_note({
+                    "boundary": name, "sig": sig, "compiled": False,
+                    "compile_ms": None, "device_us": device_us,
+                    "flops": _entry_flops(led, name, sig),
+                })
+                return out
+            return fn(*args, **kwargs)
+
+        wrapper.__wrapped__ = fn
+        wrapper.devprof_boundary = name
+        return wrapper
+
+    return deco
+
+
+def _entry_flops(led: LaunchLedger, name: str, sig: str) -> Optional[float]:
+    with led._lock:
+        e = led._entries.get((name, sig))
+        return e.flops if e is not None else None
+
+
+# -- on-demand profiler capture (admin plane) ------------------------
+
+def capture_profile(state_dir: str, window_ms: int = 1000) -> str:
+    """Start a ``jax.profiler`` trace window under ``state_dir`` and
+    stop it after ``window_ms`` on a background thread — the admin-RPC
+    seam (udsserver METHOD_PROFILE) returns the capture directory
+    immediately; XLA-level inspection happens offline."""
+    import jax
+
+    out_dir = os.path.join(
+        state_dir, "devprof_trace", f"capture-{os.getpid()}-{time.time_ns()}"
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    jax.profiler.start_trace(out_dir)
+
+    def _stop():
+        time.sleep(max(0, int(window_ms)) / 1e3)
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # koordlint: disable=broad-except(reason: double-stop / backend teardown races are admin-plane noise, not daemon faults)
+            pass
+
+    threading.Thread(target=_stop, daemon=True, name="devprof-capture").start()
+    return out_dir
+
+
+# -- report CLI ------------------------------------------------------
+
+def _fmt_num(v, scale=1.0, suffix="") -> str:
+    if v is None:
+        return "-"
+    return f"{v / scale:,.1f}{suffix}"
+
+
+def format_report(doc: dict, top: int = 10) -> str:
+    """Render a dumped ledger: the compile ledger (one row per
+    boundary+signature with compile ms / flops / bytes) and the
+    top-N-by-cumulative-device-time table."""
+    lines = []
+    backend = doc.get("backend") or "unknown"
+    lines.append(
+        f"devprof ledger — backend={backend} sample={doc.get('sample')}"
+    )
+    lines.append("")
+    lines.append("compile ledger:")
+    header = (
+        f"  {'boundary':<44} {'compile_ms':>10} {'flops':>12} "
+        f"{'bytes':>12} {'temp_b':>10}  sig"
+    )
+    lines.append(header)
+    for e in doc.get("entries", []):
+        lines.append(
+            f"  {e['boundary']:<44} "
+            f"{_fmt_num(e.get('compile_ms')):>10} "
+            f"{_fmt_num(e.get('flops')):>12} "
+            f"{_fmt_num(e.get('bytes_accessed')):>12} "
+            f"{_fmt_num(e.get('temp_bytes')):>10}  {e.get('sig', '')}"
+        )
+    if not doc.get("entries"):
+        lines.append("  (no compiles captured)")
+    lines.append("")
+    lines.append(f"top boundaries by cumulative device time (top {top}):")
+    lines.append(
+        f"  {'boundary':<44} {'device_ms':>10} {'sampled':>8} "
+        f"{'launches':>9} {'compiles':>9}"
+    )
+    ranked = sorted(
+        doc.get("boundaries", {}).items(),
+        key=lambda kv: kv[1].get("device_us_total", 0.0),
+        reverse=True,
+    )
+    shown = 0
+    for name, st in ranked:
+        if shown >= top:
+            break
+        lines.append(
+            f"  {name:<44} "
+            f"{st.get('device_us_total', 0.0) / 1e3:>10,.2f} "
+            f"{st.get('sampled', 0):>8} {st.get('launches', 0):>9} "
+            f"{st.get('compiles', 0):>9}"
+        )
+        shown += 1
+    if not ranked:
+        lines.append("  (no launches recorded)")
+    retraces = doc.get("retraces", [])
+    if retraces:
+        lines.append("")
+        lines.append(f"attributed retraces ({len(retraces)}):")
+        for r in retraces:
+            lines.append(
+                f"  {r['boundary']}  +{_fmt_num(r.get('compile_ms'))} ms"
+                f"  sig={r.get('sig', '')}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m koordinator_tpu.obs.devprof",
+        description="Print the XLA launch ledger captured under a "
+        "daemon's --state-dir (compile costs + top boundaries by "
+        "cumulative device time).",
+    )
+    ap.add_argument("state_dir", help="daemon --state-dir (or any "
+                    f"directory holding {LEDGER_FILENAME})")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows in the device-time table (default 10)")
+    args = ap.parse_args(argv)
+    path = args.state_dir
+    if os.path.isdir(path):
+        path = os.path.join(path, LEDGER_FILENAME)
+    if not os.path.exists(path):
+        print(f"devprof: no ledger at {path} (run a daemon with "
+              "--devprof-sample > 0, or call devprof.dump())",
+              file=sys.stderr)
+        return 2
+    with open(path) as fh:
+        doc = json.load(fh)
+    print(format_report(doc, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via main() in tests
+    raise SystemExit(main())

@@ -65,6 +65,10 @@ koord_scorer_repl_send_batch_frames    histogram —
 koord_scorer_repl_compress_total       counter   op (encode|decode)
 koord_scorer_autoscale_events_total    counter   action (scale_up|scale_down)
 koord_scorer_autoscale_replicas        gauge     — (autoscaler's target size)
+koord_scorer_devprof_compiles_total    counter   boundary, backend
+koord_scorer_devprof_compile_ms_total  counter   boundary, backend
+koord_scorer_devprof_device_us         histogram boundary
+koord_scorer_devprof_retrace_total     counter   boundary
 ====================================== ========= ==========================
 
 The ``koord_scorer_coalesce_*`` families observe the coalescing
@@ -169,6 +173,10 @@ SEND_BATCH_FRAMES = "koord_scorer_repl_send_batch_frames"
 REPL_COMPRESS = "koord_scorer_repl_compress_total"
 AUTOSCALE_EVENTS = "koord_scorer_autoscale_events_total"
 AUTOSCALE_REPLICAS = "koord_scorer_autoscale_replicas"
+DEVPROF_COMPILES = "koord_scorer_devprof_compiles_total"
+DEVPROF_COMPILE_MS = "koord_scorer_devprof_compile_ms_total"
+DEVPROF_DEVICE_US = "koord_scorer_devprof_device_us"
+DEVPROF_RETRACE = "koord_scorer_devprof_retrace_total"
 
 # occupancy is a count-of-requests-per-launch, not a latency: its own
 # power-of-two buckets (the dispatcher caps batches at 16 by default;
@@ -383,6 +391,24 @@ _FAMILIES = (
      "the autoscaler's current target follower count (what it is "
      "holding the tier at, between --autoscale-min and "
      "--autoscale-max)"),
+    (DEVPROF_COMPILES, "counter",
+     "XLA programs the launch ledger (obs/devprof.py) captured through "
+     "the AOT path, by jit boundary and backend platform — each is one "
+     "(boundary, shape signature) compile-ledger row"),
+    (DEVPROF_COMPILE_MS, "counter",
+     "cumulative XLA compile wall-time the ledger attributed, by "
+     "boundary and backend; divide by devprof_compiles_total for the "
+     "mean compile cost of that boundary's programs"),
+    (DEVPROF_DEVICE_US, "histogram",
+     "sampled per-launch device execution time (dispatch to "
+     "block_until_ready on the launch's own outputs), by boundary; "
+     "sampling is 1-in-N (--devprof-sample), so multiply counts by N "
+     "to estimate launch totals"),
+    (DEVPROF_RETRACE, "counter",
+     "attributed retraces: a registered boundary minted a NEW program "
+     "for a shape signature after its first — the per-boundary "
+     "breakdown of koord_scorer_jit_cache_miss_total the ledger names "
+     "in /healthz and the report CLI"),
 )
 
 # journal appends are MICROsecond-scale (a header pack + one buffered
@@ -393,6 +419,14 @@ _JOURNAL_APPEND_BUCKETS = (
     float("inf"),
 )
 
+# sampled device launches span ~100 us (a warm delta scatter) to
+# multiple seconds (a cold-start dense cycle on CPU): wider-than-journal
+# microsecond buckets
+_DEVPROF_US_BUCKETS = (
+    100.0, 1_000.0, 5_000.0, 20_000.0, 100_000.0, 500_000.0,
+    2_000_000.0, 10_000_000.0, float("inf"),
+)
+
 # per-family bucket overrides (histograms default to DEFAULT_BUCKETS_MS)
 _BUCKET_OVERRIDES = {
     COALESCE_OCCUPANCY: _OCCUPANCY_BUCKETS,
@@ -400,6 +434,7 @@ _BUCKET_OVERRIDES = {
     JOURNAL_APPEND_US: _JOURNAL_APPEND_BUCKETS,
     # frames-per-wakeup is a count, like coalesce occupancy
     SEND_BATCH_FRAMES: _OCCUPANCY_BUCKETS,
+    DEVPROF_DEVICE_US: _DEVPROF_US_BUCKETS,
 }
 
 
@@ -651,6 +686,26 @@ class ScorerMetrics:
         self.registry.counter_add(
             TRACE_EXPORT_DROPPED, 1, {"reason": reason}
         )
+
+    # -- device-time truth (ISSUE 19): fed by obs/devprof.py through
+    # its weakref metrics sink; all values arrive as host scalars the
+    # ledger already materialized --
+    def devprof_compile(
+        self, boundary: str, backend: str, compile_ms: float
+    ) -> None:
+        labels = {"boundary": boundary, "backend": backend or "unknown"}
+        self.registry.counter_add(DEVPROF_COMPILES, 1, labels)
+        self.registry.counter_add(
+            DEVPROF_COMPILE_MS, float(compile_ms), labels
+        )
+
+    def devprof_device_us(self, boundary: str, us: float) -> None:
+        self.registry.histogram_observe(
+            DEVPROF_DEVICE_US, float(us), {"boundary": boundary}
+        )
+
+    def devprof_retrace(self, boundary: str) -> None:
+        self.registry.counter_add(DEVPROF_RETRACE, 1, {"boundary": boundary})
 
     # -- trace-driven replay (ISSUE 12) --
     def observe_trace_cycle(self, band: str, rpc: str, ms: float) -> None:

@@ -44,6 +44,7 @@ from koordinator_tpu.config import (
 )
 from koordinator_tpu.constraints.gang import gang_satisfaction
 from koordinator_tpu.model.snapshot import ClusterSnapshot
+from koordinator_tpu.obs import devprof
 from koordinator_tpu.ops.fit import nonzero_requests
 from koordinator_tpu.ops.loadaware import (
     loadaware_node_masks,
@@ -163,6 +164,7 @@ def _cycle_operands(
     return operands, in_specs, prod_sensitive
 
 
+@devprof.boundary("parallel.shard_assign._assign_sharded")
 @partial(jax.jit, static_argnames=("cfg", "mesh", "has_mask", "has_scores"))
 def _assign_sharded(
     snapshot: ClusterSnapshot,
@@ -285,6 +287,7 @@ def _assign_sharded(
     )
 
 
+@devprof.boundary("parallel.shard_assign._assign_waves")
 @partial(
     jax.jit,
     static_argnames=("cfg", "mesh", "has_mask", "has_scores", "wave", "top_m"),

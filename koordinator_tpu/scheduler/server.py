@@ -105,6 +105,7 @@ class SchedulerServer:
         brownout_max_lag: Optional[int] = None,
         trace_export: Optional[str] = None,
         shed_fractions: Optional[dict] = None,
+        devprof_sample: Optional[int] = None,
     ):
         # persistent compile cache under the daemon's state dir: a
         # restarted sidecar skips the multi-second (16.5s on TPU,
@@ -236,6 +237,13 @@ class SchedulerServer:
             servicer_kw["trace_export"] = trace_export
         if shed_fractions is not None:
             servicer_kw["shed_fractions"] = shed_fractions
+        # device-time truth (ISSUE 19): --devprof-sample wires the XLA
+        # launch ledger — compile/cost attribution at every registered
+        # jit boundary plus 1-in-N device-time sampling.  Default off:
+        # the serving path stays bit-inert (reply-byte parity, zero jit
+        # cache misses) unless the operator opts in.
+        if devprof_sample is not None:
+            servicer_kw["devprof_sample"] = int(devprof_sample)
         # replication role (ISSUE 8, koordinator_tpu/replication/):
         # --replicate-from makes this daemon a READ FOLLOWER — it
         # subscribes to the named leader's replication socket, applies
@@ -389,6 +397,10 @@ class SchedulerServer:
                             # per-series quantiles from the gate's
                             # own estimator
                             "slo": outer.slo_health(),
+                            # device-time truth (ISSUE 19): backend
+                            # platform, compile ledger summary, top
+                            # boundaries by cumulative device time
+                            "device": outer.device_health(),
                         },
                     )
                     return
@@ -547,6 +559,17 @@ class SchedulerServer:
         }
         return out
 
+    def device_health(self) -> dict:
+        """The /healthz ``device`` block (ISSUE 19): backend platform
+        and device count, the launch ledger's compile summary (compiles,
+        cumulative compile wall-time, attributed retraces), and the top
+        boundaries by cumulative sampled device time.  With the ledger
+        off (``--devprof-sample`` unset/0) the block still reports the
+        platform so operators can tell CPU-leg from TPU-leg daemons."""
+        from koordinator_tpu.obs import devprof
+
+        return devprof.health_block()
+
     # -- crash tolerance (ISSUE 11) --
     def _journal_path(self) -> str:
         return os.path.join(self.state_dir, "journal.krj")
@@ -664,14 +687,35 @@ class SchedulerServer:
         # reconnecting client lands must already see the resumed chain
         if self._journal_enabled and not self.replicate_from:
             self._boot_journal()
-        from koordinator_tpu.bridge.udsserver import METHOD_PROMOTE
+        from koordinator_tpu.bridge.udsserver import (
+            METHOD_PROFILE,
+            METHOD_PROMOTE,
+        )
 
         def _promote_admin(payload: bytes) -> bytes:
             return self.promote().encode()
 
+        def _profile_admin(payload: bytes) -> bytes:
+            # on-demand device profile capture (ISSUE 19): payload is an
+            # optional ASCII window in milliseconds; the reply is the
+            # capture directory under --state-dir.  jax.profiler stops
+            # on a background thread so the admin RPC returns
+            # immediately — the operator polls the directory.
+            from koordinator_tpu.obs import devprof
+
+            window_ms = 1000
+            if payload.strip():
+                window_ms = int(payload.strip().decode("ascii"))
+            return devprof.capture_profile(
+                self.state_dir, window_ms=window_ms
+            ).encode()
+
         self._raw_server = RawUdsServer(
             self.uds_path + ".raw", servicer=self.servicer,
-            admin_handlers={METHOD_PROMOTE: _promote_admin},
+            admin_handlers={
+                METHOD_PROMOTE: _promote_admin,
+                METHOD_PROFILE: _profile_admin,
+            },
         ).start()
         if self.enable_grpc:
             self._grpc_server = make_server(servicer=self.servicer)
@@ -1090,6 +1134,22 @@ def build_arg_parser() -> argparse.ArgumentParser:
             f"KOORD_SHED_FRACTION_{suffix})",
         )
     ap.add_argument(
+        "--devprof-sample", type=int,
+        default=(
+            int(os.environ["KOORD_DEVPROF_SAMPLE"])
+            if os.environ.get("KOORD_DEVPROF_SAMPLE") else None
+        ),
+        help="device-time truth (docs/OBSERVABILITY.md \"Device-time "
+        "truth\"): sample 1-in-N serving launches for device wall-time "
+        "through the XLA launch ledger, and capture compile time + XLA "
+        "cost/memory analysis at every jit boundary's first compile; "
+        "16 is the recommended rate; 0/unset = off — the serving path "
+        "stays bit-inert (reply-byte parity, zero retraces).  Ledger "
+        "persists to <state-dir>/devprof.json; read it with `python -m "
+        "koordinator_tpu.obs.devprof <state-dir>` (env: "
+        "KOORD_DEVPROF_SAMPLE)",
+    )
+    ap.add_argument(
         "--state-dir", default=None,
         help="daemon state directory (default: $XDG_STATE_HOME/"
         "koord-scheduler, per-user); the persistent XLA compile cache "
@@ -1139,6 +1199,7 @@ def main(argv=None) -> int:
         brownout_max_lag=args.brownout_max_lag,
         trace_export=args.trace_export,
         shed_fractions=shed_fractions,
+        devprof_sample=args.devprof_sample,
     ).start()
     try:
         threading.Event().wait()  # koordlint: disable=unbounded-wait(main thread parks forever by design; the server threads own the work and KeyboardInterrupt unparks)

@@ -66,6 +66,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from koordinator_tpu.obs import devprof
 from koordinator_tpu.solver.greedy import feasibility_mask, score_all
 from koordinator_tpu.solver.incremental import (
     _pad_rows,
@@ -157,11 +158,13 @@ def _build_carry(snapshot, cfg):
     return cand, count
 
 
+@devprof.boundary("solver.candidates._build")
 @partial(jax.jit, static_argnames=("cfg",))
 def _build(snapshot, *, cfg):
     return _build_carry(snapshot, cfg)
 
 
+@devprof.boundary("solver.candidates._build_sharded")
 @partial(jax.jit, static_argnames=("cfg", "mesh"))
 def _build_sharded(snapshot, *, cfg, mesh):
     from koordinator_tpu.parallel.mesh import (
@@ -225,11 +228,13 @@ def _refresh_carry(snapshot, cand, count, node_idx, pod_idx, cfg):
     return cand, count
 
 
+@devprof.boundary("solver.candidates._refresh")
 @partial(jax.jit, static_argnames=("cfg",))
 def _refresh(snapshot, cand, count, node_idx, pod_idx, *, cfg):
     return _refresh_carry(snapshot, cand, count, node_idx, pod_idx, cfg)
 
 
+@devprof.boundary("solver.candidates._refresh_sharded")
 @partial(jax.jit, static_argnames=("cfg", "mesh"))
 def _refresh_sharded(snapshot, cand, count, node_idx, pod_idx, *, cfg, mesh):
     from koordinator_tpu.parallel.mesh import (
@@ -286,11 +291,13 @@ def _score_carry(snapshot, cand, cfg):
     return scores, feas & (cand < n)
 
 
+@devprof.boundary("solver.candidates._score")
 @partial(jax.jit, static_argnames=("cfg",))
 def _score(snapshot, cand, *, cfg):
     return _score_carry(snapshot, cand, cfg)
 
 
+@devprof.boundary("solver.candidates._score_sharded")
 @partial(jax.jit, static_argnames=("cfg", "mesh"))
 def _score_sharded(snapshot, cand, *, cfg, mesh):
     from koordinator_tpu.parallel.mesh import (
@@ -372,6 +379,7 @@ def score_candidates(snapshot, cand, cfg, mesh=None):
     return _score(snapshot, cand, cfg=cfg)
 
 
+@devprof.boundary("solver.candidates.sparse_top_k")
 @partial(jax.jit, static_argnames=("k", "hi"))
 def sparse_top_k(scores, feasible, cand, *, k, hi):
     """Serving top-k over the [P, C] cells, mapped back to real node

@@ -32,6 +32,7 @@ from jax import lax
 from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG, MOST_ALLOCATED
 from koordinator_tpu.constraints.gang import gang_satisfaction
 from koordinator_tpu.model.snapshot import ClusterSnapshot
+from koordinator_tpu.obs import devprof
 from koordinator_tpu.ops.fit import fit_mask, nonzero_requests
 from koordinator_tpu.ops.loadaware import (
     loadaware_node_masks,
@@ -259,6 +260,7 @@ def score_all(snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG
     return apply_term_scores(snapshot, cfg, scores), feasible
 
 
+@devprof.boundary("solver.greedy.score_cycle")
 @partial(jax.jit, static_argnames=("cfg",))
 def score_cycle(snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONFIG):
     """Stateless batch scoring: scores + feasibility for every (pod, node).
@@ -270,6 +272,7 @@ def score_cycle(snapshot: ClusterSnapshot, cfg: CycleConfig = DEFAULT_CYCLE_CONF
     return score_all(snapshot, cfg)
 
 
+@devprof.boundary("solver.greedy.greedy_assign")
 @partial(jax.jit, static_argnames=("cfg",))
 def greedy_assign(
     snapshot: ClusterSnapshot,

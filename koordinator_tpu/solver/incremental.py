@@ -64,6 +64,7 @@ import jax
 import jax.numpy as jnp
 
 from koordinator_tpu.model.snapshot import pad_bucket
+from koordinator_tpu.obs import devprof
 from koordinator_tpu.solver.greedy import score_all
 
 
@@ -129,6 +130,7 @@ def _rescore_body(snapshot, scores, feasible, node_idx, pod_idx, cfg):
     return scores, feasible
 
 
+@devprof.boundary("solver.incremental._rescore")
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
 def _rescore(snapshot, scores, feasible, node_idx, pod_idx, *, cfg):
     """Single-chip incremental rescore; ``scores`` is donated (the
@@ -137,6 +139,7 @@ def _rescore(snapshot, scores, feasible, node_idx, pod_idx, *, cfg):
     return _rescore_body(snapshot, scores, feasible, node_idx, pod_idx, cfg)
 
 
+@devprof.boundary("solver.incremental._rescore_sharded")
 @partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnums=(1,))
 def _rescore_sharded(snapshot, scores, feasible, node_idx, pod_idx, *, cfg, mesh):
     """Shard-LOCAL incremental rescore over the cluster mesh: the score

@@ -38,6 +38,7 @@ from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG, MOST_ALLOC
 from koordinator_tpu.constraints.gang import gang_satisfaction
 from koordinator_tpu.model import resources as res
 from koordinator_tpu.model.snapshot import MAX_NODE_SCORE, ClusterSnapshot
+from koordinator_tpu.obs import devprof
 from koordinator_tpu.ops.fit import nonzero_requests
 from koordinator_tpu.ops.loadaware import (
     loadaware_node_masks,
@@ -718,6 +719,7 @@ def _wave_cycle_kernel(
     rounds_ref[:] = rounds_ref[:] + rounds
 
 
+@devprof.boundary("solver.pallas_cycle._run_cycle")
 @partial(jax.jit, static_argnames=("cfg", "block", "interpret", "wave", "top_m"))
 def _run_cycle(
     preq, psreq, pest, qid, pvalid, pprod, alloc, usage, qrt,
@@ -841,6 +843,7 @@ def greedy_assign_pallas(
     )
 
 
+@devprof.boundary("solver.pallas_cycle._greedy_assign_pallas")
 @partial(jax.jit, static_argnames=("cfg", "interpret"))
 def _greedy_assign_pallas(
     snapshot: ClusterSnapshot,

@@ -37,6 +37,7 @@ from koordinator_tpu.config import CycleConfig, DEFAULT_CYCLE_CONFIG, MOST_ALLOC
 from koordinator_tpu.constraints.gang import gang_satisfaction
 from koordinator_tpu.model import resources as res
 from koordinator_tpu.model.snapshot import MAX_NODE_SCORE, ClusterSnapshot
+from koordinator_tpu.obs import devprof
 from koordinator_tpu.model.snapshot import PriorityClass
 from koordinator_tpu.ops.fit import nonzero_requests
 from koordinator_tpu.ops.loadaware import (
@@ -268,6 +269,7 @@ def _cycle_kernel_dense(
     lax.fori_loop(jnp.int32(0), jnp.int32(block), step, jnp.int32(0))
 
 
+@devprof.boundary("solver.pallas_dense._run_cycle_dense")
 @partial(jax.jit, static_argnames=("cfg", "block", "interpret"))
 def _run_cycle_dense(
     preq, psreq, pest, qid, pvalid, pprod, alloc, req0, usage, qrt,
@@ -372,6 +374,7 @@ def greedy_assign_dense(
     return _greedy_assign_dense(snapshot, cfg, interpret, extra_mask, extra_scores)
 
 
+@devprof.boundary("solver.pallas_dense._greedy_assign_dense")
 @partial(jax.jit, static_argnames=("cfg", "interpret"))
 def _greedy_assign_dense(
     snapshot: ClusterSnapshot,

@@ -29,8 +29,10 @@ import jax
 import jax.numpy as jnp
 
 from koordinator_tpu.model.snapshot import pad_bucket
+from koordinator_tpu.obs import devprof
 
 
+@devprof.boundary("solver.resident._scatter_flat")
 @partial(jax.jit, donate_argnums=(0,))
 def _scatter_flat(arr, idx, val):
     """arr.flat[idx] = val (OOB indices dropped), preserving arr's dtype.
@@ -44,6 +46,7 @@ def _scatter_flat(arr, idx, val):
     return flat.reshape(arr.shape)
 
 
+@devprof.boundary("solver.resident._scatter_flat_sharded")
 @partial(jax.jit, static_argnames=("mesh",), donate_argnums=(0,))
 def _scatter_flat_sharded(arr, idx, val, *, mesh):
     """Shard-LOCAL scatter into a mesh-resident node tensor (ISSUE 7).

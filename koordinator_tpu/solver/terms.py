@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 
 from koordinator_tpu.model.snapshot import MAX_NODE_SCORE
+from koordinator_tpu.obs import devprof
 from koordinator_tpu.ops.scoring import (
     most_requested_score,
     weighted_resource_score,
@@ -260,6 +261,7 @@ def apply_terms(snapshot, cfg, scores, feasible):
     )
 
 
+@devprof.boundary("solver.terms._term_extras_jit")
 @partial(jax.jit, static_argnames=("cfg",))
 def _term_extras_jit(snapshot, cfg):
     P = snapshot.pods.requests.shape[0]

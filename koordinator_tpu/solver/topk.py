@@ -58,6 +58,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from koordinator_tpu.model.snapshot import MAX_NODE_SCORE
+from koordinator_tpu.obs import devprof
 
 # f32 represents every integer up to 2^24 exactly; ranks at or past it
 # would collapse distinct scores onto one float (wrong order, silently)
@@ -83,6 +84,7 @@ def score_upper_bound(cfg) -> int:
     return hi + terms_upper_bound(cfg)
 
 
+@devprof.boundary("solver.topk.masked_top_k")
 @partial(jax.jit, static_argnames=("k", "hi"))
 def masked_top_k(scores, feasible, *, k, hi):
     """(top_scores i64[..., k], top_idx i32[..., k]) of the masked

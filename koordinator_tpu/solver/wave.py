@@ -65,6 +65,7 @@ from koordinator_tpu.config import (
 )
 from koordinator_tpu.constraints.gang import gang_satisfaction
 from koordinator_tpu.model.snapshot import ClusterSnapshot, PriorityClass
+from koordinator_tpu.obs import devprof
 from koordinator_tpu.ops.fit import nonzero_requests
 from koordinator_tpu.ops.loadaware import (
     loadaware_node_masks,
@@ -371,6 +372,7 @@ def resolve_wave(
     return choices, committed, done, quse_new, ncommit
 
 
+@devprof.boundary("solver.wave._wave_assign")
 @partial(
     jax.jit,
     static_argnames=("cfg", "wave", "top_m", "has_mask", "has_scores"),

@@ -759,3 +759,116 @@ class TestSparseArtifactFields:
         assert doc["truncated"] is True
         assert doc["metric"] == "sparse"
         assert "config_sparse_cpu" in doc["error"]
+
+
+class TestArtifactSchemaDevprofFields:
+    """ISSUE 19: the device-time-truth fields — the launch ledger's
+    compile-vs-device split, the attributed backend, the sampling
+    overhead delta and the parent's TPU-probe verdict.  The archive
+    rule is unchanged: malformed values must not be archived, nulls
+    (probe failed / ledger off) always pass."""
+
+    def _line(self, **extra):
+        doc = {"metric": "m", "value": 1.0, "unit": "ms"}
+        doc.update(extra)
+        return json.dumps(doc)
+
+    def test_well_formed_devprof_fields_pass(self):
+        assert bench._validate_artifact(self._line(
+            devprof_backend="cpu", devprof_compiles=7,
+            devprof_compile_ms_total=412.6, devprof_device_score_us=83.2,
+            devprof_flops_per_launch=1.2e9, devprof_overhead_p99_pct=0.7,
+            tpu_probe="unreachable",
+        )) == []
+
+    def test_all_null_devprof_fields_pass(self):
+        assert bench._validate_artifact(self._line(
+            devprof_backend=None, devprof_compiles=None,
+            devprof_compile_ms_total=None, devprof_device_score_us=None,
+            devprof_flops_per_launch=None, devprof_overhead_p99_pct=None,
+            tpu_probe=None,
+        )) == []
+
+    def test_malformed_compile_and_device_fields_fail(self):
+        assert bench._validate_artifact(
+            self._line(devprof_compile_ms_total=-1)
+        )
+        assert bench._validate_artifact(
+            self._line(devprof_compile_ms_total=float("nan"))
+        )
+        assert bench._validate_artifact(
+            self._line(devprof_device_score_us=float("inf"))
+        )
+        assert bench._validate_artifact(
+            self._line(devprof_device_score_us="83")
+        )
+        assert bench._validate_artifact(
+            self._line(devprof_flops_per_launch=-2.0)
+        )
+
+    def test_backend_and_compiles_shape(self):
+        assert bench._validate_artifact(self._line(devprof_backend=""))
+        assert bench._validate_artifact(self._line(devprof_backend=3))
+        assert bench._validate_artifact(self._line(devprof_compiles=-1))
+        assert bench._validate_artifact(self._line(devprof_compiles=True))
+        assert bench._validate_artifact(self._line(devprof_compiles=2.5))
+
+    def test_overhead_delta_rule_matches_trace_overhead(self):
+        # negative is legitimate run noise; below -100 is fabricated
+        assert bench._validate_artifact(
+            self._line(devprof_overhead_p99_pct=-3.0)
+        ) == []
+        assert bench._validate_artifact(
+            self._line(devprof_overhead_p99_pct=-101.0)
+        )
+        assert bench._validate_artifact(
+            self._line(devprof_overhead_p99_pct=float("nan"))
+        )
+
+    def test_tpu_probe_field_shape(self):
+        assert bench._validate_artifact(self._line(tpu_probe="live")) == []
+        assert bench._validate_artifact(
+            self._line(tpu_probe="live-then-lost")
+        ) == []
+        assert bench._validate_artifact(self._line(tpu_probe=""))
+        assert bench._validate_artifact(self._line(tpu_probe=7))
+
+    def test_stamp_tpu_probe_rides_the_artifact(self):
+        # the r04/r05 fix: the parent's probe verdict is stamped onto
+        # whatever the child printed, and an unparseable line passes
+        # through untouched for the validator to reject downstream
+        line = bench._stamp_tpu_probe(self._line(), "unreachable")
+        doc = json.loads(line)
+        assert doc["tpu_probe"] == "unreachable"
+        assert bench._validate_artifact(line) == []
+        assert bench._stamp_tpu_probe("not json{", "live") == "not json{"
+        assert bench._stamp_tpu_probe(None, "live") is None
+
+    def test_deadline_flush_still_valid_with_devprof_schema(self):
+        """The truncated-flush line must stay schema-valid now that the
+        validator knows the devprof fields (the deadline artifact
+        carries none of them — all-absent must read as all-null)."""
+        emitted, fired = [], []
+        now = [0.0]
+
+        def sleep(s):
+            now[0] += s
+
+        d = bench._ArtifactDeadline(
+            100.0,
+            emit=lambda line: emitted.append(line) or True,
+            clock=lambda: now[0],
+            sleep=sleep,
+            on_fire=lambda rc: fired.append(rc),
+        )
+        old_stage = bench._PROGRESS["stage"]
+        try:
+            bench._PROGRESS["stage"] = "devprof_storm"
+            d.watch()
+        finally:
+            bench._PROGRESS["stage"] = old_stage
+        assert fired == [1] and len(emitted) == 1
+        assert bench._validate_artifact(emitted[0]) == []
+        doc = json.loads(emitted[0])
+        assert doc["truncated"] is True
+        assert "devprof_storm" in doc["error"]

@@ -2363,3 +2363,135 @@ class TestSuppressionAudit:
         )
         assert "NO REASON" in report
         assert "AUDIT FAILED: 1 problem(s)" in report
+
+
+class TestUnregisteredJitBoundary:
+    """Seeded regressions for the device-time-truth rule (ISSUE 19):
+    serving-path jit boundaries must register with the launch ledger."""
+
+    R = ["unregistered-jit-boundary"]
+
+    def _lint(self, src, path="koordinator_tpu/solver/fixture.py"):
+        return run_rules_on_source(path, textwrap.dedent(src), self.R)
+
+    def test_unregistered_jitted_def_fires(self):
+        vs = self._lint("""
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def _score(snapshot, cfg):
+            return snapshot
+        """)
+        assert len(vs) == 1
+        assert "no @devprof.boundary" in vs[0].message
+
+    def test_registered_jitted_def_is_clean(self):
+        vs = self._lint("""
+        from functools import partial
+        import jax
+        from koordinator_tpu.obs import devprof
+
+        @devprof.boundary("solver.fixture._score")
+        @partial(jax.jit, static_argnames=("cfg",))
+        def _score(snapshot, cfg):
+            return snapshot
+        """)
+        assert vs == []
+
+    def test_boundary_below_jit_fires_order_violation(self):
+        # decorators apply bottom-up: boundary below jit wraps the raw
+        # function and the AOT compile capture has nothing to .lower()
+        vs = self._lint("""
+        from functools import partial
+        import jax
+        from koordinator_tpu.obs import devprof
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        @devprof.boundary("solver.fixture._score")
+        def _score(snapshot, cfg):
+            return snapshot
+        """)
+        assert len(vs) == 1
+        assert "BELOW" in vs[0].message
+
+    def test_non_literal_boundary_name_fires(self):
+        vs = self._lint("""
+        import jax
+        from koordinator_tpu.obs import devprof
+
+        NAME = "solver.fixture._score"
+
+        @devprof.boundary(NAME)
+        @jax.jit
+        def _score(x):
+            return x
+        """)
+        assert len(vs) == 1
+        assert "string literal" in vs[0].message
+
+    def test_jit_call_form_assignment_fires(self):
+        vs = self._lint("""
+        import jax
+
+        def _scatter(arr, idx):
+            return arr
+
+        scatter = jax.jit(_scatter, donate_argnums=(0,))
+        """)
+        assert len(vs) == 1
+        assert "call-form" in vs[0].message
+
+    def test_shard_map_outside_jit_fires(self):
+        vs = self._lint("""
+        from koordinator_tpu.parallel.mesh import shard_map_compat
+
+        def helper(mesh, x):
+            return shard_map_compat(
+                lambda a: a, mesh=mesh, in_specs=None, out_specs=None
+            )(x)
+        """)
+        assert len(vs) == 1
+        assert "shard_map launch outside" in vs[0].message
+
+    def test_shard_map_inside_registered_jit_is_clean(self):
+        vs = self._lint("""
+        from functools import partial
+        import jax
+        from koordinator_tpu.obs import devprof
+        from koordinator_tpu.parallel.mesh import shard_map_compat
+
+        @devprof.boundary("solver.fixture._sharded")
+        @partial(jax.jit, static_argnames=("mesh",))
+        def _sharded(x, *, mesh):
+            return shard_map_compat(
+                lambda a: a, mesh=mesh, in_specs=None, out_specs=None
+            )(x)
+        """)
+        assert vs == []
+
+    def test_out_of_scope_module_is_exempt(self):
+        # harness/test modules never sit on the Score/Assign path
+        vs = self._lint("""
+        import jax
+
+        @jax.jit
+        def scenario_kernel(x):
+            return x
+        """, path="koordinator_tpu/harness/fixture.py")
+        assert vs == []
+
+    def test_suppression_with_reason_is_honored(self):
+        vs = self._lint("""
+        import jax
+
+        @jax.jit  # koordlint: disable=unregistered-jit-boundary(reason: cold-path migration helper, never on the serving path)
+        def _migrate(x):
+            return x
+        """)
+        assert vs == []
+
+    def test_reason_required_for_suppression(self):
+        from koordinator_tpu.analysis import suppressions
+
+        assert "unregistered-jit-boundary" in suppressions.REASON_REQUIRED
