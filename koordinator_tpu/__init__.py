@@ -42,17 +42,20 @@ jax.config.update("jax_enable_x64", True)
 # configure_compilation_cache (scheduler/server.py).
 
 
-def configure_compilation_cache(path, min_compile_seconds: float = 1.0) -> None:
+def configure_compilation_cache(path, min_compile_seconds: float = 1.0,
+                                force: bool = False) -> None:
     """Point JAX's persistent compilation cache at ``path``.
 
     Must run before the first compile — the cache is initialized lazily on
     first use and later re-pointing does not move already-initialized
     state.  ``path=None`` or ``""`` disables the cache.  The
     ``KOORD_XLA_CACHE`` env var takes precedence over programmatic calls
-    (an operator override must win over a daemon default).
+    (an operator override must win over a daemon default) — except under
+    ``force=True``, the seam for an EXPLICIT ``--xla-cache`` flag, which
+    outranks the env default exactly because the operator typed it.
     """
     env = os.environ.get("KOORD_XLA_CACHE", "")
-    if env:
+    if env and not force:
         return  # import-time wiring below already honored the override
     if not path:
         jax.config.update("jax_compilation_cache_dir", None)

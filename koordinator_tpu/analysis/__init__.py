@@ -77,6 +77,13 @@ Rules (each suppressible per line with ``# koordlint: disable=<rule>``):
   assignments and ``shard_map`` launches outside any jitted def are
   flagged — an unregistered boundary's compiles and device time
   silently escape the ledger, /metrics and /healthz.
+* ``prewarm-drift``     — statically diffs every ``@devprof.boundary``
+  registration in the repo against the AOT prewarm tables in
+  obs/prewarm.py (``PREWARM_BOUNDARIES`` + ``PREWARM_EXCLUDED``), both
+  directions: a registered boundary in neither table rots the replay
+  set (its compiles land cold every boot), a table entry nothing
+  registers is a stale row, and a name in both tables is a
+  contradiction — the tables partition the boundary space.
 * ``unguarded-shared-state`` — guarded-state inference
   (analysis/guards.py): an attribute a class writes under its lock is
   presumed lock-protected, so a lock-free write elsewhere (or a
@@ -116,6 +123,7 @@ RULES = (
     "unbounded-wait",
     "wire-contract",
     "metrics-doc-drift",
+    "prewarm-drift",
     "lock-order-cycle",
     "lockorder-doc-drift",
     "unguarded-shared-state",

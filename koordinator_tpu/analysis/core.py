@@ -204,7 +204,12 @@ def run_repo(
     cross-language wire-contract diff, the metrics-vs-doc table diff
     and the whole-program lock-order graph (cycles + LOCKORDER.md
     drift).  Returns sorted violations."""
-    from koordinator_tpu.analysis import lockgraph, metricsdoc, wire_contract
+    from koordinator_tpu.analysis import (
+        lockgraph,
+        metricsdoc,
+        prewarmdrift,
+        wire_contract,
+    )
 
     root = root or find_repo_root(os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__)))))
@@ -225,6 +230,9 @@ def run_repo(
     if rules is None or "metrics-doc-drift" in rules:
         out.extend(_filter_file_comments(
             root, metricsdoc.check_repo(root), honor_suppressions))
+    if rules is None or "prewarm-drift" in rules:
+        out.extend(_filter_file_comments(
+            root, prewarmdrift.check_repo(root), honor_suppressions))
     if rules is None or {lockgraph.CYCLE_RULE, lockgraph.DRIFT_RULE} & set(rules):
         found = [
             v for v in lockgraph.check_repo(root)

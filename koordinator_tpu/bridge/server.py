@@ -1386,12 +1386,19 @@ class ScorerServicer:
             refresh_reason = None
             merges = 0
             if cres is None:
-                cand, count = build_candidates(snap, self.cfg)
+                # cold: the pipelined build (ISSUE 20) engages past the
+                # block threshold — the node mesh, when configured,
+                # shards its counts pass over the block axis
+                cand, count = build_candidates(
+                    snap, self.cfg, node_mesh=self.mesh
+                )
                 refresh_reason = "cold"
             elif cres.dirty_nodes or cres.dirty_pods:
                 if cres.merges >= self.cfg.candidate_max_stale:
                     # merge-chain bound hit: one full rebuild resets it
-                    cand, count = build_candidates(snap, self.cfg)
+                    cand, count = build_candidates(
+                        snap, self.cfg, node_mesh=self.mesh
+                    )
                     refresh_reason = "stale"
                 else:
                     cand, count = refresh_candidates(

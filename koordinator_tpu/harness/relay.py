@@ -336,6 +336,14 @@ def autoscale_wave(
         "plateau_ticks_judged": plateau_judged,
         "plateau_ticks_within_slo": plateau_ok,
         "slo_held": plateau_judged > 0 and plateau_ok == plateau_judged,
+        # spawn -> first-served-read economics (ISSUE 20): how long the
+        # tier's capacity lever takes to turn a SCALE_UP decision into a
+        # serving replica (RelayTier.spawn_leaf returns only once the
+        # leaf's server started, so the lever-call duration IS it)
+        "spawn_to_ready_ms": scaler.stats()["spawn_to_ready_ms"],
+        "spawn_to_ready_ms_all": [
+            round(v, 3) for v in scaler.spawn_to_ready_ms
+        ],
         "events": list(scaler.events),
         "records": records,
         "registry": metrics.registry,

@@ -65,16 +65,23 @@ from typing import Any, Callable, Dict, List, Optional
 __all__ = [
     "boundary",
     "boundaries",
+    "boundary_fn",
     "configure",
     "reset",
     "enabled",
+    "capture_enabled",
     "drain_notes",
     "summary",
     "health_block",
     "dump",
+    "dump_prewarm",
+    "load_prewarm",
+    "replay_records",
+    "record_prewarm_compile",
     "capture_profile",
     "DEFAULT_SAMPLE",
     "LEDGER_FILENAME",
+    "PREWARM_FILENAME",
 ]
 
 # the recommended sampling rate when the operator turns devprof on
@@ -83,9 +90,21 @@ DEFAULT_SAMPLE = 16
 
 LEDGER_FILENAME = "devprof.json"
 
+# the prewarm replay set: every (boundary, signature) the process ever
+# launched, with an abstract (ShapeDtypeStruct) argument spec a future
+# incarnation can replay through fn.lower(...).compile() — pickled
+# because the specs carry real static objects (frozen CycleConfig);
+# same trust domain as the xla-cache executables beside it
+PREWARM_FILENAME = "prewarm.pkl"
+
 # flush the on-disk ledger every this many sampled launches (compile
 # events always flush immediately — they are rare and load-bearing)
 _FLUSH_EVERY = 32
+
+# flush prewarm launch-count hotness every this many captured launches
+# (a NEW signature always flushes immediately — losing one would leave
+# a cold hole in the next incarnation's replay set)
+_REPLAY_FLUSH_EVERY = 256
 
 # signature strings are labels on events and ledger rows; a pathological
 # static repr must not bloat them
@@ -168,10 +187,15 @@ class LaunchLedger:
         self._entries: Dict[tuple, _Entry] = {}  # (boundary, sig) -> row
         self._retraces: List[dict] = []  # attributed retrace events
         self.sample = 0
+        self.capture = False  # prewarm replay-spec capture (ISSUE 20)
         self._metrics_ref: Optional[Callable[[], Any]] = None
         self.state_dir: Optional[str] = None
         self._counter = 0  # global launch counter driving 1-in-N
         self._unflushed = 0
+        # (boundary, sig) -> replay record: per-sig launch hotness plus
+        # the pickled abstract argument spec (None = non-replayable)
+        self._replays: Dict[tuple, dict] = {}
+        self._replay_unflushed = 0
         self._tls = threading.local()
 
     # -- registration ------------------------------------------------
@@ -185,12 +209,15 @@ class LaunchLedger:
 
     # -- configuration -----------------------------------------------
     def configure(self, sample: Optional[int] = None, metrics=None,
-                  state_dir: Optional[str] = None) -> None:
+                  state_dir: Optional[str] = None,
+                  capture: Optional[bool] = None) -> None:
         import weakref
 
         with self._lock:
             if sample is not None:
                 self.sample = max(0, int(sample))
+            if capture is not None:
+                self.capture = bool(capture)
             if metrics is not None:
                 # weakref, CycleTelemetry-feed style: the ledger is
                 # process-global and must never pin a servicer's
@@ -276,6 +303,101 @@ class LaunchLedger:
                 pass
         if flush:
             self._flush(force=True)
+
+    # -- prewarm replay capture (ISSUE 20) ---------------------------
+    def note_replay(self, name: str, sig: str, args: tuple,
+                    kwargs: dict) -> None:
+        """Capture-mode accounting: bump the (boundary, sig) launch
+        hotness; on first sight, record the abstract argument spec a
+        future incarnation replays.  Spec pickling happens OUTSIDE the
+        lock (statics can be arbitrarily slow to serialize); the
+        double-checked insert keeps concurrent first-sights exact."""
+        with self._lock:
+            rec = self._replays.get((name, sig))
+            if rec is not None:
+                rec["launches"] += 1
+                self._replay_unflushed += 1
+                flush = self._replay_unflushed >= _REPLAY_FLUSH_EVERY
+                if flush:
+                    self._replay_unflushed = 0
+            else:
+                flush = False
+        if rec is None:
+            spec = _replay_spec_bytes(args, kwargs)
+            with self._lock:
+                rec = self._replays.setdefault((name, sig), {
+                    "boundary": name,
+                    "sig": sig,
+                    "launches": 0,
+                    "spec": spec,
+                    "first_seen_s": time.time(),
+                })
+                rec["launches"] += 1
+            flush = True  # a new signature flushes immediately
+        if flush:
+            self.dump_prewarm()
+
+    def record_prewarm_compile(self, name: str, sig: str, backend: str,
+                               compile_ms: float, cost: Optional[dict],
+                               mem: Optional[dict]) -> None:
+        """A compile the PREWARM thread performed: lands in the compile
+        ledger like any other (so a later live launch of the same
+        signature sees it warm and skips its own AOT capture), but is
+        NOT an attributed retrace and feeds the ``prewarm_*`` metric
+        families, not ``devprof_*`` — replaying yesterday's signatures
+        is the expected boot path, not a shape-stability regression."""
+        with self._lock:
+            st = self._boundaries.setdefault(name, _BoundaryStats())
+            st.compiles += 1
+            e = self._entries.setdefault((name, sig), _Entry(name, sig))
+            e.backend = backend
+            e.compile_ms = compile_ms
+            if cost:
+                e.flops = cost.get("flops")
+                e.bytes_accessed = cost.get("bytes accessed")
+            if mem:
+                e.temp_bytes = mem.get("temp")
+                e.argument_bytes = mem.get("argument")
+                e.output_bytes = mem.get("output")
+        self._flush(force=True)
+
+    def replay_records(self) -> List[dict]:
+        """The captured replay set, ledger-hot order (most-launched
+        first; ties break on name+sig for a deterministic replay)."""
+        with self._lock:
+            recs = [dict(r) for r in self._replays.values()]
+        recs.sort(key=lambda r: (-r["launches"], r["boundary"], r["sig"]))
+        return recs
+
+    def load_replays(self, records: List[dict]) -> None:
+        """Seed the capture set from a prior incarnation's prewarm file
+        so re-dumps don't forget signatures this process never
+        launched (counts merge additively on re-sight)."""
+        with self._lock:
+            for r in records:
+                key = (r.get("boundary"), r.get("sig"))
+                if key not in self._replays:
+                    self._replays[key] = dict(r)
+
+    def dump_prewarm(self, state_dir: Optional[str] = None) -> Optional[str]:
+        """Write the replay set as ``<state-dir>/prewarm.pkl``.
+        Returns the path, or None without a state dir."""
+        import pickle
+
+        target = state_dir or self.state_dir
+        if not target:
+            return None
+        path = os.path.join(target, PREWARM_FILENAME)
+        doc = {"version": 1, "records": self.replay_records()}
+        tmp = path + ".tmp"
+        try:
+            os.makedirs(target, exist_ok=True)
+            with open(tmp, "wb") as fh:
+                pickle.dump(doc, fh)
+            os.replace(tmp, path)
+        except OSError:
+            return None
+        return path
 
     # -- per-thread launch notes (span attribution seam) -------------
     def push_note(self, note: dict) -> None:
@@ -381,6 +503,12 @@ class LaunchLedger:
 
 _LEDGER = LaunchLedger()
 
+# boundary name -> the jitted callable the decorator wrapped.  Module
+# scope (NOT ledger state): decoration happens once per import, and a
+# test's reset() must not orphan the prewarm runner's name->fn
+# resolution.  Latest registration wins (module reloads).
+_BOUNDARY_FNS: Dict[str, Any] = {}
+
 
 def _ledger() -> LaunchLedger:
     return _LEDGER
@@ -395,16 +523,72 @@ def reset() -> None:
 
 
 def configure(sample: Optional[int] = None, metrics=None,
-              state_dir: Optional[str] = None) -> None:
-    _LEDGER.configure(sample=sample, metrics=metrics, state_dir=state_dir)
+              state_dir: Optional[str] = None,
+              capture: Optional[bool] = None) -> None:
+    _LEDGER.configure(sample=sample, metrics=metrics, state_dir=state_dir,
+                      capture=capture)
 
 
 def enabled() -> bool:
     return _LEDGER.sample > 0
 
 
+def capture_enabled() -> bool:
+    return _LEDGER.capture
+
+
 def boundaries() -> List[str]:
     return _LEDGER.boundaries()
+
+
+def boundary_fn(name: str) -> Optional[Any]:
+    """The jitted callable registered under ``name`` (its ``.lower``
+    AOT seam is the prewarm replay target), or None when the defining
+    module has not been imported in this process."""
+    return _BOUNDARY_FNS.get(name)
+
+
+def replay_records() -> List[dict]:
+    return _LEDGER.replay_records()
+
+
+def dump_prewarm(state_dir: Optional[str] = None) -> Optional[str]:
+    return _LEDGER.dump_prewarm(state_dir)
+
+
+def load_prewarm(state_dir: str) -> List[dict]:
+    """Read ``<state-dir>/prewarm.pkl`` -> replay records, ledger-hot
+    order.  Missing/corrupt files are an empty replay set — prewarm is
+    an accelerant, never a boot dependency."""
+    import pickle
+
+    path = os.path.join(state_dir, PREWARM_FILENAME)
+    try:
+        with open(path, "rb") as fh:
+            doc = pickle.load(fh)
+    except Exception:  # koordlint: disable=broad-except(reason: a missing, torn or version-drifted prewarm file must degrade to a cold boot, never block one)
+        return []
+    records = doc.get("records") if isinstance(doc, dict) else None
+    if not isinstance(records, list):
+        return []
+    out = [
+        r for r in records
+        if isinstance(r, dict) and r.get("boundary") and r.get("sig")
+    ]
+    out.sort(key=lambda r: (-int(r.get("launches") or 0),
+                            r["boundary"], r["sig"]))
+    return out
+
+
+def record_prewarm_compile(name: str, sig: str, backend: str,
+                           compile_ms: float, cost: Optional[dict],
+                           mem: Optional[dict]) -> None:
+    _LEDGER.record_prewarm_compile(name, sig, backend, compile_ms,
+                                   cost, mem)
+
+
+def load_replays(records: List[dict]) -> None:
+    _LEDGER.load_replays(records)
 
 
 def drain_notes() -> List[dict]:
@@ -488,6 +672,35 @@ def shape_signature(args: tuple, kwargs: dict) -> str:
     return sig
 
 
+# -- prewarm replay specs --------------------------------------------
+
+def _replay_spec_bytes(args: tuple, kwargs: dict) -> Optional[bytes]:
+    """Pickle an ABSTRACT copy of a launch's arguments: array leaves
+    become ``jax.ShapeDtypeStruct`` (shape/dtype/weak_type — exactly
+    what ``fn.lower`` needs to mint the same program), statics ride
+    as-is.  None = non-replayable (a process-local static like a Mesh
+    refuses pickling); the launch itself is never at risk."""
+    try:
+        import pickle
+
+        import jax
+        from jax.tree_util import tree_map
+
+        def leaf(x):
+            shape = getattr(x, "shape", None)
+            dtype = getattr(x, "dtype", None)
+            if shape is not None and dtype is not None:
+                return jax.ShapeDtypeStruct(
+                    shape, dtype,
+                    weak_type=bool(getattr(x, "weak_type", False)),
+                )
+            return x
+
+        return pickle.dumps(tree_map(leaf, (args, dict(kwargs))))
+    except Exception:  # koordlint: disable=broad-except(reason: an unpicklable static (Mesh, callables) marks the signature non-replayable; capture degrades, the launch is unaffected)
+        return None
+
+
 # -- AOT capture -----------------------------------------------------
 
 def _cost_dict(compiled) -> Optional[dict]:
@@ -555,24 +768,37 @@ def boundary(name: str):
         @partial(jax.jit, static_argnames=("cfg",))
         def score_cycle(snapshot, *, cfg): ...
 
-    Off (``sample == 0``): one integer compare then tail-call — the
-    warm stream is bit-identical with zero retraces (the tier-1
-    retrace-guard oracles run this path).  Inside a live jax trace the
-    wrapper also steps aside: nested boundaries (``score_cycle`` under
-    the Pallas cycle, term extras inside ``score_all``) measure at
-    their outermost host callsite only.
+    Off (``sample == 0`` and prewarm capture off): one comparison then
+    tail-call — the warm stream is bit-identical with zero retraces
+    (the tier-1 retrace-guard oracles run this path).  Inside a live
+    jax trace the wrapper also steps aside: nested boundaries
+    (``score_cycle`` under the Pallas cycle, term extras inside
+    ``score_all``) measure at their outermost host callsite only.
+    With prewarm capture ON (``--prewarm``, ISSUE 20) every outermost
+    launch additionally records its (boundary, signature) and an
+    abstract replay spec for the next incarnation's prewarm thread.
     """
 
     def deco(fn):
         _LEDGER.register(name)
+        _BOUNDARY_FNS[name] = fn
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             led = _LEDGER
-            if led.sample <= 0:
+            if led.sample <= 0 and not led.capture:
                 return fn(*args, **kwargs)  # bit-inert fast path
             if not _trace_state_clean():
                 return fn(*args, **kwargs)  # nested under another jit
+            if led.capture:
+                try:
+                    led.note_replay(
+                        name, shape_signature(args, kwargs), args, kwargs
+                    )
+                except Exception:  # koordlint: disable=broad-except(reason: replay capture is an accelerant — an exotic pytree costs the prewarm record, never the launch)
+                    pass
+                if led.sample <= 0:
+                    return fn(*args, **kwargs)
             led.note_launch(name)
             compile_ms = None
             try:

@@ -69,6 +69,9 @@ koord_scorer_devprof_compiles_total    counter   boundary, backend
 koord_scorer_devprof_compile_ms_total  counter   boundary, backend
 koord_scorer_devprof_device_us         histogram boundary
 koord_scorer_devprof_retrace_total     counter   boundary
+koord_scorer_prewarm_signatures_total  counter   result (compiled|skipped|failed)
+koord_scorer_prewarm_compile_ms_total  counter   —
+koord_scorer_prewarm_pending           gauge     —
 ====================================== ========= ==========================
 
 The ``koord_scorer_coalesce_*`` families observe the coalescing
@@ -177,6 +180,9 @@ DEVPROF_COMPILES = "koord_scorer_devprof_compiles_total"
 DEVPROF_COMPILE_MS = "koord_scorer_devprof_compile_ms_total"
 DEVPROF_DEVICE_US = "koord_scorer_devprof_device_us"
 DEVPROF_RETRACE = "koord_scorer_devprof_retrace_total"
+PREWARM_SIGNATURES = "koord_scorer_prewarm_signatures_total"
+PREWARM_COMPILE_MS = "koord_scorer_prewarm_compile_ms_total"
+PREWARM_PENDING = "koord_scorer_prewarm_pending"
 
 # occupancy is a count-of-requests-per-launch, not a latency: its own
 # power-of-two buckets (the dispatcher caps batches at 16 by default;
@@ -409,6 +415,21 @@ _FAMILIES = (
      "for a shape signature after its first — the per-boundary "
      "breakdown of koord_scorer_jit_cache_miss_total the ledger names "
      "in /healthz and the report CLI"),
+    (PREWARM_SIGNATURES, "counter",
+     "signatures the boot-time AOT prewarm thread (ISSUE 20, "
+     "obs/prewarm.py) processed from <state-dir>/prewarm.pkl, by "
+     "result: compiled replayed through lower().compile(), skipped "
+     "had no replay spec or no resolvable boundary, failed raised "
+     "(code/backend drift since capture — the live path still "
+     "compiles inline)"),
+    (PREWARM_COMPILE_MS, "counter",
+     "cumulative compile wall-time the prewarm thread spent replaying "
+     "persisted signatures; with a warm persistent XLA cache this "
+     "collapses to trace time only"),
+    (PREWARM_PENDING, "gauge",
+     "replayable signatures the prewarm thread has not reached yet "
+     "(0 = prewarm done; a request arriving for a pending signature "
+     "just compiles inline, exactly as an unprewarmed boot)"),
 )
 
 # journal appends are MICROsecond-scale (a header pack + one buffered
@@ -706,6 +727,18 @@ class ScorerMetrics:
 
     def devprof_retrace(self, boundary: str) -> None:
         self.registry.counter_add(DEVPROF_RETRACE, 1, {"boundary": boundary})
+
+    # -- AOT signature prewarm (ISSUE 20) --
+    def count_prewarm(self, result: str) -> None:
+        self.registry.counter_add(
+            PREWARM_SIGNATURES, 1, {"result": result}
+        )
+
+    def add_prewarm_compile_ms(self, ms: float) -> None:
+        self.registry.counter_add(PREWARM_COMPILE_MS, float(ms))
+
+    def set_prewarm_pending(self, pending: int) -> None:
+        self.registry.gauge_set(PREWARM_PENDING, int(pending))
 
     # -- trace-driven replay (ISSUE 12) --
     def observe_trace_cycle(self, band: str, rpc: str, ms: float) -> None:

@@ -209,6 +209,13 @@ class ReplicaAutoscaler:
         self.ticks = 0
         self.scale_ups = 0
         self.scale_downs = 0
+        # spawn -> first-served-read economics (ISSUE 20): each spawn
+        # stamps a start; a SYNCHRONOUS lever (the in-process relay
+        # tier returns once the replica serves) closes it on return,
+        # an async lever closes it via notify_ready() when the replica
+        # reports in.  The last closed interval is the stats() stat.
+        self._spawn_t0: Optional[float] = None
+        self.spawn_to_ready_ms: List[float] = []
         self.events: List[Dict[str, object]] = []
         self._max_events = max(1, int(max_events))
         self._stop = threading.Event()
@@ -283,9 +290,16 @@ class ReplicaAutoscaler:
         if action == SCALE_UP:
             self.replicas += 1
             self.scale_ups += 1
+            t0 = time.perf_counter()
+            self._spawn_t0 = t0
             try:
                 self.spawn()
+                # a synchronous lever just finished the whole start; an
+                # async one re-stamps the real readiness via
+                # notify_ready() (later wins — it replaces this sample)
+                self._record_ready(t0)
             except Exception:  # a broken capacity lever must not kill the control loop; cooldown already gates the retry rate
+                self._spawn_t0 = None
                 logger.exception("autoscale spawn failed")
         elif action == SCALE_DOWN:
             self.replicas -= 1
@@ -317,6 +331,27 @@ class ReplicaAutoscaler:
                 pass
         return record
 
+    def _record_ready(self, t0: float) -> None:
+        ms = (time.perf_counter() - t0) * 1e3
+        self.spawn_to_ready_ms.append(ms)
+        del self.spawn_to_ready_ms[:-self._max_events]
+
+    def notify_ready(self) -> None:
+        """Async-lever readiness callback: the daemon layer calls this
+        when the replica the last spawn started actually serves.  The
+        measured interval REPLACES the lever-return sample the spawn
+        recorded (for a kick-off-and-return lever, return time is not
+        readiness)."""
+        t0 = self._spawn_t0
+        if t0 is None:
+            return
+        self._spawn_t0 = None
+        ms = (time.perf_counter() - t0) * 1e3
+        if self.spawn_to_ready_ms:
+            self.spawn_to_ready_ms[-1] = ms
+        else:
+            self.spawn_to_ready_ms.append(ms)
+
     # -- optional daemon thread --
     def start(self) -> "ReplicaAutoscaler":
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -345,5 +380,9 @@ class ReplicaAutoscaler:
             "scale_ups": self.scale_ups,
             "scale_downs": self.scale_downs,
             "cooldown": self._cooldown,
+            "spawn_to_ready_ms": (
+                round(self.spawn_to_ready_ms[-1], 3)
+                if self.spawn_to_ready_ms else None
+            ),
             "events": list(self.events[-16:]),
         }

@@ -106,6 +106,8 @@ class SchedulerServer:
         trace_export: Optional[str] = None,
         shed_fractions: Optional[dict] = None,
         devprof_sample: Optional[int] = None,
+        xla_cache: Optional[str] = None,
+        prewarm: bool = False,
     ):
         # persistent compile cache under the daemon's state dir: a
         # restarted sidecar skips the multi-second (16.5s on TPU,
@@ -136,6 +138,17 @@ class SchedulerServer:
                 koordinator_tpu.configure_compilation_cache(
                     os.path.join(state_dir, "xla-cache")
                 )
+        if xla_cache is not None:
+            # an EXPLICIT --xla-cache outranks both the state-dir
+            # default above and the KOORD_XLA_CACHE env (force=True):
+            # the operator typed it.  "" / "0" disables the cache.
+            import koordinator_tpu
+
+            koordinator_tpu.configure_compilation_cache(
+                None if xla_cache in ("", "0") else xla_cache,
+                force=True,
+            )
+        self.xla_cache = xla_cache
         cfg = DEFAULT_CYCLE_CONFIG
         self.profiles = []
         if config_path:
@@ -244,6 +257,27 @@ class SchedulerServer:
         # cache misses) unless the operator opts in.
         if devprof_sample is not None:
             servicer_kw["devprof_sample"] = int(devprof_sample)
+        # cold-path kill (ISSUE 20, docs/KERNEL.md "Cold path"):
+        # --prewarm turns on the launch ledger's CAPTURE mode — every
+        # boundary launch records its abstract signature into
+        # <state-dir>/prewarm.pkl — and, at start()/promote(), replays
+        # the PREVIOUS incarnation's set through the AOT seam
+        # (fn.lower(...).compile()) on a background thread while the
+        # transports already serve.  Default off: with the flag unset
+        # the boundary wrapper keeps its bit-inert fast path.
+        self._prewarm_enabled = bool(prewarm) and bool(state_dir)
+        self._prewarm_runner = None
+        if prewarm and not self._prewarm_enabled:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "--prewarm needs a writable --state-dir for the "
+                "signature set; prewarm disabled for this run"
+            )
+        if self._prewarm_enabled:
+            from koordinator_tpu.obs import devprof
+
+            devprof.configure(capture=True, state_dir=state_dir)
         # replication role (ISSUE 8, koordinator_tpu/replication/):
         # --replicate-from makes this daemon a READ FOLLOWER — it
         # subscribes to the named leader's replication socket, applies
@@ -401,6 +435,10 @@ class SchedulerServer:
                             # platform, compile ledger summary, top
                             # boundaries by cumulative device time
                             "device": outer.device_health(),
+                            # cold-path kill (ISSUE 20): AOT signature
+                            # prewarm progress — replay state, counts,
+                            # cumulative compile time
+                            "prewarm": outer.prewarm_health(),
                         },
                     )
                     return
@@ -559,6 +597,36 @@ class SchedulerServer:
         }
         return out
 
+    def prewarm_health(self) -> dict:
+        """The /healthz ``prewarm`` block (ISSUE 20): whether the AOT
+        signature prewarm is enabled and, once the runner started, its
+        replay progress — state (loading/importing/replaying/done),
+        signature counts by outcome, cumulative compile milliseconds.
+        A request arriving before its signature replays just compiles
+        inline, so "pending > 0" is a boot-latency note, never an
+        availability problem."""
+        out: dict = {"enabled": self._prewarm_enabled}
+        runner = self._prewarm_runner
+        if runner is not None:
+            out.update(runner.stats())
+        return out
+
+    def _start_prewarm(self) -> None:
+        """Kick the background AOT replay of the persisted signature
+        set.  Runs while the transports already serve: a request whose
+        signature has not compiled yet compiles inline exactly as
+        today (the persistent disk cache still catches repeats).
+        promote() re-kicks it so a promoted follower also warms the
+        leader-path boundaries; already-compiled signatures are ledger
+        hits and cost microseconds."""
+        from koordinator_tpu.obs.prewarm import PrewarmRunner
+
+        if self._prewarm_runner is not None:
+            self._prewarm_runner.stop()
+        self._prewarm_runner = PrewarmRunner(
+            self.state_dir, metrics=self.servicer.telemetry.metrics
+        ).start()
+
     def device_health(self) -> dict:
         """The /healthz ``device`` block (ISSUE 19): backend platform
         and device count, the launch ledger's compile summary (compiles,
@@ -648,6 +716,15 @@ class SchedulerServer:
                     self.servicer, self.repl_path, journal=self.journal
                 ).attach().start()
             self._promoted = True
+            if self._prewarm_enabled:
+                try:
+                    self._start_prewarm()
+                except Exception:  # prewarm is an accelerant: a failed re-kick must not fail the promotion that clients are waiting on
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "post-promotion prewarm re-kick failed"
+                    )
             return sid
 
     def _install_sigusr2(self) -> None:
@@ -824,9 +901,23 @@ class SchedulerServer:
             target=self.elector.run, daemon=True
         )
         self._elector_thread.start()
+        # AOT signature prewarm LAST (ISSUE 20): every transport above
+        # is already accepting, so the background replay overlaps real
+        # serving — exactly the contract (an unreplayed signature
+        # compiles inline, the breaker/brownout ladder is untouched)
+        if self._prewarm_enabled:
+            self._start_prewarm()
         return self
 
     def stop(self):
+        if self._prewarm_runner is not None:
+            self._prewarm_runner.stop()
+        if self._prewarm_enabled:
+            # final dump so signatures first seen after the last
+            # incremental flush still make the next boot's replay set
+            from koordinator_tpu.obs import devprof
+
+            devprof.dump_prewarm(self.state_dir)
         self.elector.stop()
         if self._elector_thread:
             self._elector_thread.join(timeout=5)
@@ -1150,6 +1241,31 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "KOORD_DEVPROF_SAMPLE)",
     )
     ap.add_argument(
+        "--xla-cache", dest="xla_cache",
+        default=None,
+        help="persistent XLA compile cache directory (docs/KERNEL.md "
+        "\"Cold path\"): an explicit path here outranks both the "
+        "<state-dir>/xla-cache default and the KOORD_XLA_CACHE env; "
+        "'' or '0' disables the cache for this run.  Point every "
+        "replica of a tier (leader, followers, autoscaler spawns) at "
+        "the SAME directory so one replica's compile is every "
+        "replica's warm start (env: KOORD_XLA_CACHE)",
+    )
+    ap.add_argument(
+        "--prewarm", action="store_true",
+        default=bool(os.environ.get("KOORD_PREWARM")),
+        help="AOT signature prewarm (docs/KERNEL.md \"Cold path\"): "
+        "record every jit boundary's argument signatures into "
+        "<state-dir>/prewarm.pkl and, on the next boot, AOT-compile "
+        "the recorded set in ledger-hot order on a background thread "
+        "while the daemon already serves — a restarted daemon reaches "
+        "full warm speed without waiting for live traffic to re-trace "
+        "every shape.  Progress publishes on koord_scorer_prewarm_* "
+        "and /healthz 'prewarm'.  Default off: unset, the serving "
+        "path is bit-identical to a build without the feature (env: "
+        "KOORD_PREWARM=1)",
+    )
+    ap.add_argument(
         "--state-dir", default=None,
         help="daemon state directory (default: $XDG_STATE_HOME/"
         "koord-scheduler, per-user); the persistent XLA compile cache "
@@ -1200,6 +1316,8 @@ def main(argv=None) -> int:
         trace_export=args.trace_export,
         shed_fractions=shed_fractions,
         devprof_sample=args.devprof_sample,
+        xla_cache=args.xla_cache,
+        prewarm=args.prewarm,
     ).start()
     try:
         threading.Event().wait()  # koordlint: disable=unbounded-wait(main thread parks forever by design; the server threads own the work and KeyboardInterrupt unparks)

@@ -259,3 +259,82 @@ class TestAutoscaleWave:
         assert prof[0] == 1.0
         assert max(prof) == 10.0
         assert prof[4:12] == [10.0] * 8  # the plateau
+
+
+class TestSpawnToReady:
+    """ISSUE 20: the spawn -> first-served-read economics.  A SCALE_UP
+    tick stamps the lever-call wall; a synchronous lever's return IS
+    readiness, an async lever's daemon layer replaces the sample via
+    ``notify_ready()`` (later wins), and ``stats()`` exposes the last
+    sample for /healthz and the tree bench artifact."""
+
+    def test_sync_lever_duration_is_the_sample(self):
+        import time
+
+        sc = ReplicaAutoscaler(
+            _policy(up_after=1),
+            signals=lambda: BREACH,
+            spawn=lambda: time.sleep(0.01), drain=lambda: None,
+            replicas=1,
+        )
+        sc.tick()
+        assert len(sc.spawn_to_ready_ms) == 1
+        assert sc.spawn_to_ready_ms[0] >= 10.0
+        assert sc.stats()["spawn_to_ready_ms"] == pytest.approx(
+            sc.spawn_to_ready_ms[0], abs=0.001
+        )
+
+    def test_notify_ready_replaces_the_lever_return_sample(self):
+        sc = ReplicaAutoscaler(
+            _policy(up_after=1),
+            signals=lambda: BREACH,
+            spawn=lambda: None, drain=lambda: None, replicas=1,
+        )
+        sc.tick()
+        quick = sc.spawn_to_ready_ms[-1]
+        sc.notify_ready()  # the replica actually served only now
+        assert len(sc.spawn_to_ready_ms) == 1  # replaced, not appended
+        assert sc.spawn_to_ready_ms[-1] >= quick
+
+    def test_notify_without_pending_spawn_is_a_noop(self):
+        sc = _scaler(_policy())
+        sc.notify_ready()
+        assert sc.spawn_to_ready_ms == []
+        assert sc.stats()["spawn_to_ready_ms"] is None
+
+    def test_notify_arms_once_per_spawn(self):
+        sc = ReplicaAutoscaler(
+            _policy(up_after=1),
+            signals=lambda: BREACH,
+            spawn=lambda: None, drain=lambda: None, replicas=1,
+        )
+        sc.tick()
+        sc.notify_ready()
+        first = sc.spawn_to_ready_ms[-1]
+        sc.notify_ready()  # stale duplicate from the daemon layer
+        assert sc.spawn_to_ready_ms == [first]
+
+    def test_failed_spawn_leaves_no_sample(self):
+        def bad_spawn():
+            raise RuntimeError("no capacity")
+
+        sc = ReplicaAutoscaler(
+            _policy(up_after=1),
+            signals=lambda: BREACH,
+            spawn=bad_spawn, drain=lambda: None, replicas=1,
+        )
+        sc.tick()
+        assert sc.spawn_to_ready_ms == []
+        sc.notify_ready()  # the failed spawn must not arm a notify
+        assert sc.spawn_to_ready_ms == []
+
+    def test_samples_are_bounded_like_events(self):
+        sc = ReplicaAutoscaler(
+            _policy(up_after=1, max_replicas=600, cooldown_ticks=0),
+            signals=lambda: BREACH,
+            spawn=lambda: None, drain=lambda: None, replicas=1,
+            max_events=4,
+        )
+        for _ in range(10):
+            sc.tick()
+        assert len(sc.spawn_to_ready_ms) == 4

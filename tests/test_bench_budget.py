@@ -872,3 +872,102 @@ class TestArtifactSchemaDevprofFields:
         doc = json.loads(emitted[0])
         assert doc["truncated"] is True
         assert "devprof_storm" in doc["error"]
+
+
+class TestArtifactSchemaColdstartFields:
+    """ISSUE 20: the cold-path economics fields — the coldstart leg's
+    boot walls and prewarm stats, the failover warm-restart
+    replay/compile split, and the autoscaler's spawn->ready wall.
+    Nulls always pass (leg not run / attribution unavailable);
+    malformed values must not be archived."""
+
+    def _line(self, **extra):
+        doc = {"metric": "m", "value": 1.0, "unit": "ms"}
+        doc.update(extra)
+        return json.dumps(doc)
+
+    def test_well_formed_coldstart_fields_pass(self):
+        assert bench._validate_artifact(self._line(
+            cold_start_ms=1995.1, warm_cache_start_ms=840.3,
+            cold_start_speedup=2.37, prewarm_ms=530.3,
+            prewarm_signatures=12, prewarm_compiled=11,
+            prewarm_compile_ms=263.4, cold_build_serial_ms=37000.0,
+            cold_build_ms=14800.0, cold_build_speedup=2.5,
+            build_nodes=2097152,
+        )) == []
+
+    def test_all_null_coldstart_fields_pass(self):
+        assert bench._validate_artifact(self._line(
+            cold_start_ms=None, warm_cache_start_ms=None,
+            cold_start_speedup=None, prewarm_ms=None,
+            prewarm_signatures=None, prewarm_compiled=None,
+            prewarm_compile_ms=None, cold_build_serial_ms=None,
+            cold_build_ms=None, cold_build_speedup=None,
+            build_nodes=None,
+        )) == []
+
+    def test_malformed_boot_walls_fail(self):
+        assert bench._validate_artifact(self._line(cold_start_ms=-1))
+        assert bench._validate_artifact(
+            self._line(warm_cache_start_ms=float("nan"))
+        )
+        assert bench._validate_artifact(
+            self._line(cold_start_speedup=float("inf"))
+        )
+        assert bench._validate_artifact(self._line(prewarm_ms=-0.5))
+
+    def test_malformed_build_timings_fail(self):
+        assert bench._validate_artifact(
+            self._line(cold_build_serial_ms=-3.0)
+        )
+        assert bench._validate_artifact(
+            self._line(cold_build_ms=float("nan"))
+        )
+        assert bench._validate_artifact(
+            self._line(cold_build_speedup=-1.0)
+        )
+
+    def test_prewarm_counts_must_be_nonneg_ints(self):
+        for key in ("prewarm_signatures", "prewarm_compiled",
+                    "build_nodes"):
+            assert bench._validate_artifact(self._line(**{key: 0})) == []
+            assert bench._validate_artifact(self._line(**{key: -1}))
+            assert bench._validate_artifact(self._line(**{key: True}))
+            assert bench._validate_artifact(self._line(**{key: 2.5}))
+
+    def test_failover_restart_split_fields(self):
+        assert bench._validate_artifact(self._line(
+            restart_replay_ms=12.4, restart_compile_ms=310.9
+        )) == []
+        assert bench._validate_artifact(self._line(
+            restart_replay_ms=None, restart_compile_ms=None
+        )) == []
+        assert bench._validate_artifact(
+            self._line(restart_replay_ms=-1.0)
+        )
+        assert bench._validate_artifact(
+            self._line(restart_compile_ms=float("nan"))
+        )
+
+    def test_spawn_to_ready_field(self):
+        assert bench._validate_artifact(
+            self._line(spawn_to_ready_ms=41.2)
+        ) == []
+        assert bench._validate_artifact(
+            self._line(spawn_to_ready_ms=None)
+        ) == []
+        assert bench._validate_artifact(
+            self._line(spawn_to_ready_ms=-0.1)
+        )
+        assert bench._validate_artifact(
+            self._line(spawn_to_ready_ms=float("inf"))
+        )
+
+    def test_coldstart_is_a_dispatchable_config(self):
+        # the driver archives per-config: a choice missing from the
+        # inline parser would make the leg silently unrunnable
+        import inspect
+
+        src = inspect.getsource(bench.main)
+        assert '"coldstart"' in src
+        assert "--coldstart-server" in src

@@ -469,3 +469,94 @@ class TestOverflowRefusal:
         finally:
             sv.telemetry.close()
             server.stop(0)
+
+
+class TestPipelinedBuildParity:
+    """ISSUE 20: the pipelined cold build (`_build_pipelined`) is a
+    perf path, so its contract is BYTE-parity with the serial
+    `lax.scan` oracle (`_build`) — same cand lists, same exact counts,
+    across geometries, including feasibility deserts the block pruning
+    skips, plus the `KOORD_PARALLEL_BUILD` routing seams."""
+
+    def _parity(self, snap, cfg):
+        from koordinator_tpu.solver.candidates import (
+            _build,
+            _build_pipelined,
+        )
+
+        cand_s, count_s = _build(snap, cfg=cfg)
+        cand_p, count_p = _build_pipelined(snap, cfg)
+        assert (np.asarray(cand_p).tobytes()
+                == np.asarray(cand_s).tobytes())
+        assert (np.asarray(count_p).tobytes()
+                == np.asarray(count_s).tobytes())
+        return np.asarray(cand_p), np.asarray(count_p)
+
+    def test_parity_across_geometries(self):
+        cfg = CycleConfig(candidate_width=64)
+        for n, p, n_open, seed in (
+            (2048, 64, 17, 16),   # 2 blocks
+            (4096, 32, 64, 17),   # 4 blocks, lists exactly full
+            (4096, 32, 1, 18),    # near-empty feasibility
+            (512, 16, 9, 19),     # single block (b = n): degenerate
+        ):
+            snap = _narrow_snapshot(n, p, n_open, seed=seed)
+            cand, count = self._parity(snap, cfg)
+            assert (count == n_open).all()
+            assert (cand[:, :n_open] < n).all()
+
+    def test_parity_when_feasibility_lives_in_the_last_block(self):
+        # every earlier block is a desert the pruning pass must skip
+        # WITHOUT skipping the one block that matters
+        cfg = CycleConfig(candidate_width=64)
+        n = 4096
+        snap = _narrow_snapshot(
+            n, 24, 0, seed=20, extra_nodes=range(n - 5, n)
+        )
+        cand, count = self._parity(snap, cfg)
+        assert (count == 5).all()
+        assert (cand[:, :5] >= n - 5).all()
+
+    def test_parity_under_overflow_counts_stay_exact(self):
+        # neither path raises at build time; both report the same
+        # exact counts and the shared readback check refuses
+        cfg = CycleConfig(candidate_width=8)
+        snap = _narrow_snapshot(2048, 16, 21, seed=21)
+        _, count = self._parity(snap, cfg)
+        with pytest.raises(CandidateOverflow):
+            check_candidate_overflow(count, cfg.candidate_width)
+
+    def test_env_routing_seams(self, monkeypatch):
+        import koordinator_tpu.solver.candidates as mod
+
+        calls = []
+        monkeypatch.setattr(
+            mod, "_build",
+            lambda snapshot, *, cfg: calls.append("serial") or "S",
+        )
+        monkeypatch.setattr(
+            mod, "_build_pipelined",
+            lambda snapshot, cfg, node_mesh=None: (
+                calls.append("pipelined") or "P"
+            ),
+        )
+        cfg = CycleConfig(candidate_width=64)
+        small = _narrow_snapshot(512, 8, 3)  # 1 block: auto -> serial
+        big_n = mod._SWEEP_BLOCK * mod._PARALLEL_MIN_BLOCKS
+        big = _narrow_snapshot(big_n, 8, 3)  # at threshold -> pipelined
+
+        monkeypatch.delenv("KOORD_PARALLEL_BUILD", raising=False)
+        assert build_candidates(small, cfg) == "S"
+        assert build_candidates(big, cfg) == "P"
+        monkeypatch.setenv("KOORD_PARALLEL_BUILD", "0")
+        assert build_candidates(big, cfg) == "S"
+        monkeypatch.setenv("KOORD_PARALLEL_BUILD", "1")
+        assert build_candidates(small, cfg) == "P"
+        assert calls == ["serial", "pipelined", "serial", "pipelined"]
+
+    def test_forced_pipelined_serves_the_whole_contract(self, monkeypatch):
+        # routing forced through the pipelined build, then the full
+        # sparse-vs-dense exactness sweep on the result
+        monkeypatch.setenv("KOORD_PARALLEL_BUILD", "1")
+        snap = _narrow_snapshot(2048, 32, 11, seed=22)
+        _assert_sparse_equals_dense(snap, CycleConfig(candidate_width=64))

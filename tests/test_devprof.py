@@ -54,6 +54,9 @@ _EXPECTED = {
     "solver.incremental._rescore_sharded",
     "solver.candidates._build",
     "solver.candidates._build_sharded",
+    "solver.candidates._count_blocks",
+    "solver.candidates._count_blocks_sharded",
+    "solver.candidates._extract_block",
     "solver.candidates._refresh",
     "solver.candidates._refresh_sharded",
     "solver.candidates._score",

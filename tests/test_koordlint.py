@@ -2495,3 +2495,126 @@ class TestUnregisteredJitBoundary:
         from koordinator_tpu.analysis import suppressions
 
         assert "unregistered-jit-boundary" in suppressions.REASON_REQUIRED
+
+
+class TestPrewarmDrift:
+    """ISSUE 20: the prewarm tables in obs/prewarm.py partition the
+    registered boundary space — one-sided drift against the repo's
+    ``@devprof.boundary`` registrations must fail lint in BOTH
+    directions (the metrics-doc-drift shape applied to the AOT replay
+    contract)."""
+
+    REGISTRATIONS = [
+        ("solver.candidates._build", "koordinator_tpu/solver/candidates.py", 10),
+        ("solver.candidates._build_sharded", "koordinator_tpu/solver/candidates.py", 20),
+        ("solver.topk.masked_top_k", "koordinator_tpu/solver/topk.py", 5),
+    ]
+    PREWARM_FIXTURE = textwrap.dedent('''
+        PREWARM_BOUNDARIES = (
+            "solver.candidates._build",
+            "solver.topk.masked_top_k",
+        )
+
+        PREWARM_EXCLUDED = {
+            "solver.candidates._build_sharded": "mesh static is process-local",
+        }
+    ''')
+
+    def test_aligned_sources_are_clean(self):
+        from koordinator_tpu.analysis import prewarmdrift
+
+        assert prewarmdrift.diff_prewarm(
+            self.REGISTRATIONS, self.PREWARM_FIXTURE
+        ) == []
+
+    def test_head_is_clean(self):
+        from koordinator_tpu.analysis import prewarmdrift
+
+        root = find_repo_root(REPO)
+        assert prewarmdrift.check_repo(root) == []
+
+    def test_registered_but_untabled_caught(self):
+        from koordinator_tpu.analysis import prewarmdrift
+
+        regs = self.REGISTRATIONS + [
+            ("solver.wave._wave_assign", "koordinator_tpu/solver/wave.py", 42),
+        ]
+        got = prewarmdrift.diff_prewarm(regs, self.PREWARM_FIXTURE)
+        assert len(got) == 1
+        assert got[0].rule == "prewarm-drift"
+        assert "solver.wave._wave_assign" in got[0].message
+        assert "absent from both prewarm tables" in got[0].message
+        # flags the registration's own file and line
+        assert got[0].path.endswith("wave.py")
+        assert got[0].line == 42
+
+    def test_stale_replay_row_caught(self):
+        from koordinator_tpu.analysis import prewarmdrift
+
+        regs = [r for r in self.REGISTRATIONS
+                if r[0] != "solver.topk.masked_top_k"]
+        got = prewarmdrift.diff_prewarm(regs, self.PREWARM_FIXTURE)
+        assert len(got) == 1
+        assert "solver.topk.masked_top_k" in got[0].message
+        assert "stale replay row" in got[0].message
+        # flags the prewarm.py table entry's line
+        assert got[0].path.endswith("prewarm.py")
+        assert got[0].line > 0
+
+    def test_stale_exclusion_caught(self):
+        from koordinator_tpu.analysis import prewarmdrift
+
+        regs = [r for r in self.REGISTRATIONS
+                if r[0] != "solver.candidates._build_sharded"]
+        got = prewarmdrift.diff_prewarm(regs, self.PREWARM_FIXTURE)
+        assert len(got) == 1
+        assert "stale exclusion" in got[0].message
+        assert got[0].path.endswith("prewarm.py")
+
+    def test_double_listing_caught(self):
+        from koordinator_tpu.analysis import prewarmdrift
+
+        both = self.PREWARM_FIXTURE.replace(
+            '"solver.candidates._build",',
+            '"solver.candidates._build",\n    '
+            '"solver.candidates._build_sharded",',
+        )
+        got = prewarmdrift.diff_prewarm(self.REGISTRATIONS, both)
+        assert any(
+            "BOTH" in v.message
+            and "solver.candidates._build_sharded" in v.message
+            for v in got
+        )
+
+    def test_registration_parser_skips_docstring_examples(self):
+        from koordinator_tpu.analysis import prewarmdrift
+
+        src = textwrap.dedent('''
+            from koordinator_tpu.obs import devprof
+
+            def helper():
+                """Example:
+
+                    @devprof.boundary("solver.fake.from_docstring")
+                    def f(x): ...
+                """
+
+            @devprof.boundary("solver.real.registered")
+            def real(x):
+                return x
+        ''')
+        got = prewarmdrift.parse_boundary_registrations(src)
+        assert [name for name, _ in got] == ["solver.real.registered"]
+
+    def test_vanished_tables_fail_loudly(self):
+        from koordinator_tpu.analysis import prewarmdrift
+
+        got = prewarmdrift.diff_prewarm(self.REGISTRATIONS, "X = 1\n")
+        assert any(
+            "no PREWARM_BOUNDARIES / PREWARM_EXCLUDED" in v.message
+            for v in got
+        )
+
+    def test_rule_is_registered_and_runs_in_run_repo(self):
+        assert "prewarm-drift" in RULES
+        assert run_repo(root=REPO, rules=["prewarm-drift"]) == []

@@ -1,0 +1,322 @@
+"""AOT signature prewarm (ISSUE 20): the capture round-trip, the
+background replay runner, and the recovery contract.
+
+Unit surfaces: capture-mode replay records (abstract specs, synchronous
+flush on a new signature, non-replayable statics degrade to spec=None),
+``prewarm.pkl`` persistence ordering, the off-path bit-inert contract,
+:class:`PrewarmRunner` replaying a prior incarnation's set across a
+``devprof.reset()`` (compiled/skipped/failed accounting, the metrics
+seam, the /healthz stats block), and the journal satellite: a
+``recover()`` + prewarm-mid-flight boot must end byte-identical to a
+serial restart with ZERO retraces post-recovery under
+``retrace_guard(budget=0)``.
+
+The subprocess-level acceptance runs (two real daemon boots sharing one
+XLA cache, cold vs warm walls) live in ``bench.py --config coldstart``;
+this file owns everything assertable in-process.
+"""
+
+import functools
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from koordinator_tpu.analysis.retrace_guard import retrace_guard  # noqa: E402
+from koordinator_tpu.bridge.codegen import pb2  # noqa: E402
+from koordinator_tpu.bridge.server import ScorerServicer  # noqa: E402
+from koordinator_tpu.bridge.state import numpy_to_tensor  # noqa: E402
+from koordinator_tpu.harness import generators  # noqa: E402
+from koordinator_tpu.harness.chaos import (  # noqa: E402
+    assert_mirror_parity,
+    flat_score_bytes,
+)
+from koordinator_tpu.harness.golden import build_sync_request  # noqa: E402
+from koordinator_tpu.model import resources as res  # noqa: E402
+from koordinator_tpu.obs import devprof  # noqa: E402
+from koordinator_tpu.obs.prewarm import (  # noqa: E402
+    PREWARM_BOUNDARIES,
+    PREWARM_EXCLUDED,
+    PrewarmRunner,
+)
+from koordinator_tpu.replication.journal import FrameJournal  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_ledger():
+    devprof.reset()
+    yield
+    devprof.reset()
+
+
+def _make_boundary(name="test.prewarm.double"):
+    @devprof.boundary(name)
+    @jax.jit
+    def double(x):
+        return x * 2
+
+    return double
+
+
+class _FakeMetrics:
+    """Records the typed prewarm calls the runner makes."""
+
+    def __init__(self):
+        self.counts = {}
+        self.compile_ms = 0.0
+        self.pending = []
+
+    def count_prewarm(self, result):
+        self.counts[result] = self.counts.get(result, 0) + 1
+
+    def add_prewarm_compile_ms(self, ms):
+        self.compile_ms += ms
+
+    def set_prewarm_pending(self, pending):
+        self.pending.append(pending)
+
+
+class TestCaptureRoundTrip:
+    def test_capture_records_abstract_specs_in_hot_order(self):
+        devprof.configure(capture=True)
+        fn = _make_boundary()
+        fn(jnp.arange(4.0))
+        fn(jnp.arange(4.0))  # warm re-launch bumps hotness only
+        fn(jnp.arange(8.0))  # second signature
+        recs = devprof.replay_records()
+        assert len(recs) == 2
+        # ledger-hot order: the twice-launched signature leads
+        assert recs[0]["launches"] == 2 and recs[1]["launches"] == 1
+        for rec in recs:
+            assert rec["boundary"] == "test.prewarm.double"
+            assert rec["spec"]  # replayable
+        # specs decode to ShapeDtypeStruct leaves, never real buffers
+        import pickle
+
+        args, kwargs = pickle.loads(recs[0]["spec"])
+        assert isinstance(args[0], jax.ShapeDtypeStruct)
+        assert kwargs == {}
+
+    def test_new_signature_flushes_prewarm_pkl_synchronously(self, tmp_path):
+        # the SIGKILL contract: once a launch returned, the file on
+        # disk already names its signature — no clean shutdown needed
+        devprof.configure(capture=True, state_dir=str(tmp_path))
+        fn = _make_boundary()
+        fn(jnp.arange(4.0))
+        path = os.path.join(str(tmp_path), "prewarm.pkl")
+        assert os.path.exists(path)
+        assert len(devprof.load_prewarm(str(tmp_path))) == 1
+
+    def test_unpicklable_static_degrades_to_non_replayable(self):
+        devprof.configure(capture=True)
+
+        @devprof.boundary("test.prewarm.mesh_like")
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def apply(x, f):
+            return f(x)
+
+        out = apply(jnp.arange(4.0), lambda v: v * 3)
+        np.testing.assert_array_equal(np.asarray(out), np.arange(4.0) * 3)
+        recs = devprof.replay_records()
+        assert len(recs) == 1
+        assert recs[0]["spec"] is None  # capture degraded, launch fine
+
+    def test_load_replays_merges_without_forgetting(self):
+        devprof.configure(capture=True)
+        fn = _make_boundary()
+        fn(jnp.arange(4.0))
+        prior = [{"boundary": "test.prewarm.gone", "sig": "sig-old",
+                  "launches": 7, "spec": b"x"}]
+        devprof.load_replays(prior)
+        names = {r["boundary"] for r in devprof.replay_records()}
+        # yesterday's signature survives a re-dump even though this
+        # process never launched it
+        assert names == {"test.prewarm.double", "test.prewarm.gone"}
+
+    def test_missing_or_corrupt_file_is_an_empty_set(self, tmp_path):
+        assert devprof.load_prewarm(str(tmp_path)) == []
+        with open(os.path.join(str(tmp_path), "prewarm.pkl"), "wb") as fh:
+            fh.write(b"not a pickle")
+        assert devprof.load_prewarm(str(tmp_path)) == []
+
+
+class TestBitInertOff:
+    def test_off_path_records_nothing(self):
+        # default: sample 0, capture off — the wrapper fast path
+        fn = _make_boundary()
+        fn(jnp.arange(4.0))
+        assert devprof.replay_records() == []
+        # registration itself is eager (an all-zero stats row), but no
+        # launch, compile or retrace is ever recorded on the off path
+        summ = devprof.summary()
+        for stats in summ["boundaries"].values():
+            assert stats["launches"] == 0 and stats["compiles"] == 0
+        assert summ["retraces"] == []
+
+    def test_off_result_identical_to_unwrapped(self):
+        fn = _make_boundary()
+
+        @jax.jit
+        def bare(x):
+            return x * 2
+
+        x = jnp.arange(16.0)
+        assert np.asarray(fn(x)).tobytes() == np.asarray(bare(x)).tobytes()
+
+
+class TestPrewarmRunner:
+    def _capture_set(self, tmp_path, shapes=(4, 8)):
+        devprof.configure(capture=True, state_dir=str(tmp_path))
+        fn = _make_boundary()
+        for n in shapes:
+            fn(jnp.arange(float(n)))
+        return fn
+
+    def test_replays_prior_incarnation_across_reset(self, tmp_path):
+        self._capture_set(tmp_path)
+        devprof.reset()  # "next boot": fresh ledger, same process fns
+        m = _FakeMetrics()
+        runner = PrewarmRunner(str(tmp_path), metrics=m).start()
+        assert runner.wait(timeout=30)
+        st = runner.stats()
+        assert st["state"] == "done"
+        assert st["total"] == 2 and st["replayable"] == 2
+        assert st["compiled"] == 2 and st["failed"] == 0
+        assert st["compile_ms_total"] > 0
+        assert st["elapsed_ms"] is not None
+        # the metrics seam saw every replay and the gauge drained to 0
+        assert m.counts == {"compiled": 2}
+        assert m.compile_ms > 0
+        assert m.pending[-1] == 0
+        # replayed compiles land in the compile ledger as warm entries,
+        # NOT as attributed retraces
+        summ = devprof.summary()
+        assert summ["boundaries"]["test.prewarm.double"]["compiles"] == 2
+        assert summ["retraces"] == []
+
+    def test_replay_set_survives_the_next_dump(self, tmp_path):
+        self._capture_set(tmp_path)
+        devprof.reset()
+        runner = PrewarmRunner(str(tmp_path)).start()
+        assert runner.wait(timeout=30)
+        # the runner seed-merged the loaded records, so a dump from
+        # the NEW process (which never launched them live) keeps them
+        devprof.dump_prewarm(str(tmp_path))
+        assert len(devprof.load_prewarm(str(tmp_path))) == 2
+
+    def test_empty_state_dir_finishes_idle(self, tmp_path):
+        runner = PrewarmRunner(str(tmp_path)).start()
+        assert runner.wait(timeout=30)
+        st = runner.stats()
+        assert st["state"] == "done" and st["total"] == 0
+
+    def test_unresolvable_and_corrupt_records_are_counted(self, tmp_path):
+        devprof.configure(capture=True)
+        devprof.load_replays([
+            # boundary name nothing in this process registers
+            {"boundary": "test.prewarm.never_registered", "sig": "s1",
+             "launches": 3, "spec": b"irrelevant"},
+            # resolvable boundary, corrupt spec bytes
+            {"boundary": "test.prewarm.double", "sig": "s2",
+             "launches": 2, "spec": b"not a pickle"},
+            # non-replayable (mesh-like) record
+            {"boundary": "test.prewarm.double", "sig": "s3",
+             "launches": 1, "spec": None},
+        ])
+        _make_boundary()  # registers test.prewarm.double
+        devprof.dump_prewarm(str(tmp_path))
+        devprof.reset()
+        _make_boundary()
+        m = _FakeMetrics()
+        runner = PrewarmRunner(str(tmp_path), metrics=m).start()
+        assert runner.wait(timeout=30)
+        st = runner.stats()
+        assert st["total"] == 3 and st["compiled"] == 0
+        assert st["skipped"] == 2 and st["failed"] == 1
+        assert m.counts == {"skipped": 2, "failed": 1}
+
+    def test_tables_partition_the_registered_boundary_space(self):
+        # the contract prewarm-drift lints statically, asserted live
+        assert not set(PREWARM_BOUNDARIES) & set(PREWARM_EXCLUDED)
+
+
+def _tiny_sync(pods=32, nodes=8, seed=3):
+    nodes_l, pods_l, gangs, quotas = generators.quota_colocation(
+        seed=seed, pods=pods, nodes=nodes, tenants=2
+    )
+    req, _ = build_sync_request(nodes_l, pods_l, gangs, quotas)
+    return req, nodes_l
+
+
+def _warm_usage_frame(prev, bump):
+    cur = prev.copy()
+    cur.flat[bump % cur.size] += 1 + bump
+    warm = pb2.SyncRequest()
+    warm.nodes.usage.CopyFrom(numpy_to_tensor(cur, prev))
+    return warm, cur
+
+
+class TestJournalRecoverWithPrewarm:
+    """The recovery satellite: a journaled restart that runs the
+    prewarm replay mid-flight must end byte-identical to a serial
+    (prewarm-free) restart, and hold the warm path's zero-retrace
+    contract once the replay completes."""
+
+    def test_recover_with_prewarm_matches_serial_restart(self, tmp_path):
+        # ---- incarnation 1: journaled leader, capture on -----------
+        state_dir = str(tmp_path)
+        devprof.configure(capture=True, state_dir=state_dir)
+        req, nodes_l = _tiny_sync()
+        jpath = os.path.join(state_dir, "journal.krj")
+        sv = ScorerServicer(score_memo=False)
+        j = FrameJournal(jpath, compact_every=100)
+        j.recover(sv)
+        j.attach(sv)
+        sv.sync(req)
+        prev = np.asarray(
+            [res.resource_vector(n.get("usage", {})) for n in nodes_l],
+            dtype=np.int64,
+        )
+        for i in range(4):
+            warm, prev = _warm_usage_frame(prev, i)
+            sv.sync(warm)
+        first_bytes = flat_score_bytes(sv, sv.snapshot_id())
+        assert devprof.load_prewarm(state_dir)  # signatures captured
+
+        # ---- incarnation 2: recover + prewarm MID-FLIGHT -----------
+        devprof.reset()
+        sv_p = ScorerServicer(score_memo=False)
+        j_p = FrameJournal(jpath, compact_every=100)
+        runner = PrewarmRunner(state_dir).start()  # overlaps recovery
+        j_p.recover(sv_p)
+        assert runner.wait(timeout=60)
+        assert runner.stats()["state"] == "done"
+
+        # ---- serial oracle: same journal, no prewarm ---------------
+        sv_s = ScorerServicer(score_memo=False)
+        FrameJournal(jpath, compact_every=100).recover(sv_s)
+
+        assert_mirror_parity(sv_p, sv_s)
+        sid = sv_p.snapshot_id()
+        bytes_p = flat_score_bytes(sv_p, sid)
+        assert bytes_p == flat_score_bytes(sv_s, sid)
+        assert bytes_p == first_bytes  # and both match incarnation 1
+
+        # ---- zero retraces post-recovery ---------------------------
+        # warm-up: the first post-recovery cycle pays its traces (the
+        # prewarmed disk cache makes them cheap, but jit's in-memory
+        # dispatch cache starts empty); steady state after it must be
+        # retrace-free, prewarm thread already drained
+        warm, prev = _warm_usage_frame(prev, 100)
+        sv_p.sync(warm)
+        flat_score_bytes(sv_p, sv_p.snapshot_id())
+        with retrace_guard(budget=0) as counter:
+            for i in range(3):
+                warm, prev = _warm_usage_frame(prev, 101 + i)
+                sv_p.sync(warm)
+                flat_score_bytes(sv_p, sv_p.snapshot_id())
+        assert counter.traces == 0 and counter.compiles == 0
